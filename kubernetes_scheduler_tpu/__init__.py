@@ -1,0 +1,30 @@
+"""kubernetes_scheduler_tpu — a TPU-native batched cluster-scheduling framework.
+
+Re-imagines the capabilities of the Yoda kube-scheduler plugin
+(Mr-LvGJ/kubernetes-scheduler, mounted at /root/reference) as a batched
+assignment engine on TPU:
+
+- the per-pod × per-node Filter/Score goroutine fan-out of the upstream
+  scheduling framework (reference: pkg/yoda/scheduler.go:96-156) becomes one
+  jitted JAX program over dense pod × node matrices;
+- the Redis side-channel used to memoize per-cycle statistics
+  (reference: pkg/yoda/cache/cache.go, pkg/yoda/score/algorithm.go:57-89)
+  is eliminated — the whole score matrix is produced in a single device pass;
+- the Prometheus utilization scrape (reference: pkg/yoda/advisor/advisor.go)
+  is kept host-side and materialized as a dense node-utilization matrix;
+- scoring policies (live and legacy: pkg/yoda/score/algorithm.go) are
+  pluggable vmapped kernels; GPU-card ("SCV") predicates
+  (pkg/yoda/filter/filter.go) become boolean mask tensors;
+- the node axis is sharded across a `jax.sharding.Mesh` with XLA collectives
+  over ICI — the framework's data/"sequence" parallelism.
+
+Layout:
+    ops/       pure-JAX kernels (score, feasibility, normalize, assign, stats)
+    parallel/  mesh construction, shard_map engine, collectives
+    models/    scoring policies: heuristic kernels + learned (flax) scorer
+    host/      cluster state, snapshot builders, metrics advisor, queue, binder
+    sim/       kwok-style synthetic cluster generators for benchmarks
+    utils/     config, logging/tracing, padding helpers
+"""
+
+__version__ = "0.1.0"

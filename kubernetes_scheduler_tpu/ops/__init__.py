@@ -1,0 +1,19 @@
+"""Pure-JAX kernels: the device-side compute path of the framework.
+
+Every kernel is a pure function over dense, statically-shaped arrays with an
+explicit validity mask for padding, so it composes with `jax.jit`, `jax.vmap`
+and `shard_map` without data-dependent Python control flow.
+"""
+
+from kubernetes_scheduler_tpu.ops import resources
+from kubernetes_scheduler_tpu.ops.stats import utilization_stats
+from kubernetes_scheduler_tpu.ops.score import (
+    balanced_cpu_diskio,
+    balanced_diskio,
+    free_capacity,
+    card_score,
+)
+from kubernetes_scheduler_tpu.ops.normalize import min_max_normalize, softmax_normalize
+from kubernetes_scheduler_tpu.ops.feasibility import resource_fit, card_fit
+from kubernetes_scheduler_tpu.ops.collect import collect_max_card_values
+from kubernetes_scheduler_tpu.ops.assign import greedy_assign

@@ -1,0 +1,25 @@
+"""Cluster-wide max collection for score normalization.
+
+Vectorizes pkg/yoda/collection/collection.go:30-76: the reference walks the
+SCV list host-side accumulating per-metric maxima over every card that fits
+the pod; here it is a masked max-reduction over the [node, card] axes. The
+reference seeds every max with 1 (collection.go:31-38) so the later
+`metric * 100 / max` never divides by zero — reproduced.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def collect_max_card_values(
+    cards: jnp.ndarray,
+    fits: jnp.ndarray,
+) -> jnp.ndarray:
+    """Max per metric over a pod's fitting cards.
+
+    cards: [n, c, 6]; fits: [p, n, c] bool (from feasibility.card_fit).
+    Returns max_values[p, 6], each seeded at 1.0 (collection.go:31-38).
+    """
+    masked = jnp.where(fits[..., None], cards[None, :, :, :], 0.0)
+    return jnp.maximum(masked.max(axis=(1, 2)), 1.0)

@@ -1,0 +1,120 @@
+"""Feasibility-mask kernels: boolean F[p, n] over the full batch.
+
+The reference's Filter extension point passes every node (log-only,
+pkg/yoda/scheduler.go:96-99), but its capability surface includes real
+resource-fit math (pkg/yoda/score/algorithm.go:209-262, used for scoring)
+and GPU-card predicates (pkg/yoda/filter/filter.go:11-58, the legacy SCV
+path). Here both become batched mask tensors, which is what the upstream
+NodeResourcesFit filter computes per (pod, node) — evaluated for the whole
+batch in one pass.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def resource_fit(
+    allocatable: jnp.ndarray,
+    requested: jnp.ndarray,
+    pod_request: jnp.ndarray,
+    node_mask: jnp.ndarray,
+) -> jnp.ndarray:
+    """NodeResourcesFit as one broadcast compare-and-reduce.
+
+    allocatable: [n, r] per-node allocatable quantities (A)
+    requested:   [n, r] per-node already-requested quantities (Q); callers
+                 build this with non-zero defaults applied, mirroring
+                 NonZeroRequested in the reference's
+                 CalculateResourceAllocatableRequest (algorithm.go:219-221)
+    pod_request: [p, r] per-pod requests (R) with non-zero defaults
+    node_mask:   [n] bool
+
+    A resource the pod does not request never excludes a node — this covers
+    the reference's extended-resource bypass (algorithm.go:211-215: if the
+    pod requests 0 of a scalar resource, the resource is skipped) and is a
+    no-op for canonical resources (0 <= anything).
+
+    Returns F[p, n] bool: requested + pod_request <= allocatable on every
+    requested resource.
+    """
+    fits = requested[None, :, :] + pod_request[:, None, :] <= allocatable[None, :, :]
+    fits = fits | (pod_request[:, None, :] == 0)
+    return fits.all(-1) & node_mask[None, :]
+
+
+def card_fit(
+    cards: jnp.ndarray,
+    card_mask: jnp.ndarray,
+    card_healthy: jnp.ndarray,
+    want_number: jnp.ndarray,
+    want_memory: jnp.ndarray,
+    want_clock: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """GPU-card feasibility, vectorizing pkg/yoda/filter/filter.go:11-58.
+
+    cards:        [n, c, 6] metric order (bandwidth, clock, core, power,
+                  free_memory, total_memory)
+    card_mask:    [n, c] bool, real (non-padded) cards
+    card_healthy: [n, c] bool, card.Health == "Healthy"
+    want_number:  [p] int32, pod label `scv/number`; a pod with no GPU
+                  demand at all encodes want_number=0 (fits every node); a
+                  GPU pod without an explicit number label wants 1 card
+                  (filter.go:15: absent label => (CardNumber > 0, 1), which
+                  is exactly want_number=1)
+    want_memory:  [p] pod label `scv/memory`; -1 = label absent
+                  (unconstrained, filter.go:32). The reference gates on
+                  label *presence*, not value: a present-but-"0" (or
+                  unparsable, strToUint => 0) label demands FreeMemory >= 0
+                  from want_number healthy cards — encode that as 0, not -1
+    want_clock:   [p] pod label `scv/clock`; -1 = label absent
+                  (filter.go:49). A present "0" demands Clock == 0, which
+                  no real card has — the reference then rejects every node
+
+    Per-card predicates (filter.go:52-58): a card satisfies the memory demand
+    iff healthy AND free_memory >= want; satisfies the clock demand iff
+    healthy AND clock == want. A node fits iff
+        want_number <= card_number                 (PodFitsNumber)
+        AND #cards fitting memory >= want_number   (PodFitsMemory)
+        AND #cards fitting clock  >= want_number   (PodFitsClock).
+    Pods with want_number == 0 fit every node (no GPU demand).
+
+    Returns (node_fits[p, n] bool, per_card_fits[p, n, c] bool); the latter
+    feeds card_score (a card contributes iff it meets both demands,
+    algorithm.go:270-273).
+    """
+    free_mem = cards[..., 4]  # [n, c]
+    clock = cards[..., 1]
+    healthy = card_healthy & card_mask
+    mem_unconstrained = want_memory < 0  # [p] label absent
+    clock_unconstrained = want_clock < 0
+
+    mem_ok = healthy[None, :, :] & (free_mem[None, :, :] >= want_memory[:, None, None])
+    clock_ok = healthy[None, :, :] & (clock[None, :, :] == want_clock[:, None, None])
+
+    card_number = card_mask.sum(-1)  # [n]
+    n_mem = mem_ok.sum(-1)  # [p, n]
+    n_clock = clock_ok.sum(-1)
+
+    number_fits = want_number[:, None] <= card_number[None, :]
+    mem_fits = mem_unconstrained[:, None] | (n_mem >= want_number[:, None])
+    clock_fits = clock_unconstrained[:, None] | (n_clock >= want_number[:, None])
+    no_gpu_demand = (want_number == 0)[:, None]
+
+    node_fits = no_gpu_demand | (number_fits & mem_fits & clock_fits)
+
+    # A card "fits the pod" for scoring/collection when it meets both
+    # demands: FreeMemory >= memory AND Clock >= clock (algorithm.go:270-272,
+    # collection.go:45-49). Unlike the filter predicates, the reference does
+    # NOT check health here, and scoring uses Clock >= want where filtering
+    # used == — both quirks reproduced (real cards only, via card_mask).
+    # For absent labels the reference's PodFits* return 0 demands
+    # (filter.go:32,49), so clamp the -1 sentinels to 0 here.
+    score_mem = jnp.maximum(want_memory, 0)
+    score_clock = jnp.maximum(want_clock, 0)
+    per_card = (
+        card_mask[None, :, :]
+        & (free_mem[None, :, :] >= score_mem[:, None, None])
+        & (clock[None, :, :] >= score_clock[:, None, None])
+    )
+    return node_fits, per_card

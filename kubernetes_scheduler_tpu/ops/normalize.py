@@ -1,0 +1,67 @@
+"""Score normalization kernels.
+
+Replaces the NormalizeScore extension point (pkg/yoda/scheduler.go:158-183):
+min-max rescale of each pod's node scores to [0, MaxNodeScore], including the
+reference's `highest == lowest` guard (scheduler.go:173-175: decrement lowest
+by one, which maps every node to exactly MaxNodeScore). Also provides a
+softmax variant for the batched engine (the north-star design's device-side
+normalization, BASELINE.json).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# framework.MaxNodeScore in the upstream scheduler framework.
+MAX_NODE_SCORE = 100.0
+
+
+def min_max_normalize(
+    scores: jnp.ndarray,
+    node_mask: jnp.ndarray,
+    *,
+    max_node_score: float = MAX_NODE_SCORE,
+    integer_parity: bool = False,
+) -> jnp.ndarray:
+    """Per-pod min-max rescale to [0, max_node_score] over valid nodes.
+
+    scores:    [p, n] raw scores
+    node_mask: [n] bool
+    integer_parity: reproduce the reference exactly — upstream hands
+        NormalizeScore int64 scores (already truncated at
+        pkg/yoda/scheduler.go:154) and the rescale at scheduler.go:178 is
+        integer division. With this flag the inputs are floored and the
+        division truncated, matching the Go path bit-for-bit.
+
+    Padded nodes get 0.
+    """
+    if integer_parity:
+        scores = jnp.floor(scores)
+    big = jnp.asarray(jnp.finfo(jnp.float32).max, jnp.float32)
+    masked_hi = jnp.where(node_mask[None, :], scores, -big)
+    masked_lo = jnp.where(node_mask[None, :], scores, big)
+    # Reference seeds highest with 0 (scheduler.go:162), so an all-negative
+    # score vector still normalizes against highest=0. lowest is seeded with
+    # scores[0] (always a real node upstream).
+    highest = jnp.maximum(masked_hi.max(axis=1, keepdims=True), 0.0)
+    lowest = masked_lo.min(axis=1, keepdims=True)
+    lowest = jnp.where(highest == lowest, lowest - 1.0, lowest)
+    out = (scores - lowest) * max_node_score / (highest - lowest)
+    if integer_parity:
+        out = jnp.trunc(out)
+    return jnp.where(node_mask[None, :], out, 0.0)
+
+
+def softmax_normalize(
+    scores: jnp.ndarray,
+    node_mask: jnp.ndarray,
+    *,
+    temperature: float = 1.0,
+) -> jnp.ndarray:
+    """Masked softmax over the node axis: scores become a placement
+    distribution. Used by the learned policy head and as the batched
+    engine's alternative to min-max (differentiable, scale-free)."""
+    neg = jnp.asarray(-1e30, scores.dtype)
+    logits = jnp.where(node_mask[None, :], scores / temperature, neg)
+    return jax.nn.softmax(logits, axis=-1)

@@ -1,0 +1,204 @@
+"""Scoring-policy kernels: batched pod × node score matrices.
+
+Each kernel vectorizes one of the reference's scoring policies over the full
+pending-pod × node batch, replacing the per-(pod, node) plugin invocations
+(pkg/yoda/scheduler.go:116-156) and the Redis memoization they require
+(pkg/yoda/score/algorithm.go:57-63,116). All kernels:
+
+  - take a `node_mask` for padding and return raw scores with padded entries
+    left in place (callers mask before reductions / normalization);
+  - are elementwise + broadcast over [pods, nodes] — XLA fuses the whole
+    policy into a handful of HBM-bandwidth-bound loops, and on TPU the
+    matrix layout keeps the lanes full.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from kubernetes_scheduler_tpu.ops.stats import UtilizationStats
+
+# Legacy per-metric weights from the reference's scoring constants
+# (pkg/yoda/score/algorithm.go:24-35).
+BANDWIDTH_WEIGHT = 1.0
+CLOCK_WEIGHT = 1.0
+CORE_WEIGHT = 2.0
+POWER_WEIGHT = 1.0
+FREE_MEMORY_WEIGHT = 3.0
+TOTAL_MEMORY_WEIGHT = 1.0
+ACTUAL_WEIGHT = 2.0
+DISK_IO_WEIGHT = 100.0
+ALLOCATE_WEIGHT = 3.0
+
+# Raw score range of the live policy (pkg/yoda/score/algorithm.go:111).
+MAX_RAW_SCORE = 10.0
+
+
+def balanced_cpu_diskio(
+    stats: UtilizationStats,
+    r_cpu: jnp.ndarray,
+    r_io: jnp.ndarray,
+    *,
+    truncate: bool = False,
+) -> jnp.ndarray:
+    """The live policy: CPU/disk-IO load balancing.
+
+    Vectorizes BalancedCpuDiskIOPriority (pkg/yoda/score/algorithm.go:99-119):
+        beta  = 1 / (1 + Rcpu / Rio)
+        alpha = 1 - beta
+        L[p,n] = |alpha[p] * V[n] - beta[p] * U[n]|
+        S[p,n] = 10 - 10 * L[p,n]
+
+    r_cpu: [p] pod CPU request in millicores (algorithm.go:104)
+    r_io:  [p] pod disk-IO demand from the `diskIO` annotation in MB/s
+           (algorithm.go:103). A missing/unparsable annotation is 0 in the
+           reference (strconv returns 0); Go then computes Rcpu/0 = +Inf so
+           beta = 0, alpha = 1 — we reproduce that limit explicitly instead
+           of relying on float division by zero.
+    truncate: reproduce the reference's `uint64(Si)` floor quantization to
+           11 integer levels (algorithm.go:113). Off by default: the batch
+           engine keeps full precision and documents the deviation.
+
+    Returns S[p, n] float32.
+    """
+    r_cpu = r_cpu.astype(jnp.float32)
+    r_io = r_io.astype(jnp.float32)
+    safe_io = jnp.where(r_io > 0, r_io, 1.0)
+    beta = jnp.where(r_io > 0, 1.0 / (1.0 + r_cpu / safe_io), 0.0)  # [p]
+    alpha = 1.0 - beta
+    load = jnp.abs(
+        alpha[:, None] * stats.v[None, :] - beta[:, None] * stats.u[None, :]
+    )
+    s = MAX_RAW_SCORE - MAX_RAW_SCORE * load
+    if truncate:
+        # uint64() in Go truncates toward zero; scores here are >= 0 whenever
+        # load <= 1, and the reference never guards load > 1, so mirror a
+        # plain floor on the non-negative branch and clamp the rest to 0
+        # (uint64 of a negative float is undefined behavior in Go; observed
+        # behavior on amd64 is saturation — we choose 0 and document it).
+        s = jnp.where(s >= 0, jnp.floor(s), 0.0)
+    return s
+
+
+def balanced_diskio(
+    stats: UtilizationStats,
+    disk_io: jnp.ndarray,
+    r_io: jnp.ndarray,
+    node_mask: jnp.ndarray,
+) -> jnp.ndarray:
+    """Legacy variance-minimization policy (dead in the reference main path).
+
+    Vectorizes BalancedDiskIOPriority (pkg/yoda/score/algorithm.go:121-176):
+        Tj = Dj + Rio;  Fj = Tj / 100
+        F_avg = u_avg - (Uj - Fj) / N
+        Mj = M_tmp - ((Uj - u_avg)^2 - (Fj - F_avg)^2) / N
+        S  = 100 - 100 * (Mj - M_min) / (M_max - M_min)
+
+    Reference quirk reproduced deliberately: M_max/M_min are seeded with
+    0 and 1e6 before the node loop (algorithm.go:122-123), so the min/max
+    used for rescaling includes those sentinels whenever every Mj is
+    positive (resp. below 1e6). Golden tests pin this behavior.
+
+    disk_io: [n] MB/s; r_io: [p]; returns S[p, n] float32.
+    """
+    n = stats.n_valid
+    t = disk_io[None, :] + r_io[:, None].astype(jnp.float32)  # [p,n]
+    f = t / 100.0
+    u = stats.u[None, :]
+    f_avg = stats.u_avg - (u - f) / n
+    m = stats.m_var - ((u - stats.u_avg) ** 2 - (f - f_avg) ** 2) / n
+    big = jnp.asarray(jnp.finfo(jnp.float32).max, jnp.float32)
+    m_masked_max = jnp.where(node_mask[None, :], m, -big)
+    m_masked_min = jnp.where(node_mask[None, :], m, big)
+    m_max = jnp.maximum(m_masked_max.max(axis=1, keepdims=True), 0.0)
+    m_min = jnp.minimum(m_masked_min.min(axis=1, keepdims=True), 1.0e6)
+    denom = m_max - m_min
+    safe = jnp.where(denom != 0, denom, 1.0)
+    return 100.0 - 100.0 * (m - m_min) / safe
+
+
+def free_capacity(
+    cpu_pct: jnp.ndarray,
+    mem_pct: jnp.ndarray,
+    disk_io: jnp.ndarray,
+    *,
+    disk_io_weight: float = DISK_IO_WEIGHT,
+    cpu_weight: float = CORE_WEIGHT,
+    memory_weight: float = FREE_MEMORY_WEIGHT,
+) -> jnp.ndarray:
+    """Legacy weighted free-capacity policy.
+
+    Vectorizes CalculateBasicScore2 (pkg/yoda/score/algorithm.go:178-198):
+        S[n] = 100*(100 - floor(DiskIO)) + 2*(100 - Cpu) + 3*(100 - Memory)
+    (the reference truncates DiskIO to int64 before subtracting,
+    algorithm.go:189 — reproduced with floor). Pod-independent: returns
+    S[n] float32; callers broadcast over the pod axis.
+    """
+    disk_score = disk_io_weight * (100.0 - jnp.floor(disk_io))
+    cpu_score = cpu_weight * (100.0 - cpu_pct)
+    mem_score = memory_weight * (100.0 - mem_pct)
+    return disk_score + cpu_score + mem_score
+
+
+def card_score(
+    cards: jnp.ndarray,
+    card_mask: jnp.ndarray,
+    fits: jnp.ndarray,
+    max_values: jnp.ndarray,
+    *,
+    reference_clock_bug: bool = False,
+    integer_parity: bool = False,
+) -> jnp.ndarray:
+    """GPU-card scoring: per-card weighted normalized metrics, summed per node.
+
+    Vectorizes the reference's commented-out GPU path
+    (pkg/yoda/score/algorithm.go:264-291): each fitting card contributes
+        bandwidth*100/max_bw * 1 + clock*100/max_clock * 1 + core*100/max_core * 2
+        + power*100/max_power * 1 + free_mem*100/max_free * 3
+        + total_mem*100/max_total * 1
+
+    cards:      [n, c, 6] float32, metric order
+                (bandwidth, clock, core, power, free_memory, total_memory)
+    card_mask:  [n, c] bool, real cards
+    fits:       [p, n, c] bool, per-pod card feasibility (see feasibility.card_fit)
+    max_values: [p, 6] per-pod maxima over fitting cards, exactly the shape
+                collect.collect_max_card_values returns (the reference
+                recollects maxima per pod, collection.go:30-55)
+    reference_clock_bug: the reference normalizes clock by MaxBandwidth
+                (algorithm.go:283: `clock = card.Clock * 100 / value.MaxBandwidth`)
+                — almost certainly a typo. Default False normalizes clock by
+                max clock; set True for value-parity with the commented code.
+    integer_parity: reproduce the Go path's uint arithmetic — each
+                `metric * 100 / max` is integer (floor) division
+                (algorithm.go:282-287) before weighting. Off by default.
+
+    Returns S[p, n] float32.
+    """
+    weights = jnp.asarray(
+        [
+            BANDWIDTH_WEIGHT,
+            CLOCK_WEIGHT,
+            CORE_WEIGHT,
+            POWER_WEIGHT,
+            FREE_MEMORY_WEIGHT,
+            TOTAL_MEMORY_WEIGHT,
+        ],
+        jnp.float32,
+    )
+    denom = max_values  # [p, 6]
+    if reference_clock_bug:
+        denom = denom.at[:, 1].set(max_values[:, 0])
+    denom = jnp.maximum(denom, 1.0)
+    if integer_parity:
+        # Go uint division is exact; float32 `floor(a*100/b)` can land one
+        # off when a*100/b is an exact integer. Metric values are integers
+        # < 2^24, so int32 arithmetic reproduces the Go path bit-for-bit.
+        ratio = (
+            cards[None, :, :, :].astype(jnp.int32) * 100
+            // denom[:, None, None, :].astype(jnp.int32)
+        ).astype(jnp.float32)
+    else:
+        ratio = cards[None, :, :, :] * 100.0 / denom[:, None, None, :]  # [p,n,c,6]
+    per_card = (ratio * weights).sum(-1)  # [p, n, c]
+    valid = fits & card_mask[None, :, :]
+    return (per_card * valid).sum(-1)

@@ -1,0 +1,50 @@
+"""Cluster-wide utilization statistics as device reductions.
+
+Replaces the reference's two O(N) host loops with Redis round-trips
+(pkg/yoda/score/algorithm.go:67-89: U_i/V_i per node, u_avg, M_tmp variance,
+each value SET/GET through Redis) with masked mean/variance reductions that
+run in one pass on device. In the sharded engine these become `psum`s over
+the node-axis mesh dimension.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+# Normalization divisors hard-coded in the reference
+# (pkg/yoda/score/algorithm.go:71: Ui = DiskIO / 50.0, :73: Vi = Cpu / 100.0).
+DISK_IO_DIVISOR = 50.0
+CPU_DIVISOR = 100.0
+
+
+class UtilizationStats(NamedTuple):
+    u: jnp.ndarray       # [n] disk-IO utilization, DiskIO / 50
+    v: jnp.ndarray       # [n] CPU utilization, Cpu% / 100
+    u_avg: jnp.ndarray   # [] masked mean of u
+    m_var: jnp.ndarray   # [] masked population variance of u ("M_tmp")
+    n_valid: jnp.ndarray  # [] number of valid (unpadded) nodes
+
+
+def utilization_stats(
+    disk_io: jnp.ndarray,
+    cpu_pct: jnp.ndarray,
+    node_mask: jnp.ndarray,
+    *,
+    disk_io_divisor: float = DISK_IO_DIVISOR,
+    cpu_divisor: float = CPU_DIVISOR,
+) -> UtilizationStats:
+    """Compute U, V, u_avg and M_tmp over the valid nodes.
+
+    disk_io:   [n] float, MB/s per node (advisor's DiskIO series)
+    cpu_pct:   [n] float, CPU%% per node (advisor's Cpu series)
+    node_mask: [n] bool, True for real nodes, False for padding
+    """
+    mask = node_mask.astype(disk_io.dtype)
+    n_valid = jnp.maximum(mask.sum(), 1.0)
+    u = disk_io / disk_io_divisor
+    v = cpu_pct / cpu_divisor
+    u_avg = (u * mask).sum() / n_valid
+    m_var = (((u - u_avg) ** 2) * mask).sum() / n_valid
+    return UtilizationStats(u=u, v=v, u_avg=u_avg, m_var=m_var, n_valid=n_valid)

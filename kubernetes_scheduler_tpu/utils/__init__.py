@@ -1,0 +1,1 @@
+from kubernetes_scheduler_tpu.utils.padding import bucket_size, pad_axis, pad_to_bucket

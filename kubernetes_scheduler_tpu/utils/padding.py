@@ -1,0 +1,43 @@
+"""Bucketed padding: dynamic cluster sizes vs. XLA static shapes.
+
+The pending-pod count P and node count N vary every cycle, but everything
+under `jit` must be statically shaped. We round each axis up to a bucket
+(powers of two by default, with a floor) so recompilation only happens when
+a cluster crosses a bucket boundary, and carry boolean masks for the
+padding. Buckets are also kept multiples of 8 so the node axis divides the
+TPU sublane tiling and any mesh size up to 8.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def bucket_size(n: int, *, floor: int = 8, multiple: int = 8) -> int:
+    """Smallest power-of-two bucket >= n, at least `floor`, a multiple of
+    `multiple`."""
+    b = floor
+    while b < n:
+        b *= 2
+    return int(np.ceil(b / multiple) * multiple)
+
+
+def pad_axis(arr: np.ndarray, size: int, axis: int = 0, fill=0) -> np.ndarray:
+    """Pad `axis` of `arr` with `fill` up to `size`."""
+    cur = arr.shape[axis]
+    if cur == size:
+        return arr
+    if cur > size:
+        raise ValueError(f"axis {axis} has {cur} > bucket {size}")
+    widths = [(0, 0)] * arr.ndim
+    widths[axis] = (0, size - cur)
+    return np.pad(arr, widths, constant_values=fill)
+
+
+def pad_to_bucket(arr: np.ndarray, axis: int = 0, *, floor: int = 8, fill=0):
+    """Pad `axis` up to its bucket; returns (padded, mask) where mask is a
+    bool array over the padded axis marking real entries."""
+    size = bucket_size(arr.shape[axis], floor=floor)
+    mask = np.zeros(size, bool)
+    mask[: arr.shape[axis]] = True
+    return pad_axis(arr, size, axis, fill), mask

@@ -1,0 +1,18 @@
+"""Test harness: hermetic, CPU-only, 8 virtual devices.
+
+Must run before jax initializes its backend: force the CPU platform and a
+virtual 8-device topology so sharding tests (`shard_map` over a Mesh)
+exercise real multi-device paths without TPU hardware.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

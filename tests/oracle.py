@@ -1,0 +1,183 @@
+"""Scalar golden oracle: an independent plain-Python port of the reference's
+scoring math, written loop-by-loop from the Go formulas (not from our JAX
+kernels) so kernel tests have something to disagree with.
+
+Formula sources (all in /root/reference):
+  - balanced_cpu_diskio: pkg/yoda/score/algorithm.go:99-119
+  - stats (u_avg, M_tmp): pkg/yoda/score/algorithm.go:67-89
+  - balanced_diskio:      pkg/yoda/score/algorithm.go:121-176
+  - free_capacity:        pkg/yoda/score/algorithm.go:178-198
+  - card scoring:         pkg/yoda/score/algorithm.go:264-291 (commented legacy)
+  - card predicates:      pkg/yoda/filter/filter.go:11-58
+  - min-max normalize:    pkg/yoda/scheduler.go:158-183
+  - max collection:       pkg/yoda/collection/collection.go:30-76
+"""
+
+from __future__ import annotations
+
+import math
+
+
+def stats_oracle(disk_io, cpu_pct):
+    u = [d / 50.0 for d in disk_io]
+    v = [c / 100.0 for c in cpu_pct]
+    u_avg = sum(u) / len(u)
+    m_tmp = sum((ui - u_avg) ** 2 for ui in u) / len(u)
+    return u, v, u_avg, m_tmp
+
+
+def balanced_cpu_diskio_oracle(disk_io, cpu_pct, r_cpu, r_io, truncate=False):
+    """Score of one pod against every node."""
+    u, v, _, _ = stats_oracle(disk_io, cpu_pct)
+    if r_io > 0:
+        beta = 1.0 / (1.0 + r_cpu / r_io)
+    else:
+        beta = 0.0  # Go: Rcpu/0 = +Inf => beta = 0
+    alpha = 1.0 - beta
+    out = []
+    for ui, vi in zip(u, v):
+        li = abs(alpha * vi - beta * ui)
+        si = 10.0 - 10.0 * li
+        if truncate:
+            si = float(int(si)) if si >= 0 else 0.0
+        out.append(si)
+    return out
+
+
+def balanced_diskio_oracle(disk_io, cpu_pct, r_io):
+    u, _, u_avg, m_tmp = stats_oracle(disk_io, cpu_pct)
+    n = len(disk_io)
+    m_max, m_min = 0.0, 1000000.0  # sentinel seeds, algorithm.go:122-123
+    ms = []
+    for j in range(n):
+        tj = disk_io[j] + r_io
+        fj = tj / 100.0
+        uj = u[j]
+        f_avg = u_avg - (uj - fj) / n
+        mj = m_tmp - ((uj - u_avg) ** 2 - (fj - f_avg) ** 2) / n
+        m_max = max(m_max, mj)
+        m_min = min(m_min, mj)
+        ms.append(mj)
+    return [100.0 - (100.0 * (m - m_min) / (m_max - m_min)) for m in ms]
+
+
+def free_capacity_oracle(cpu_pct, mem_pct, disk_io):
+    out = []
+    for c, m, d in zip(cpu_pct, mem_pct, disk_io):
+        out.append(100 * (100 - int(d)) + 2 * (100 - c) + 3 * (100 - m))
+    return out
+
+
+def normalize_oracle(scores, max_node_score=100.0):
+    highest = 0.0
+    lowest = scores[0]
+    for s in scores:
+        lowest = min(lowest, s)
+        highest = max(highest, s)
+    if highest == lowest:
+        lowest -= 1
+    return [(s - lowest) * max_node_score / (highest - lowest) for s in scores]
+
+
+# --- GPU-card path -----------------------------------------------------------
+# A card is a dict: bandwidth, clock, core, power, free_memory, total_memory,
+# healthy (bool). A node is a list of cards.
+
+
+def card_fits_memory(card, memory):
+    return card["healthy"] and card["free_memory"] >= memory  # filter.go:52-54
+
+
+def card_fits_clock(card, clock):
+    return card["healthy"] and card["clock"] == clock  # filter.go:56-58
+
+
+def pod_fits_node_oracle(cards, want_number, want_memory, want_clock):
+    """filter.go:11-50 against one node's card list.
+
+    want_memory / want_clock = -1 encodes "label absent" (the reference
+    gates on label presence, filter.go:19,36); a present-but-zero label is
+    a real demand (FreeMemory >= 0 from healthy cards / Clock == 0).
+    want_number = 0 encodes a pod with no GPU demand.
+    """
+    if want_number == 0:
+        return True
+    if want_number > len(cards):
+        return False
+    if want_memory >= 0:
+        if sum(1 for c in cards if card_fits_memory(c, want_memory)) < want_number:
+            return False
+    if want_clock >= 0:
+        if sum(1 for c in cards if card_fits_clock(c, want_clock)) < want_number:
+            return False
+    return True
+
+
+def collect_max_oracle(nodes, want_number, want_memory, want_clock):
+    """collection.go:30-55: maxima over fitting cards of fitting nodes.
+
+    The demands used for card admission are the PodFits* return values,
+    which are 0 for absent labels (filter.go:32,49) — clamp -1 to 0.
+    """
+    mem = max(want_memory, 0)
+    clock = max(want_clock, 0)
+    maxima = dict(
+        bandwidth=1, clock=1, core=1, power=1, free_memory=1, total_memory=1
+    )
+    for cards in nodes:
+        if not pod_fits_node_oracle(cards, want_number, want_memory, want_clock):
+            continue
+        for c in cards:
+            if c["free_memory"] >= mem and c["clock"] >= clock:
+                for k in maxima:
+                    maxima[k] = max(maxima[k], c[k])
+    return maxima
+
+
+def card_score_oracle(cards, maxima, want_memory, want_clock,
+                      reference_clock_bug=False, integer_parity=False):
+    """algorithm.go:264-291 for one node: sum of per-card weighted scores
+    over cards meeting the (>=) demands. Note the reference does not check
+    card health in this loop (algorithm.go:270-272), and its arithmetic is
+    uint division — metric*100/max floors (integer_parity=True)."""
+    mem = max(want_memory, 0)
+    clock = max(want_clock, 0)
+    total = 0.0
+    div = (lambda a, b: a * 100 // b) if integer_parity else (lambda a, b: a * 100 / b)
+    clock_denom = maxima["bandwidth"] if reference_clock_bug else maxima["clock"]
+    for c in cards:
+        if not (c["free_memory"] >= mem and c["clock"] >= clock):
+            continue
+        total += (
+            div(c["bandwidth"], maxima["bandwidth"]) * 1
+            + div(c["clock"], clock_denom) * 1
+            + div(c["core"], maxima["core"]) * 2
+            + div(c["power"], maxima["power"]) * 1
+            + div(c["free_memory"], maxima["free_memory"]) * 3
+            + div(c["total_memory"], maxima["total_memory"]) * 1
+        )
+    return total
+
+
+def greedy_assign_oracle(scores, feasible, pod_request, node_free, priority):
+    """Reference-semantics sequential scheduling: pods in priority order
+    (sort.go:8-18, stable on queue order), each binds to its best feasible
+    node with remaining capacity."""
+    p = len(scores)
+    free = [list(row) for row in node_free]
+    order = sorted(range(p), key=lambda i: (-priority[i], i))
+    out = [-1] * p
+    for i in order:
+        best, best_s = -1, -math.inf
+        for j in range(len(free)):
+            if not feasible[i][j]:
+                continue
+            if any(pod_request[i][r] > free[j][r] for r in range(len(free[j]))):
+                continue
+            if scores[i][j] > best_s:
+                best, best_s = j, scores[i][j]
+        if best >= 0:
+            out[i] = best
+            for r in range(len(free[best])):
+                free[best][r] -= pod_request[i][r]
+    return out

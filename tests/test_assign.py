@@ -1,0 +1,98 @@
+"""Assignment kernels: greedy parity with the scalar oracle, capacity safety."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from kubernetes_scheduler_tpu.ops.assign import auction_assign, greedy_assign
+from tests import oracle
+
+RNG = np.random.default_rng(2)
+
+
+def random_problem(p, n, r=3):
+    scores = RNG.uniform(0, 10, (p, n)).astype(np.float32)
+    feasible = RNG.random((p, n)) > 0.2
+    pod_req = RNG.integers(1, 5, (p, r)).astype(np.float32)
+    node_free = RNG.integers(3, 20, (n, r)).astype(np.float32)
+    priority = RNG.integers(0, 5, p).astype(np.int32)
+    return scores, feasible, pod_req, node_free, priority
+
+
+def test_greedy_matches_oracle():
+    scores, feasible, pod_req, node_free, priority = random_problem(20, 7)
+    res = greedy_assign(
+        jnp.asarray(scores),
+        jnp.asarray(feasible),
+        jnp.asarray(pod_req),
+        jnp.asarray(node_free),
+        jnp.asarray(priority),
+        jnp.ones(20, bool),
+    )
+    want = oracle.greedy_assign_oracle(
+        scores.tolist(), feasible.tolist(), pod_req.tolist(),
+        node_free.tolist(), priority.tolist(),
+    )
+    assert np.asarray(res.node_idx).tolist() == want
+
+
+def _check_capacity(node_idx, pod_req, node_free):
+    used = np.zeros_like(node_free)
+    for i, j in enumerate(node_idx):
+        if j >= 0:
+            used[j] += pod_req[i]
+    assert (used <= node_free + 1e-6).all()
+
+
+def test_greedy_capacity_never_oversubscribed():
+    scores, feasible, pod_req, node_free, priority = random_problem(64, 5)
+    res = greedy_assign(
+        jnp.asarray(scores), jnp.asarray(feasible), jnp.asarray(pod_req),
+        jnp.asarray(node_free), jnp.asarray(priority), jnp.ones(64, bool),
+    )
+    idx = np.asarray(res.node_idx)
+    _check_capacity(idx, pod_req, node_free)
+    # free_after is consistent
+    used = node_free - np.asarray(res.free_after)
+    want_used = np.zeros_like(node_free)
+    for i, j in enumerate(idx):
+        if j >= 0:
+            want_used[j] += pod_req[i]
+    np.testing.assert_allclose(used, want_used)
+
+
+def test_greedy_priority_order_wins_scarce_node():
+    # One node, capacity for one pod; higher priority pod gets it.
+    scores = jnp.asarray([[5.0], [9.0]])
+    feasible = jnp.ones((2, 1), bool)
+    pod_req = jnp.asarray([[1.0], [1.0]])
+    node_free = jnp.asarray([[1.0]])
+    priority = jnp.asarray([10, 1], jnp.int32)
+    res = greedy_assign(scores, feasible, pod_req, node_free, priority, jnp.ones(2, bool))
+    assert np.asarray(res.node_idx).tolist() == [0, -1]
+
+
+def test_greedy_pod_mask_padding_ignored():
+    scores, feasible, pod_req, node_free, priority = random_problem(8, 4)
+    mask = np.array([True] * 5 + [False] * 3)
+    res = greedy_assign(
+        jnp.asarray(scores), jnp.asarray(feasible), jnp.asarray(pod_req),
+        jnp.asarray(node_free), jnp.asarray(priority), jnp.asarray(mask),
+    )
+    idx = np.asarray(res.node_idx)
+    assert (idx[5:] == -1).all()
+
+
+def test_auction_capacity_safe_and_complete():
+    scores, feasible, pod_req, node_free, priority = random_problem(48, 6)
+    res = auction_assign(
+        jnp.asarray(scores), jnp.asarray(feasible), jnp.asarray(pod_req),
+        jnp.asarray(node_free), jnp.asarray(priority), jnp.ones(48, bool),
+        rounds=16,
+    )
+    idx = np.asarray(res.node_idx)
+    _check_capacity(idx, pod_req, node_free)
+    # every unassigned pod truly has no feasible node with remaining capacity
+    free = np.asarray(res.free_after)
+    for i in np.where(idx < 0)[0]:
+        for j in range(free.shape[0]):
+            assert not (feasible[i, j] and (pod_req[i] <= free[j]).all())

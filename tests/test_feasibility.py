@@ -1,0 +1,145 @@
+"""Feasibility-mask kernels vs. scalar predicates."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from kubernetes_scheduler_tpu.ops import card_fit, collect_max_card_values, resource_fit
+from kubernetes_scheduler_tpu.ops.score import card_score
+from tests import oracle
+
+RNG = np.random.default_rng(1)
+
+METRICS = ("bandwidth", "clock", "core", "power", "free_memory", "total_memory")
+
+
+def random_cards(n_nodes, max_cards=4):
+    nodes = []
+    for _ in range(n_nodes):
+        cards = []
+        for _ in range(RNG.integers(0, max_cards + 1)):
+            cards.append(
+                dict(
+                    bandwidth=int(RNG.integers(1, 100)),
+                    clock=int(RNG.choice([1000, 1500, 2000])),
+                    core=int(RNG.integers(1, 5000)),
+                    power=int(RNG.integers(50, 400)),
+                    free_memory=int(RNG.integers(0, 32_000)),
+                    total_memory=int(RNG.integers(16_000, 48_000)),
+                    healthy=bool(RNG.random() > 0.2),
+                )
+            )
+        nodes.append(cards)
+    return nodes
+
+
+def pack_cards(nodes, c_max=4):
+    n = len(nodes)
+    cards = np.zeros((n, c_max, 6), np.float32)
+    mask = np.zeros((n, c_max), bool)
+    healthy = np.zeros((n, c_max), bool)
+    for i, cs in enumerate(nodes):
+        for j, c in enumerate(cs):
+            cards[i, j] = [c[m] for m in METRICS]
+            mask[i, j] = True
+            healthy[i, j] = c["healthy"]
+    return jnp.asarray(cards), jnp.asarray(mask), jnp.asarray(healthy)
+
+
+def test_resource_fit():
+    # 3 nodes x 3 resources; pod 0 fits node 0,2; pod 1 fits only node 2;
+    # pod 2 requests an extended resource only node 0 exposes.
+    alloc = jnp.asarray(
+        [[4000, 8e9, 2], [1000, 2e9, 0], [8000, 16e9, 0]], jnp.float32
+    )
+    req = jnp.asarray([[1000, 1e9, 0], [900, 1e9, 0], [100, 1e9, 0]], jnp.float32)
+    pods = jnp.asarray(
+        [[1000, 1e9, 0], [7000, 1e9, 0], [100, 1e8, 1]], jnp.float32
+    )
+    mask = jnp.asarray([True, True, True])
+    f = np.asarray(resource_fit(alloc, req, pods, mask))
+    assert f.tolist() == [
+        [True, False, True],
+        [False, False, True],
+        [True, False, False],  # node 2 exposes no extended resource
+    ]
+
+
+def test_resource_fit_unrequested_extended_bypass():
+    # algorithm.go:211-215: pod requesting 0 of an extended resource is not
+    # excluded by it, even when requested > allocatable on that slot.
+    alloc = jnp.asarray([[1000, 1e9, 0]], jnp.float32)
+    req = jnp.asarray([[0, 0, 5]], jnp.float32)  # oversubscribed extended slot
+    pods = jnp.asarray([[500, 1e8, 0]], jnp.float32)
+    f = np.asarray(resource_fit(alloc, req, pods, jnp.asarray([True])))
+    assert f.tolist() == [[True]]
+
+
+def test_card_fit_matches_oracle():
+    nodes = random_cards(24)
+    cards, mask, healthy = pack_cards(nodes)
+    # (want_number, want_memory, want_clock); -1 = label absent,
+    # 0 = label present with value "0" (or unparsable -> strToUint 0).
+    demands = [
+        (0, -1, -1),        # non-GPU pod: fits everywhere
+        (1, 8000, -1),      # memory demand only
+        (2, -1, 1500),      # clock demand only
+        (1, 16000, 2000),   # both
+        (3, 1, -1),         # tiny explicit memory demand
+        (1, 0, -1),         # present "0" memory: needs 1 healthy card
+        (1, -1, 0),         # present "0" clock: Clock == 0 never matches
+    ]
+    want_n = jnp.asarray([d[0] for d in demands], jnp.int32)
+    want_m = jnp.asarray([d[1] for d in demands], jnp.float32)
+    want_c = jnp.asarray([d[2] for d in demands], jnp.float32)
+    fits, _ = card_fit(cards, mask, healthy, want_n, want_m, want_c)
+    fits = np.asarray(fits)
+    for p, (g, m, c) in enumerate(demands):
+        for j, cs in enumerate(nodes):
+            assert fits[p, j] == oracle.pod_fits_node_oracle(cs, g, m, c), (p, j)
+    # the "clock label present but 0" pod must fit nowhere with cards
+    assert not fits[6, [len(cs) > 0 for cs in nodes]].any()
+
+
+def test_collect_and_card_score_match_oracle():
+    nodes = random_cards(16)
+    cards, mask, healthy = pack_cards(nodes)
+    g, m, c = 1, 4000, 1500
+    want_n = jnp.asarray([g], jnp.int32)
+    want_m = jnp.asarray([m], jnp.float32)
+    want_c = jnp.asarray([c], jnp.float32)
+    node_fits, per_card = card_fit(cards, mask, healthy, want_n, want_m, want_c)
+
+    maxima = oracle.collect_max_oracle(nodes, g, m, c)
+    # Device-side maxima over fitting cards of fitting nodes:
+    fits_for_collect = per_card & node_fits[:, :, None]
+    got_max = np.asarray(collect_max_card_values(cards, fits_for_collect))  # [p, 6]
+    want_max = [maxima[k] for k in METRICS]
+    np.testing.assert_allclose(got_max[0], want_max)
+
+    s = np.asarray(
+        card_score(cards, mask, per_card, jnp.asarray(got_max, jnp.float32))
+    )[0]
+    for j, cs in enumerate(nodes):
+        want = oracle.card_score_oracle(cs, maxima, m, c)
+        np.testing.assert_allclose(s[j], want, rtol=1e-5, atol=1e-4)
+
+
+def test_card_score_multi_pod_and_integer_parity():
+    """card_score composed directly with collect_max_card_values for several
+    pods at once (the [p, 6] maxima contract), in Go uint-arithmetic mode."""
+    nodes = random_cards(10)
+    cards, mask, healthy = pack_cards(nodes)
+    demands = [(1, 4000, -1), (1, -1, 1500), (2, 1000, -1), (0, -1, -1)]
+    want_n = jnp.asarray([d[0] for d in demands], jnp.int32)
+    want_m = jnp.asarray([d[1] for d in demands], jnp.float32)
+    want_c = jnp.asarray([d[2] for d in demands], jnp.float32)
+    node_fits, per_card = card_fit(cards, mask, healthy, want_n, want_m, want_c)
+    got_max = collect_max_card_values(cards, per_card & node_fits[:, :, None])
+    s = np.asarray(
+        card_score(cards, mask, per_card, got_max, integer_parity=True)
+    )
+    for p, (g, m, c) in enumerate(demands):
+        maxima = oracle.collect_max_oracle(nodes, g, m, c)
+        for j, cs in enumerate(nodes):
+            want = oracle.card_score_oracle(cs, maxima, m, c, integer_parity=True)
+            np.testing.assert_allclose(s[p, j], want, rtol=1e-5, atol=1e-4), (p, j)

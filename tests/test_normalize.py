@@ -1,0 +1,50 @@
+"""NormalizeScore parity tests (pkg/yoda/scheduler.go:158-183)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from kubernetes_scheduler_tpu.ops import min_max_normalize, softmax_normalize
+from tests import oracle
+
+
+def run(scores, n_valid=None):
+    scores = np.asarray(scores, np.float32)[None, :]
+    n = scores.shape[1] if n_valid is None else n_valid
+    mask = np.arange(scores.shape[1]) < n
+    return np.asarray(min_max_normalize(jnp.asarray(scores), jnp.asarray(mask)))[0]
+
+
+def test_basic_rescale():
+    s = [3.0, 7.0, 5.0, 9.0]
+    np.testing.assert_allclose(run(s), oracle.normalize_oracle(s), rtol=1e-6)
+
+
+def test_equal_scores_guard():
+    # highest == lowest => lowest-- => every node gets exactly 100
+    s = [4.0, 4.0, 4.0]
+    got = run(s)
+    assert got.tolist() == [100.0, 100.0, 100.0]
+    assert oracle.normalize_oracle(s) == [100.0, 100.0, 100.0]
+
+
+def test_highest_seeded_at_zero():
+    # Reference seeds highest=0 (scheduler.go:162): all-negative scores
+    # normalize against 0, not their own max.
+    s = [-5.0, -1.0, -3.0]
+    np.testing.assert_allclose(run(s), oracle.normalize_oracle(s), rtol=1e-6)
+
+
+def test_padding_excluded():
+    s = np.array([3.0, 7.0, 5.0, 999.0, -999.0])
+    got = run(s, n_valid=3)
+    np.testing.assert_allclose(got[:3], oracle.normalize_oracle([3.0, 7.0, 5.0]), rtol=1e-6)
+    assert got[3] == 0.0 and got[4] == 0.0
+
+
+def test_softmax_masked():
+    s = jnp.asarray([[1.0, 2.0, 3.0, 50.0]])
+    mask = jnp.asarray([True, True, True, False])
+    p = np.asarray(softmax_normalize(s, mask))[0]
+    np.testing.assert_allclose(p.sum(), 1.0, rtol=1e-6)
+    assert p[3] < 1e-12
+    assert p[2] > p[1] > p[0]

@@ -1,0 +1,87 @@
+"""Golden tests: batched score kernels vs. the scalar oracle."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from kubernetes_scheduler_tpu.ops import (
+    balanced_cpu_diskio,
+    balanced_diskio,
+    free_capacity,
+    utilization_stats,
+)
+from tests import oracle
+
+RNG = np.random.default_rng(0)
+
+
+def make_cluster(n):
+    disk_io = RNG.uniform(0, 50, n)
+    cpu = RNG.uniform(0, 100, n)
+    mem = RNG.uniform(0, 100, n)
+    return disk_io, cpu, mem
+
+
+def padded_stats(disk_io, cpu, pad=0):
+    n = len(disk_io)
+    d = np.concatenate([disk_io, np.zeros(pad)])
+    c = np.concatenate([cpu, np.zeros(pad)])
+    mask = np.arange(n + pad) < n
+    return utilization_stats(jnp.asarray(d, jnp.float32), jnp.asarray(c, jnp.float32), jnp.asarray(mask))
+
+
+@pytest.mark.parametrize("n,pad", [(1, 0), (7, 0), (16, 5), (64, 64)])
+def test_stats_match_oracle(n, pad):
+    disk_io, cpu, _ = make_cluster(n)
+    stats = padded_stats(disk_io, cpu, pad)
+    _, _, u_avg, m_tmp = oracle.stats_oracle(disk_io, cpu)
+    np.testing.assert_allclose(float(stats.u_avg), u_avg, rtol=1e-5)
+    np.testing.assert_allclose(float(stats.m_var), m_tmp, rtol=1e-4, atol=1e-6)
+    assert int(stats.n_valid) == n
+
+
+@pytest.mark.parametrize("r_cpu,r_io", [(100.0, 10.0), (250.0, 1.0), (100.0, 0.0), (4000.0, 40.0)])
+def test_balanced_cpu_diskio_matches_oracle(r_cpu, r_io):
+    disk_io, cpu, _ = make_cluster(12)
+    stats = padded_stats(disk_io, cpu, pad=4)
+    s = balanced_cpu_diskio(stats, jnp.asarray([r_cpu]), jnp.asarray([r_io]))
+    want = oracle.balanced_cpu_diskio_oracle(disk_io, cpu, r_cpu, r_io)
+    np.testing.assert_allclose(np.asarray(s)[0, :12], want, rtol=1e-5, atol=1e-5)
+
+
+def test_balanced_cpu_diskio_truncation_parity():
+    disk_io, cpu, _ = make_cluster(20)
+    stats = padded_stats(disk_io, cpu)
+    s = balanced_cpu_diskio(stats, jnp.asarray([300.0]), jnp.asarray([25.0]), truncate=True)
+    want = oracle.balanced_cpu_diskio_oracle(disk_io, cpu, 300.0, 25.0, truncate=True)
+    np.testing.assert_array_equal(np.asarray(s)[0], want)
+
+
+def test_balanced_cpu_diskio_batched_pods():
+    """The kernel scores P pods in one call == P oracle calls."""
+    disk_io, cpu, _ = make_cluster(9)
+    stats = padded_stats(disk_io, cpu, pad=7)
+    r_cpu = np.array([100.0, 2000.0, 50.0])
+    r_io = np.array([10.0, 5.0, 0.0])
+    s = np.asarray(balanced_cpu_diskio(stats, jnp.asarray(r_cpu), jnp.asarray(r_io)))
+    for p in range(3):
+        want = oracle.balanced_cpu_diskio_oracle(disk_io, cpu, r_cpu[p], r_io[p])
+        np.testing.assert_allclose(s[p, :9], want, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("n", [3, 17])
+def test_balanced_diskio_matches_oracle(n):
+    disk_io, cpu, _ = make_cluster(n)
+    stats = padded_stats(disk_io, cpu, pad=3)
+    mask = jnp.asarray(np.arange(n + 3) < n)
+    d = jnp.asarray(np.concatenate([disk_io, np.zeros(3)]), jnp.float32)
+    s = balanced_diskio(stats, d, jnp.asarray([12.0]), mask)
+    want = oracle.balanced_diskio_oracle(disk_io, cpu, 12.0)
+    np.testing.assert_allclose(np.asarray(s)[0, :n], want, rtol=2e-4, atol=2e-3)
+
+
+def test_free_capacity_matches_oracle():
+    disk_io, cpu, mem = make_cluster(15)
+    s = free_capacity(jnp.asarray(cpu, jnp.float32), jnp.asarray(mem, jnp.float32), jnp.asarray(disk_io, jnp.float32))
+    want = oracle.free_capacity_oracle(cpu, mem, disk_io)
+    np.testing.assert_allclose(np.asarray(s), want, rtol=1e-5)

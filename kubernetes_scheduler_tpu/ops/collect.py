@@ -12,6 +12,16 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
+def local_max_card_values(cards: jnp.ndarray, fits: jnp.ndarray) -> jnp.ndarray:
+    """Unclamped max per metric over a pod's fitting (local) cards; the
+    sharded engine pmax-reduces this across node shards before clamping.
+
+    cards: [n, c, 6]; fits: [p, n, c] bool. Returns [p, 6] (0 where no card
+    fits)."""
+    masked = jnp.where(fits[..., None], cards[None, :, :, :], 0.0)
+    return masked.max(axis=(1, 2))
+
+
 def collect_max_card_values(
     cards: jnp.ndarray,
     fits: jnp.ndarray,
@@ -21,5 +31,4 @@ def collect_max_card_values(
     cards: [n, c, 6]; fits: [p, n, c] bool (from feasibility.card_fit).
     Returns max_values[p, 6], each seeded at 1.0 (collection.go:31-38).
     """
-    masked = jnp.where(fits[..., None], cards[None, :, :, :], 0.0)
-    return jnp.maximum(masked.max(axis=(1, 2)), 1.0)
+    return jnp.maximum(local_max_card_values(cards, fits), 1.0)

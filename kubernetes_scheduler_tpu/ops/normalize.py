@@ -17,12 +17,29 @@ import jax.numpy as jnp
 MAX_NODE_SCORE = 100.0
 
 
+def score_bounds(
+    scores: jnp.ndarray, node_mask: jnp.ndarray
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-pod (highest, lowest) over valid nodes, with the reference's
+    seeds: highest starts at 0 (scheduler.go:162) so an all-negative row
+    still normalizes against 0; lowest is seeded from a real node's score.
+    Shapes [p, 1] each. The sharded engine computes these locally and
+    reduces with pmax/pmin before normalizing."""
+    big = jnp.asarray(jnp.finfo(jnp.float32).max, jnp.float32)
+    masked_hi = jnp.where(node_mask[None, :], scores, -big)
+    masked_lo = jnp.where(node_mask[None, :], scores, big)
+    highest = jnp.maximum(masked_hi.max(axis=1, keepdims=True), 0.0)
+    lowest = masked_lo.min(axis=1, keepdims=True)
+    return highest, lowest
+
+
 def min_max_normalize(
     scores: jnp.ndarray,
     node_mask: jnp.ndarray,
     *,
     max_node_score: float = MAX_NODE_SCORE,
     integer_parity: bool = False,
+    bounds: tuple[jnp.ndarray, jnp.ndarray] | None = None,
 ) -> jnp.ndarray:
     """Per-pod min-max rescale to [0, max_node_score] over valid nodes.
 
@@ -33,19 +50,17 @@ def min_max_normalize(
         pkg/yoda/scheduler.go:154) and the rescale at scheduler.go:178 is
         integer division. With this flag the inputs are floored and the
         division truncated, matching the Go path bit-for-bit.
+    bounds: optional precomputed (highest, lowest) [p, 1] pair — the
+        sharded engine passes pmax/pmin-reduced global bounds here.
 
     Padded nodes get 0.
     """
     if integer_parity:
         scores = jnp.floor(scores)
-    big = jnp.asarray(jnp.finfo(jnp.float32).max, jnp.float32)
-    masked_hi = jnp.where(node_mask[None, :], scores, -big)
-    masked_lo = jnp.where(node_mask[None, :], scores, big)
-    # Reference seeds highest with 0 (scheduler.go:162), so an all-negative
-    # score vector still normalizes against highest=0. lowest is seeded with
-    # scores[0] (always a real node upstream).
-    highest = jnp.maximum(masked_hi.max(axis=1, keepdims=True), 0.0)
-    lowest = masked_lo.min(axis=1, keepdims=True)
+    if bounds is not None:
+        highest, lowest = bounds
+    else:
+        highest, lowest = score_bounds(scores, node_mask)
     lowest = jnp.where(highest == lowest, lowest - 1.0, lowest)
     out = (scores - lowest) * max_node_score / (highest - lowest)
     if integer_parity:

@@ -101,17 +101,44 @@ def balanced_diskio(
 
     disk_io: [n] MB/s; r_io: [p]; returns S[p, n] float32.
     """
+    m = balanced_diskio_m(stats, disk_io, r_io)
+    m_hi, m_lo = balanced_diskio_local_bounds(m, node_mask)
+    return balanced_diskio_from_m(m, m_hi, m_lo)
+
+
+def balanced_diskio_m(
+    stats: UtilizationStats, disk_io: jnp.ndarray, r_io: jnp.ndarray
+) -> jnp.ndarray:
+    """The per-(pod, node) Mj statistic (algorithm.go:138-151). Split out so
+    the sharded engine can compute it locally and reduce the bounds with
+    pmax/pmin across node shards."""
     n = stats.n_valid
     t = disk_io[None, :] + r_io[:, None].astype(jnp.float32)  # [p,n]
     f = t / 100.0
     u = stats.u[None, :]
     f_avg = stats.u_avg - (u - f) / n
-    m = stats.m_var - ((u - stats.u_avg) ** 2 - (f - f_avg) ** 2) / n
+    return stats.m_var - ((u - stats.u_avg) ** 2 - (f - f_avg) ** 2) / n
+
+
+def balanced_diskio_local_bounds(
+    m: jnp.ndarray, node_mask: jnp.ndarray
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(m_max, m_min) [p, 1] over valid local nodes, including the
+    reference's sentinel seeds (algorithm.go:122-123: M_max starts at 0,
+    M_min at 1e6)."""
     big = jnp.asarray(jnp.finfo(jnp.float32).max, jnp.float32)
     m_masked_max = jnp.where(node_mask[None, :], m, -big)
     m_masked_min = jnp.where(node_mask[None, :], m, big)
     m_max = jnp.maximum(m_masked_max.max(axis=1, keepdims=True), 0.0)
     m_min = jnp.minimum(m_masked_min.min(axis=1, keepdims=True), 1.0e6)
+    return m_max, m_min
+
+
+def balanced_diskio_from_m(
+    m: jnp.ndarray, m_max: jnp.ndarray, m_min: jnp.ndarray
+) -> jnp.ndarray:
+    """Finish the policy: min-max rescale of Mj to [0, 100]
+    (algorithm.go:163-172)."""
     denom = m_max - m_min
     safe = jnp.where(denom != 0, denom, 1.0)
     return 100.0 - 100.0 * (m - m_min) / safe

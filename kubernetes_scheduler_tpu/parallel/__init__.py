@@ -1,0 +1,13 @@
+"""Mesh construction and the node-axis-sharded scheduling engine.
+
+The "parallelism" of a batch scheduler is the node axis (10k+) and the
+pending-pod axis (5k+): nodes shard across TPU chips over ICI, pods stay
+replicated, and the per-cycle reductions (utilization mean/variance, score
+bounds, global argmax during assignment) become XLA collectives. This is
+the structural cousin of sequence parallelism in an ML framework — a long
+sharded axis with cheap elementwise math and a few collective reductions —
+without any O(N^2) attention term (SURVEY.md §2, §5).
+"""
+
+from kubernetes_scheduler_tpu.parallel.mesh import NODE_AXIS, make_mesh
+from kubernetes_scheduler_tpu.parallel.engine import make_sharded_schedule_fn

@@ -1,0 +1,22 @@
+"""Device-mesh construction.
+
+One logical axis for the scheduler: `node` — the cluster-node dimension is
+sharded across chips (ICI within a slice; DCN only if a snapshot ever spans
+hosts). Built here so every component agrees on axis names.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+NODE_AXIS = "node"
+
+
+def make_mesh(n_devices: int | None = None, *, axis: str = NODE_AXIS) -> Mesh:
+    """1-D mesh over the first `n_devices` devices (default: all)."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (axis,))

@@ -16,3 +16,11 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# This image's sitecustomize registers a TPU PJRT plugin and pins
+# JAX_PLATFORMS=axon before conftest runs, so the env var alone is not
+# enough — override via config before any backend is touched.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.device_count() == 8, "expected 8 virtual CPU devices for sharding tests"

@@ -1,0 +1,139 @@
+"""Engine tests: the full batched cycle, single-device and sharded."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubernetes_scheduler_tpu.engine import PodBatch, SnapshotArrays, schedule_batch
+from kubernetes_scheduler_tpu.parallel import make_mesh, make_sharded_schedule_fn
+from tests import oracle
+
+RNG = np.random.default_rng(3)
+
+
+def random_state(n, p, r=3, c=2, gpu=False):
+    alloc = RNG.integers(4000, 16000, (n, r)).astype(np.float32)
+    reqd = RNG.integers(0, 4000, (n, r)).astype(np.float32)
+    snapshot = SnapshotArrays(
+        allocatable=jnp.asarray(alloc),
+        requested=jnp.asarray(reqd),
+        disk_io=jnp.asarray(RNG.uniform(0, 50, n), jnp.float32),
+        cpu_pct=jnp.asarray(RNG.uniform(0, 100, n), jnp.float32),
+        mem_pct=jnp.asarray(RNG.uniform(0, 100, n), jnp.float32),
+        net_up=jnp.asarray(RNG.uniform(0, 10, n), jnp.float32),
+        net_down=jnp.asarray(RNG.uniform(0, 10, n), jnp.float32),
+        node_mask=jnp.ones(n, bool),
+        cards=jnp.asarray(RNG.integers(1, 1000, (n, c, 6)), jnp.float32),
+        card_mask=jnp.asarray(RNG.random((n, c)) > 0.3),
+        card_healthy=jnp.asarray(RNG.random((n, c)) > 0.2),
+    )
+    pods = PodBatch(
+        request=jnp.asarray(RNG.integers(100, 3000, (p, r)), jnp.float32),
+        r_io=jnp.asarray(RNG.uniform(0, 40, p), jnp.float32),
+        priority=jnp.asarray(RNG.integers(0, 10, p), jnp.int32),
+        pod_mask=jnp.ones(p, bool),
+        want_number=jnp.asarray(
+            RNG.integers(0, 3, p) if gpu else np.zeros(p), jnp.int32
+        ),
+        want_memory=jnp.full((p,), -1.0, jnp.float32),
+        want_clock=jnp.full((p,), -1.0, jnp.float32),
+    )
+    return snapshot, pods
+
+
+def test_schedule_batch_end_to_end():
+    snapshot, pods = random_state(32, 10)
+    res = schedule_batch(snapshot, pods)
+    idx = np.asarray(res.node_idx)
+    # every assigned pod's node was feasible
+    feas = np.asarray(res.feasible)
+    for i, j in enumerate(idx):
+        if j >= 0:
+            assert feas[i, j]
+    # capacity respected
+    free = np.asarray(snapshot.allocatable - snapshot.requested)
+    used = np.zeros_like(free)
+    for i, j in enumerate(idx):
+        if j >= 0:
+            used[j] += np.asarray(pods.request)[i]
+    assert (used <= free + 1e-3).all()
+
+
+def test_schedule_batch_matches_scalar_oracle_pipeline():
+    """The engine's assignment equals the scalar oracle run on the engine's
+    own (oracle-verified) score/feasibility matrices."""
+    snapshot, pods = random_state(24, 8)
+    res = schedule_batch(snapshot, pods)
+    want = oracle.greedy_assign_oracle(
+        np.asarray(res.scores).tolist(),
+        np.asarray(res.feasible).tolist(),
+        np.asarray(pods.request).tolist(),
+        np.asarray(
+            jnp.where(snapshot.node_mask[:, None],
+                      snapshot.allocatable - snapshot.requested, 0.0)
+        ).tolist(),
+        np.asarray(pods.priority).tolist(),
+    )
+    assert np.asarray(res.node_idx).tolist() == want
+
+
+@pytest.mark.parametrize("policy", ["balanced_cpu_diskio", "balanced_diskio", "free_capacity", "card"])
+def test_sharded_engine_matches_single_device(policy):
+    assert jax.device_count() == 8, "conftest must force 8 cpu devices"
+    n, p = 64, 6
+    snapshot, pods = random_state(n, p, gpu=(policy == "card"))
+    single = schedule_batch(snapshot, pods, policy=policy)
+    mesh = make_mesh(8)
+    sharded_fn = make_sharded_schedule_fn(mesh, policy=policy)
+    sharded = sharded_fn(snapshot, pods)
+    # psum/pmax reduce in a different order than a single-device sum, so
+    # float32 results agree only to ~1e-3 absolute.
+    np.testing.assert_allclose(
+        np.asarray(sharded.raw_scores), np.asarray(single.raw_scores),
+        rtol=1e-4, atol=2e-3,
+    )
+    np.testing.assert_allclose(
+        np.asarray(sharded.scores), np.asarray(single.scores),
+        rtol=1e-4, atol=2e-3,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(sharded.feasible), np.asarray(single.feasible)
+    )
+    assert np.asarray(sharded.node_idx).tolist() == np.asarray(single.node_idx).tolist()
+    np.testing.assert_allclose(
+        np.asarray(sharded.free_after), np.asarray(single.free_after), atol=1e-3
+    )
+
+
+@pytest.mark.parametrize("normalizer", ["softmax", "none"])
+def test_sharded_normalizers_match_single_device(normalizer):
+    snapshot, pods = random_state(64, 6)
+    single = schedule_batch(snapshot, pods, normalizer=normalizer)
+    sharded = make_sharded_schedule_fn(make_mesh(8), normalizer=normalizer)(
+        snapshot, pods
+    )
+    np.testing.assert_allclose(
+        np.asarray(sharded.scores), np.asarray(single.scores),
+        rtol=1e-4, atol=1e-5,
+    )
+    assert np.asarray(sharded.node_idx).tolist() == np.asarray(single.node_idx).tolist()
+
+
+def test_sharded_engine_padded_nodes():
+    """Real node count not divisible by the mesh: padding spread across
+    shards must not change results."""
+    n_real, n_pad, p = 50, 64, 5
+    snapshot, pods = random_state(n_pad, p)
+    mask = np.zeros(n_pad, bool)
+    mask[:n_real] = True
+    snapshot = snapshot._replace(node_mask=jnp.asarray(mask))
+    single = schedule_batch(snapshot, pods)
+    sharded = make_sharded_schedule_fn(make_mesh(8))(snapshot, pods)
+    assert np.asarray(sharded.node_idx).tolist() == np.asarray(single.node_idx).tolist()
+    np.testing.assert_allclose(
+        np.asarray(sharded.scores)[:, :n_real],
+        np.asarray(single.scores)[:, :n_real],
+        rtol=1e-5, atol=1e-4,
+    )
+    assert (np.asarray(sharded.node_idx) < n_real).all()

@@ -36,7 +36,17 @@ from kubernetes_scheduler_tpu.ops import (
     resource_fit,
     utilization_stats,
 )
-from kubernetes_scheduler_tpu.ops.assign import AssignResult, auction_assign, greedy_assign
+from kubernetes_scheduler_tpu.ops.assign import (
+    AffinityState,
+    AssignResult,
+    auction_assign,
+    greedy_assign,
+)
+from kubernetes_scheduler_tpu.ops.constraints import (
+    node_affinity_fit,
+    pod_affinity_fit,
+    taint_toleration_fit,
+)
 from kubernetes_scheduler_tpu.ops.normalize import softmax_normalize
 
 POLICIES = ("balanced_cpu_diskio", "balanced_diskio", "free_capacity", "card")
@@ -64,6 +74,13 @@ class SnapshotArrays(NamedTuple):
     cards: jnp.ndarray         # [n, c, 6] float32
     card_mask: jnp.ndarray     # [n, c] bool
     card_healthy: jnp.ndarray  # [n, c] bool
+    # constraint state (ops/constraints.py encodings; empty via make_snapshot)
+    taints: jnp.ndarray           # [n, T, 3] int32 (key, value, effect)
+    taint_mask: jnp.ndarray       # [n, T] bool
+    node_labels: jnp.ndarray      # [n, Ln, 2] int32 (key, value)
+    node_label_mask: jnp.ndarray  # [n, Ln] bool
+    domain_counts: jnp.ndarray    # [n, S] float32 selector match counts
+    domain_id: jnp.ndarray        # [n, S] int32 topology-domain id per selector
 
 
 class PodBatch(NamedTuple):
@@ -76,6 +93,146 @@ class PodBatch(NamedTuple):
     want_number: jnp.ndarray  # [p] int32 (0 = no GPU demand)
     want_memory: jnp.ndarray  # [p] float32 (-1 = label absent)
     want_clock: jnp.ndarray   # [p] float32 (-1 = label absent)
+    # constraint demands (ops/constraints.py encodings; empty via make_pod_batch)
+    tolerations: jnp.ndarray       # [p, L, 4] int32 (key, value, op, effect)
+    tol_mask: jnp.ndarray          # [p, L] bool
+    na_key: jnp.ndarray            # [p, E] int32 node-affinity expr keys
+    na_op: jnp.ndarray             # [p, E] int32 (In/NotIn/Exists/DoesNotExist)
+    na_vals: jnp.ndarray           # [p, E, V] int32 value-id sets
+    na_val_mask: jnp.ndarray       # [p, E, V] bool
+    na_mask: jnp.ndarray           # [p, E] bool
+    affinity_sel: jnp.ndarray      # [p, K] int32 selector ids, -1 pad
+    anti_affinity_sel: jnp.ndarray  # [p, K] int32 selector ids, -1 pad
+    pod_matches: jnp.ndarray       # [p, S] bool — pod's labels match selector s
+
+
+def make_snapshot(
+    allocatable,
+    requested,
+    disk_io,
+    cpu_pct,
+    mem_pct,
+    *,
+    net_up=None,
+    net_down=None,
+    node_mask=None,
+    cards=None,
+    card_mask=None,
+    card_healthy=None,
+    taints=None,
+    taint_mask=None,
+    node_labels=None,
+    node_label_mask=None,
+    domain_counts=None,
+    domain_id=None,
+) -> SnapshotArrays:
+    """SnapshotArrays with no-op defaults for everything optional (no cards,
+    no taints, no labels, no selector counts)."""
+    n = allocatable.shape[0]
+    z = lambda *shape: jnp.zeros(shape, jnp.float32)  # noqa: E731
+    zi = lambda *shape: jnp.zeros(shape, jnp.int32)  # noqa: E731
+    zb = lambda *shape: jnp.zeros(shape, bool)  # noqa: E731
+    return SnapshotArrays(
+        allocatable=jnp.asarray(allocatable, jnp.float32),
+        requested=jnp.asarray(requested, jnp.float32),
+        disk_io=jnp.asarray(disk_io, jnp.float32),
+        cpu_pct=jnp.asarray(cpu_pct, jnp.float32),
+        mem_pct=jnp.asarray(mem_pct, jnp.float32),
+        net_up=z(n) if net_up is None else jnp.asarray(net_up, jnp.float32),
+        net_down=z(n) if net_down is None else jnp.asarray(net_down, jnp.float32),
+        node_mask=jnp.ones(n, bool) if node_mask is None else jnp.asarray(node_mask, bool),
+        cards=z(n, 1, 6) if cards is None else jnp.asarray(cards, jnp.float32),
+        # a provided payload with an omitted mask defaults to all-valid —
+        # a zero-mask default would silently disable the constraint
+        card_mask=(
+            (zb(n, 1) if cards is None else jnp.ones(jnp.asarray(cards).shape[:2], bool))
+            if card_mask is None else jnp.asarray(card_mask, bool)
+        ),
+        card_healthy=(
+            (zb(n, 1) if cards is None else jnp.ones(jnp.asarray(cards).shape[:2], bool))
+            if card_healthy is None else jnp.asarray(card_healthy, bool)
+        ),
+        taints=zi(n, 1, 3) if taints is None else jnp.asarray(taints, jnp.int32),
+        taint_mask=(
+            (zb(n, 1) if taints is None else jnp.ones(jnp.asarray(taints).shape[:2], bool))
+            if taint_mask is None else jnp.asarray(taint_mask, bool)
+        ),
+        node_labels=zi(n, 1, 2) if node_labels is None else jnp.asarray(node_labels, jnp.int32),
+        node_label_mask=(
+            (zb(n, 1) if node_labels is None
+             else jnp.ones(jnp.asarray(node_labels).shape[:2], bool))
+            if node_label_mask is None else jnp.asarray(node_label_mask, bool)
+        ),
+        domain_counts=z(n, 1) if domain_counts is None else jnp.asarray(domain_counts, jnp.float32),
+        # default: every node its own domain (hostname topology)
+        domain_id=(
+            jnp.broadcast_to(
+                jnp.arange(n, dtype=jnp.int32)[:, None],
+                (n, 1 if domain_counts is None else jnp.asarray(domain_counts).shape[1]),
+            )
+            if domain_id is None
+            else jnp.asarray(domain_id, jnp.int32)
+        ),
+    )
+
+
+def make_pod_batch(
+    request,
+    *,
+    r_io=None,
+    priority=None,
+    pod_mask=None,
+    want_number=None,
+    want_memory=None,
+    want_clock=None,
+    tolerations=None,
+    tol_mask=None,
+    na_key=None,
+    na_op=None,
+    na_vals=None,
+    na_val_mask=None,
+    na_mask=None,
+    affinity_sel=None,
+    anti_affinity_sel=None,
+    pod_matches=None,
+) -> PodBatch:
+    """PodBatch with no-op defaults (no GPU demand, no tolerations, no
+    affinity requirements)."""
+    p = request.shape[0]
+    z = lambda *shape: jnp.zeros(shape, jnp.float32)  # noqa: E731
+    zi = lambda *shape: jnp.zeros(shape, jnp.int32)  # noqa: E731
+    zb = lambda *shape: jnp.zeros(shape, bool)  # noqa: E731
+    return PodBatch(
+        request=jnp.asarray(request, jnp.float32),
+        r_io=z(p) if r_io is None else jnp.asarray(r_io, jnp.float32),
+        priority=zi(p) if priority is None else jnp.asarray(priority, jnp.int32),
+        pod_mask=jnp.ones(p, bool) if pod_mask is None else jnp.asarray(pod_mask, bool),
+        want_number=zi(p) if want_number is None else jnp.asarray(want_number, jnp.int32),
+        want_memory=jnp.full((p,), -1.0, jnp.float32) if want_memory is None else jnp.asarray(want_memory, jnp.float32),
+        want_clock=jnp.full((p,), -1.0, jnp.float32) if want_clock is None else jnp.asarray(want_clock, jnp.float32),
+        tolerations=zi(p, 1, 4) if tolerations is None else jnp.asarray(tolerations, jnp.int32),
+        tol_mask=(
+            (zb(p, 1) if tolerations is None
+             else jnp.ones(jnp.asarray(tolerations).shape[:2], bool))
+            if tol_mask is None else jnp.asarray(tol_mask, bool)
+        ),
+        na_key=zi(p, 1) if na_key is None else jnp.asarray(na_key, jnp.int32),
+        na_op=zi(p, 1) if na_op is None else jnp.asarray(na_op, jnp.int32),
+        na_vals=zi(p, 1, 1) if na_vals is None else jnp.asarray(na_vals, jnp.int32),
+        na_val_mask=(
+            (zb(p, 1, 1) if na_vals is None
+             else jnp.ones(jnp.asarray(na_vals).shape, bool))
+            if na_val_mask is None else jnp.asarray(na_val_mask, bool)
+        ),
+        na_mask=(
+            (zb(p, 1) if na_key is None
+             else jnp.ones(jnp.asarray(na_key).shape, bool))
+            if na_mask is None else jnp.asarray(na_mask, bool)
+        ),
+        affinity_sel=jnp.full((p, 1), -1, jnp.int32) if affinity_sel is None else jnp.asarray(affinity_sel, jnp.int32),
+        anti_affinity_sel=jnp.full((p, 1), -1, jnp.int32) if anti_affinity_sel is None else jnp.asarray(anti_affinity_sel, jnp.int32),
+        pod_matches=zb(p, 1) if pod_matches is None else jnp.asarray(pod_matches, bool),
+    )
 
 
 class ScheduleResult(NamedTuple):
@@ -113,9 +270,21 @@ def compute_scores(
     raise ValueError(f"unknown policy {policy!r}; expected one of {POLICIES}")
 
 
-def compute_feasibility(snapshot: SnapshotArrays, pods: PodBatch) -> jnp.ndarray:
+def compute_feasibility(
+    snapshot: SnapshotArrays,
+    pods: PodBatch,
+    *,
+    include_pod_affinity: bool = True,
+) -> jnp.ndarray:
     """All filter masks ANDed: resource fit (NodeResourcesFit semantics,
-    algorithm.go:209-262) and GPU-card predicates (filter.go:11-58)."""
+    algorithm.go:209-262), GPU-card predicates (filter.go:11-58),
+    taint/toleration, node affinity, and inter-pod (anti)affinity
+    (ops/constraints.py; capabilities required by BASELINE.md config 4).
+
+    include_pod_affinity=False leaves inter-pod (anti)affinity out of the
+    static mask: the greedy assigner evaluates it dynamically per placement
+    (ops/assign.py AffinityState) so pods within one window see each
+    other's placements, exactly like upstream's per-pod re-snapshot."""
     fits = resource_fit(
         snapshot.allocatable, snapshot.requested, pods.request, snapshot.node_mask
     )
@@ -123,7 +292,19 @@ def compute_feasibility(snapshot: SnapshotArrays, pods: PodBatch) -> jnp.ndarray
         snapshot.cards, snapshot.card_mask, snapshot.card_healthy,
         pods.want_number, pods.want_memory, pods.want_clock,
     )
-    return fits & gpu_fits & pods.pod_mask[:, None]
+    taint_ok = taint_toleration_fit(
+        snapshot.taints, snapshot.taint_mask, pods.tolerations, pods.tol_mask
+    )
+    na_ok = node_affinity_fit(
+        snapshot.node_labels, snapshot.node_label_mask,
+        pods.na_key, pods.na_op, pods.na_vals, pods.na_val_mask, pods.na_mask,
+    )
+    out = fits & gpu_fits & taint_ok & na_ok & pods.pod_mask[:, None]
+    if include_pod_affinity:
+        out = out & pod_affinity_fit(
+            snapshot.domain_counts, pods.affinity_sel, pods.anti_affinity_sel
+        )
+    return out
 
 
 def compute_free_capacity(snapshot: SnapshotArrays) -> jnp.ndarray:
@@ -146,9 +327,17 @@ def schedule_batch(
     assigner: str = "greedy",
     normalizer: str = "min_max",
 ) -> ScheduleResult:
-    """One scheduling cycle for the whole pending window, on device."""
+    """One scheduling cycle for the whole pending window, on device.
+
+    Inter-pod (anti)affinity within the window is exact on the greedy
+    path (dynamic AffinityState). The auction path applies it statically
+    against pre-window counts only — callers with window-internal selector
+    interactions should use greedy (host.scheduler enforces this).
+    """
     raw = compute_scores(snapshot, pods, policy)
-    feasible = compute_feasibility(snapshot, pods)
+    feasible = compute_feasibility(
+        snapshot, pods, include_pod_affinity=(assigner != "greedy")
+    )
     if normalizer == "min_max":
         norm = min_max_normalize(raw, snapshot.node_mask)
     elif normalizer == "softmax":
@@ -159,10 +348,21 @@ def schedule_batch(
         raise ValueError(f"unknown normalizer {normalizer!r}")
 
     free = compute_free_capacity(snapshot)
-    assign_fn = {"greedy": greedy_assign, "auction": auction_assign}[assigner]
-    res: AssignResult = assign_fn(
-        norm, feasible, pods.request, free, pods.priority, pods.pod_mask
-    )
+    if assigner == "greedy":
+        res: AssignResult = greedy_assign(
+            norm, feasible, pods.request, free, pods.priority, pods.pod_mask,
+            affinity=AffinityState(
+                domain_counts=snapshot.domain_counts,
+                domain_id=snapshot.domain_id,
+                pod_matches=pods.pod_matches,
+                affinity_sel=pods.affinity_sel,
+                anti_affinity_sel=pods.anti_affinity_sel,
+            ),
+        )
+    else:
+        res = auction_assign(
+            norm, feasible, pods.request, free, pods.priority, pods.pod_mask
+        )
     return ScheduleResult(
         node_idx=res.node_idx,
         scores=norm,

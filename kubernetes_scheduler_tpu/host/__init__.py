@@ -1,0 +1,23 @@
+"""Host layer: everything between the cluster and the device engine.
+
+The reference splits this across the upstream kube-scheduler framework
+(queue, snapshot, binding cycle) and its plugin (pkg/yoda). Here the host
+layer owns:
+
+- typed cluster objects (types.py) standing in for the k8s API objects,
+- string-interning snapshot builders producing the dense arrays the engine
+  consumes (snapshot.py),
+- the metrics advisor scraping Prometheus (advisor.py),
+- the per-cycle cache that replaces Redis (cache.py),
+- the priority scheduling queue with retry backoff (queue.py),
+- the extension-point plugin surface and the scalar fallback path
+  (plugins.py),
+- the scheduling loop that ties it together (scheduler.py).
+"""
+
+from kubernetes_scheduler_tpu.host.types import Card, Container, Node, Pod, Taint, Toleration
+from kubernetes_scheduler_tpu.host.snapshot import SnapshotBuilder
+from kubernetes_scheduler_tpu.host.advisor import NodeUtil, PrometheusAdvisor, StaticAdvisor
+from kubernetes_scheduler_tpu.host.cache import CycleCache
+from kubernetes_scheduler_tpu.host.queue import SchedulingQueue
+from kubernetes_scheduler_tpu.host.scheduler import Scheduler

@@ -1,0 +1,134 @@
+"""Metrics advisor: the node-utilization sensory input.
+
+Keeps the reference's design (pkg/yoda/advisor/advisor.go) — five PromQL
+instant queries joined by hostname into one record per node — but fixes
+its pathologies:
+
+- the Prometheus host is configuration, not a hard-coded constant
+  (advisor.go:15);
+- one fetch per scheduling cycle for the whole batch, not 5 HTTP calls per
+  (pod, node) score invocation (scheduler.go:126 calls res.Init() per
+  node);
+- the result is a dense array block ready for device upload, not a
+  map walked per node;
+- transport is injectable, so tests run hermetically (the reference's
+  tests hit the production endpoints, advisor_test.go:8-18).
+
+Join semantics preserved: series keyed by `kubernetes_io_hostname` with
+`instance` as fallback (advisor.go:199-202); nodes missing from a series
+keep zeros rather than failing the cycle (advisor.go:190,213 skip
+silently); network-IO fetch errors degrade to zeros instead of failing
+scheduling (advisor.go:219,242 swallow errors).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.parse
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Callable
+
+# The five instant queries, functionally equivalent to advisor.go:16-20:
+# per-node CPU%, memory%, disk-IO MB/s, network transmit/receive MB/s.
+PROM_QUERIES = {
+    "cpu_pct": (
+        'sum by (kubernetes_io_hostname, instance)'
+        '(rate(container_cpu_usage_seconds_total{image!="",pod!=""}[1m]) * 100)'
+    ),
+    "mem_pct": (
+        "(node_memory_MemTotal_bytes-node_memory_MemFree_bytes"
+        "-node_memory_Buffers_bytes-node_memory_Cached_bytes)"
+        '/node_memory_MemTotal_bytes{kubernetes_io_hostname!=""} * 100'
+    ),
+    "disk_io": (
+        '(rate(node_disk_read_bytes_total{device="vda"}[1m]) '
+        '+ rate(node_disk_written_bytes_total{device="vda"}[1m])) /1024/1024'
+    ),
+    "net_up": (
+        "sum by (kubernetes_io_hostname,instance) "
+        '(rate (node_network_transmit_bytes_total{kubernetes_io_hostname!=""}[1m]))'
+        "/1024/1024"
+    ),
+    "net_down": (
+        "sum by (kubernetes_io_hostname,instance)"
+        '(rate (node_network_receive_bytes_total{kubernetes_io_hostname!=""}[1m]))'
+        "/1024/1024"
+    ),
+}
+
+# net_up/net_down failures degrade to zeros (advisor.go:219,242); the other
+# three fail the cycle like the reference's PreScore error path
+# (scheduler.go:106-109).
+SOFT_FAIL_SERIES = {"net_up", "net_down"}
+
+
+@dataclass
+class NodeUtil:
+    cpu_pct: float = 0.0
+    mem_pct: float = 0.0
+    disk_io: float = 0.0
+    net_up: float = 0.0
+    net_down: float = 0.0
+
+
+Transport = Callable[[str, dict], dict]
+
+
+def _urllib_transport(url: str, form: dict) -> dict:
+    data = urllib.parse.urlencode(form).encode()
+    with urllib.request.urlopen(url, data=data, timeout=10) as resp:
+        return json.load(resp)
+
+
+class PrometheusAdvisor:
+    """Scrapes the five series and joins them into {node: NodeUtil}."""
+
+    def __init__(self, host: str, *, transport: Transport | None = None):
+        self.host = host
+        self.transport = transport or _urllib_transport
+
+    def _fetch_series(self, query: str) -> dict[str, float]:
+        payload = self.transport(
+            f"http://{self.host}/api/v1/query", {"query": query}
+        )
+        out: dict[str, float] = {}
+        for item in payload.get("data", {}).get("result", []):
+            metric = item.get("metric", {})
+            # join key: kubernetes_io_hostname, falling back to instance
+            key = metric.get("kubernetes_io_hostname") or metric.get("instance")
+            if not key:
+                continue
+            value = item.get("value", [None, None])[1]
+            try:
+                out[key] = float(value)
+            except (TypeError, ValueError):
+                continue
+        return out
+
+    def fetch(self) -> dict[str, NodeUtil]:
+        series: dict[str, dict[str, float]] = {}
+        for name, query in PROM_QUERIES.items():
+            try:
+                series[name] = self._fetch_series(query)
+            except Exception:
+                if name in SOFT_FAIL_SERIES:
+                    series[name] = {}
+                else:
+                    raise
+        nodes: dict[str, NodeUtil] = {}
+        for name, values in series.items():
+            for host, v in values.items():
+                nodes.setdefault(host, NodeUtil())
+                setattr(nodes[host], name, v)
+        return nodes
+
+
+@dataclass
+class StaticAdvisor:
+    """Hermetic advisor for tests and simulation."""
+
+    utils: dict[str, NodeUtil] = field(default_factory=dict)
+
+    def fetch(self) -> dict[str, NodeUtil]:
+        return self.utils

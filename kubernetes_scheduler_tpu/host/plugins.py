@@ -1,0 +1,178 @@
+"""Extension-point plugin surface + the scalar fallback scoring path.
+
+The reference implements six scheduling-framework extension points
+(pkg/yoda/scheduler.go:26-31: PreFilter, Filter, PreScore, Score,
+NormalizeScore via ScoreExtensions, PreBind). This module keeps that
+surface — so behavior stays auditable hook-by-hook against the reference —
+and provides `ScalarYodaPlugin`, a pure-Python implementation with the
+same per-pod/per-node call pattern. It is the `TPUBatchScore=false`
+fallback: no device, no batching, same answers; its per-cycle statistics
+memoization uses CycleCache where the reference used Redis.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Protocol
+
+from kubernetes_scheduler_tpu.host.advisor import NodeUtil
+from kubernetes_scheduler_tpu.host.cache import CycleCache
+from kubernetes_scheduler_tpu.host.snapshot import parse_float_or_zero, pod_resource_request
+from kubernetes_scheduler_tpu.host.types import Node, Pod
+
+MAX_NODE_SCORE = 100.0
+
+
+@dataclass
+class CycleState:
+    """Per-pod scratch, the framework.CycleState analog (scheduler.go:105)."""
+
+    data: dict = field(default_factory=dict)
+
+    def write(self, key, value):
+        self.data[key] = value
+
+    def read(self, key):
+        return self.data[key]
+
+
+class SchedulerPlugin(Protocol):
+    def pre_filter(self, state: CycleState, pod: Pod) -> None: ...
+    def filter(self, state: CycleState, pod: Pod, node: Node) -> bool: ...
+    def pre_score(self, state: CycleState, pod: Pod, nodes: list[Node]) -> None: ...
+    def score(self, state: CycleState, pod: Pod, node: Node) -> float: ...
+    def normalize_scores(
+        self, state: CycleState, pod: Pod, scores: dict[str, float]
+    ) -> dict[str, float]: ...
+    def pre_bind(self, state: CycleState, pod: Pod, node_name: str) -> None: ...
+
+
+class ScalarYodaPlugin:
+    """The reference's plugin behavior, hook for hook, without the network.
+
+    - pre_filter / filter: log-only pass-through (scheduler.go:91-99 —
+      every node passes; real filtering happens in the engine path).
+    - pre_score: advisor snapshot into CycleState + cache flush
+      (scheduler.go:101-113).
+    - score: per-cycle statistics computed once and memoized (the
+      algorithm.go:47-97 structure, with CycleCache replacing Redis) then
+      the live BalancedCpuDiskIO formula (algorithm.go:99-119).
+    - normalize_scores: min-max to [0, 100] with the highest==lowest guard
+      (scheduler.go:158-183).
+    - pre_bind: snapshot existence check (scheduler.go:189-196).
+    """
+
+    def __init__(self, utils: dict[str, NodeUtil], *, truncate: bool = True):
+        self.utils = utils
+        self.cache = CycleCache()
+        self.truncate = truncate
+
+    def pre_filter(self, state, pod):
+        return None
+
+    def filter(self, state, pod, node):
+        return True
+
+    def pre_score(self, state, pod, nodes):
+        self.cache.flush()
+        state.write("nodeInfo", {n.name: self.utils.get(n.name, NodeUtil()) for n in nodes})
+
+    def _ensure_stats(self, state, nodes: list[Node]):
+        if "U-AVG" in self.cache:
+            return
+        info = state.read("nodeInfo")
+        u_sum = 0.0
+        for n in nodes:
+            u = info[n.name].disk_io / 50.0
+            v = info[n.name].cpu_pct / 100.0
+            self.cache.set(f"U-{n.name}", u)
+            self.cache.set(f"V-{n.name}", v)
+            u_sum += u
+        u_avg = u_sum / len(nodes)
+        m_tmp = sum(
+            (self.cache.get(f"U-{n.name}") - u_avg) ** 2 for n in nodes
+        ) / len(nodes)
+        self.cache.set("U-AVG", u_avg)
+        self.cache.set("M-tmp", m_tmp)
+        self.cache.set("nodeLen", len(nodes))
+
+    def score(self, state, pod, node, *, all_nodes: list[Node] | None = None):
+        nodes = all_nodes or [node]
+        memo = self.cache.get(f"S-{node.name}")
+        if memo is not None:
+            return memo
+        self._ensure_stats(state, nodes)
+        r_io = parse_float_or_zero(pod.annotations.get("diskIO"))
+        r_cpu = pod_resource_request(pod, "cpu")
+        beta = 1.0 / (1.0 + r_cpu / r_io) if r_io > 0 else 0.0
+        alpha = 1.0 - beta
+        result = 0.0
+        for n in nodes:
+            u = self.cache.get(f"U-{n.name}")
+            v = self.cache.get(f"V-{n.name}")
+            load = abs(alpha * v - beta * u)
+            s = 10.0 - 10.0 * load
+            if self.truncate:  # uint64() truncation, algorithm.go:113
+                s = float(int(s)) if s >= 0 else 0.0
+            self.cache.set(f"S-{n.name}", s)
+            if n.name == node.name:
+                result = s
+        return result
+
+    def normalize_scores(self, state, pod, scores):
+        self.cache.flush()
+        highest = max(0.0, *scores.values()) if scores else 0.0
+        lowest = min(scores.values()) if scores else 0.0
+        if highest == lowest:
+            lowest -= 1.0
+        return {
+            name: (s - lowest) * MAX_NODE_SCORE / (highest - lowest)
+            for name, s in scores.items()
+        }
+
+    def pre_bind(self, state, pod, node_name):
+        return None
+
+
+def scalar_schedule_one(
+    plugin: ScalarYodaPlugin,
+    pod: Pod,
+    nodes: list[Node],
+    free: dict[str, dict[str, float]],
+) -> str | None:
+    """One full upstream-style scheduling cycle for one pod: the hook
+    sequence of SURVEY.md §3.2, with real resource-fit filtering and
+    capacity bookkeeping (which upstream's NodeResourcesFit + binding cycle
+    provide around the reference plugin)."""
+    state = CycleState()
+    plugin.pre_filter(state, pod)
+    plugin.pre_score(state, pod, nodes)
+    feasible = []
+    for node in nodes:
+        if not plugin.filter(state, pod, node):
+            continue
+        ok = True
+        for res, avail in free[node.name].items():
+            req = pod_resource_request(pod, res)
+            if req > 0 and req > avail:
+                ok = False
+                break
+        if ok:
+            feasible.append(node)
+    if not feasible:
+        return None
+    scores = {
+        n.name: plugin.score(state, pod, n, all_nodes=nodes) for n in feasible
+    }
+    scores = plugin.normalize_scores(state, pod, scores)
+    # deterministic argmax: highest score, first in node order on ties
+    best = None
+    best_s = -math.inf
+    for n in feasible:
+        if scores[n.name] > best_s:
+            best, best_s = n.name, scores[n.name]
+    plugin.pre_bind(state, pod, best)
+    for res in free[best]:
+        free[best][res] -= pod_resource_request(pod, res)
+    return best

@@ -1,0 +1,86 @@
+"""Scheduling queue: priority ordering + retry backoff.
+
+Reproduces the two queue behaviors the reference relies on:
+- priority ordering by the `scv/priority` label, higher first (the
+  QueueSort comparator the reference defines but never registers,
+  pkg/yoda/sort/sort.go:8-18) with FIFO order among equals;
+- unschedulable pods retry with exponential backoff between
+  podInitialBackoffSeconds=1 and podMaxBackoffSeconds=10
+  (deploy/yoda-scheduler.yaml:19-20).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import time
+from dataclasses import dataclass, field
+
+from kubernetes_scheduler_tpu.host.types import Pod
+
+
+def pod_priority(pod: Pod) -> int:
+    """sort.go:12-18: integer `scv/priority` label, 0 when absent/garbage."""
+    try:
+        return int(pod.labels.get("scv/priority", 0))
+    except (TypeError, ValueError):
+        return 0
+
+
+@dataclass(order=True)
+class _Entry:
+    sort_key: tuple
+    pod: Pod = field(compare=False)
+
+
+class SchedulingQueue:
+    def __init__(
+        self,
+        *,
+        initial_backoff: float = 1.0,
+        max_backoff: float = 10.0,
+        clock=time.monotonic,
+    ):
+        self._active: list[_Entry] = []
+        self._backoff: list[tuple[float, int, Pod]] = []  # (ready_at, seq, pod)
+        self._attempts: dict[str, int] = {}
+        self._seq = itertools.count()
+        self.initial_backoff = initial_backoff
+        self.max_backoff = max_backoff
+        self._clock = clock
+
+    def _key(self, pod: Pod) -> tuple:
+        return (-pod_priority(pod), next(self._seq))
+
+    def push(self, pod: Pod) -> None:
+        heapq.heappush(self._active, _Entry(self._key(pod), pod))
+
+    def requeue_unschedulable(self, pod: Pod) -> None:
+        """Failed cycle -> backoff queue with exponential delay."""
+        uid = f"{pod.namespace}/{pod.name}"
+        attempt = self._attempts.get(uid, 0) + 1
+        self._attempts[uid] = attempt
+        delay = min(self.initial_backoff * 2 ** (attempt - 1), self.max_backoff)
+        heapq.heappush(
+            self._backoff, (self._clock() + delay, next(self._seq), pod)
+        )
+
+    def mark_scheduled(self, pod: Pod) -> None:
+        self._attempts.pop(f"{pod.namespace}/{pod.name}", None)
+
+    def _drain_backoff(self) -> None:
+        now = self._clock()
+        while self._backoff and self._backoff[0][0] <= now:
+            _, _, pod = heapq.heappop(self._backoff)
+            self.push(pod)
+
+    def pop_window(self, max_pods: int) -> list[Pod]:
+        """Highest-priority window of pending pods for one engine cycle."""
+        self._drain_backoff()
+        out = []
+        while self._active and len(out) < max_pods:
+            out.append(heapq.heappop(self._active).pod)
+        return out
+
+    def __len__(self) -> int:
+        return len(self._active) + len(self._backoff)

@@ -1,0 +1,195 @@
+"""The scheduling loop: queue -> snapshot -> engine -> bind.
+
+This is the layer the reference gets for free from the embedded upstream
+kube-scheduler (SURVEY.md §1: queue, node snapshot, binding cycle, leader
+election) — rebuilt around batching: instead of one pod per cycle with a
+per-node plugin fan-out, each cycle pops a priority-ordered window of
+pending pods, builds one dense snapshot, runs one device program, and
+emits all bindings.
+
+Fallback: with feature gate tpu_batch_score=False (the design's
+`--feature-gates=TPUBatchScore=false`) the loop runs the scalar per-pod
+plugin path (host/plugins.py) — same scheduling decisions, no device —
+which is also the recovery path if the device is unreachable: an engine
+failure flips one cycle to scalar rather than stalling scheduling.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+import numpy as np
+
+from kubernetes_scheduler_tpu.engine import schedule_batch
+from kubernetes_scheduler_tpu.host.advisor import NodeUtil
+from kubernetes_scheduler_tpu.host.plugins import ScalarYodaPlugin, scalar_schedule_one
+from kubernetes_scheduler_tpu.host.queue import SchedulingQueue
+from kubernetes_scheduler_tpu.host.snapshot import SnapshotBuilder, pod_resource_request
+from kubernetes_scheduler_tpu.host.types import Node, Pod
+from kubernetes_scheduler_tpu.utils.config import SchedulerConfig
+
+log = logging.getLogger("yoda_tpu.scheduler")
+
+
+@dataclass
+class Binding:
+    pod: Pod
+    node_name: str
+
+
+class RecordingBinder:
+    """Binder for simulation/tests; a k8s binder would POST
+    pods/<p>/binding here (the process boundary at SURVEY.md §3.2)."""
+
+    def __init__(self):
+        self.bindings: list[Binding] = []
+
+    def bind(self, pod: Pod, node_name: str) -> None:
+        pod.node_name = node_name
+        self.bindings.append(Binding(pod, node_name))
+
+
+@dataclass
+class CycleMetrics:
+    """Per-cycle observability (SURVEY.md §5: the reference exports
+    nothing; we track the north-star numbers)."""
+
+    pods_in: int = 0
+    pods_bound: int = 0
+    pods_unschedulable: int = 0
+    cycle_seconds: float = 0.0
+    engine_seconds: float = 0.0
+    used_fallback: bool = False
+
+
+class Scheduler:
+    def __init__(
+        self,
+        config: SchedulerConfig,
+        *,
+        advisor,
+        binder=None,
+        list_nodes: Callable[[], list[Node]],
+        list_running_pods: Callable[[], list[Pod]],
+    ):
+        self.config = config
+        self.advisor = advisor
+        self.binder = binder or RecordingBinder()
+        self.list_nodes = list_nodes
+        self.list_running_pods = list_running_pods
+        self.queue = SchedulingQueue(
+            initial_backoff=config.initial_backoff_seconds,
+            max_backoff=config.max_backoff_seconds,
+        )
+        self.builder = SnapshotBuilder(
+            extended_resources=list(config.extended_resources)
+        )
+        self.metrics: list[CycleMetrics] = []
+
+    def submit(self, pod: Pod) -> None:
+        self.queue.push(pod)
+
+    # ---- one cycle -----------------------------------------------------
+
+    def run_cycle(self) -> CycleMetrics:
+        m = CycleMetrics()
+        t0 = time.perf_counter()
+        window = self.queue.pop_window(self.config.batch_window)
+        m.pods_in = len(window)
+        if not window:
+            m.cycle_seconds = time.perf_counter() - t0
+            self.metrics.append(m)
+            return m
+
+        nodes = self.list_nodes()
+        running = self.list_running_pods()
+        utils = self.advisor.fetch()
+
+        if self.config.feature_gates.tpu_batch_score and nodes:
+            try:
+                self._run_batched(window, nodes, running, utils, m)
+            except Exception:
+                log.exception("engine cycle failed; falling back to scalar path")
+                m.used_fallback = True
+                self._run_scalar(window, nodes, utils, m)
+        else:
+            m.used_fallback = True
+            self._run_scalar(window, nodes, utils, m)
+
+        m.cycle_seconds = time.perf_counter() - t0
+        self.metrics.append(m)
+        return m
+
+    def _run_batched(self, window, nodes, running, utils, m: CycleMetrics):
+        pods_batch = self.builder.build_pod_batch(window)
+        snapshot = self.builder.build_snapshot(
+            nodes, utils, running, pending_pods=window
+        )
+        assigner = self.config.assigner
+        if assigner != "greedy" and bool(
+            np.asarray(pods_batch.pod_matches).any()
+            and (
+                (np.asarray(pods_batch.affinity_sel) >= 0).any()
+                or (np.asarray(pods_batch.anti_affinity_sel) >= 0).any()
+            )
+        ):
+            # window-internal selector interactions need the greedy path's
+            # dynamic domain counts; auction would evaluate (anti)affinity
+            # against stale pre-window counts
+            log.info("window has inter-pod affinity interactions; using greedy")
+            assigner = "greedy"
+        t0 = time.perf_counter()
+        res = schedule_batch(
+            snapshot,
+            pods_batch,
+            policy=self.config.policy,
+            assigner=assigner,
+            normalizer=self.config.normalizer,
+        )
+        idx = np.asarray(res.node_idx)
+        m.engine_seconds = time.perf_counter() - t0
+        for i, pod in enumerate(window):
+            j = int(idx[i])
+            if j >= 0:
+                self.binder.bind(pod, nodes[j].name)
+                self.queue.mark_scheduled(pod)
+                m.pods_bound += 1
+            else:
+                self.queue.requeue_unschedulable(pod)
+                m.pods_unschedulable += 1
+
+    def _run_scalar(self, window, nodes, utils, m: CycleMetrics):
+        plugin = ScalarYodaPlugin(utils)
+        free = {
+            n.name: {
+                res: n.allocatable.get(res, 0.0) for res in self.builder.resource_names
+            }
+            for n in nodes
+        }
+        for pod in self.list_running_pods():
+            if pod.node_name in free:
+                for res in free[pod.node_name]:
+                    free[pod.node_name][res] -= pod_resource_request(pod, res)
+        for pod in window:
+            plugin.cache.flush()
+            best = scalar_schedule_one(plugin, pod, nodes, free) if nodes else None
+            if best is not None:
+                self.binder.bind(pod, best)
+                self.queue.mark_scheduled(pod)
+                m.pods_bound += 1
+            else:
+                self.queue.requeue_unschedulable(pod)
+                m.pods_unschedulable += 1
+
+    # ---- loop ----------------------------------------------------------
+
+    def run_until_empty(self, *, max_cycles: int = 1000) -> list[CycleMetrics]:
+        out = []
+        for _ in range(max_cycles):
+            if len(self.queue) == 0:
+                break
+            out.append(self.run_cycle())
+        return out

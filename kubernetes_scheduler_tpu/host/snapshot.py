@@ -1,0 +1,358 @@
+"""Snapshot builder: typed cluster objects -> dense device arrays.
+
+The equivalent of the upstream scheduler's node snapshot plus the
+reference's per-(pod, node) resource math (CalculateResourceAllocatable-
+Request / CalculatePodResourceRequest, pkg/yoda/score/algorithm.go:209-262)
+— evaluated once for the whole batch into matrices instead of per plugin
+call. Strings (label keys/values, taint keys) are interned to int32 ids so
+constraint matching runs as integer tensor compares on device.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from kubernetes_scheduler_tpu.engine import PodBatch, SnapshotArrays, make_pod_batch, make_snapshot
+from kubernetes_scheduler_tpu.host.advisor import NodeUtil
+from kubernetes_scheduler_tpu.host.types import Node, Pod
+from kubernetes_scheduler_tpu.ops import constraints as C
+from kubernetes_scheduler_tpu.ops.resources import (
+    CANONICAL_NAMES,
+    DEFAULT_MEMORY_REQUEST,
+    DEFAULT_MILLI_CPU_REQUEST,
+    N_CANONICAL,
+)
+from kubernetes_scheduler_tpu.utils.padding import bucket_size
+
+_EFFECTS = {
+    "NoSchedule": C.NO_SCHEDULE,
+    "PreferNoSchedule": C.PREFER_NO_SCHEDULE,
+    "NoExecute": C.NO_EXECUTE,
+}
+_NA_OPS = {
+    "In": C.OP_IN,
+    "NotIn": C.OP_NOT_IN,
+    "Exists": C.OP_EXISTS,
+    "DoesNotExist": C.OP_NOT_EXISTS,
+}
+_CARD_METRICS = ("bandwidth", "clock", "core", "power", "free_memory", "total_memory")
+
+
+def parse_float_or_zero(s) -> float:
+    """strconv semantics used throughout the reference: unparsable -> 0
+    (filter.go:60-95, algorithm.go:103)."""
+    try:
+        return float(s)
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def parse_int_or_zero(s) -> int:
+    try:
+        return int(s)
+    except (TypeError, ValueError):
+        return 0
+
+
+class Interner:
+    """String -> dense int32 id table (one per vocabulary)."""
+
+    def __init__(self):
+        self._table: dict[str, int] = {}
+
+    def id(self, s: str) -> int:
+        if s not in self._table:
+            self._table[s] = len(self._table)
+        return self._table[s]
+
+    def __len__(self):
+        return len(self._table)
+
+
+def pod_resource_request(pod: Pod, resource: str) -> float:
+    """max(sum(containers), max(initContainers)) + overhead, with the
+    non-zero defaults for cpu/memory (algorithm.go:238-262 +
+    schedutil.GetNonzeroRequestForResource semantics)."""
+
+    def one(c, res):
+        v = c.requests.get(res, 0.0)
+        if v == 0.0 and res == "cpu":
+            return DEFAULT_MILLI_CPU_REQUEST
+        if v == 0.0 and res == "memory":
+            return DEFAULT_MEMORY_REQUEST
+        return v
+
+    total = sum(one(c, resource) for c in pod.containers)
+    for ic in pod.init_containers:
+        total = max(total, one(ic, resource))
+    return total + pod.overhead.get(resource, 0.0)
+
+
+@dataclass
+class SnapshotBuilder:
+    """Builds (SnapshotArrays, PodBatch) with shared interning tables.
+
+    Axes are padded to power-of-two buckets (utils/padding.py) so the jitted
+    engine recompiles only on bucket growth.
+    """
+
+    extended_resources: list[str] = field(default_factory=list)
+    label_keys: Interner = field(default_factory=Interner)
+    label_values: Interner = field(default_factory=Interner)
+    selectors: dict[tuple, int] = field(default_factory=dict)
+
+    @property
+    def resource_names(self) -> list[str]:
+        return list(CANONICAL_NAMES) + self.extended_resources
+
+    # ---- node side ----------------------------------------------------
+
+    def build_snapshot(
+        self,
+        nodes: list[Node],
+        utils: dict[str, NodeUtil],
+        running_pods: list[Pod],
+        *,
+        pending_pods: list[Pod] | None = None,
+    ) -> SnapshotArrays:
+        names = self.resource_names
+        r = len(names)
+        n_real = len(nodes)
+        n = bucket_size(n_real)
+
+        alloc = np.zeros((n, r), np.float32)
+        requested = np.zeros((n, r), np.float32)
+        disk_io = np.zeros(n, np.float32)
+        cpu_pct = np.zeros(n, np.float32)
+        mem_pct = np.zeros(n, np.float32)
+        net_up = np.zeros(n, np.float32)
+        net_down = np.zeros(n, np.float32)
+        mask = np.zeros(n, bool)
+        mask[:n_real] = True
+
+        node_index = {nd.name: i for i, nd in enumerate(nodes)}
+        for i, nd in enumerate(nodes):
+            for j, res in enumerate(names):
+                if res == "cpu":
+                    alloc[i, j] = nd.allocatable.get("cpu", 0.0)  # millicores
+                else:
+                    alloc[i, j] = nd.allocatable.get(res, 0.0)
+            u = utils.get(nd.name)
+            if u:
+                disk_io[i] = u.disk_io
+                cpu_pct[i] = u.cpu_pct
+                mem_pct[i] = u.mem_pct
+                net_up[i] = u.net_up
+                net_down[i] = u.net_down
+
+        # NonZeroRequested accumulation over running pods (algorithm.go:219-221)
+        for pod in running_pods:
+            if pod.node_name not in node_index:
+                continue
+            i = node_index[pod.node_name]
+            for j, res in enumerate(names):
+                requested[i, j] += pod_resource_request(pod, res)
+            requested[i, names.index("pods")] += 1
+
+        # cards
+        c_max = bucket_size(max((len(nd.cards) for nd in nodes), default=0), floor=1, multiple=1)
+        cards = np.zeros((n, c_max, 6), np.float32)
+        card_mask = np.zeros((n, c_max), bool)
+        card_healthy = np.zeros((n, c_max), bool)
+        for i, nd in enumerate(nodes):
+            for j, card in enumerate(nd.cards):
+                cards[i, j] = [getattr(card, m) for m in _CARD_METRICS]
+                card_mask[i, j] = True
+                card_healthy[i, j] = card.health == "Healthy"
+
+        # taints
+        t_max = bucket_size(max((len(nd.taints) for nd in nodes), default=0), floor=1, multiple=1)
+        taints = np.zeros((n, t_max, 3), np.int32)
+        taint_mask = np.zeros((n, t_max), bool)
+        for i, nd in enumerate(nodes):
+            for j, t in enumerate(nd.taints):
+                taints[i, j] = (
+                    self.label_keys.id(t.key),
+                    self.label_values.id(t.value),
+                    _EFFECTS.get(t.effect, C.NO_SCHEDULE),
+                )
+                taint_mask[i, j] = True
+
+        # labels
+        l_max = bucket_size(max((len(nd.labels) for nd in nodes), default=0), floor=1, multiple=1)
+        labels = np.zeros((n, l_max, 2), np.int32)
+        label_mask = np.zeros((n, l_max), bool)
+        for i, nd in enumerate(nodes):
+            for j, (k, v) in enumerate(nd.labels.items()):
+                labels[i, j] = (self.label_keys.id(k), self.label_values.id(v))
+                label_mask[i, j] = True
+
+        domain_counts, domain_id = self._domain_counts(
+            nodes, running_pods, pending_pods or [], n
+        )
+
+        return make_snapshot(
+            allocatable=alloc, requested=requested, disk_io=disk_io,
+            cpu_pct=cpu_pct, mem_pct=mem_pct, net_up=net_up,
+            net_down=net_down, node_mask=mask, cards=cards,
+            card_mask=card_mask, card_healthy=card_healthy, taints=taints,
+            taint_mask=taint_mask, node_labels=labels,
+            node_label_mask=label_mask, domain_counts=domain_counts,
+            domain_id=domain_id,
+        )
+
+    def _selector_id(self, term) -> int:
+        key = (tuple(sorted(term.match_labels.items())), term.topology_key)
+        if key not in self.selectors:
+            self.selectors[key] = len(self.selectors)
+        return self.selectors[key]
+
+    def _selector_slots(self) -> int:
+        return bucket_size(max(len(self.selectors), 1), floor=1, multiple=1)
+
+    def _domain_counts(
+        self, nodes: list[Node], running: list[Pod], pending: list[Pod], n: int
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """For every distinct (selector, topology_key) used by the pending
+        window: count running pods matching the selector, aggregated over
+        each node's topology domain (exact for matchLabels selectors —
+        conjunction checked per running pod host-side, which is O(pods x
+        selectors) once per cycle).
+
+        Also returns domain_id[n, S]: each node's topology domain for
+        selector s, encoded as the index of the first node in that domain,
+        so the engine's in-window placement counts stay statically shaped
+        (ops/assign.py AffinityState)."""
+        for pod in pending:
+            for term in pod.pod_affinity:
+                self._selector_id(term)
+        s = self._selector_slots()
+        counts = np.zeros((n, s), np.float32)
+        # default: every node is its own (hostname) domain
+        domain_id = np.tile(
+            np.arange(n, dtype=np.int32)[:, None], (1, s)
+        )
+        if not self.selectors:
+            return counts, domain_id
+        node_index = {nd.name: i for i, nd in enumerate(nodes)}
+        # per-node raw counts
+        raw = np.zeros((len(nodes), s), np.float32)
+        for pod in running:
+            i = node_index.get(pod.node_name)
+            if i is None:
+                continue
+            for (items, _topo), sid in self.selectors.items():
+                if all(pod.labels.get(k) == v for k, v in items):
+                    raw[i, sid] += 1
+        # aggregate over topology domains
+        for (_items, topo), sid in self.selectors.items():
+            domains: dict[str, float] = {}
+            first: dict[str, int] = {}
+            for i, nd in enumerate(nodes):
+                d = nd.name if topo == "kubernetes.io/hostname" else nd.labels.get(topo, "")
+                domains[d] = domains.get(d, 0.0) + raw[i, sid]
+                first.setdefault(d, i)
+            for i, nd in enumerate(nodes):
+                d = nd.name if topo == "kubernetes.io/hostname" else nd.labels.get(topo, "")
+                counts[i, sid] = domains[d]
+                domain_id[i, sid] = first[d]
+        return counts, domain_id
+
+    # ---- pod side ------------------------------------------------------
+
+    def build_pod_batch(self, pods: list[Pod]) -> PodBatch:
+        names = self.resource_names
+        r = len(names)
+        p_real = len(pods)
+        p = bucket_size(p_real)
+
+        request = np.zeros((p, r), np.float32)
+        r_io = np.zeros(p, np.float32)
+        priority = np.zeros(p, np.int32)
+        pod_mask = np.zeros(p, bool)
+        pod_mask[:p_real] = True
+        want_number = np.zeros(p, np.int32)
+        want_memory = np.full(p, -1.0, np.float32)
+        want_clock = np.full(p, -1.0, np.float32)
+
+        l_max = bucket_size(max((len(pd.tolerations) for pd in pods), default=0), floor=1, multiple=1)
+        tols = np.zeros((p, l_max, 4), np.int32)
+        tol_mask = np.zeros((p, l_max), bool)
+        e_max = bucket_size(max((len(pd.node_affinity) for pd in pods), default=0), floor=1, multiple=1)
+        v_max = bucket_size(
+            max((len(e.values) for pd in pods for e in pd.node_affinity), default=0),
+            floor=1, multiple=1,
+        )
+        na_key = np.zeros((p, e_max), np.int32)
+        na_op = np.zeros((p, e_max), np.int32)
+        na_vals = np.zeros((p, e_max, v_max), np.int32)
+        na_val_mask = np.zeros((p, e_max, v_max), bool)
+        na_mask = np.zeros((p, e_max), bool)
+        k_max = bucket_size(
+            max((len(pd.pod_affinity) for pd in pods), default=0), floor=1, multiple=1
+        )
+        aff = np.full((p, k_max), -1, np.int32)
+        anti = np.full((p, k_max), -1, np.int32)
+
+        for i, pod in enumerate(pods):
+            for j, res in enumerate(names):
+                request[i, j] = pod_resource_request(pod, res)
+            request[i, names.index("pods")] = 1
+            # diskIO annotation (algorithm.go:103; unparsable -> 0)
+            r_io[i] = parse_float_or_zero(pod.annotations.get("diskIO"))
+            # scv/priority label (sort.go:12-18)
+            priority[i] = parse_int_or_zero(pod.labels.get("scv/priority"))
+            # GPU demands (filter.go:11-50): a pod with any scv demand label
+            # but no explicit number wants 1 card
+            has_gpu_labels = any(
+                k in pod.labels for k in ("scv/number", "scv/memory", "scv/clock")
+            )
+            if has_gpu_labels:
+                want_number[i] = (
+                    parse_int_or_zero(pod.labels["scv/number"])
+                    if "scv/number" in pod.labels
+                    else 1
+                )
+                if "scv/memory" in pod.labels:
+                    want_memory[i] = parse_int_or_zero(pod.labels["scv/memory"])
+                if "scv/clock" in pod.labels:
+                    want_clock[i] = parse_int_or_zero(pod.labels["scv/clock"])
+            for j, t in enumerate(pod.tolerations):
+                tols[i, j] = (
+                    -1 if t.key is None else self.label_keys.id(t.key),
+                    self.label_values.id(t.value),
+                    C.TOL_EXISTS if t.operator == "Exists" else C.TOL_EQUAL,
+                    0 if not t.effect else _EFFECTS.get(t.effect, 0),
+                )
+                tol_mask[i, j] = True
+            for j, e in enumerate(pod.node_affinity):
+                na_key[i, j] = self.label_keys.id(e.key)
+                na_op[i, j] = _NA_OPS[e.operator]
+                na_mask[i, j] = True
+                for q, v in enumerate(e.values):
+                    na_vals[i, j, q] = self.label_values.id(v)
+                    na_val_mask[i, j, q] = True
+            for j, term in enumerate(pod.pod_affinity):
+                sid = self._selector_id(term)
+                (anti if term.anti else aff)[i, j] = sid
+
+        # pod_matches: does pending pod p's label set satisfy selector s —
+        # the engine needs this to update in-window domain counts when the
+        # greedy scan places each pod (ops/assign.py AffinityState)
+        s = self._selector_slots()
+        pod_matches = np.zeros((p, s), bool)
+        for i, pod in enumerate(pods):
+            for (items, _topo), sid in self.selectors.items():
+                if all(pod.labels.get(k) == v for k, v in items):
+                    pod_matches[i, sid] = True
+
+        return make_pod_batch(
+            request=request, r_io=r_io, priority=priority, pod_mask=pod_mask,
+            want_number=want_number, want_memory=want_memory,
+            want_clock=want_clock, tolerations=tols, tol_mask=tol_mask,
+            na_key=na_key, na_op=na_op, na_vals=na_vals,
+            na_val_mask=na_val_mask, na_mask=na_mask, affinity_sel=aff,
+            anti_affinity_sel=anti, pod_matches=pod_matches,
+        )

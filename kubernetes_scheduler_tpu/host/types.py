@@ -1,0 +1,110 @@
+"""Typed cluster objects — the host-side stand-ins for the k8s API types
+the reference consumes (v1.Pod, v1.Node, framework.NodeInfo, and the SCV
+CRD's Card/Scv, pkg/yoda/filter/filter.go:8).
+
+Deliberately minimal: only the fields the scheduling capabilities touch.
+String quantities use plain floats in canonical units (cpu millicores,
+bytes, counts) — parsing of k8s quantity strings ("500m", "2Gi") is in
+parse_quantity below.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+def parse_quantity(q: str | int | float) -> float:
+    """k8s resource.Quantity subset: '500m', '2Gi', '1.5', 4."""
+    if isinstance(q, (int, float)):
+        return float(q)
+    s = q.strip()
+    suffixes = {
+        "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50,
+        "k": 1e3, "M": 1e6, "G": 1e9, "T": 1e12, "P": 1e15,
+    }
+    if s.endswith("m"):
+        return float(s[:-1]) / 1000.0
+    for suf, mult in suffixes.items():
+        if s.endswith(suf):
+            return float(s[: -len(suf)]) * mult
+    return float(s)
+
+
+def parse_cpu_milli(q: str | int | float) -> float:
+    """CPU quantity to millicores ('500m' -> 500, 2 -> 2000)."""
+    if isinstance(q, str) and q.strip().endswith("m"):
+        return float(q.strip()[:-1])
+    return parse_quantity(q) * 1000.0
+
+
+@dataclass
+class Container:
+    requests: dict[str, float] = field(default_factory=dict)  # canonical units
+
+
+@dataclass
+class Toleration:
+    key: str | None = None   # None = empty key (wildcard with Exists)
+    value: str = ""
+    operator: str = "Equal"  # "Equal" | "Exists"
+    effect: str = ""         # "" = all effects
+
+
+@dataclass
+class MatchExpression:
+    key: str
+    operator: str            # In | NotIn | Exists | DoesNotExist
+    values: list[str] = field(default_factory=list)
+
+
+@dataclass
+class PodAffinityTerm:
+    match_labels: dict[str, str]
+    topology_key: str = "kubernetes.io/hostname"
+    anti: bool = False
+
+
+@dataclass
+class Pod:
+    name: str
+    namespace: str = "default"
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    containers: list[Container] = field(default_factory=list)
+    init_containers: list[Container] = field(default_factory=list)
+    overhead: dict[str, float] = field(default_factory=dict)
+    tolerations: list[Toleration] = field(default_factory=list)
+    node_affinity: list[MatchExpression] = field(default_factory=list)
+    pod_affinity: list[PodAffinityTerm] = field(default_factory=list)
+    node_name: str | None = None  # set once bound
+    scheduler_name: str = "yoda-tpu"
+
+
+@dataclass
+class Taint:
+    key: str
+    value: str = ""
+    effect: str = "NoSchedule"  # NoSchedule | PreferNoSchedule | NoExecute
+
+
+@dataclass
+class Card:
+    """GPU card, mirroring the SCV CRD status fields the reference filters
+    and scores on (filter.go:52-58, algorithm.go:280-291)."""
+
+    bandwidth: float = 0
+    clock: float = 0
+    core: float = 0
+    power: float = 0
+    free_memory: float = 0
+    total_memory: float = 0
+    health: str = "Healthy"
+
+
+@dataclass
+class Node:
+    name: str
+    labels: dict[str, str] = field(default_factory=dict)
+    taints: list[Taint] = field(default_factory=list)
+    allocatable: dict[str, float] = field(default_factory=dict)
+    cards: list[Card] = field(default_factory=list)

@@ -1,0 +1,20 @@
+"""Scheduling-policy "model families".
+
+Two families:
+- heuristic: the reference's four scoring formulas as selectable policies
+  (the live BalancedCpuDiskIO plus the three dead/legacy alternates,
+  pkg/yoda/score/algorithm.go) — zero parameters, pure kernels.
+- learned: a trainable two-tower scorer (flax) over pod/node features,
+  trained to imitate (or improve on) a heuristic teacher — the framework's
+  flagship *model* in the ML sense, and the vehicle for the multi-chip
+  dp x node training-step sharding.
+"""
+
+from kubernetes_scheduler_tpu.models.policy import HEURISTIC_POLICIES, get_policy
+from kubernetes_scheduler_tpu.models.learned import (
+    NodeScorer,
+    TrainState,
+    init_train_state,
+    make_features,
+    train_step,
+)

@@ -1,0 +1,168 @@
+"""Learned scoring policy: a two-tower scorer trained to imitate (or
+improve on) the heuristic policies.
+
+Design rationale: the heuristic score is a fixed formula of two utilization
+series; a learned scorer consumes the full feature set the advisor already
+collects (CPU, memory, disk-IO, both network directions — the reference
+scrapes all five series but its live formula uses only two,
+pkg/yoda/advisor/advisor.go:16-20 vs score/algorithm.go:105-111) plus the
+resource-fit state. Two towers (pod MLP, node MLP) meet in a single
+[p, d] x [d, n] matmul — the MXU-friendly shape — so scoring P pods on N
+nodes is one batched contraction rather than P.N formula evaluations.
+
+Sharding (the framework's "training parallelism"): on a dp x node mesh the
+example/pod axis is data-parallel over `dp` and the node axis — our long
+"sequence" axis — shards over `node`; parameters are replicated. The score
+matmul then has lhs sharded on dp, rhs on node: XLA turns the loss
+reduction into psums over both axes. This is exercised multi-chip by
+__graft_entry__.dryrun_multichip.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+import optax
+
+from kubernetes_scheduler_tpu.engine import PodBatch, SnapshotArrays
+from kubernetes_scheduler_tpu.ops.stats import CPU_DIVISOR, DISK_IO_DIVISOR
+
+POD_FEATURES = 6
+NODE_FEATURES = 8
+
+
+def make_features(
+    snapshot: SnapshotArrays, pods: PodBatch
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(pod_x[p, POD_FEATURES], node_x[n, NODE_FEATURES]) in roughly [0, 1]
+    ranges. Kept in float32 host-side; towers cast to bfloat16 internally."""
+    r = snapshot.allocatable
+    safe_alloc = jnp.maximum(r, 1.0)
+    free_frac = (r - snapshot.requested) / safe_alloc          # [n, r]
+    node_x = jnp.concatenate(
+        [
+            snapshot.cpu_pct[:, None] / CPU_DIVISOR,
+            snapshot.mem_pct[:, None] / 100.0,
+            snapshot.disk_io[:, None] / DISK_IO_DIVISOR,
+            snapshot.net_up[:, None] / 100.0,
+            snapshot.net_down[:, None] / 100.0,
+            free_frac[:, :3],
+        ],
+        axis=1,
+    )
+    req = pods.request
+    pod_x = jnp.concatenate(
+        [
+            req[:, 0:1] / 32000.0,              # cpu milli vs largest node
+            req[:, 1:2] / (64.0 * 2**30),       # memory vs largest node
+            req[:, 2:3] / 110.0,                # pod-slot demand
+            pods.r_io[:, None] / DISK_IO_DIVISOR,
+            pods.priority[:, None].astype(jnp.float32) / 10.0,
+            pods.want_number[:, None].astype(jnp.float32) / 8.0,
+        ],
+        axis=1,
+    )
+    return pod_x, node_x
+
+
+class Tower(nn.Module):
+    width: int
+    depth: int
+    out: int
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(jnp.bfloat16)
+        for _ in range(self.depth):
+            x = nn.Dense(self.width)(x)
+            x = nn.gelu(x)
+        return nn.Dense(self.out)(x)
+
+
+class NodeScorer(nn.Module):
+    """Two-tower scorer: score[p, n] = pod_emb @ node_emb^T / sqrt(d) + b[n]."""
+
+    d_model: int = 128
+    width: int = 256
+    depth: int = 2
+
+    @nn.compact
+    def __call__(self, pod_x: jnp.ndarray, node_x: jnp.ndarray) -> jnp.ndarray:
+        pod_e = Tower(self.width, self.depth, self.d_model, name="pod_tower")(pod_x)
+        node_e = Tower(self.width, self.depth, self.d_model, name="node_tower")(node_x)
+        bias = nn.Dense(1, name="node_bias")(
+            node_x.astype(jnp.bfloat16)
+        )[:, 0]
+        scale = jnp.asarray(1.0 / jnp.sqrt(self.d_model), jnp.bfloat16)
+        scores = pod_e @ node_e.T * scale + bias[None, :]
+        return scores.astype(jnp.float32)
+
+
+class TrainState(NamedTuple):
+    params: dict
+    opt_state: optax.OptState
+    step: jnp.ndarray
+
+
+def init_train_state(
+    rng: jax.Array,
+    *,
+    model: NodeScorer | None = None,
+    learning_rate: float = 1e-3,
+) -> tuple[TrainState, NodeScorer, optax.GradientTransformation]:
+    model = model or NodeScorer()
+    params = model.init(
+        rng, jnp.zeros((1, POD_FEATURES)), jnp.zeros((1, NODE_FEATURES))
+    )
+    tx = optax.adamw(learning_rate)
+    return TrainState(params, tx.init(params), jnp.zeros((), jnp.int32)), model, tx
+
+
+def imitation_loss(
+    model: NodeScorer,
+    params,
+    pod_x: jnp.ndarray,
+    node_x: jnp.ndarray,
+    teacher_scores: jnp.ndarray,
+    node_mask: jnp.ndarray,
+    pod_mask: jnp.ndarray,
+) -> jnp.ndarray:
+    """Masked listwise KL to the teacher's softmax placement distribution
+    plus a small MSE anchor on raw scores. The teacher is any heuristic
+    policy's raw score matrix (engine.compute_scores)."""
+    pred = model.apply(params, pod_x, node_x)                   # [p, n]
+    neg = jnp.asarray(-1e30, pred.dtype)
+    mask2 = node_mask[None, :] & pod_mask[:, None]
+    t_logp = jax.nn.log_softmax(jnp.where(mask2, teacher_scores, neg), axis=-1)
+    p_logp = jax.nn.log_softmax(jnp.where(mask2, pred, neg), axis=-1)
+    valid = jnp.maximum(pod_mask.sum(), 1.0)
+    kl = (jnp.exp(t_logp) * (t_logp - p_logp) * mask2).sum() / valid
+    mse = (((pred - teacher_scores) ** 2) * mask2).sum() / jnp.maximum(
+        mask2.sum(), 1.0
+    )
+    return kl + 0.01 * mse
+
+
+def train_step(
+    state: TrainState,
+    model: NodeScorer,
+    tx: optax.GradientTransformation,
+    pod_x: jnp.ndarray,
+    node_x: jnp.ndarray,
+    teacher_scores: jnp.ndarray,
+    node_mask: jnp.ndarray,
+    pod_mask: jnp.ndarray,
+) -> tuple[TrainState, jnp.ndarray]:
+    """One optimizer step. Pure; callers jit it (optionally with sharded
+    inputs — the loss reductions become cross-device psums under GSPMD)."""
+    loss, grads = jax.value_and_grad(
+        lambda p: imitation_loss(
+            model, p, pod_x, node_x, teacher_scores, node_mask, pod_mask
+        )
+    )(state.params)
+    updates, opt_state = tx.update(grads, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    return TrainState(params, opt_state, state.step + 1), loss

@@ -1,0 +1,56 @@
+"""Heuristic policy registry.
+
+The reference hard-wires one live policy and keeps three alternates dead in
+comments or unreachable branches (pkg/yoda/score/algorithm.go:90-96). Here
+every policy is a first-class registry entry selectable per cycle; each
+maps to a kernel dispatched inside engine.compute_scores.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class PolicyInfo:
+    name: str
+    description: str
+    reference: str  # file:line in /root/reference
+    live_in_reference: bool
+
+
+HEURISTIC_POLICIES = {
+    "balanced_cpu_diskio": PolicyInfo(
+        name="balanced_cpu_diskio",
+        description="CPU/disk-IO load balancing: S = 10 - 10|alpha.V - beta.U|",
+        reference="pkg/yoda/score/algorithm.go:99-119",
+        live_in_reference=True,
+    ),
+    "balanced_diskio": PolicyInfo(
+        name="balanced_diskio",
+        description="disk-IO variance minimization, min-max rescaled",
+        reference="pkg/yoda/score/algorithm.go:121-176",
+        live_in_reference=False,
+    ),
+    "free_capacity": PolicyInfo(
+        name="free_capacity",
+        description="weighted free capacity: 100(100-io) + 2(100-cpu) + 3(100-mem)",
+        reference="pkg/yoda/score/algorithm.go:178-198",
+        live_in_reference=False,
+    ),
+    "card": PolicyInfo(
+        name="card",
+        description="GPU-card weighted normalized metrics, summed per node",
+        reference="pkg/yoda/score/algorithm.go:264-291",
+        live_in_reference=False,
+    ),
+}
+
+
+def get_policy(name: str) -> PolicyInfo:
+    try:
+        return HEURISTIC_POLICIES[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown policy {name!r}; available: {sorted(HEURISTIC_POLICIES)}"
+        ) from None

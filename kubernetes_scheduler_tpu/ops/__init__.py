@@ -17,3 +17,8 @@ from kubernetes_scheduler_tpu.ops.normalize import min_max_normalize, softmax_no
 from kubernetes_scheduler_tpu.ops.feasibility import resource_fit, card_fit
 from kubernetes_scheduler_tpu.ops.collect import collect_max_card_values
 from kubernetes_scheduler_tpu.ops.assign import greedy_assign
+from kubernetes_scheduler_tpu.ops.constraints import (
+    node_affinity_fit,
+    pod_affinity_fit,
+    taint_toleration_fit,
+)

@@ -38,6 +38,70 @@ class AssignResult(NamedTuple):
     n_assigned: jnp.ndarray    # [] int32
 
 
+class AffinityState(NamedTuple):
+    """Inter-pod (anti)affinity state threaded through greedy assignment.
+
+    The upstream scheduler re-snapshots between single-pod cycles, so pod B
+    sees pod A's placement; a batched window must reproduce that
+    incrementally or hard anti-affinity can be violated inside the window.
+    greedy_assign maintains a running per-(domain, selector) count of
+    window placements on top of the host-provided base counts.
+
+    domain_counts:     [n, S] base counts (running pods, host-aggregated)
+    domain_id:         [n, S] int32 — node n's topology-domain id for
+                       selector s, encoded as a representative node index
+                       in [0, n) (first node of the domain), so the
+                       in-window counts array can be statically shaped [n, S]
+    pod_matches:       [p, S] bool — pending pod p's labels match selector s
+    affinity_sel:      [p, K] int32, -1 padded
+    anti_affinity_sel: [p, K] int32, -1 padded
+    """
+
+    domain_counts: jnp.ndarray
+    domain_id: jnp.ndarray
+    pod_matches: jnp.ndarray
+    affinity_sel: jnp.ndarray
+    anti_affinity_sel: jnp.ndarray
+
+
+def affinity_ok_from_counts(
+    cnt: jnp.ndarray, a_sel: jnp.ndarray, t_sel: jnp.ndarray
+) -> jnp.ndarray:
+    """[n] bool from live domain counts cnt[n, S] and one pod's selector
+    lists a_sel/t_sel[K] (-1 padded; ids >= S are unsatisfiable, see
+    constraints.pod_affinity_fit)."""
+    s = cnt.shape[1]
+    a = jnp.clip(a_sel, 0, max(s - 1, 0))
+    t = jnp.clip(t_sel, 0, max(s - 1, 0))
+    aff_ok = ((cnt[:, a] > 0) | (a_sel[None, :] < 0)).all(-1)   # [n]
+    anti_ok = ((cnt[:, t] == 0) | (t_sel[None, :] < 0)).all(-1)
+    valid = ~((a_sel >= s).any() | (t_sel >= s).any())
+    return aff_ok & anti_ok & valid
+
+
+def _affinity_row_ok(
+    aff: AffinityState, added: jnp.ndarray, i: jnp.ndarray
+) -> jnp.ndarray:
+    """[n] bool: does every (anti)affinity selector of pod i hold on each
+    node, counting both pre-existing and in-window placements."""
+    s = aff.domain_counts.shape[1]
+    cols = jnp.arange(s)[None, :]
+    cnt = aff.domain_counts + added[aff.domain_id, cols]     # [n, S]
+    return affinity_ok_from_counts(cnt, aff.affinity_sel[i], aff.anti_affinity_sel[i])
+
+
+def _affinity_update(
+    aff: AffinityState, added: jnp.ndarray, i: jnp.ndarray,
+    choice: jnp.ndarray, found: jnp.ndarray
+) -> jnp.ndarray:
+    """Record pod i's placement on node `choice` into the in-window
+    counts."""
+    s = aff.domain_counts.shape[1]
+    cols = jnp.arange(s)
+    inc = jnp.where(found, aff.pod_matches[i].astype(added.dtype), 0.0)
+    return added.at[aff.domain_id[choice], cols].add(inc)
+
+
 def _priority_order(priority: jnp.ndarray, pod_mask: jnp.ndarray) -> jnp.ndarray:
     """Stable order: valid pods by descending priority, padding last.
 
@@ -55,6 +119,7 @@ def greedy_assign(
     node_free: jnp.ndarray,
     priority: jnp.ndarray,
     pod_mask: jnp.ndarray,
+    affinity: AffinityState | None = None,
 ) -> AssignResult:
     """Sequential-greedy assignment as a lax.scan.
 
@@ -68,22 +133,32 @@ def greedy_assign(
     """
     order = _priority_order(priority, pod_mask)
     p = scores.shape[0]
+    added0 = (
+        None if affinity is None else jnp.zeros_like(affinity.domain_counts)
+    )
 
-    def step(free, i):
+    def step(carry, i):
+        free, added = carry
         req = pod_request[i]                      # [r]
         # Unrequested resources never exclude a node, matching
         # feasibility.resource_fit's extended-resource bypass
         # (algorithm.go:211-215) even when a slot is oversubscribed.
         cap_ok = ((req[None, :] <= free) | (req[None, :] == 0)).all(-1)  # [n]
         mask = feasible[i] & cap_ok & pod_mask[i]
+        if affinity is not None:
+            mask = mask & _affinity_row_ok(affinity, added, i)
         row = jnp.where(mask, scores[i], NEG)
         choice = jnp.argmax(row)
         found = mask.any()
         delta = jnp.zeros_like(free).at[choice].set(req)
         free = jnp.where(found, free - delta, free)
-        return free, jnp.where(found, choice.astype(jnp.int32), jnp.int32(-1))
+        if affinity is not None:
+            added = _affinity_update(affinity, added, i, choice, found)
+        return (free, added), jnp.where(
+            found, choice.astype(jnp.int32), jnp.int32(-1)
+        )
 
-    free_after, picks = jax.lax.scan(step, node_free, order)
+    (free_after, _), picks = jax.lax.scan(step, (node_free, added0), order)
     node_idx = jnp.full((p,), -1, jnp.int32).at[order].set(picks)
     return AssignResult(
         node_idx=node_idx,

@@ -1,0 +1,169 @@
+"""Taint/toleration, node-affinity and inter-pod-affinity mask kernels.
+
+The reference has no implementation of these (its Filter passes every node,
+pkg/yoda/scheduler.go:96-99; upstream kube-scheduler's TaintToleration and
+InterPodAffinity plugins handled them outside the plugin) — but the
+framework's benchmark matrix requires them as batch predicates
+(BASELINE.md config 4: "5k pods x 5k nodes with inter-pod
+affinity/anti-affinity + taints"). They are formulated from scratch as
+tensor ops over integer-id-encoded labels, following upstream Kubernetes
+semantics.
+
+Encoding (host side interns strings to int32 ids; -1 is "absent"):
+
+- taints[n, T, 3]: (key_id, value_id, effect), effect in {1=NoSchedule,
+  2=PreferNoSchedule, 3=NoExecute}; taint_mask[n, T].
+- tolerations[p, L, 4]: (key_id, value_id, op, effect); op in {0=Exists,
+  1=Equal}; key_id = -1 means "empty key" (with Exists: tolerate
+  everything); effect = 0 means "all effects"; tol_mask[p, L].
+- node labels as (key_id, value_id) pairs: node_labels[n, Ln, 2] with
+  node_label_mask[n, Ln].
+- node-affinity requirements: one required nodeSelectorTerm of up to E
+  matchExpressions (ANDed), each (key_id, op, values[V]); op in
+  {0=In, 1=NotIn, 2=Exists, 3=DoesNotExist}.
+- inter-pod (anti)affinity: the host resolves each distinct label selector
+  in the batch against running pods and aggregates matches over each
+  selector's topology domain, handing the device domain_counts[n, s] =
+  "#running pods matching selector s in node n's topology domain". Pods
+  carry selector indices (-1 padded). pod_affinity_fit below evaluates
+  these counts statically (pre-window state); batch-internal interactions
+  (pods of the same window affecting each other) are handled exactly by
+  the greedy assigner, which threads live per-domain placement counts
+  through its scan (ops/assign.py AffinityState).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# taint effects
+NO_SCHEDULE = 1
+PREFER_NO_SCHEDULE = 2
+NO_EXECUTE = 3
+# toleration operators
+TOL_EXISTS = 0
+TOL_EQUAL = 1
+# node-affinity expression operators
+OP_IN = 0
+OP_NOT_IN = 1
+OP_EXISTS = 2
+OP_NOT_EXISTS = 3
+
+
+def taint_toleration_fit(
+    taints: jnp.ndarray,
+    taint_mask: jnp.ndarray,
+    tolerations: jnp.ndarray,
+    tol_mask: jnp.ndarray,
+) -> jnp.ndarray:
+    """F[p, n]: no untolerated NoSchedule/NoExecute taint.
+
+    A toleration matches a taint iff
+      (tol.key == -1 and tol.op == Exists) or
+      (tol.key == taint.key and
+       (tol.op == Exists or tol.value == taint.value))
+    and (tol.effect == 0 or tol.effect == taint.effect)
+    — upstream v1.Toleration.ToleratesTaint semantics.
+    PreferNoSchedule taints never filter (scoring concern only).
+    """
+    t_key = taints[..., 0][None, :, :, None]    # [1, n, T, 1]
+    t_val = taints[..., 1][None, :, :, None]
+    t_eff = taints[..., 2][None, :, :, None]
+    o_key = tolerations[..., 0][:, None, None, :]  # [p, 1, 1, L]
+    o_val = tolerations[..., 1][:, None, None, :]
+    o_op = tolerations[..., 2][:, None, None, :]
+    o_eff = tolerations[..., 3][:, None, None, :]
+
+    wildcard_key = (o_key == -1) & (o_op == TOL_EXISTS)
+    key_ok = wildcard_key | (
+        (o_key == t_key) & ((o_op == TOL_EXISTS) | (o_val == t_val))
+    )
+    eff_ok = (o_eff == 0) | (o_eff == t_eff)
+    matches = key_ok & eff_ok & tol_mask[:, None, None, :]  # [p, n, T, L]
+    tolerated = matches.any(-1)                              # [p, n, T]
+
+    hard = taint_mask[None, :, :] & (
+        (taints[..., 2] == NO_SCHEDULE) | (taints[..., 2] == NO_EXECUTE)
+    )[None, :, :]
+    return ~(hard & ~tolerated).any(-1)
+
+
+def node_affinity_fit(
+    node_labels: jnp.ndarray,
+    node_label_mask: jnp.ndarray,
+    expr_key: jnp.ndarray,
+    expr_op: jnp.ndarray,
+    expr_vals: jnp.ndarray,
+    expr_val_mask: jnp.ndarray,
+    expr_mask: jnp.ndarray,
+) -> jnp.ndarray:
+    """F[p, n]: node satisfies every (ANDed) required matchExpression.
+
+    node_labels: [n, Ln, 2] (key_id, value_id); node_label_mask: [n, Ln]
+    expr_key:  [p, E] int32; expr_op: [p, E]
+    expr_vals: [p, E, V] int32 value-id sets; expr_val_mask: [p, E, V]
+    expr_mask: [p, E] (False = padding: expression ignored)
+
+    Upstream semantics: In — label present with value in set; NotIn —
+    label absent OR value not in set; Exists — label present;
+    DoesNotExist — label absent.
+    """
+    n_key = node_labels[..., 0]  # [n, Ln]
+    n_val = node_labels[..., 1]
+
+    # key presence per (p, e, n): any node label with matching key
+    key_eq = (
+        n_key[None, None, :, :] == expr_key[:, :, None, None]
+    ) & node_label_mask[None, None, :, :]                      # [p, E, n, Ln]
+    has_key = key_eq.any(-1)                                   # [p, E, n]
+
+    # value match: node's value for the key is in the expression's set
+    val_in_set = (
+        n_val[None, None, :, :, None] == expr_vals[:, :, None, None, :]
+    ) & expr_val_mask[:, :, None, None, :]                     # [p, E, n, Ln, V]
+    key_val_match = (key_eq[..., None] & val_in_set).any((-1, -2))  # [p, E, n]
+
+    op = expr_op[:, :, None]
+    ok = jnp.where(
+        op == OP_IN,
+        key_val_match,
+        jnp.where(
+            op == OP_NOT_IN,
+            ~key_val_match,
+            jnp.where(op == OP_EXISTS, has_key, ~has_key),
+        ),
+    )  # [p, E, n]
+    ok = ok | ~expr_mask[:, :, None]
+    return ok.all(1)  # [p, n]
+
+
+def pod_affinity_fit(
+    domain_counts: jnp.ndarray,
+    affinity_sel: jnp.ndarray,
+    anti_affinity_sel: jnp.ndarray,
+) -> jnp.ndarray:
+    """F[p, n] from pre-aggregated topology-domain match counts.
+
+    domain_counts:     [n, S] float32 — running pods matching selector s in
+                       node n's topology domain (host-aggregated)
+    affinity_sel:      [p, K] int32 selector indices, -1 padding; every
+                       listed selector must have a match in the domain
+    anti_affinity_sel: [p, K] int32; every listed selector must have zero
+                       matches in the domain
+
+    A selector id >= S is a host-side bug (pod batch built against a
+    different snapshot's selector table). Rather than silently aliasing
+    another selector's counts, such ids are treated as unsatisfiable: the
+    pod becomes infeasible everywhere and surfaces as unschedulable.
+    """
+    s = domain_counts.shape[1]
+    invalid_aff = affinity_sel >= s                          # [p, K]
+    invalid_anti = anti_affinity_sel >= s
+    aff = jnp.clip(affinity_sel, 0, max(s - 1, 0))
+    aff_counts = domain_counts[:, aff]                       # [n, p, K]
+    aff_ok = (aff_counts > 0) | (affinity_sel[None, :, :] < 0)
+    anti = jnp.clip(anti_affinity_sel, 0, max(s - 1, 0))
+    anti_counts = domain_counts[:, anti]
+    anti_ok = (anti_counts == 0) | (anti_affinity_sel[None, :, :] < 0)
+    valid = ~(invalid_aff.any(-1) | invalid_anti.any(-1))    # [p]
+    return (aff_ok & anti_ok).all(-1).transpose() & valid[:, None]  # [p, n]

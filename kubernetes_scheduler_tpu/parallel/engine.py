@@ -34,7 +34,7 @@ from kubernetes_scheduler_tpu.engine import (
     compute_free_capacity,
 )
 from kubernetes_scheduler_tpu.ops import card_fit, card_score, free_capacity
-from kubernetes_scheduler_tpu.ops.assign import NEG, _priority_order
+from kubernetes_scheduler_tpu.ops.assign import NEG, _priority_order, affinity_ok_from_counts
 from kubernetes_scheduler_tpu.ops.collect import local_max_card_values
 from kubernetes_scheduler_tpu.ops.normalize import min_max_normalize, score_bounds, softmax_normalize
 from kubernetes_scheduler_tpu.ops.score import (
@@ -91,22 +91,40 @@ def _sharded_greedy(
     feasible: jnp.ndarray,
     pods: PodBatch,
     free0: jnp.ndarray,
+    snapshot: SnapshotArrays,
 ):
     """Exact greedy over the sharded node axis.
 
     Each scan step: local masked argmax -> all_gather of (score, global idx)
     candidates -> identical global choice on every shard (first-max
-    tie-break matches the single-device argmax) -> owning shard decrements.
+    tie-break matches the single-device argmax) -> owning shard decrements
+    its capacity slice, and the chosen node's topology-domain ids are
+    psum-broadcast so every shard updates the (replicated) in-window
+    inter-pod-affinity counts identically.
     """
     n_local = norm.shape[1]
+    n_devices = jax.lax.psum(1, NODE_AXIS)
+    n_global = n_local * n_devices
     offset = jax.lax.axis_index(NODE_AXIS).astype(jnp.int32) * n_local
     order = _priority_order(pods.priority, pods.pod_mask)
     p = norm.shape[0]
+    s = snapshot.domain_counts.shape[1]
+    cols = jnp.arange(s)
+    # the scan body mixes per-shard (varying) values into the update chain,
+    # so the carry must start out marked varying for the vma checker
+    added0 = jax.lax.pvary(jnp.zeros((n_global, s), jnp.float32), NODE_AXIS)
 
-    def step(free, i):
+    def step(carry, i):
+        free, added = carry
         req = pods.request[i]
         cap_ok = ((req[None, :] <= free) | (req[None, :] == 0)).all(-1)
-        mask = feasible[i] & cap_ok & pods.pod_mask[i]
+        # live inter-pod affinity counts: base (local) + in-window
+        # placements (replicated, indexed by global domain id)
+        cnt = snapshot.domain_counts + added[snapshot.domain_id, cols[None, :]]
+        aff_ok = affinity_ok_from_counts(
+            cnt, pods.affinity_sel[i], pods.anti_affinity_sel[i]
+        )
+        mask = feasible[i] & cap_ok & aff_ok & pods.pod_mask[i]
         row = jnp.where(mask, norm[i], NEG)
         local_best = row.max()
         local_arg = jnp.argmax(row).astype(jnp.int32) + offset
@@ -122,9 +140,17 @@ def _sharded_greedy(
         mine = found & (local_idx >= 0) & (local_idx < n_local)
         delta = jnp.zeros_like(free).at[jnp.clip(local_idx, 0, n_local - 1)].set(req)
         free = jnp.where(mine, free - delta, free)
-        return free, jnp.where(found, chosen, jnp.int32(-1))
+        # broadcast the chosen node's domain ids (owning shard contributes
+        # id+1, others 0; -1 after psum means "not found")
+        local_dom = snapshot.domain_id[jnp.clip(local_idx, 0, n_local - 1)]  # [S]
+        dom = jax.lax.psum(jnp.where(mine, local_dom + 1, 0), NODE_AXIS) - 1
+        inc = jnp.where(
+            found & (dom >= 0), pods.pod_matches[i].astype(jnp.float32), 0.0
+        )
+        added = added.at[jnp.clip(dom, 0, n_global - 1), cols].add(inc)
+        return (free, added), jnp.where(found, chosen, jnp.int32(-1))
 
-    free_after, picks = jax.lax.scan(step, free0, order)
+    (free_after, _), picks = jax.lax.scan(step, (free0, added0), order)
     node_idx = jnp.full((p,), -1, jnp.int32).at[order].set(picks)
     # picks are computed identically on every shard, but the replication
     # checker cannot see that through all_gather/argmax; a pmax over equal
@@ -149,15 +175,10 @@ def make_sharded_schedule_fn(
 
     node = P(NODE_AXIS)
     rep = P()
-    snap_specs = SnapshotArrays(
-        allocatable=node, requested=node, disk_io=node, cpu_pct=node,
-        mem_pct=node, net_up=node, net_down=node, node_mask=node,
-        cards=node, card_mask=node, card_healthy=node,
-    )
-    pod_specs = PodBatch(
-        request=rep, r_io=rep, priority=rep, pod_mask=rep,
-        want_number=rep, want_memory=rep, want_clock=rep,
-    )
+    # every per-node array shards on its leading node axis; per-pod arrays
+    # are replicated
+    snap_specs = SnapshotArrays(**{f: node for f in SnapshotArrays._fields})
+    pod_specs = PodBatch(**{f: rep for f in PodBatch._fields})
     out_specs = ScheduleResult(
         node_idx=rep,
         scores=P(None, NODE_AXIS),
@@ -170,8 +191,10 @@ def make_sharded_schedule_fn(
     def body(snapshot: SnapshotArrays, pods: PodBatch) -> ScheduleResult:
         raw = _sharded_scores(snapshot, pods, policy)
         # purely local/elementwise on the node axis — reuse the
-        # single-device implementation so the two paths cannot diverge
-        feasible = compute_feasibility(snapshot, pods)
+        # single-device implementation so the two paths cannot diverge.
+        # Inter-pod affinity is excluded from the static mask: the greedy
+        # scan evaluates it dynamically (base + in-window counts).
+        feasible = compute_feasibility(snapshot, pods, include_pod_affinity=False)
 
         if normalizer == "min_max":
             hi, lo = score_bounds(raw, snapshot.node_mask)
@@ -192,7 +215,7 @@ def make_sharded_schedule_fn(
             raise ValueError(f"unknown normalizer {normalizer!r}")
 
         free0 = compute_free_capacity(snapshot)
-        node_idx, free_after = _sharded_greedy(norm, feasible, pods, free0)
+        node_idx, free_after = _sharded_greedy(norm, feasible, pods, free0, snapshot)
         return ScheduleResult(
             node_idx=node_idx,
             scores=norm,

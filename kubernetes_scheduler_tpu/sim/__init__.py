@@ -1,0 +1,6 @@
+from kubernetes_scheduler_tpu.sim.cluster_gen import (
+    BENCH_CONFIGS,
+    gen_cluster,
+    gen_config,
+    gen_pods,
+)

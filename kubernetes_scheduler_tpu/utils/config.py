@@ -1,0 +1,79 @@
+"""Typed configuration.
+
+Collects the reference's three config tiers into one structure (SURVEY.md
+§5): upstream KubeSchedulerConfiguration knobs (backoffs, extension-point
+toggles), plugin args (the demo fields at pkg/yoda/scheduler.go:36-40),
+and — most importantly — everything the reference hard-codes that should
+have been config: Prometheus host (advisor.go:15), Redis address
+(cache/cache.go:18, gone entirely here), score weights
+(score/algorithm.go:24-35), and the normalization divisors
+(algorithm.go:71,73). Plus the TPU-era knobs: policy/assigner selection,
+batch window, mesh devices, and feature gates.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+
+
+@dataclass
+class FeatureGates:
+    """TPUBatchScore gates batch-on-device vs. the scalar fallback path
+    (the north-star design's `--feature-gates=TPUBatchScore=false`,
+    BASELINE.json)."""
+
+    tpu_batch_score: bool = True
+
+
+@dataclass
+class AdvisorConfig:
+    prometheus_host: str = "prometheus.monitoring:9090"
+    # normalization divisors (algorithm.go:71,73)
+    disk_io_divisor: float = 50.0
+    cpu_divisor: float = 100.0
+
+
+@dataclass
+class SchedulerConfig:
+    scheduler_name: str = "yoda-tpu"
+    policy: str = "balanced_cpu_diskio"
+    assigner: str = "greedy"
+    normalizer: str = "min_max"
+    batch_window: int = 1024
+    # resource -> weight, all 1 like the reference (scheduler.go:75-77)
+    resource_weights: dict = field(
+        default_factory=lambda: {
+            "cpu": 1, "memory": 1, "pods": 1, "storage": 1,
+            "ephemeral-storage": 1,
+        }
+    )
+    extended_resources: list = field(default_factory=list)
+    # queue backoffs (deploy/yoda-scheduler.yaml:19-20)
+    initial_backoff_seconds: float = 1.0
+    max_backoff_seconds: float = 10.0
+    mesh_devices: int | None = None  # None = single device
+    feature_gates: FeatureGates = field(default_factory=FeatureGates)
+    advisor: AdvisorConfig = field(default_factory=AdvisorConfig)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SchedulerConfig":
+        d = dict(d)
+        if "feature_gates" in d and isinstance(d["feature_gates"], dict):
+            d["feature_gates"] = FeatureGates(**d["feature_gates"])
+        if "advisor" in d and isinstance(d["advisor"], dict):
+            d["advisor"] = AdvisorConfig(**d["advisor"])
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(f"unknown config keys: {sorted(unknown)}")
+        return cls(**d)
+
+    @classmethod
+    def from_json(cls, path: str) -> "SchedulerConfig":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)

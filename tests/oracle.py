@@ -159,6 +159,53 @@ def card_score_oracle(cards, maxima, want_memory, want_clock,
     return total
 
 
+# --- constraint predicates (upstream Kubernetes semantics) -------------------
+# taints: list of (key, value, effect); effect 1=NoSchedule 2=Prefer 3=NoExecute
+# tolerations: list of (key, value, op, effect); op 0=Exists 1=Equal;
+#   key None = wildcard; effect 0 = all
+
+
+def toleration_tolerates(tol, taint):
+    key, value, op, effect = tol
+    t_key, t_value, t_effect = taint
+    if effect != 0 and effect != t_effect:
+        return False
+    if key is None:
+        return op == 0  # empty key + Exists tolerates everything
+    if key != t_key:
+        return False
+    return op == 0 or value == t_value
+
+
+def taint_fit_oracle(taints, tolerations):
+    for taint in taints:
+        if taint[2] not in (1, 3):  # only NoSchedule/NoExecute filter
+            continue
+        if not any(toleration_tolerates(t, taint) for t in tolerations):
+            return False
+    return True
+
+
+def node_affinity_fit_oracle(node_labels, exprs):
+    """node_labels: dict key->value; exprs: list of (key, op, values) with
+    op 0=In 1=NotIn 2=Exists 3=DoesNotExist; ANDed."""
+    for key, op, values in exprs:
+        present = key in node_labels
+        if op == 0:  # In
+            if not (present and node_labels[key] in values):
+                return False
+        elif op == 1:  # NotIn
+            if present and node_labels[key] in values:
+                return False
+        elif op == 2:  # Exists
+            if not present:
+                return False
+        elif op == 3:  # DoesNotExist
+            if present:
+                return False
+    return True
+
+
 def greedy_assign_oracle(scores, feasible, pod_request, node_free, priority):
     """Reference-semantics sequential scheduling: pods in priority order
     (sort.go:8-18, stable on queue order), each binds to its best feasible

@@ -1,0 +1,322 @@
+"""Constraint mask kernels vs. scalar upstream-semantics oracles."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from kubernetes_scheduler_tpu.engine import make_pod_batch, make_snapshot, schedule_batch
+from kubernetes_scheduler_tpu.ops import (
+    node_affinity_fit,
+    pod_affinity_fit,
+    taint_toleration_fit,
+)
+from kubernetes_scheduler_tpu.ops.constraints import (
+    NO_EXECUTE,
+    NO_SCHEDULE,
+    OP_EXISTS,
+    OP_IN,
+    OP_NOT_EXISTS,
+    OP_NOT_IN,
+    PREFER_NO_SCHEDULE,
+    TOL_EQUAL,
+    TOL_EXISTS,
+)
+from tests import oracle
+
+RNG = np.random.default_rng(4)
+
+
+def pack_taints(per_node, t_max=4):
+    n = len(per_node)
+    taints = np.zeros((n, t_max, 3), np.int32)
+    mask = np.zeros((n, t_max), bool)
+    for i, ts in enumerate(per_node):
+        for j, t in enumerate(ts):
+            taints[i, j] = t
+            mask[i, j] = True
+    return jnp.asarray(taints), jnp.asarray(mask)
+
+
+def pack_tols(per_pod, l_max=4):
+    p = len(per_pod)
+    tols = np.zeros((p, l_max, 4), np.int32)
+    mask = np.zeros((p, l_max), bool)
+    for i, ls in enumerate(per_pod):
+        for j, (key, value, op, effect) in enumerate(ls):
+            tols[i, j] = (-1 if key is None else key, value, op, effect)
+            mask[i, j] = True
+    return jnp.asarray(tols), jnp.asarray(mask)
+
+
+def test_taint_toleration_matches_oracle():
+    # keys/values are interned ids
+    node_taints = [
+        [],                                     # untainted
+        [(1, 1, NO_SCHEDULE)],
+        [(1, 2, NO_EXECUTE), (2, 1, NO_SCHEDULE)],
+        [(3, 1, PREFER_NO_SCHEDULE)],           # soft taint: never filters
+        [(1, 1, NO_SCHEDULE), (1, 1, NO_EXECUTE)],
+    ]
+    pod_tols = [
+        [],                                     # no tolerations
+        [(1, 1, TOL_EQUAL, 0)],                 # tolerate key1=val1, all effects
+        [(1, 0, TOL_EXISTS, 0)],                # tolerate any key1
+        [(None, 0, TOL_EXISTS, 0)],             # wildcard: tolerate everything
+        [(1, 1, TOL_EQUAL, NO_SCHEDULE)],       # only NoSchedule effect
+        [(1, 0, TOL_EXISTS, 0), (2, 1, TOL_EQUAL, 0)],
+    ]
+    taints, t_mask = pack_taints(node_taints)
+    tols, l_mask = pack_tols(pod_tols)
+    got = np.asarray(taint_toleration_fit(taints, t_mask, tols, l_mask))
+    for p, tl in enumerate(pod_tols):
+        for n_, ts in enumerate(node_taints):
+            assert got[p, n_] == oracle.taint_fit_oracle(ts, tl), (p, n_)
+
+
+def test_taint_toleration_random_fuzz():
+    keys = [1, 2, 3]
+    vals = [1, 2]
+    effects = [NO_SCHEDULE, PREFER_NO_SCHEDULE, NO_EXECUTE]
+    node_taints = [
+        [
+            (int(RNG.choice(keys)), int(RNG.choice(vals)), int(RNG.choice(effects)))
+            for _ in range(RNG.integers(0, 4))
+        ]
+        for _ in range(20)
+    ]
+    pod_tols = [
+        [
+            (
+                None if RNG.random() < 0.1 else int(RNG.choice(keys)),
+                int(RNG.choice(vals)),
+                int(RNG.choice([TOL_EXISTS, TOL_EQUAL])),
+                int(RNG.choice([0, NO_SCHEDULE, NO_EXECUTE])),
+            )
+            for _ in range(RNG.integers(0, 4))
+        ]
+        for _ in range(15)
+    ]
+    taints, t_mask = pack_taints(node_taints)
+    tols, l_mask = pack_tols(pod_tols)
+    got = np.asarray(taint_toleration_fit(taints, t_mask, tols, l_mask))
+    for p, tl in enumerate(pod_tols):
+        for n_, ts in enumerate(node_taints):
+            # wildcard encoding: None key with op Equal is meaningless and
+            # not produced by the host; skip those rows
+            assert got[p, n_] == oracle.taint_fit_oracle(
+                ts, [t for t in tl if not (t[0] is None and t[2] == TOL_EQUAL)]
+            ), (p, n_)
+
+
+def pack_node_labels(per_node, l_max=4):
+    n = len(per_node)
+    labels = np.zeros((n, l_max, 2), np.int32)
+    mask = np.zeros((n, l_max), bool)
+    for i, d in enumerate(per_node):
+        for j, (k, v) in enumerate(d.items()):
+            labels[i, j] = (k, v)
+            mask[i, j] = True
+    return jnp.asarray(labels), jnp.asarray(mask)
+
+
+def pack_exprs(per_pod, e_max=3, v_max=3):
+    p = len(per_pod)
+    key = np.zeros((p, e_max), np.int32)
+    op = np.zeros((p, e_max), np.int32)
+    vals = np.zeros((p, e_max, v_max), np.int32)
+    val_mask = np.zeros((p, e_max, v_max), bool)
+    mask = np.zeros((p, e_max), bool)
+    for i, exprs in enumerate(per_pod):
+        for j, (k, o, vs) in enumerate(exprs):
+            key[i, j], op[i, j], mask[i, j] = k, o, True
+            for q, v in enumerate(vs):
+                vals[i, j, q] = v
+                val_mask[i, j, q] = True
+    return tuple(map(jnp.asarray, (key, op, vals, val_mask, mask)))
+
+
+def test_node_affinity_matches_oracle():
+    node_labels = [
+        {1: 1, 2: 1},
+        {1: 2},
+        {2: 3},
+        {},
+        {1: 1, 2: 2, 3: 1},
+    ]
+    pod_exprs = [
+        [],                                      # no requirements
+        [(1, OP_IN, [1, 2])],                    # zone in {a, b}
+        [(1, OP_NOT_IN, [2])],                   # zone not b (absent ok)
+        [(2, OP_EXISTS, [])],
+        [(3, OP_NOT_EXISTS, [])],
+        [(1, OP_IN, [1]), (2, OP_EXISTS, [])],   # conjunction
+    ]
+    labels, l_mask = pack_node_labels(node_labels)
+    key, op, vals, val_mask, e_mask = pack_exprs(pod_exprs)
+    got = np.asarray(node_affinity_fit(labels, l_mask, key, op, vals, val_mask, e_mask))
+    for p, exprs in enumerate(pod_exprs):
+        for n_, nl in enumerate(node_labels):
+            assert got[p, n_] == oracle.node_affinity_fit_oracle(nl, exprs), (p, n_)
+
+
+def test_pod_affinity_fit():
+    # 4 nodes, 2 selectors: selector 0 matched in domains of nodes 0,1;
+    # selector 1 matched only at node 2's domain.
+    counts = jnp.asarray([[2.0, 0.0], [1.0, 0.0], [0.0, 3.0], [0.0, 0.0]])
+    aff = jnp.asarray([[0, -1], [-1, -1], [1, -1]], jnp.int32)
+    anti = jnp.asarray([[-1, -1], [0, -1], [0, 1]], jnp.int32)
+    got = np.asarray(pod_affinity_fit(counts, aff, anti))
+    assert got.tolist() == [
+        [True, True, False, False],    # needs sel0 nearby
+        [False, False, True, True],    # repelled by sel0
+        [False, False, False, False],  # needs sel1 but repels sel0&1 -> never
+    ]
+
+
+def test_pod_affinity_invalid_selector_id_is_unsatisfiable():
+    counts = jnp.asarray([[0.0], [1.0]])  # S = 1
+    aff = jnp.asarray([[3]], jnp.int32)   # id 3 out of range: host bug
+    anti = jnp.asarray([[-1]], jnp.int32)
+    got = np.asarray(pod_affinity_fit(counts, aff, anti))
+    assert not got.any()  # surfaces as unschedulable, never aliases
+
+
+def test_window_internal_anti_affinity_exact():
+    """Two same-labeled pods with self anti-affinity in ONE window must land
+    in different topology domains (the upstream per-pod re-snapshot
+    behavior, reproduced by the greedy scan's dynamic domain counts)."""
+    from kubernetes_scheduler_tpu.host import (
+        Container, Node, NodeUtil, Pod, Scheduler, StaticAdvisor,
+    )
+    from kubernetes_scheduler_tpu.host.types import PodAffinityTerm
+    from kubernetes_scheduler_tpu.utils.config import SchedulerConfig
+
+    nodes = [
+        Node(name=f"n{i}", allocatable={"cpu": 8000, "memory": 32 * 2**30, "pods": 110})
+        for i in range(4)
+    ]
+    utils = {n.name: NodeUtil(cpu_pct=50, disk_io=10) for n in nodes}
+
+    def replica(name):
+        return Pod(
+            name=name,
+            labels={"app": "db"},
+            containers=[Container(requests={"cpu": 500})],
+            annotations={"diskIO": "5"},
+            pod_affinity=[PodAffinityTerm({"app": "db"}, anti=True)],
+        )
+
+    s = Scheduler(
+        SchedulerConfig(batch_window=16),
+        advisor=StaticAdvisor(utils),
+        list_nodes=lambda: nodes,
+        # bound pods become running pods for the next cycle
+        list_running_pods=lambda: [b.pod for b in s.binder.bindings],
+    )
+    for i in range(3):
+        s.submit(replica(f"db-{i}"))
+    m = s.run_cycle()
+    assert m.pods_bound == 3
+    hosts = [b.node_name for b in s.binder.bindings]
+    assert len(set(hosts)) == 3, f"anti-affinity violated within window: {hosts}"
+    # a 5th replica on a 4-node cluster is unschedulable
+    s.submit(replica("db-3"))
+    s.submit(replica("db-4"))
+    m2 = s.run_cycle()
+    assert m2.pods_bound == 1 and m2.pods_unschedulable == 1
+
+
+def test_window_internal_positive_affinity():
+    """A pod requiring affinity to a pod scheduled in the SAME window must
+    co-locate with it once placed."""
+    from kubernetes_scheduler_tpu.host import (
+        Container, Node, NodeUtil, Pod, Scheduler, StaticAdvisor,
+    )
+    from kubernetes_scheduler_tpu.host.types import PodAffinityTerm
+    from kubernetes_scheduler_tpu.utils.config import SchedulerConfig
+
+    nodes = [
+        Node(name=f"n{i}", allocatable={"cpu": 8000, "memory": 32 * 2**30, "pods": 110})
+        for i in range(4)
+    ]
+    utils = {n.name: NodeUtil(cpu_pct=40 + i, disk_io=10) for i, n in enumerate(nodes)}
+    web = Pod(
+        name="web", labels={"app": "web", "scv/priority": "9"},
+        containers=[Container(requests={"cpu": 500})], annotations={"diskIO": "5"},
+    )
+    sidecar = Pod(
+        name="sidecar",
+        containers=[Container(requests={"cpu": 100})], annotations={"diskIO": "5"},
+        pod_affinity=[PodAffinityTerm({"app": "web"})],
+    )
+    s = Scheduler(
+        SchedulerConfig(batch_window=16),
+        advisor=StaticAdvisor(utils),
+        list_nodes=lambda: nodes,
+        list_running_pods=lambda: [],
+    )
+    s.submit(web)
+    s.submit(sidecar)
+    m = s.run_cycle()
+    assert m.pods_bound == 2
+    bound = {b.pod.name: b.node_name for b in s.binder.bindings}
+    assert bound["sidecar"] == bound["web"]
+
+
+def test_make_batch_mask_defaults_to_valid_for_provided_payloads():
+    """Providing tolerations/taints/na exprs without masks must mean
+    'all provided entries are real', not 'ignore the payload'."""
+    n, p = 2, 1
+    taints = np.asarray([[[7, 0, NO_SCHEDULE]], [[7, 0, NO_SCHEDULE]]], np.int32)
+    snap = make_snapshot(
+        allocatable=np.full((n, 1), 1000, np.float32),
+        requested=np.zeros((n, 1), np.float32),
+        disk_io=np.zeros(n), cpu_pct=np.zeros(n), mem_pct=np.zeros(n),
+        taints=taints,  # no taint_mask
+    )
+    pods = make_pod_batch(request=np.full((p, 1), 10, np.float32))
+    res = schedule_batch(snap, pods)
+    # untolerated NoSchedule taints on every node -> unschedulable
+    assert int(res.n_assigned) == 0
+
+    tols = np.asarray([[[7, 0, TOL_EXISTS, 0]]], np.int32)
+    pods_tol = make_pod_batch(
+        request=np.full((p, 1), 10, np.float32), tolerations=tols  # no tol_mask
+    )
+    res2 = schedule_batch(snap, pods_tol)
+    assert int(res2.n_assigned) == 1
+
+
+def test_engine_with_constraints_end_to_end():
+    """Taints + affinity wired through schedule_batch feasibility."""
+    n, p, r = 8, 3, 2
+    alloc = np.full((n, r), 10000, np.float32)
+    reqd = np.zeros((n, r), np.float32)
+    # nodes 0-3 tainted NoSchedule key9; nodes 4-7 labeled zone(5)=1
+    node_taints = [[(9, 1, NO_SCHEDULE)]] * 4 + [[]] * 4
+    node_labels = [{}] * 4 + [{5: 1}] * 4
+    taints, t_mask = pack_taints(node_taints)
+    labels, l_mask = pack_node_labels(node_labels)
+    snapshot = make_snapshot(
+        allocatable=alloc, requested=reqd,
+        disk_io=np.full(n, 10.0), cpu_pct=np.full(n, 50.0),
+        mem_pct=np.full(n, 50.0),
+        taints=taints, taint_mask=t_mask,
+        node_labels=labels, node_label_mask=l_mask,
+    )
+    # pod0: no tolerations, no affinity -> only untainted nodes 4-7
+    # pod1: tolerates key9 -> all nodes
+    # pod2: requires zone=1 -> nodes 4-7 (also untolerated -> 4-7)
+    tols, tol_mask = pack_tols([[], [(9, 1, TOL_EQUAL, 0)], []])
+    key, op, vals, val_mask, e_mask = pack_exprs([[], [], [(5, OP_IN, [1])]])
+    pods = make_pod_batch(
+        request=np.full((p, r), 100, np.float32),
+        r_io=np.full(p, 10.0),
+        tolerations=tols, tol_mask=tol_mask,
+        na_key=key, na_op=op, na_vals=vals, na_val_mask=val_mask, na_mask=e_mask,
+    )
+    res = schedule_batch(snapshot, pods)
+    feas = np.asarray(res.feasible)
+    assert feas[0].tolist() == [False] * 4 + [True] * 4
+    assert feas[1].tolist() == [True] * 8
+    assert feas[2].tolist() == [False] * 4 + [True] * 4
+    assert (np.asarray(res.node_idx) >= 0).all()

@@ -5,7 +5,11 @@ import jax
 import jax.numpy as jnp
 import pytest
 
-from kubernetes_scheduler_tpu.engine import PodBatch, SnapshotArrays, schedule_batch
+from kubernetes_scheduler_tpu.engine import (
+    make_pod_batch,
+    make_snapshot,
+    schedule_batch,
+)
 from kubernetes_scheduler_tpu.parallel import make_mesh, make_sharded_schedule_fn
 from tests import oracle
 
@@ -13,31 +17,23 @@ RNG = np.random.default_rng(3)
 
 
 def random_state(n, p, r=3, c=2, gpu=False):
-    alloc = RNG.integers(4000, 16000, (n, r)).astype(np.float32)
-    reqd = RNG.integers(0, 4000, (n, r)).astype(np.float32)
-    snapshot = SnapshotArrays(
-        allocatable=jnp.asarray(alloc),
-        requested=jnp.asarray(reqd),
-        disk_io=jnp.asarray(RNG.uniform(0, 50, n), jnp.float32),
-        cpu_pct=jnp.asarray(RNG.uniform(0, 100, n), jnp.float32),
-        mem_pct=jnp.asarray(RNG.uniform(0, 100, n), jnp.float32),
-        net_up=jnp.asarray(RNG.uniform(0, 10, n), jnp.float32),
-        net_down=jnp.asarray(RNG.uniform(0, 10, n), jnp.float32),
-        node_mask=jnp.ones(n, bool),
-        cards=jnp.asarray(RNG.integers(1, 1000, (n, c, 6)), jnp.float32),
-        card_mask=jnp.asarray(RNG.random((n, c)) > 0.3),
-        card_healthy=jnp.asarray(RNG.random((n, c)) > 0.2),
+    snapshot = make_snapshot(
+        allocatable=RNG.integers(4000, 16000, (n, r)).astype(np.float32),
+        requested=RNG.integers(0, 4000, (n, r)).astype(np.float32),
+        disk_io=RNG.uniform(0, 50, n),
+        cpu_pct=RNG.uniform(0, 100, n),
+        mem_pct=RNG.uniform(0, 100, n),
+        net_up=RNG.uniform(0, 10, n),
+        net_down=RNG.uniform(0, 10, n),
+        cards=RNG.integers(1, 1000, (n, c, 6)),
+        card_mask=RNG.random((n, c)) > 0.3,
+        card_healthy=RNG.random((n, c)) > 0.2,
     )
-    pods = PodBatch(
-        request=jnp.asarray(RNG.integers(100, 3000, (p, r)), jnp.float32),
-        r_io=jnp.asarray(RNG.uniform(0, 40, p), jnp.float32),
-        priority=jnp.asarray(RNG.integers(0, 10, p), jnp.int32),
-        pod_mask=jnp.ones(p, bool),
-        want_number=jnp.asarray(
-            RNG.integers(0, 3, p) if gpu else np.zeros(p), jnp.int32
-        ),
-        want_memory=jnp.full((p,), -1.0, jnp.float32),
-        want_clock=jnp.full((p,), -1.0, jnp.float32),
+    pods = make_pod_batch(
+        request=RNG.integers(100, 3000, (p, r)),
+        r_io=RNG.uniform(0, 40, p),
+        priority=RNG.integers(0, 10, p),
+        want_number=RNG.integers(0, 3, p) if gpu else np.zeros(p),
     )
     return snapshot, pods
 

@@ -1,0 +1,276 @@
+"""Host layer: advisor join semantics, queue, snapshot builder, full loop."""
+
+import numpy as np
+import pytest
+
+from kubernetes_scheduler_tpu.host import (
+    Card,
+    Container,
+    Node,
+    NodeUtil,
+    Pod,
+    PrometheusAdvisor,
+    Scheduler,
+    SchedulingQueue,
+    SnapshotBuilder,
+    StaticAdvisor,
+    Taint,
+)
+from kubernetes_scheduler_tpu.host.types import (
+    MatchExpression,
+    PodAffinityTerm,
+    Toleration,
+    parse_cpu_milli,
+    parse_quantity,
+)
+from kubernetes_scheduler_tpu.ops.resources import (
+    DEFAULT_MEMORY_REQUEST,
+    DEFAULT_MILLI_CPU_REQUEST,
+)
+from kubernetes_scheduler_tpu.utils.config import SchedulerConfig
+
+
+def make_node(name, cpu=8000, mem=32 * 2**30, **kw):
+    return Node(
+        name=name,
+        allocatable={"cpu": cpu, "memory": mem, "pods": 110},
+        **kw,
+    )
+
+
+def make_pod(name, cpu=500, mem=2**30, **kw):
+    return Pod(
+        name=name,
+        containers=[Container(requests={"cpu": cpu, "memory": mem})],
+        **kw,
+    )
+
+
+def test_quantity_parsing():
+    assert parse_quantity("2Gi") == 2 * 2**30
+    assert parse_quantity("500m") == 0.5
+    assert parse_quantity("1.5") == 1.5
+    assert parse_cpu_milli("500m") == 500
+    assert parse_cpu_milli(2) == 2000
+
+
+def test_advisor_join_and_soft_failures():
+    """Join by kubernetes_io_hostname with instance fallback
+    (advisor.go:199-202); net series errors degrade to zeros
+    (advisor.go:219,242); cpu series errors propagate."""
+
+    def transport(url, form):
+        q = form["query"]
+        if "container_cpu" in q:
+            return {
+                "data": {
+                    "result": [
+                        {"metric": {"kubernetes_io_hostname": "n1"}, "value": [0, "55.5"]},
+                        {"metric": {"instance": "n2"}, "value": [0, "10"]},
+                        {"metric": {}, "value": [0, "99"]},  # unjoinable: skipped
+                    ]
+                }
+            }
+        if "node_disk" in q:
+            return {
+                "data": {"result": [
+                    {"metric": {"kubernetes_io_hostname": "n1"}, "value": [0, "12.5"]},
+                ]}
+            }
+        if "transmit" in q or "receive" in q:
+            raise OSError("network io query failed")
+        return {"data": {"result": []}}
+
+    adv = PrometheusAdvisor("example:9090", transport=transport)
+    utils = adv.fetch()
+    assert utils["n1"].cpu_pct == 55.5
+    assert utils["n1"].disk_io == 12.5
+    assert utils["n1"].net_up == 0.0  # soft-failed
+    assert utils["n2"].cpu_pct == 10.0  # instance fallback
+
+    def hard_fail(url, form):
+        raise OSError("prometheus down")
+
+    with pytest.raises(OSError):
+        PrometheusAdvisor("example:9090", transport=hard_fail).fetch()
+
+
+def test_queue_priority_and_backoff():
+    now = [0.0]
+    q = SchedulingQueue(clock=lambda: now[0])
+    q.push(make_pod("low", ))
+    q.push(make_pod("high", labels={"scv/priority": "9"}))
+    q.push(make_pod("mid", labels={"scv/priority": "5"}))
+    assert [p.name for p in q.pop_window(10)] == ["high", "mid", "low"]
+
+    p = make_pod("retry")
+    q.requeue_unschedulable(p)
+    assert q.pop_window(10) == []          # still backing off (1s)
+    now[0] = 1.1
+    assert [x.name for x in q.pop_window(10)] == ["retry"]
+    # second failure: 2s backoff
+    q.requeue_unschedulable(p)
+    now[0] = 2.0
+    assert q.pop_window(10) == []
+    now[0] = 3.2
+    assert [x.name for x in q.pop_window(10)] == ["retry"]
+    # backoff is capped at max_backoff
+    for _ in range(10):
+        q.requeue_unschedulable(p)
+        assert q._backoff[0][0] - now[0] <= 10.0 + 1e-9
+        q._backoff.clear()
+
+
+def test_snapshot_builder_resource_math():
+    b = SnapshotBuilder()
+    nodes = [make_node("n1"), make_node("n2", cpu=4000)]
+    running = [make_pod("r1", cpu=1000, mem=2**30)]
+    running[0].node_name = "n1"
+    # a pod with no requests gets the non-zero defaults
+    empty = Pod(name="empty", containers=[Container()])
+    snap = b.build_snapshot(nodes, {"n1": NodeUtil(cpu_pct=50)}, running)
+    batch = b.build_pod_batch([make_pod("p1", cpu=250), empty])
+
+    assert snap.allocatable.shape[0] == 8  # bucketed
+    assert float(snap.allocatable[0, 0]) == 8000
+    assert float(snap.requested[0, 0]) == 1000
+    assert float(snap.requested[0, 2]) == 1  # pod count
+    assert float(snap.cpu_pct[0]) == 50
+    assert float(batch.request[0, 0]) == 250
+    assert float(batch.request[1, 0]) == DEFAULT_MILLI_CPU_REQUEST
+    assert float(batch.request[1, 1]) == DEFAULT_MEMORY_REQUEST
+
+
+def test_snapshot_builder_gpu_and_scv_labels():
+    b = SnapshotBuilder()
+    node = make_node("g1")
+    node.cards = [Card(clock=1500, free_memory=16000), Card(clock=2000, free_memory=8000, health="Unhealthy")]
+    snap = b.build_snapshot([node], {}, [])
+    assert snap.cards.shape[1] == 2
+    assert bool(snap.card_healthy[0, 0]) and not bool(snap.card_healthy[0, 1])
+
+    pods = [
+        make_pod("nogpu"),
+        make_pod("implicit", labels={"scv/memory": "8000"}),     # wants 1 card
+        make_pod("explicit", labels={"scv/number": "2", "scv/clock": "1500"}),
+        make_pod("garbage", labels={"scv/number": "xyz"}),       # strconv -> 0
+    ]
+    batch = b.build_pod_batch(pods)
+    assert batch.want_number.tolist()[:4] == [0, 1, 2, 0]
+    assert float(batch.want_memory[1]) == 8000
+    assert float(batch.want_memory[2]) == -1  # label absent
+    assert float(batch.want_clock[2]) == 1500
+
+
+def test_domain_counts_topology_aggregation():
+    b = SnapshotBuilder()
+    nodes = [
+        make_node("a1", labels={"zone": "za"}),
+        make_node("a2", labels={"zone": "za"}),
+        make_node("b1", labels={"zone": "zb"}),
+    ]
+    web = make_pod("web", labels={"app": "web"})
+    web.node_name = "a1"
+    pending = [
+        Pod(
+            name="wants-web-zone",
+            containers=[Container()],
+            pod_affinity=[PodAffinityTerm({"app": "web"}, topology_key="zone")],
+        ),
+        Pod(
+            name="avoids-web-host",
+            containers=[Container()],
+            pod_affinity=[PodAffinityTerm({"app": "web"}, anti=True)],
+        ),
+    ]
+    snap = b.build_snapshot(nodes, {}, [web], pending_pods=pending)
+    batch = b.build_pod_batch(pending)
+    counts = np.asarray(snap.domain_counts)
+    # zone selector: both za nodes see the count, zb none
+    zone_sid = int(batch.affinity_sel[0, 0])
+    assert counts[:3, zone_sid].tolist() == [1.0, 1.0, 0.0]
+    # hostname selector: only a1
+    host_sid = int(batch.anti_affinity_sel[1, 0])
+    assert counts[:3, host_sid].tolist() == [1.0, 0.0, 0.0]
+
+
+def make_sched(nodes, running, utils, **cfg):
+    config = SchedulerConfig(batch_window=64, **cfg)
+    return Scheduler(
+        config,
+        advisor=StaticAdvisor(utils),
+        list_nodes=lambda: nodes,
+        list_running_pods=lambda: running,
+    )
+
+
+def test_scheduler_end_to_end_batched_vs_scalar():
+    """Full loop: batched and scalar paths bind every pod and agree on
+    placements for untruncated scores... the scalar path reproduces the
+    reference's uint64 truncation, so compare binding feasibility, not
+    exact node choice."""
+    nodes = [make_node(f"n{i}", cpu=8000) for i in range(6)]
+    utils = {
+        f"n{i}": NodeUtil(cpu_pct=10 * i, mem_pct=30, disk_io=5 * i)
+        for i in range(6)
+    }
+    pods = [
+        make_pod(f"p{i}", cpu=500, annotations={"diskIO": "10"},
+                 labels={"scv/priority": str(i % 3)})
+        for i in range(10)
+    ]
+
+    s_batch = make_sched(nodes, [], utils)
+    for p in pods:
+        s_batch.submit(p)
+    m = s_batch.run_cycle()
+    assert m.pods_in == 10 and m.pods_bound == 10 and not m.used_fallback
+
+    pods2 = [
+        make_pod(f"q{i}", cpu=500, annotations={"diskIO": "10"},
+                 labels={"scv/priority": str(i % 3)})
+        for i in range(10)
+    ]
+    config = SchedulerConfig.from_dict(
+        {"batch_window": 64, "feature_gates": {"tpu_batch_score": False}}
+    )
+    s_scalar = Scheduler(
+        config,
+        advisor=StaticAdvisor(utils),
+        list_nodes=lambda: nodes,
+        list_running_pods=lambda: [],
+    )
+    for p in pods2:
+        s_scalar.submit(p)
+    m2 = s_scalar.run_cycle()
+    assert m2.pods_bound == 10 and m2.used_fallback
+
+
+def test_scheduler_unschedulable_requeues_with_backoff():
+    nodes = [make_node("tiny", cpu=100, mem=2**20)]
+    s = make_sched(nodes, [], {"tiny": NodeUtil()})
+    s.submit(make_pod("huge", cpu=99999, mem=2**40))
+    m = s.run_cycle()
+    assert m.pods_unschedulable == 1 and m.pods_bound == 0
+    assert len(s.queue) == 1  # waiting in backoff
+    assert s.queue.pop_window(10) == []  # not ready yet
+
+
+def test_scheduler_constraints_respected_in_loop():
+    nodes = [
+        make_node("tainted", taints=[Taint(key="gpu", value="yes")]),
+        make_node("plain", labels={"disk": "ssd"}),
+    ]
+    utils = {n.name: NodeUtil(cpu_pct=50, disk_io=10) for n in nodes}
+    tolerant = make_pod("tolerant", annotations={"diskIO": "5"})
+    tolerant.tolerations = [Toleration(key="gpu", operator="Exists")]
+    tolerant.node_affinity = [MatchExpression("disk", "NotIn", ["ssd"])]
+    picky = make_pod("picky", annotations={"diskIO": "5"})
+    picky.node_affinity = [MatchExpression("disk", "In", ["ssd"])]
+
+    s = make_sched(nodes, [], utils)
+    s.submit(tolerant)
+    s.submit(picky)
+    s.run_cycle()
+    bound = {b.pod.name: b.node_name for b in s.binder.bindings}
+    assert bound == {"tolerant": "tainted", "picky": "plain"}

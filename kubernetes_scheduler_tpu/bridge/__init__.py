@@ -1,0 +1,14 @@
+"""Host <-> TPU-sidecar gRPC bridge (SURVEY.md §7 step 3).
+
+One RPC per scheduling cycle: ScheduleBatch(matrices) -> bindings.
+Replaces the reference's per-score network chatter (5·(N+1) Prometheus
+HTTP calls + O(N) Redis round-trips per pod, SURVEY.md §3.2) with a
+single dense transfer.
+"""
+
+from kubernetes_scheduler_tpu.bridge.client import (
+    EngineUnavailable,
+    LocalEngine,
+    RemoteEngine,
+)
+from kubernetes_scheduler_tpu.bridge.server import make_server

@@ -1,0 +1,139 @@
+"""Host-side client for the engine sidecar.
+
+`RemoteEngine` exposes the same call surface as the in-process engine
+(`schedule_batch(snapshot, pods, policy=..., ...) -> ScheduleResult`), so
+host/scheduler.py can swap between LocalEngine and RemoteEngine behind
+the TPUBatchScore feature gate. Deadline + bounded retry + health check
+implement the failure-detection contract of SURVEY.md §5: an unreachable
+sidecar raises EngineUnavailable and the scheduler's cycle falls back to
+the scalar path instead of stalling.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+import grpc
+import numpy as np
+
+from kubernetes_scheduler_tpu import engine
+from kubernetes_scheduler_tpu.bridge import codec
+from kubernetes_scheduler_tpu.bridge import schedule_pb2 as pb
+from kubernetes_scheduler_tpu.bridge.server import MAX_MESSAGE_BYTES, SERVICE
+
+log = logging.getLogger("yoda_tpu.bridge.client")
+
+_RETRYABLE = (
+    grpc.StatusCode.UNAVAILABLE,
+    grpc.StatusCode.DEADLINE_EXCEEDED,
+)
+
+
+class EngineUnavailable(RuntimeError):
+    """The sidecar could not serve the cycle (after retries)."""
+
+
+LocalEngine = engine.LocalEngine  # re-export; defined grpc-free in engine.py
+
+
+class RemoteEngine:
+    def __init__(
+        self,
+        target: str,
+        *,
+        deadline_seconds: float = 30.0,
+        retries: int = 1,
+        decisions_only: bool = False,
+    ):
+        self.target = target
+        self.deadline_seconds = deadline_seconds
+        self.retries = retries
+        self.decisions_only = decisions_only
+        self._channel = grpc.insecure_channel(
+            target,
+            options=[
+                ("grpc.max_receive_message_length", MAX_MESSAGE_BYTES),
+                ("grpc.max_send_message_length", MAX_MESSAGE_BYTES),
+            ],
+        )
+        self._schedule = self._channel.unary_unary(
+            f"/{SERVICE}/ScheduleBatch",
+            request_serializer=pb.ScheduleRequest.SerializeToString,
+            response_deserializer=pb.ScheduleReply.FromString,
+        )
+        self._health = self._channel.unary_unary(
+            f"/{SERVICE}/Health",
+            request_serializer=pb.HealthRequest.SerializeToString,
+            response_deserializer=pb.HealthReply.FromString,
+        )
+        self.last_engine_seconds = 0.0
+
+    def schedule_batch(
+        self,
+        snapshot,
+        pods,
+        *,
+        policy: str = "balanced_cpu_diskio",
+        assigner: str = "greedy",
+        normalizer: str = "min_max",
+    ) -> engine.ScheduleResult:
+        request = pb.ScheduleRequest(
+            policy=policy,
+            assigner=assigner,
+            normalizer=normalizer,
+            decisions_only=self.decisions_only,
+        )
+        codec.pack_fields(snapshot, request.snapshot)
+        codec.pack_fields(pods, request.pods)
+
+        last_err = None
+        for attempt in range(self.retries + 1):
+            try:
+                reply = self._schedule(request, timeout=self.deadline_seconds)
+                self.last_engine_seconds = reply.engine_seconds
+                return self._unpack_result(reply, snapshot, pods)
+            except grpc.RpcError as e:
+                last_err = e
+                if e.code() not in _RETRYABLE:
+                    raise EngineUnavailable(
+                        f"sidecar rejected cycle: {e.code().name}: {e.details()}"
+                    ) from e
+                log.warning(
+                    "sidecar %s unavailable (attempt %d/%d): %s",
+                    self.target, attempt + 1, self.retries + 1, e.code().name,
+                )
+                if attempt < self.retries:
+                    time.sleep(min(0.1 * 2**attempt, 1.0))
+        raise EngineUnavailable(
+            f"sidecar {self.target} unreachable after {self.retries + 1} attempts"
+        ) from last_err
+
+    def _unpack_result(self, reply, snapshot, pods) -> engine.ScheduleResult:
+        p = np.asarray(pods.request).shape[0]
+        n = np.asarray(snapshot.allocatable).shape[0]
+        # decisions_only replies omit the [p, n] matrices; fill with empties
+        defaults = {
+            "scores": np.zeros((p, n), np.float32),
+            "raw_scores": np.zeros((p, n), np.float32),
+            "feasible": np.zeros((p, n), bool),
+        }
+        return codec.unpack_fields(
+            engine.ScheduleResult, reply.result, defaults=defaults
+        )
+
+    def healthy(self, *, timeout: float = 2.0) -> bool:
+        try:
+            reply = self._health(pb.HealthRequest(), timeout=timeout)
+            return reply.status == "SERVING"
+        except grpc.RpcError:
+            return False
+
+    def health_info(self, *, timeout: float = 2.0) -> pb.HealthReply | None:
+        try:
+            return self._health(pb.HealthRequest(), timeout=timeout)
+        except grpc.RpcError:
+            return None
+
+    def close(self) -> None:
+        self._channel.close()

@@ -1,0 +1,160 @@
+"""The TPU engine sidecar: a gRPC server wrapping the batched engine.
+
+This process owns the device. The host scheduler (Python or native) sends
+one ScheduleBatch RPC per cycle; the sidecar runs the jitted program and
+returns bindings — the stateless, restartable device worker of SURVEY.md
+§5 ("sidecar restart = stateless recovery"). The gRPC stubs are
+hand-written against the method paths in schedule.proto because this
+image ships protoc without grpc_python_plugin.
+
+Run:  python -m kubernetes_scheduler_tpu.bridge.server --port 50051
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import threading
+import time
+from concurrent import futures
+
+import grpc
+import jax
+import numpy as np
+
+from kubernetes_scheduler_tpu import engine
+from kubernetes_scheduler_tpu.bridge import codec
+from kubernetes_scheduler_tpu.bridge import schedule_pb2 as pb
+
+log = logging.getLogger("yoda_tpu.bridge.server")
+
+SERVICE = "yodatpu.Engine"
+_DECISION_FIELDS = ("node_idx", "free_after", "n_assigned")
+
+# Matrices are ~P*N*4 bytes; 10k nodes x 4k pods of f32 scores is ~160 MB.
+MAX_MESSAGE_BYTES = 512 * 1024 * 1024
+
+
+class EngineService:
+    """Unary handlers for the two RPCs. A single worker thread serializes
+    device access (the batched design needs no cross-request locking —
+    contrast the reference's RWMutex around Score, scheduler.go:147-149)."""
+
+    def __init__(self, *, sharded_fn=None):
+        self._sharded_fn = sharded_fn
+        self.cycles_served = 0
+        self._lock = threading.Lock()
+
+    def schedule_batch(self, request: pb.ScheduleRequest, context) -> pb.ScheduleReply:
+        try:
+            snapshot = codec.unpack_fields(engine.SnapshotArrays, request.snapshot)
+            pods = codec.unpack_fields(engine.PodBatch, request.pods)
+        except (ValueError, TypeError) as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        t0 = time.perf_counter()
+        try:
+            if self._sharded_fn is not None:
+                res = self._sharded_fn(snapshot, pods)
+            else:
+                res = engine.schedule_batch(
+                    snapshot,
+                    pods,
+                    policy=request.policy or "balanced_cpu_diskio",
+                    assigner=request.assigner or "greedy",
+                    normalizer=request.normalizer or "min_max",
+                )
+        except ValueError as e:  # unknown policy/assigner/normalizer
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        res = jax.tree_util.tree_map(np.asarray, res)
+        dt = time.perf_counter() - t0
+        with self._lock:
+            self.cycles_served += 1
+        reply = pb.ScheduleReply(engine_seconds=dt)
+        only = set(_DECISION_FIELDS) if request.decisions_only else None
+        codec.pack_fields(res, reply.result, only=only)
+        return reply
+
+    def health(self, request: pb.HealthRequest, context) -> pb.HealthReply:
+        devs = jax.devices()
+        return pb.HealthReply(
+            status="SERVING",
+            device_count=len(devs),
+            platform=devs[0].platform if devs else "none",
+            cycles_served=self.cycles_served,
+        )
+
+
+def make_server(
+    address: str = "127.0.0.1:0",
+    *,
+    sharded_fn=None,
+    max_workers: int = 1,
+) -> tuple[grpc.Server, int, EngineService]:
+    """Build (server, bound_port, service). max_workers=1 keeps device
+    access single-writer; raise it only for a CPU-only sidecar."""
+    service = EngineService(sharded_fn=sharded_fn)
+    handlers = grpc.method_handlers_generic_handler(
+        SERVICE,
+        {
+            "ScheduleBatch": grpc.unary_unary_rpc_method_handler(
+                service.schedule_batch,
+                request_deserializer=pb.ScheduleRequest.FromString,
+                response_serializer=pb.ScheduleReply.SerializeToString,
+            ),
+            "Health": grpc.unary_unary_rpc_method_handler(
+                service.health,
+                request_deserializer=pb.HealthRequest.FromString,
+                response_serializer=pb.HealthReply.SerializeToString,
+            ),
+        },
+    )
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        options=[
+            ("grpc.max_receive_message_length", MAX_MESSAGE_BYTES),
+            ("grpc.max_send_message_length", MAX_MESSAGE_BYTES),
+        ],
+    )
+    server.add_generic_rpc_handlers((handlers,))
+    port = server.add_insecure_port(address)
+    if port == 0:
+        raise RuntimeError(f"could not bind {address}")
+    return server, port, service
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=50051)
+    parser.add_argument(
+        "--mesh-devices",
+        type=int,
+        default=0,
+        help="shard the node axis over this many devices (0 = single device)",
+    )
+    parser.add_argument("--policy", default="balanced_cpu_diskio")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    sharded_fn = None
+    if args.mesh_devices > 1:
+        from jax.sharding import Mesh
+        from kubernetes_scheduler_tpu.parallel.engine import make_sharded_schedule_fn
+        from kubernetes_scheduler_tpu.parallel.mesh import NODE_AXIS
+
+        mesh = Mesh(np.asarray(jax.devices()[: args.mesh_devices]), (NODE_AXIS,))
+        sharded_fn = make_sharded_schedule_fn(mesh, policy=args.policy)
+
+    server, port, _ = make_server(
+        f"{args.host}:{args.port}", sharded_fn=sharded_fn
+    )
+    server.start()
+    log.info(
+        "engine sidecar serving on %s:%d (devices=%s)",
+        args.host, port, jax.devices(),
+    )
+    server.wait_for_termination()
+
+
+if __name__ == "__main__":
+    main()

@@ -244,6 +244,21 @@ class ScheduleResult(NamedTuple):
     n_assigned: jnp.ndarray   # [] int32
 
 
+class LocalEngine:
+    """In-process engine with the bridge's call surface, so the host
+    scheduler swaps Local/Remote behind one attribute (grpc-free — the
+    no-bridge configuration must not import grpc)."""
+
+    def schedule_batch(self, snapshot, pods, **kw) -> "ScheduleResult":
+        return schedule_batch(snapshot, pods, **kw)
+
+    def healthy(self) -> bool:
+        return True
+
+    def close(self) -> None:
+        pass
+
+
 def compute_scores(
     snapshot: SnapshotArrays, pods: PodBatch, policy: str
 ) -> jnp.ndarray:

@@ -23,7 +23,7 @@ from typing import Callable
 
 import numpy as np
 
-from kubernetes_scheduler_tpu.engine import schedule_batch
+from kubernetes_scheduler_tpu.engine import LocalEngine
 from kubernetes_scheduler_tpu.host.advisor import NodeUtil
 from kubernetes_scheduler_tpu.host.plugins import ScalarYodaPlugin, scalar_schedule_one
 from kubernetes_scheduler_tpu.host.queue import SchedulingQueue
@@ -74,9 +74,11 @@ class Scheduler:
         binder=None,
         list_nodes: Callable[[], list[Node]],
         list_running_pods: Callable[[], list[Pod]],
+        engine=None,
     ):
         self.config = config
         self.advisor = advisor
+        self.engine = engine or LocalEngine()
         self.binder = binder or RecordingBinder()
         self.list_nodes = list_nodes
         self.list_running_pods = list_running_pods
@@ -142,7 +144,7 @@ class Scheduler:
             log.info("window has inter-pod affinity interactions; using greedy")
             assigner = "greedy"
         t0 = time.perf_counter()
-        res = schedule_batch(
+        res = self.engine.schedule_batch(
             snapshot,
             pods_batch,
             policy=self.config.policy,
@@ -151,6 +153,14 @@ class Scheduler:
         )
         idx = np.asarray(res.node_idx)
         m.engine_seconds = time.perf_counter() - t0
+        p_padded = int(np.asarray(pods_batch.request).shape[0])
+        if idx.shape != (p_padded,) or p_padded < len(window):
+            # a version-skewed remote engine must fail BEFORE any bind, so
+            # the fallback re-schedules the window exactly once
+            raise RuntimeError(
+                f"engine returned node_idx shape {idx.shape} for a "
+                f"{len(window)}-pod window padded to {p_padded}"
+            )
         for i, pod in enumerate(window):
             j = int(idx[i])
             if j >= 0:

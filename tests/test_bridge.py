@@ -1,0 +1,162 @@
+"""Bridge tests: codec roundtrip, server/client golden parity with the
+in-process engine, decisions_only wire slimming, health, and the
+unreachable-sidecar fallback path in the host scheduler."""
+
+import numpy as np
+import pytest
+
+from kubernetes_scheduler_tpu import engine
+from kubernetes_scheduler_tpu.bridge import codec
+from kubernetes_scheduler_tpu.bridge import schedule_pb2 as pb
+from kubernetes_scheduler_tpu.bridge.client import (
+    EngineUnavailable,
+    LocalEngine,
+    RemoteEngine,
+)
+from kubernetes_scheduler_tpu.bridge.server import make_server
+from kubernetes_scheduler_tpu.sim import gen_cluster, gen_pods
+
+
+@pytest.fixture(scope="module")
+def live_server():
+    server, port, service = make_server("127.0.0.1:0")
+    server.start()
+    client = RemoteEngine(f"127.0.0.1:{port}", deadline_seconds=60.0)
+    yield client, service
+    client.close()
+    server.stop(grace=None)
+
+
+# ---- codec ----------------------------------------------------------------
+
+
+def test_codec_roundtrip_dtypes():
+    for arr in [
+        np.arange(12, dtype=np.float32).reshape(3, 4),
+        np.array([[True, False], [False, True]]),
+        np.arange(5, dtype=np.int32),
+        np.float32(3.5),  # scalar
+    ]:
+        out = codec.unpack_array(codec.pack_array(arr))
+        np.testing.assert_array_equal(out, np.asarray(arr))
+        assert out.dtype == np.asarray(arr).dtype
+        assert out.shape == np.asarray(arr).shape
+
+
+def test_codec_namedtuple_roundtrip():
+    snap = gen_cluster(16, seed=0, constraints=True)
+    named = codec.pack_fields(snap, pb.NamedTensors())
+    back = codec.unpack_fields(engine.SnapshotArrays, named)
+    for name, a, b in zip(snap._fields, snap, back):
+        np.testing.assert_array_equal(np.asarray(a), b, err_msg=name)
+
+
+def test_codec_rejects_unknown_and_missing_fields():
+    named = codec.pack_fields(gen_pods(4, seed=1), pb.NamedTensors())
+    named.tensors["bogus"].CopyFrom(codec.pack_array(np.zeros(2)))
+    with pytest.raises(ValueError, match="unknown"):
+        codec.unpack_fields(engine.PodBatch, named)
+    del named.tensors["bogus"]
+    del named.tensors["request"]
+    with pytest.raises(ValueError, match="missing"):
+        codec.unpack_fields(engine.PodBatch, named)
+
+
+def test_codec_rejects_bad_payload():
+    t = codec.pack_array(np.zeros((2, 3), np.float32))
+    t.shape[:] = [2, 4]
+    with pytest.raises(ValueError, match="elements"):
+        codec.unpack_array(t)
+
+
+# ---- server/client --------------------------------------------------------
+
+
+def test_remote_matches_local(live_server):
+    client, _ = live_server
+    snap = gen_cluster(32, seed=2, constraints=True)
+    pods = gen_pods(8, seed=3, constraints=True)
+    local = LocalEngine().schedule_batch(snap, pods)
+    remote = client.schedule_batch(snap, pods)
+    np.testing.assert_array_equal(np.asarray(local.node_idx), remote.node_idx)
+    np.testing.assert_allclose(
+        np.asarray(local.scores), remote.scores, rtol=1e-6
+    )
+    assert int(local.n_assigned) == int(remote.n_assigned)
+    assert client.last_engine_seconds > 0
+
+
+def test_decisions_only_slims_reply(live_server):
+    client, _ = live_server
+    snap = gen_cluster(16, seed=4)
+    pods = gen_pods(4, seed=5)
+    slim = RemoteEngine(client.target, decisions_only=True, deadline_seconds=60.0)
+    try:
+        full = client.schedule_batch(snap, pods)
+        thin = slim.schedule_batch(snap, pods)
+    finally:
+        slim.close()
+    np.testing.assert_array_equal(full.node_idx, thin.node_idx)
+    np.testing.assert_array_equal(full.free_after, thin.free_after)
+    assert not thin.scores.any()  # matrices omitted on the wire
+
+
+def test_invalid_policy_is_not_retried(live_server):
+    client, _ = live_server
+    snap = gen_cluster(8, seed=6)
+    pods = gen_pods(2, seed=7)
+    with pytest.raises(EngineUnavailable, match="INVALID_ARGUMENT"):
+        client.schedule_batch(snap, pods, policy="nope")
+
+
+def test_health(live_server):
+    client, service = live_server
+    assert client.healthy()
+    info = client.health_info()
+    assert info.status == "SERVING"
+    assert info.device_count >= 1
+    assert info.cycles_served == service.cycles_served
+
+
+def test_unreachable_sidecar():
+    client = RemoteEngine("127.0.0.1:1", deadline_seconds=0.5, retries=1)
+    try:
+        assert not client.healthy(timeout=0.5)
+        with pytest.raises(EngineUnavailable):
+            client.schedule_batch(gen_cluster(4, seed=0), gen_pods(2, seed=1))
+    finally:
+        client.close()
+
+
+def test_scheduler_falls_back_when_sidecar_down():
+    from kubernetes_scheduler_tpu.host.advisor import NodeUtil, StaticAdvisor
+    from kubernetes_scheduler_tpu.host.scheduler import Scheduler
+    from kubernetes_scheduler_tpu.host.types import Container, Node, Pod
+    from kubernetes_scheduler_tpu.utils.config import SchedulerConfig
+
+    nodes = [
+        Node(name=f"n{i}", allocatable={"cpu": 8000.0, "memory": 2**34, "pods": 110})
+        for i in range(4)
+    ]
+    utils = {
+        n.name: NodeUtil(cpu_pct=10.0 * i, mem_pct=20.0, disk_io=5.0)
+        for i, n in enumerate(nodes)
+    }
+    client = RemoteEngine("127.0.0.1:1", deadline_seconds=0.3, retries=0)
+    sched = Scheduler(
+        SchedulerConfig(batch_window=8),
+        advisor=StaticAdvisor(utils),
+        list_nodes=lambda: nodes,
+        list_running_pods=lambda: [],
+        engine=client,
+    )
+    try:
+        for i in range(3):
+            sched.submit(
+                Pod(name=f"p{i}", containers=[Container(requests={"cpu": 100.0})])
+            )
+        m = sched.run_cycle()
+    finally:
+        client.close()
+    assert m.used_fallback
+    assert m.pods_bound == 3

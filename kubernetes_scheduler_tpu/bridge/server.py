@@ -40,8 +40,11 @@ class EngineService:
     device access (the batched design needs no cross-request locking —
     contrast the reference's RWMutex around Score, scheduler.go:147-149)."""
 
-    def __init__(self, *, sharded_fn=None):
+    def __init__(self, *, sharded_fn=None, sharded_opts: dict | None = None):
         self._sharded_fn = sharded_fn
+        # options baked into sharded_fn at startup; requests asking for
+        # anything else must fail loud, not be silently overridden
+        self._sharded_opts = sharded_opts or {}
         self.cycles_served = 0
         self._lock = threading.Lock()
 
@@ -54,6 +57,19 @@ class EngineService:
         t0 = time.perf_counter()
         try:
             if self._sharded_fn is not None:
+                asked = {
+                    "policy": request.policy,
+                    "assigner": request.assigner,
+                    "normalizer": request.normalizer,
+                }
+                for key, want in asked.items():
+                    have = self._sharded_opts.get(key)
+                    if want and have and want != have:
+                        context.abort(
+                            grpc.StatusCode.INVALID_ARGUMENT,
+                            f"sidecar's sharded engine is fixed to "
+                            f"{key}={have!r}; request asked for {want!r}",
+                        )
                 res = self._sharded_fn(snapshot, pods)
             else:
                 res = engine.schedule_batch(
@@ -88,11 +104,12 @@ def make_server(
     address: str = "127.0.0.1:0",
     *,
     sharded_fn=None,
+    sharded_opts: dict | None = None,
     max_workers: int = 1,
 ) -> tuple[grpc.Server, int, EngineService]:
     """Build (server, bound_port, service). max_workers=1 keeps device
     access single-writer; raise it only for a CPU-only sidecar."""
-    service = EngineService(sharded_fn=sharded_fn)
+    service = EngineService(sharded_fn=sharded_fn, sharded_opts=sharded_opts)
     handlers = grpc.method_handlers_generic_handler(
         SERVICE,
         {
@@ -144,9 +161,14 @@ def main(argv=None):
 
         mesh = Mesh(np.asarray(jax.devices()[: args.mesh_devices]), (NODE_AXIS,))
         sharded_fn = make_sharded_schedule_fn(mesh, policy=args.policy)
+        sharded_opts = {"policy": args.policy, "normalizer": "min_max"}
+    else:
+        sharded_opts = None
 
     server, port, _ = make_server(
-        f"{args.host}:{args.port}", sharded_fn=sharded_fn
+        f"{args.host}:{args.port}",
+        sharded_fn=sharded_fn,
+        sharded_opts=sharded_opts,
     )
     server.start()
     log.info(

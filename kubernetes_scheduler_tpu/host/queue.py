@@ -84,3 +84,103 @@ class SchedulingQueue:
 
     def __len__(self) -> int:
         return len(self._active) + len(self._backoff)
+
+
+class NativeBackedQueue:
+    """SchedulingQueue surface over the C++ queue (native/queue.cc).
+
+    Pods are handed to the native side as opaque uint64 handles; this
+    wrapper owns the handle -> Pod map. Raises RuntimeError at
+    construction when the native library is unavailable — callers (the
+    Scheduler) then keep the pure-Python queue.
+    """
+
+    def __init__(
+        self,
+        *,
+        initial_backoff: float = 1.0,
+        max_backoff: float = 10.0,
+        clock=time.monotonic,
+    ):
+        from kubernetes_scheduler_tpu import native
+
+        self._q = native.NativeQueue(
+            initial_backoff=initial_backoff, max_backoff=max_backoff
+        )
+        self._clock = clock
+        self._pods: dict[int, Pod] = {}
+        self._handles = itertools.count(1)
+        self._by_uid: dict[str, int] = {}
+        # native-queue entries per handle; the handle->Pod mapping may only
+        # be dropped once no copy is queued AND the pod is done (so a uid
+        # pushed twice survives the first copy's mark_scheduled)
+        self._outstanding: dict[int, int] = {}
+
+    def _handle(self, pod: Pod) -> int:
+        uid = f"{pod.namespace}/{pod.name}"
+        h = self._by_uid.get(uid)
+        if h is None:
+            h = next(self._handles)
+            self._by_uid[uid] = h
+        self._pods[h] = pod
+        return h
+
+    def _drop_if_done(self, h: int) -> None:
+        if self._outstanding.get(h, 0) <= 0:
+            self._outstanding.pop(h, None)
+            pod = self._pods.pop(h, None)
+            if pod is not None:
+                self._by_uid.pop(f"{pod.namespace}/{pod.name}", None)
+
+    def push(self, pod: Pod) -> None:
+        h = self._handle(pod)
+        self._outstanding[h] = self._outstanding.get(h, 0) + 1
+        self._q.push(h, pod_priority(pod))
+
+    def requeue_unschedulable(self, pod: Pod) -> None:
+        h = self._handle(pod)
+        self._outstanding[h] = self._outstanding.get(h, 0) + 1
+        self._q.requeue_unschedulable(h, pod_priority(pod), self._clock())
+
+    def mark_scheduled(self, pod: Pod) -> None:
+        uid = f"{pod.namespace}/{pod.name}"
+        h = self._by_uid.get(uid)
+        if h is not None:
+            self._q.mark_scheduled(h)
+            self._drop_if_done(h)
+
+    def pop_window(self, max_pods: int) -> list[Pod]:
+        handles = self._q.pop_window(max_pods, self._clock())
+        out = []
+        for h in handles:
+            h = int(h)
+            pod = self._pods.get(h)
+            self._outstanding[h] = self._outstanding.get(h, 1) - 1
+            if pod is not None:
+                out.append(pod)
+        return out
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+
+def make_queue(
+    *,
+    initial_backoff: float = 1.0,
+    max_backoff: float = 10.0,
+    prefer_native: bool = True,
+    clock=time.monotonic,
+):
+    """Native queue when the toolchain/library allows, else pure Python."""
+    if prefer_native:
+        try:
+            return NativeBackedQueue(
+                initial_backoff=initial_backoff,
+                max_backoff=max_backoff,
+                clock=clock,
+            )
+        except (RuntimeError, ImportError):
+            pass
+    return SchedulingQueue(
+        initial_backoff=initial_backoff, max_backoff=max_backoff, clock=clock
+    )

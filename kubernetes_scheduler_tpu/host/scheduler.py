@@ -26,7 +26,7 @@ import numpy as np
 from kubernetes_scheduler_tpu.engine import LocalEngine
 from kubernetes_scheduler_tpu.host.advisor import NodeUtil
 from kubernetes_scheduler_tpu.host.plugins import ScalarYodaPlugin, scalar_schedule_one
-from kubernetes_scheduler_tpu.host.queue import SchedulingQueue
+from kubernetes_scheduler_tpu.host.queue import make_queue
 from kubernetes_scheduler_tpu.host.snapshot import SnapshotBuilder, pod_resource_request
 from kubernetes_scheduler_tpu.host.types import Node, Pod
 from kubernetes_scheduler_tpu.utils.config import SchedulerConfig
@@ -82,9 +82,21 @@ class Scheduler:
         self.binder = binder or RecordingBinder()
         self.list_nodes = list_nodes
         self.list_running_pods = list_running_pods
-        self.queue = SchedulingQueue(
+        if config.feature_gates.native_host:
+            from kubernetes_scheduler_tpu import native
+
+            self._native_ok = native.available()
+            if not self._native_ok:
+                log.warning(
+                    "native_host enabled but libyoda_host unavailable; "
+                    "using pure-Python host paths"
+                )
+        else:
+            self._native_ok = False
+        self.queue = make_queue(
             initial_backoff=config.initial_backoff_seconds,
             max_backoff=config.max_backoff_seconds,
+            prefer_native=self._native_ok,
         )
         self.builder = SnapshotBuilder(
             extended_resources=list(config.extended_resources)
@@ -154,12 +166,17 @@ class Scheduler:
         idx = np.asarray(res.node_idx)
         m.engine_seconds = time.perf_counter() - t0
         p_padded = int(np.asarray(pods_batch.request).shape[0])
-        if idx.shape != (p_padded,) or p_padded < len(window):
+        if (
+            idx.shape != (p_padded,)
+            or p_padded < len(window)
+            or (idx[: len(window)] >= len(nodes)).any()
+        ):
             # a version-skewed remote engine must fail BEFORE any bind, so
             # the fallback re-schedules the window exactly once
             raise RuntimeError(
-                f"engine returned node_idx shape {idx.shape} for a "
-                f"{len(window)}-pod window padded to {p_padded}"
+                f"engine returned node_idx shape {idx.shape} (max "
+                f"{idx.max() if idx.size else 'n/a'}) for a {len(window)}-pod "
+                f"window padded to {p_padded} over {len(nodes)} nodes"
             )
         for i, pod in enumerate(window):
             j = int(idx[i])
@@ -172,6 +189,9 @@ class Scheduler:
                 m.pods_unschedulable += 1
 
     def _run_scalar(self, window, nodes, utils, m: CycleMetrics):
+        if nodes and self._native_ok:
+            self._run_scalar_native(window, nodes, utils, m)
+            return
         plugin = ScalarYodaPlugin(utils)
         free = {
             n.name: {
@@ -188,6 +208,45 @@ class Scheduler:
             best = scalar_schedule_one(plugin, pod, nodes, free) if nodes else None
             if best is not None:
                 self.binder.bind(pod, best)
+                self.queue.mark_scheduled(pod)
+                m.pods_bound += 1
+            else:
+                self.queue.requeue_unschedulable(pod)
+                m.pods_unschedulable += 1
+
+    def _run_scalar_native(self, window, nodes, utils, m: CycleMetrics):
+        """The scalar fallback in C++ (native/scalar.cc): same decisions
+        as the Python plugin path, one library call per window."""
+        from kubernetes_scheduler_tpu import native
+        from kubernetes_scheduler_tpu.host.snapshot import parse_float_or_zero
+
+        names = self.builder.resource_names
+        req = np.array(
+            [[pod_resource_request(p, r) for r in names] for p in window],
+            np.float32,
+        )
+        r_io = np.array(
+            [parse_float_or_zero(p.annotations.get("diskIO")) for p in window],
+            np.float32,
+        )
+        free = np.array(
+            [[n.allocatable.get(r, 0.0) for r in names] for n in nodes],
+            np.float32,
+        )
+        node_index = {n.name: j for j, n in enumerate(nodes)}
+        for pod in self.list_running_pods():
+            j = node_index.get(pod.node_name)
+            if j is not None:
+                free[j] -= [pod_resource_request(pod, r) for r in names]
+        util = [utils.get(n.name, NodeUtil()) for n in nodes]
+        disk_io = np.array([u.disk_io for u in util], np.float32)
+        cpu_pct = np.array([u.cpu_pct for u in util], np.float32)
+
+        idx, _, _ = native.scalar_cycle(req, r_io, free, disk_io, cpu_pct)
+        for i, pod in enumerate(window):
+            j = int(idx[i])
+            if j >= 0:
+                self.binder.bind(pod, nodes[j].name)
                 self.queue.mark_scheduled(pod)
                 m.pods_bound += 1
             else:

@@ -1,0 +1,229 @@
+"""ctypes bindings for the native host runtime (native/libyoda_host.so).
+
+The reference's host is compiled Go; ours keeps the host hot paths native
+too: the scheduling queue, the scalar fallback cycle, and requested-matrix
+aggregation run in C++ (native/*.cc), reached from Python without
+pybind11 (not in this image) via ctypes over flat numpy buffers.
+
+The library is built on demand with `make -C native` the first time it is
+needed; `available()` reports whether a toolchain/library exists so every
+caller can fall back to the pure-Python implementations.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+log = logging.getLogger("yoda_tpu.native")
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libyoda_host.so")
+ABI_VERSION = 1
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_load_failed = False
+
+
+def _sources_newer_than_lib() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    for name in os.listdir(_NATIVE_DIR):
+        if name.endswith((".cc", ".h")):
+            if os.path.getmtime(os.path.join(_NATIVE_DIR, name)) > lib_mtime:
+                return True
+    return False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["make", "-C", _NATIVE_DIR],
+            check=True,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        return True
+    except (subprocess.SubprocessError, OSError) as e:
+        out = getattr(e, "stderr", "") or str(e)
+        log.warning("native build failed, using pure-Python host paths: %s", out)
+        return False
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    i64 = ctypes.c_int64
+
+    lib.yoda_host_abi_version.restype = ctypes.c_int32
+    lib.yoda_queue_new.restype = ctypes.c_void_p
+    lib.yoda_queue_new.argtypes = [ctypes.c_double, ctypes.c_double]
+    lib.yoda_queue_free.argtypes = [ctypes.c_void_p]
+    lib.yoda_queue_push.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int32]
+    lib.yoda_queue_requeue_unschedulable.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int32, ctypes.c_double,
+    ]
+    lib.yoda_queue_mark_scheduled.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.yoda_queue_pop_window.restype = i64
+    lib.yoda_queue_pop_window.argtypes = [ctypes.c_void_p, ctypes.c_double, u64p, i64]
+    lib.yoda_queue_len.restype = i64
+    lib.yoda_queue_len.argtypes = [ctypes.c_void_p]
+
+    lib.yoda_scalar_cycle.restype = i64
+    lib.yoda_scalar_cycle.argtypes = [
+        i64, i64, i64, f32p, f32p, f32p, f32p, f32p, ctypes.c_int, i32p,
+    ]
+    lib.yoda_aggregate_requested.argtypes = [i64, i64, i64, i32p, f32p, f32p]
+    return lib
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if _sources_newer_than_lib() and not _build():
+            _load_failed = True
+            return None
+        try:
+            lib = _bind(ctypes.CDLL(_LIB_PATH))
+        except OSError as e:
+            log.warning("could not load %s: %s", _LIB_PATH, e)
+            _load_failed = True
+            return None
+        got = lib.yoda_host_abi_version()
+        if got != ABI_VERSION:
+            log.warning("native ABI %d != expected %d; rebuilding", got, ABI_VERSION)
+            subprocess.run(
+                ["make", "-C", _NATIVE_DIR, "clean"], capture_output=True
+            )
+            if not _build():
+                _load_failed = True
+                return None
+            lib = _bind(ctypes.CDLL(_LIB_PATH))
+        _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _f32(a) -> np.ndarray:
+    return np.ascontiguousarray(a, dtype=np.float32)
+
+
+def _ptr(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+class NativeQueue:
+    """Priority + backoff queue over opaque uint64 pod handles.
+
+    Callers map handles to Pod objects (host/queue.py's NativeBackedQueue
+    does this); `now` is injected for testable clocks.
+    """
+
+    def __init__(self, *, initial_backoff: float = 1.0, max_backoff: float = 10.0):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._q = lib.yoda_queue_new(initial_backoff, max_backoff)
+
+    def push(self, pod: int, priority: int) -> None:
+        self._lib.yoda_queue_push(self._q, pod, priority)
+
+    def requeue_unschedulable(self, pod: int, priority: int, now: float) -> None:
+        self._lib.yoda_queue_requeue_unschedulable(self._q, pod, priority, now)
+
+    def mark_scheduled(self, pod: int) -> None:
+        self._lib.yoda_queue_mark_scheduled(self._q, pod)
+
+    def pop_window(self, max_pods: int, now: float) -> np.ndarray:
+        out = np.empty(max_pods, dtype=np.uint64)
+        n = self._lib.yoda_queue_pop_window(
+            self._q, now, _ptr(out, ctypes.c_uint64), max_pods
+        )
+        return out[:n]
+
+    def __len__(self) -> int:
+        return int(self._lib.yoda_queue_len(self._q))
+
+    def __del__(self):
+        q = getattr(self, "_q", None)
+        if q:
+            self._lib.yoda_queue_free(q)
+            self._q = None
+
+
+def scalar_cycle(
+    pod_req,
+    r_io,
+    free_cap,
+    disk_io,
+    cpu_pct,
+    *,
+    truncate: bool = True,
+) -> tuple[np.ndarray, np.ndarray, int]:
+    """Run the native scalar fallback cycle.
+
+    Returns (node_idx [P], free_after [N,R], n_bound). Inputs are any
+    array-likes; row order of pod_req is the scheduling (priority) order.
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    pod_req = _f32(pod_req)
+    r_io = _f32(r_io)
+    free = _f32(free_cap).copy()
+    disk_io = _f32(disk_io)
+    cpu_pct = _f32(cpu_pct)
+    p, r = pod_req.shape
+    n = free.shape[0]
+    if free.shape != (n, r):
+        raise ValueError(f"free_cap shape {free.shape} != ({n}, {r})")
+    if r_io.shape != (p,) or disk_io.shape != (n,) or cpu_pct.shape != (n,):
+        raise ValueError("inconsistent scalar_cycle input shapes")
+    out = np.empty(p, dtype=np.int32)
+    bound = lib.yoda_scalar_cycle(
+        p, n, r,
+        _ptr(pod_req, ctypes.c_float), _ptr(r_io, ctypes.c_float),
+        _ptr(free, ctypes.c_float), _ptr(disk_io, ctypes.c_float),
+        _ptr(cpu_pct, ctypes.c_float), int(truncate),
+        _ptr(out, ctypes.c_int32),
+    )
+    return out, free, int(bound)
+
+
+def aggregate_requested(pod_node, pod_req, n_nodes: int) -> np.ndarray:
+    """Sum running-pod requests into a fresh [n_nodes, R] matrix."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    pod_node = np.ascontiguousarray(pod_node, dtype=np.int32)
+    pod_req = _f32(pod_req)
+    m, r = pod_req.shape
+    if pod_node.shape != (m,):
+        raise ValueError("pod_node/pod_req length mismatch")
+    out = np.zeros((n_nodes, r), dtype=np.float32)
+    lib.yoda_aggregate_requested(
+        m, n_nodes, r,
+        _ptr(pod_node, ctypes.c_int32), _ptr(pod_req, ctypes.c_float),
+        _ptr(out, ctypes.c_float),
+    )
+    return out

@@ -25,6 +25,9 @@ class FeatureGates:
     BASELINE.json)."""
 
     tpu_batch_score: bool = True
+    # use the C++ host runtime (native/) for the queue and the scalar
+    # fallback cycle; off -> pure-Python equivalents, same decisions
+    native_host: bool = True
 
 
 @dataclass

@@ -1,0 +1,102 @@
+// Scheduling queue: priority heap + backoff heap.
+//
+// Semantics mirror kubernetes_scheduler_tpu/host/queue.py (itself modeled
+// on the reference's sort.go:8-18 comparator and the upstream queue's
+// podInitialBackoffSeconds/podMaxBackoffSeconds behavior,
+// deploy/yoda-scheduler.yaml:19-20): higher priority first, FIFO among
+// equals via a monotone sequence number; unschedulable pods re-enter the
+// active queue only after an exponentially growing delay.
+
+#include "yoda_host.h"
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <queue>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct ActiveEntry {
+  int32_t priority;
+  uint64_t seq;
+  uint64_t pod;
+  // min-heap on (-priority, seq): invert for std::priority_queue's max-heap
+  bool operator<(const ActiveEntry& o) const {
+    if (priority != o.priority) return priority < o.priority;
+    return seq > o.seq;
+  }
+};
+
+struct BackoffEntry {
+  double ready_at;
+  uint64_t seq;
+  uint64_t pod;
+  int32_t priority;
+  bool operator<(const BackoffEntry& o) const {
+    if (ready_at != o.ready_at) return ready_at > o.ready_at;  // min-heap
+    return seq > o.seq;
+  }
+};
+
+}  // namespace
+
+struct YodaQueue {
+  std::priority_queue<ActiveEntry> active;
+  std::priority_queue<BackoffEntry> backoff;
+  std::unordered_map<uint64_t, int32_t> attempts;
+  uint64_t seq = 0;
+  double initial_backoff;
+  double max_backoff;
+};
+
+extern "C" {
+
+YodaQueue* yoda_queue_new(double initial_backoff, double max_backoff) {
+  auto* q = new YodaQueue();
+  q->initial_backoff = initial_backoff;
+  q->max_backoff = max_backoff;
+  return q;
+}
+
+void yoda_queue_free(YodaQueue* q) { delete q; }
+
+void yoda_queue_push(YodaQueue* q, uint64_t pod, int32_t priority) {
+  q->active.push(ActiveEntry{priority, q->seq++, pod});
+}
+
+void yoda_queue_requeue_unschedulable(YodaQueue* q, uint64_t pod,
+                                      int32_t priority, double now) {
+  int32_t attempt = ++q->attempts[pod];
+  double delay = q->initial_backoff * std::ldexp(1.0, attempt - 1);
+  delay = std::min(delay, q->max_backoff);
+  q->backoff.push(BackoffEntry{now + delay, q->seq++, pod, priority});
+}
+
+void yoda_queue_mark_scheduled(YodaQueue* q, uint64_t pod) {
+  q->attempts.erase(pod);
+}
+
+int64_t yoda_queue_pop_window(YodaQueue* q, double now, uint64_t* out,
+                              int64_t max_n) {
+  while (!q->backoff.empty() && q->backoff.top().ready_at <= now) {
+    const BackoffEntry e = q->backoff.top();
+    q->backoff.pop();
+    q->active.push(ActiveEntry{e.priority, q->seq++, e.pod});
+  }
+  int64_t n = 0;
+  while (!q->active.empty() && n < max_n) {
+    out[n++] = q->active.top().pod;
+    q->active.pop();
+  }
+  return n;
+}
+
+int64_t yoda_queue_len(const YodaQueue* q) {
+  return static_cast<int64_t>(q->active.size() + q->backoff.size());
+}
+
+int32_t yoda_host_abi_version(void) { return 1; }
+
+}  // extern "C"

@@ -1,0 +1,107 @@
+// Scalar fallback scoring cycle.
+//
+// Native implementation of the TPUBatchScore=false path: the same
+// decisions as host/plugins.py's ScalarYodaPlugin + scalar_schedule_one
+// (which reproduce the reference's per-pod hook sequence, SURVEY.md
+// §3.2), computed in double precision like the Go float64 original:
+//   u_i = diskIO_i / 50,  v_i = cpu_i / 100        (algorithm.go:70-75)
+//   beta = 1/(1 + Rcpu/Rio), alpha = 1 - beta       (algorithm.go:105-106)
+//   S_i = 10 - 10*|alpha*v_i - beta*u_i|            (algorithm.go:110-111)
+//   optional uint64 truncation                      (algorithm.go:113)
+//   min-max normalize to [0,100], guard hi==lo      (scheduler.go:161-180)
+// plus the resource-fit filter and capacity decrement upstream provides
+// around the plugin. Statistics (u_avg, variance) are intentionally not
+// computed: the reference stores them in Redis but the live formula never
+// reads them (SURVEY.md §2 "score (live path)").
+
+#include "yoda_host.h"
+
+#include <cmath>
+#include <cstdint>
+#include <vector>
+
+extern "C" int64_t yoda_scalar_cycle(int64_t P, int64_t N, int64_t R,
+                                     const float* pod_req, const float* r_io,
+                                     float* free_cap, const float* disk_io,
+                                     const float* cpu_pct, int truncate,
+                                     int32_t* out_idx) {
+  std::vector<double> u(N), v(N);
+  for (int64_t j = 0; j < N; ++j) {
+    u[j] = disk_io[j] / 50.0;
+    v[j] = cpu_pct[j] / 100.0;
+  }
+  std::vector<double> score(N);
+  std::vector<char> feasible(N);
+
+  int64_t bound = 0;
+  for (int64_t i = 0; i < P; ++i) {
+    const float* req = pod_req + i * R;
+
+    // filter: resource fit against current free capacity
+    bool any = false;
+    for (int64_t j = 0; j < N; ++j) {
+      bool ok = true;
+      const float* freej = free_cap + j * R;
+      for (int64_t r = 0; r < R; ++r) {
+        if (req[r] > 0.0f && req[r] > freej[r]) {
+          ok = false;
+          break;
+        }
+      }
+      feasible[j] = ok;
+      any |= ok;
+    }
+    if (!any) {
+      out_idx[i] = -1;
+      continue;
+    }
+
+    // score
+    const double rio = static_cast<double>(r_io[i]);
+    const double rcpu = static_cast<double>(req[0]);
+    const double beta = rio > 0.0 ? 1.0 / (1.0 + rcpu / rio) : 0.0;
+    const double alpha = 1.0 - beta;
+    double hi = 0.0;  // reference clamps highest at >= 0 (scheduler.go:165)
+    double lo = std::numeric_limits<double>::infinity();
+    for (int64_t j = 0; j < N; ++j) {
+      if (!feasible[j]) continue;
+      double s = 10.0 - 10.0 * std::fabs(alpha * v[j] - beta * u[j]);
+      if (truncate) s = s >= 0.0 ? std::trunc(s) : 0.0;
+      score[j] = s;
+      if (s > hi) hi = s;
+      if (s < lo) lo = s;
+    }
+    if (hi == lo) lo -= 1.0;
+
+    // normalize + deterministic argmax (first max in node order)
+    int64_t best = -1;
+    double best_s = -std::numeric_limits<double>::infinity();
+    for (int64_t j = 0; j < N; ++j) {
+      if (!feasible[j]) continue;
+      const double s = (score[j] - lo) * 100.0 / (hi - lo);
+      if (s > best_s) {
+        best_s = s;
+        best = j;
+      }
+    }
+
+    out_idx[i] = static_cast<int32_t>(best);
+    float* freeb = free_cap + best * R;
+    for (int64_t r = 0; r < R; ++r) freeb[r] -= req[r];
+    ++bound;
+  }
+  return bound;
+}
+
+extern "C" void yoda_aggregate_requested(int64_t M, int64_t N, int64_t R,
+                                         const int32_t* pod_node,
+                                         const float* pod_req,
+                                         float* requested) {
+  for (int64_t i = 0; i < M; ++i) {
+    const int32_t j = pod_node[i];
+    if (j < 0 || j >= N) continue;
+    const float* req = pod_req + i * R;
+    float* row = requested + static_cast<int64_t>(j) * R;
+    for (int64_t r = 0; r < R; ++r) row[r] += req[r];
+  }
+}

@@ -1,0 +1,83 @@
+/* C API of the native host runtime (libyoda_host.so).
+ *
+ * The reference's host runtime is a compiled Go binary embedding the
+ * upstream kube-scheduler (SURVEY.md L1/L2 + the implicit upstream layer);
+ * here the host-side hot paths — the scheduling queue, the scalar
+ * fallback scoring cycle, and snapshot aggregation — are native C++,
+ * bound into Python with ctypes (kubernetes_scheduler_tpu/native/).
+ *
+ * All tensor arguments are dense row-major float32/int32 buffers, the
+ * same layout the bridge ships to the device.
+ */
+#ifndef YODA_HOST_H
+#define YODA_HOST_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* ---- scheduling queue ------------------------------------------------
+ * Priority ordering (higher first, FIFO among equals — the sort.go:8-18
+ * comparator) + exponential retry backoff between initial and max
+ * seconds (deploy/yoda-scheduler.yaml:19-20). Pods are opaque uint64
+ * handles owned by the caller. The caller supplies `now` so tests can
+ * drive a fake clock.
+ */
+typedef struct YodaQueue YodaQueue;
+
+YodaQueue* yoda_queue_new(double initial_backoff, double max_backoff);
+void yoda_queue_free(YodaQueue* q);
+void yoda_queue_push(YodaQueue* q, uint64_t pod, int32_t priority);
+/* Failed cycle: requeue with exponential backoff. */
+void yoda_queue_requeue_unschedulable(YodaQueue* q, uint64_t pod,
+                                      int32_t priority, double now);
+/* Successful bind: clear the retry counter. */
+void yoda_queue_mark_scheduled(YodaQueue* q, uint64_t pod);
+/* Drain due backoff entries, then pop up to max_n pods in priority order.
+ * Returns the number written to out. */
+int64_t yoda_queue_pop_window(YodaQueue* q, double now, uint64_t* out,
+                              int64_t max_n);
+int64_t yoda_queue_len(const YodaQueue* q);
+
+/* ---- scalar fallback cycle -------------------------------------------
+ * The TPUBatchScore=false path: per pod, sequentially — utilization
+ * statistics, BalancedCpuDiskIO score (algorithm.go:99-119, with the
+ * uint64 truncation at :113 when truncate != 0), min-max normalization
+ * (scheduler.go:158-183), resource-fit filtering against free capacity,
+ * deterministic argmax (first max in node order), capacity decrement.
+ *
+ * pod_req  [P,R]  pod resource requests (priority order = row order)
+ * r_io     [P]    diskIO annotation MB/s (0 = absent -> beta = 0)
+ * free_cap [N,R]  in: free capacity; out: capacity after bindings
+ * disk_io  [N]    node disk-IO MB/s   (advisor series)
+ * cpu_pct  [N]    node CPU percent    (advisor series)
+ * out_idx  [P]    assigned node index, -1 = unschedulable
+ * Returns the number of pods bound.
+ */
+int64_t yoda_scalar_cycle(int64_t P, int64_t N, int64_t R,
+                          const float* pod_req, const float* r_io,
+                          float* free_cap, const float* disk_io,
+                          const float* cpu_pct, int truncate,
+                          int32_t* out_idx);
+
+/* ---- snapshot aggregation --------------------------------------------
+ * Sum running-pod requests into the per-node requested matrix
+ * (the host-side analog of CalculateResourceAllocatableRequest's
+ * nonZeroRequested accumulation, algorithm.go:209-233).
+ * pod_node [M] node index per running pod (entries outside [0,N) skipped)
+ * pod_req  [M,R]; requested [N,R] accumulated in place.
+ */
+void yoda_aggregate_requested(int64_t M, int64_t N, int64_t R,
+                              const int32_t* pod_node, const float* pod_req,
+                              float* requested);
+
+/* Library ABI version; bump on any signature change. */
+int32_t yoda_host_abi_version(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* YODA_HOST_H */

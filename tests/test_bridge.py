@@ -109,6 +109,30 @@ def test_invalid_policy_is_not_retried(live_server):
         client.schedule_batch(snap, pods, policy="nope")
 
 
+def test_sharded_sidecar_rejects_mismatched_options():
+    """A sidecar whose engine is baked to one policy must reject, not
+    silently override, a request asking for another."""
+    from kubernetes_scheduler_tpu.engine import schedule_batch
+
+    fixed = lambda s, p: schedule_batch(s, p, policy="balanced_diskio")  # noqa: E731
+    server, port, _ = make_server(
+        "127.0.0.1:0",
+        sharded_fn=fixed,
+        sharded_opts={"policy": "balanced_diskio", "normalizer": "min_max"},
+    )
+    server.start()
+    client = RemoteEngine(f"127.0.0.1:{port}", deadline_seconds=60.0)
+    try:
+        snap, pods = gen_cluster(8, seed=8), gen_pods(2, seed=9)
+        ok = client.schedule_batch(snap, pods, policy="balanced_diskio")
+        assert ok.node_idx.shape == (2,)
+        with pytest.raises(EngineUnavailable, match="INVALID_ARGUMENT"):
+            client.schedule_batch(snap, pods, policy="balanced_cpu_diskio")
+    finally:
+        client.close()
+        server.stop(grace=None)
+
+
 def test_health(live_server):
     client, service = live_server
     assert client.healthy()

@@ -1,0 +1,233 @@
+"""Native host runtime tests: the C++ queue and scalar cycle must make
+exactly the decisions of their pure-Python counterparts (which are
+themselves golden-tested against the reference formulas)."""
+
+import numpy as np
+import pytest
+
+from kubernetes_scheduler_tpu import native
+from kubernetes_scheduler_tpu.host.advisor import NodeUtil, StaticAdvisor
+from kubernetes_scheduler_tpu.host.plugins import ScalarYodaPlugin, scalar_schedule_one
+from kubernetes_scheduler_tpu.host.queue import (
+    NativeBackedQueue,
+    SchedulingQueue,
+    make_queue,
+    pod_priority,
+)
+from kubernetes_scheduler_tpu.host.scheduler import Scheduler
+from kubernetes_scheduler_tpu.host.types import Container, Node, Pod
+from kubernetes_scheduler_tpu.utils.config import SchedulerConfig
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native toolchain unavailable"
+)
+
+RNG = np.random.default_rng(11)
+
+
+def make_pod(name, cpu=500.0, prio=0, r_io=None):
+    ann = {} if r_io is None else {"diskIO": str(r_io)}
+    return Pod(
+        name=name,
+        labels={"scv/priority": str(prio)},
+        annotations=ann,
+        containers=[Container(requests={"cpu": cpu, "memory": 2**30})],
+    )
+
+
+def make_node(name, cpu=8000.0):
+    return Node(name=name, allocatable={"cpu": cpu, "memory": 2**35, "pods": 110})
+
+
+# ---- queue ---------------------------------------------------------------
+
+
+def test_native_queue_matches_python_ordering():
+    clock_t = [0.0]
+    clock = lambda: clock_t[0]  # noqa: E731
+    nq = NativeBackedQueue(clock=clock)
+    pq = SchedulingQueue(clock=clock)
+    pods = [make_pod(f"p{i}", prio=int(RNG.integers(0, 5))) for i in range(50)]
+    for p in pods:
+        nq.push(p)
+        pq.push(p)
+    for window in (7, 13, 50):
+        a = [p.name for p in nq.pop_window(window)]
+        b = [p.name for p in pq.pop_window(window)]
+        assert a == b
+    assert len(nq) == len(pq) == 0
+
+
+def test_native_queue_backoff_schedule():
+    clock_t = [100.0]
+    q = NativeBackedQueue(initial_backoff=1.0, max_backoff=10.0,
+                          clock=lambda: clock_t[0])
+    pod = make_pod("r")
+    # attempts 1..5: delays 1, 2, 4, 8, 10 (capped)
+    for expect_delay in (1.0, 2.0, 4.0, 8.0, 10.0, 10.0):
+        q.requeue_unschedulable(pod)
+        clock_t[0] += expect_delay - 0.01
+        assert q.pop_window(10) == []
+        clock_t[0] += 0.02
+        assert [p.name for p in q.pop_window(10)] == ["r"]
+    # success clears the attempt counter
+    q.mark_scheduled(pod)
+    q.requeue_unschedulable(pod)
+    clock_t[0] += 1.01
+    assert [p.name for p in q.pop_window(10)] == ["r"]
+
+
+def test_native_queue_duplicate_push_survives_mark_scheduled():
+    """A uid pushed twice (duplicate informer events): binding one copy
+    must not make popping the second copy crash."""
+    q = NativeBackedQueue(clock=lambda: 0.0)
+    pod = make_pod("dup")
+    q.push(pod)
+    q.push(pod)
+    first = q.pop_window(1)
+    assert [p.name for p in first] == ["dup"]
+    q.mark_scheduled(first[0])
+    second = q.pop_window(10)
+    assert [p.name for p in second] == ["dup"]
+    q.mark_scheduled(second[0])
+    assert len(q) == 0
+    assert not q._pods and not q._by_uid and not q._outstanding
+
+
+def test_make_queue_fallback():
+    assert isinstance(make_queue(prefer_native=False), SchedulingQueue)
+    assert isinstance(make_queue(prefer_native=True), NativeBackedQueue)
+
+
+# ---- scalar cycle --------------------------------------------------------
+
+
+def random_cluster(n, p, seed):
+    rng = np.random.default_rng(seed)
+    nodes = [make_node(f"n{i}", cpu=float(rng.choice([2000, 8000, 16000])))
+             for i in range(n)]
+    utils = {
+        f"n{i}": NodeUtil(
+            cpu_pct=float(rng.uniform(0, 100)),
+            mem_pct=float(rng.uniform(0, 100)),
+            disk_io=float(rng.uniform(0, 50)),
+        )
+        for i in range(n)
+    }
+    pods = [
+        make_pod(
+            f"p{i}",
+            cpu=float(rng.integers(100, 3000)),
+            r_io=float(rng.uniform(0, 40)) if rng.random() > 0.2 else None,
+        )
+        for i in range(p)
+    ]
+    return nodes, utils, pods
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_native_scalar_matches_python_plugin_path(seed):
+    nodes, utils, pods = random_cluster(12, 30, seed)
+    names = ["cpu", "memory", "pods", "storage", "ephemeral-storage"]
+
+    # python path
+    from kubernetes_scheduler_tpu.host.snapshot import (
+        parse_float_or_zero,
+        pod_resource_request,
+    )
+
+    plugin = ScalarYodaPlugin(utils)
+    free_py = {
+        n.name: {r: n.allocatable.get(r, 0.0) for r in names} for n in nodes
+    }
+    py_choice = []
+    for pod in pods:
+        plugin.cache.flush()
+        py_choice.append(scalar_schedule_one(plugin, pod, nodes, free_py))
+
+    # native path
+    req = np.array(
+        [[pod_resource_request(p, r) for r in names] for p in pods], np.float32
+    )
+    r_io = np.array(
+        [parse_float_or_zero(p.annotations.get("diskIO")) for p in pods],
+        np.float32,
+    )
+    free = np.array(
+        [[n.allocatable.get(r, 0.0) for r in names] for n in nodes], np.float32
+    )
+    disk_io = np.array([utils[n.name].disk_io for n in nodes], np.float32)
+    cpu_pct = np.array([utils[n.name].cpu_pct for n in nodes], np.float32)
+    idx, free_after, bound = native.scalar_cycle(req, r_io, free, disk_io, cpu_pct)
+
+    native_choice = [nodes[j].name if j >= 0 else None for j in idx]
+    assert native_choice == py_choice
+    assert bound == sum(c is not None for c in py_choice)
+    # capacity bookkeeping agrees
+    for j, n in enumerate(nodes):
+        for k, r in enumerate(names):
+            assert free_after[j, k] == pytest.approx(free_py[n.name][r], rel=1e-5)
+
+
+def test_scalar_cycle_shape_validation():
+    with pytest.raises(ValueError):
+        native.scalar_cycle(
+            np.ones((2, 3)), np.ones(3), np.ones((4, 3)), np.ones(4), np.ones(4)
+        )
+
+
+def test_aggregate_requested_matches_numpy():
+    m, n, r = 200, 20, 5
+    pod_node = RNG.integers(-1, n, m).astype(np.int32)
+    pod_req = RNG.uniform(0, 100, (m, r)).astype(np.float32)
+    got = native.aggregate_requested(pod_node, pod_req, n)
+    want = np.zeros((n, r), np.float32)
+    for i in range(m):
+        if 0 <= pod_node[i] < n:
+            want[pod_node[i]] += pod_req[i]
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+# ---- scheduler integration ----------------------------------------------
+
+
+def test_scheduler_native_scalar_path_binds():
+    nodes, utils, pods = random_cluster(6, 10, 7)
+    config = SchedulerConfig.from_dict(
+        {"batch_window": 64, "feature_gates": {"tpu_batch_score": False}}
+    )
+    sched = Scheduler(
+        config,
+        advisor=StaticAdvisor(utils),
+        list_nodes=lambda: nodes,
+        list_running_pods=lambda: [],
+    )
+    assert isinstance(sched.queue, NativeBackedQueue)
+    for p in pods:
+        sched.submit(p)
+    m = sched.run_cycle()
+    assert m.used_fallback and m.pods_bound == 10
+
+    # same decisions as the pure-Python fallback
+    config2 = SchedulerConfig.from_dict(
+        {
+            "batch_window": 64,
+            "feature_gates": {"tpu_batch_score": False, "native_host": False},
+        }
+    )
+    pods2 = [make_pod(p.name, cpu=p.containers[0].requests["cpu"],
+                      r_io=p.annotations.get("diskIO")) for p in pods]
+    sched2 = Scheduler(
+        config2,
+        advisor=StaticAdvisor(utils),
+        list_nodes=lambda: nodes,
+        list_running_pods=lambda: [],
+    )
+    assert isinstance(sched2.queue, SchedulingQueue)
+    for p in pods2:
+        sched2.submit(p)
+    m2 = sched2.run_cycle()
+    assert m2.pods_bound == 10
+    assert [b.node_name for b in sched.binder.bindings] == [
+        b.node_name for b in sched2.binder.bindings
+    ]

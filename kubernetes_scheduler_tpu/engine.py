@@ -386,3 +386,91 @@ def schedule_batch(
         free_after=res.free_after,
         n_assigned=res.n_assigned,
     )
+
+
+class WindowsResult(NamedTuple):
+    node_idx: jnp.ndarray    # [w, p] int32 per-window assignments, -1 = none
+    free_after: jnp.ndarray  # [n, r] free capacity after the last window
+    n_assigned: jnp.ndarray  # [] int32 total across windows
+
+
+def stack_windows(pods: PodBatch, window: int) -> PodBatch:
+    """Reshape a [P, ...] PodBatch into [P//window, window, ...] for
+    schedule_windows. P must be a multiple of `window` (pad the batch with
+    pod_mask=False entries first — utils/padding.py)."""
+    p = pods.request.shape[0]
+    if p % window:
+        raise ValueError(f"pod count {p} not a multiple of window {window}")
+    return PodBatch(
+        *[
+            jnp.reshape(jnp.asarray(f), (p // window, window) + jnp.asarray(f).shape[1:])
+            for f in pods
+        ]
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("policy", "assigner", "normalizer")
+)
+def schedule_windows(
+    snapshot: SnapshotArrays,
+    pods_windows: PodBatch,
+    *,
+    policy: str = "balanced_cpu_diskio",
+    assigner: str = "auction",
+    normalizer: str = "none",
+) -> WindowsResult:
+    """Schedule many windows in ONE device program: lax.scan over the
+    window axis, carrying node capacity AND (anti)affinity domain counts
+    between windows, so a whole pending backlog costs one dispatch + one
+    host sync instead of one per window. (On a tunneled/remote device the
+    per-call round-trip is ~3 orders of magnitude above per-window compute
+    — this is where the batch engine's throughput comes from.)
+
+    pods_windows: a PodBatch whose arrays carry a leading [w, p, ...]
+    window axis (see stack_windows). Scores/feasibility matrices are
+    internal per-window temporaries here — XLA dead-code-eliminates the
+    ScheduleResult fields the scan does not carry out.
+
+    normalizer defaults to "none" (unlike schedule_batch): min-max and
+    softmax are strictly monotonic per pod row, so assignments are
+    unchanged, and skipping them saves a [p, n] pass per window. Pass
+    "min_max"/"softmax" to reproduce schedule_batch's score tensors
+    exactly (they are still discarded here).
+    """
+
+    def step(carry, w):
+        requested, domain_counts = carry
+        snap = snapshot._replace(
+            requested=requested, domain_counts=domain_counts
+        )
+        res = schedule_batch(
+            snap, w, policy=policy, assigner=assigner, normalizer=normalizer
+        )
+        # fold this window's placements into the domain counts so the next
+        # window's (anti)affinity sees them (the sequential host loop gets
+        # this from re-snapshotting between cycles). domain_counts[n, s] is
+        # the per-node replicated total of node n's domain, so increments
+        # are scattered onto the representative row (domain_id) and then
+        # gathered back to every member node.
+        found = res.node_idx >= 0
+        cols = jnp.arange(domain_counts.shape[1])
+        dom = snapshot.domain_id[
+            jnp.clip(res.node_idx, 0, snapshot.domain_id.shape[0] - 1)
+        ]  # [p, S]
+        inc = jnp.where(found[:, None], w.pod_matches.astype(domain_counts.dtype), 0.0)
+        added = jnp.zeros_like(domain_counts).at[dom, cols[None, :]].add(inc)
+        new_counts = domain_counts + added[snapshot.domain_id, cols[None, :]]
+        return (snapshot.allocatable - res.free_after, new_counts), (
+            res.node_idx,
+            res.n_assigned,
+        )
+
+    (req_final, _), (node_idx, counts) = jax.lax.scan(
+        step, (snapshot.requested, snapshot.domain_counts), pods_windows
+    )
+    return WindowsResult(
+        node_idx=node_idx,
+        free_after=snapshot.allocatable - req_final,
+        n_assigned=counts.sum().astype(jnp.int32),
+    )

@@ -167,6 +167,49 @@ def greedy_assign(
     )
 
 
+def _segmented_admission(
+    bid: jnp.ndarray,
+    has_bid: jnp.ndarray,
+    pod_request: jnp.ndarray,
+    free: jnp.ndarray,
+    priority: jnp.ndarray,
+) -> jnp.ndarray:
+    """[p] bool: per node, admit bidders in (priority desc, index asc)
+    order while the cumulative request including self fits the node's
+    free capacity.
+
+    O(p·log p + p·r): sort bidders by (node, -priority), segmented
+    prefix-sum of requests within each node's group, compare against that
+    node's capacity — no [p, n, r] intermediate.
+    """
+    p = bid.shape[0]
+    # sort by priority first (stable), then by node (stable) -> grouped by
+    # node, within each group by priority desc then index asc
+    key = jnp.where(has_bid, priority.astype(jnp.int32), jnp.int32(-(2**31) + 1))
+    by_prio = jnp.argsort(-key, stable=True)
+    by_node = jnp.argsort(bid[by_prio], stable=True)
+    order = by_prio[by_node]                                     # [p]
+    bid_s = bid[order]
+    req_s = jnp.where(has_bid[order][:, None], pod_request[order], 0.0)
+    total = jnp.cumsum(req_s, axis=0)                            # [p, r]
+    # segment start: running max of indices where the node id changes
+    idx = jnp.arange(p)
+    boundary = jnp.concatenate(
+        [jnp.ones((1,), bool), bid_s[1:] != bid_s[:-1]]
+    )
+    start = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(boundary, idx, 0)
+    )                                                            # [p]
+    base = jnp.where(
+        (start > 0)[:, None], total[jnp.maximum(start - 1, 0)], 0.0
+    )
+    cum = total - base                                           # [p, r] incl. self
+    cap = free[bid_s]                                            # [p, r]
+    # unrequested-resource bypass (cum==0 -> no admitted bidder needs it)
+    fits = ((cum <= cap) | (cum == 0)).all(-1) & has_bid[order]
+    return jnp.zeros((p,), bool).at[order].set(fits)
+
+
 def auction_assign(
     scores: jnp.ndarray,
     feasible: jnp.ndarray,
@@ -175,61 +218,98 @@ def auction_assign(
     priority: jnp.ndarray,
     pod_mask: jnp.ndarray,
     *,
-    rounds: int = 8,
+    rounds: int = 1024,
+    price_frac: float = 1.0 / 16.0,
 ) -> AssignResult:
-    """Parallel rounds of bid → resolve-by-priority → decrement.
+    """Price-guided parallel auction: rounds of bid → admit → reprice.
 
-    Each round every unassigned pod bids on its argmax feasible node; for
-    every node, bidders are admitted in priority order while their summed
-    requests fit the node's remaining capacity (prefix-sum admission). Not
-    identical to greedy for adversarial score ties, but capacity-safe and
-    typically within one round of greedy quality; O(rounds · P·N·R).
+    Each round every unassigned pod bids on its argmax feasible node by
+    *value* = score − price; per node, bidders are admitted in priority
+    order while their cumulative request fits remaining capacity
+    (segmented prefix-sum admission, no [p,n,r] intermediate). Nodes that
+    rejected bidders raise their price by `price_frac · score-range`, so
+    contending pods spread to their next-best nodes instead of re-bidding
+    a full node (Bertsekas-auction ε-complementary slackness; without
+    prices, P pods with similar preference orders fill one node per round
+    and the fixed round budget strands schedulable pods).
+
+    Terminates when no active pod has any feasible node with capacity —
+    i.e. the assignment is *maximal* — or after `rounds` (stragglers
+    return -1 and requeue next cycle, like upstream's backoff requeue).
+    Within a round, the top-priority bidder of every contested node is
+    always admitted (its own request passed the capacity pre-mask), so
+    each round makes progress and `rounds >= p` guarantees maximality.
+    Quality is within one price step of greedy; not bitwise-identical
+    under adversarial ties.
     """
     p, n = scores.shape
+    hi = jnp.where(feasible, scores, -jnp.inf).max()
+    lo = jnp.where(feasible, scores, jnp.inf).min()
+    # no feasible entry at all -> scale degenerates to the floor; the loop
+    # exits on round 1 anyway (no bids)
+    scale = jnp.where(
+        jnp.isfinite(hi) & jnp.isfinite(lo), jnp.maximum(hi - lo, 1e-6), 1e-6
+    )
+    step = price_frac * scale
+    # Deterministic sub-step tie-break jitter: without it, pods with
+    # identical score rows (homogeneous clusters) bid in lockstep — one
+    # admission per round — and a round budget strands schedulable pods.
+    # Magnitude << step keeps it decision-neutral except between genuine
+    # near-ties.
+    jitter = (
+        jax.random.uniform(jax.random.key(0), (p, n), scores.dtype)
+        * (0.01 * step)
+    )
 
     def round_body(state):
-        assigned, free, _round = state
+        assigned, free, price, _, _round = state
         active = pod_mask & (assigned < 0)
         cap_ok = (
             (pod_request[:, None, :] <= free[None, :, :])
             | (pod_request[:, None, :] == 0)
         ).all(-1)
         mask = feasible & cap_ok & active[:, None]
-        row = jnp.where(mask, scores, NEG)
+        row = jnp.where(mask, scores + jitter - price[None, :], NEG)
         bid = jnp.argmax(row, axis=1).astype(jnp.int32)          # [p]
         has_bid = mask.any(axis=1)
-        # Admission: per node, order bidders by (priority desc, index asc)
-        # and admit while cumulative request fits.
-        key = jnp.where(has_bid, priority.astype(jnp.int32), jnp.int32(-(2**31) + 1))
-        order = jnp.argsort(-key, stable=True)                   # [p]
-        bid_o = bid[order]
-        req_o = pod_request[order]
-        has_o = has_bid[order]
-        onehot = (
-            (bid_o[:, None] == jnp.arange(n)[None, :]) & has_o[:, None]
-        ).astype(scores.dtype)                                   # [p, n]
-        # cumulative requested per (node, resource) including self
-        cum = jnp.cumsum(onehot[:, :, None] * req_o[:, None, :], axis=0)
-        # cum == 0 on a slot means no admitted bidder requests it — apply
-        # the same unrequested-resource bypass as above.
-        fits = ((cum <= free[None, :, :]) | (cum == 0)).all(-1)  # [p, n]
-        admit_o = has_o & jnp.take_along_axis(fits, bid_o[:, None], 1)[:, 0]
-        admitted = jnp.zeros((p,), bool).at[order].set(admit_o)
+        admitted = _segmented_admission(
+            bid, has_bid, pod_request, free, priority
+        )
         new_assigned = jnp.where(admitted, bid, assigned)
-        used = (
-            (onehot * admit_o[:, None].astype(scores.dtype))[:, :, None]
-            * req_o[:, None, :]
-        ).sum(0)
-        return new_assigned, free - used, _round + 1
+        used = jnp.zeros_like(free).at[bid].add(
+            jnp.where(admitted[:, None], pod_request, 0.0)
+        )
+        rejected = (
+            jnp.zeros((n,), bool)
+            .at[bid]
+            .max(has_bid & ~admitted)
+        )
+        return (
+            new_assigned,
+            free - used,
+            price + jnp.where(rejected, step, 0.0),
+            has_bid.any(),
+            _round + 1,
+        )
 
     def cond(state):
-        assigned, free, r = state
-        active = pod_mask & (assigned < 0)
-        return (r < rounds) & active.any()
+        # `can_bid` carried from the previous body evaluation (computed on
+        # that round's pre-admission state) — at most one no-op extra round
+        # instead of recomputing the O(p·n·r) capacity mask here.
+        _assigned, _free, _price, can_bid, r = state
+        return (r < rounds) & can_bid
 
     assigned0 = jnp.full((p,), -1, jnp.int32)
-    assigned, free_after, _ = jax.lax.while_loop(
-        cond, round_body, (assigned0, node_free, jnp.int32(0))
+    assigned, free_after, _, _, _ = jax.lax.while_loop(
+        cond,
+        round_body,
+        (
+            assigned0,
+            node_free,
+            jnp.zeros((n,), scores.dtype),
+            jnp.asarray(True),
+            jnp.int32(0),
+        ),
     )
     return AssignResult(
         node_idx=assigned,

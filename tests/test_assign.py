@@ -82,6 +82,46 @@ def test_greedy_pod_mask_padding_ignored():
     assert (idx[5:] == -1).all()
 
 
+def test_auction_hot_node_contention_spreads():
+    # Degenerate case the price mechanism exists for: every pod's best node
+    # is node 0 (capacity 1). Without prices, each round fills one node and
+    # a fixed round budget strands schedulable pods; with prices, contenders
+    # spread and everyone lands somewhere.
+    p, n = 32, 40
+    scores = np.full((p, n), 1.0, np.float32)
+    scores[:, 0] = 10.0
+    pod_req = np.ones((p, 1), np.float32)
+    node_free = np.ones((n, 1), np.float32)
+    res = auction_assign(
+        jnp.asarray(scores), jnp.ones((p, n), bool), jnp.asarray(pod_req),
+        jnp.asarray(node_free), jnp.zeros(p, jnp.int32), jnp.ones(p, bool),
+    )
+    idx = np.asarray(res.node_idx)
+    assert (idx >= 0).all()
+    _check_capacity(idx, pod_req, node_free)
+    # no node got two pods
+    assert len(set(idx.tolist())) == p
+
+
+def test_auction_maximal_at_scale():
+    # Contested: 256 pods over 32 nodes with tight capacity. At default
+    # rounds the result must be maximal — no unassigned pod fits anywhere.
+    scores, feasible, pod_req, node_free, priority = random_problem(256, 32)
+    res = auction_assign(
+        jnp.asarray(scores), jnp.asarray(feasible), jnp.asarray(pod_req),
+        jnp.asarray(node_free), jnp.asarray(priority), jnp.ones(256, bool),
+    )
+    idx = np.asarray(res.node_idx)
+    _check_capacity(idx, pod_req, node_free)
+    free = np.asarray(res.free_after)
+    un = idx < 0
+    could = (
+        ((pod_req[un][:, None, :] <= free[None]) | (pod_req[un][:, None, :] == 0))
+        .all(-1) & feasible[un]
+    )
+    assert not could.any(1).any(), "auction left schedulable pods unassigned"
+
+
 def test_auction_capacity_safe_and_complete():
     scores, feasible, pod_req, node_free, priority = random_problem(48, 6)
     res = auction_assign(

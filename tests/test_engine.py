@@ -9,6 +9,8 @@ from kubernetes_scheduler_tpu.engine import (
     make_pod_batch,
     make_snapshot,
     schedule_batch,
+    schedule_windows,
+    stack_windows,
 )
 from kubernetes_scheduler_tpu.parallel import make_mesh, make_sharded_schedule_fn
 from tests import oracle
@@ -72,6 +74,72 @@ def test_schedule_batch_matches_scalar_oracle_pipeline():
         np.asarray(pods.priority).tolist(),
     )
     assert np.asarray(res.node_idx).tolist() == want
+
+
+def test_schedule_windows_matches_sequential_batches():
+    """The fused scan over windows makes the same decisions as running
+    schedule_batch per window with capacity carried on the host."""
+    snapshot, pods = random_state(40, 24)
+    windows = stack_windows(pods, 8)
+    fused = schedule_windows(snapshot, windows, assigner="greedy")
+
+    requested = snapshot.requested
+    seq_idx, total = [], 0
+    for w in range(3):
+        one = type(pods)(*[jnp.asarray(f)[w] for f in windows])
+        res = schedule_batch(
+            snapshot._replace(requested=requested), one,
+            assigner="greedy", normalizer="none",
+        )
+        requested = snapshot.allocatable - res.free_after
+        seq_idx.append(np.asarray(res.node_idx))
+        total += int(res.n_assigned)
+
+    np.testing.assert_array_equal(
+        np.asarray(fused.node_idx), np.stack(seq_idx)
+    )
+    assert int(fused.n_assigned) == total
+    np.testing.assert_allclose(
+        np.asarray(fused.free_after),
+        np.asarray(snapshot.allocatable - requested),
+        atol=1e-3,
+    )
+
+
+def test_schedule_windows_carries_anti_affinity_across_windows():
+    """A window-1 pod with hard anti-affinity to a selector must see
+    window-0 placements, not the stale pre-backlog domain counts."""
+    n, s = 4, 1
+    snapshot = make_snapshot(
+        allocatable=np.full((n, 3), 1e6, np.float32),
+        requested=np.zeros((n, 3), np.float32),
+        disk_io=np.zeros(n),
+        cpu_pct=np.zeros(n),
+        mem_pct=np.zeros(n),
+        domain_counts=np.zeros((n, s), np.float32),
+        # all nodes in ONE topology domain (represented by node 0)
+        domain_id=np.zeros((n, s), np.int32),
+    )
+    # window 0: one pod matching selector 0; window 1: one pod with hard
+    # anti-affinity against selector 0 (fits nowhere once pod 0 lands)
+    pods = make_pod_batch(
+        request=np.ones((2, 3), np.float32),
+        pod_matches=np.asarray([[True], [False]]),
+        anti_affinity_sel=np.asarray([[-1], [0]], np.int32),
+    )
+    res = schedule_windows(
+        snapshot, stack_windows(pods, 1), assigner="greedy"
+    )
+    idx = np.asarray(res.node_idx).ravel()
+    assert idx[0] >= 0
+    assert idx[1] == -1, "anti-affinity ignored window 0's placement"
+    assert int(res.n_assigned) == 1
+
+
+def test_stack_windows_rejects_ragged():
+    _, pods = random_state(4, 10)
+    with pytest.raises(ValueError):
+        stack_windows(pods, 4)
 
 
 @pytest.mark.parametrize("policy", ["balanced_cpu_diskio", "balanced_diskio", "free_capacity", "card"])

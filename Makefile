@@ -1,0 +1,40 @@
+# Mirrors the reference Makefile's local/build/push trio (fmt+vet+compile,
+# docker image builds) for the Python/JAX + C++ implementation.
+
+PY ?= python
+IMAGE_REPO ?= registry.example.com/yoda-tpu
+TAG ?= latest
+
+.PHONY: local test bench native proto clean build push
+
+# "make local" in the reference = fmt + vet + compile. Here: byte-compile
+# the package, build the native library, run the fast tests.
+local: native
+	$(PY) -m compileall -q kubernetes_scheduler_tpu bench.py __graft_entry__.py
+	$(PY) -m pytest tests/ -x -q
+
+test:
+	$(PY) -m pytest tests/ -x -q
+
+bench:
+	$(PY) bench.py
+
+native:
+	$(MAKE) -C native
+
+# regenerate the gRPC schema (bridge/schedule.proto -> schedule_pb2.py)
+proto:
+	protoc --python_out=kubernetes_scheduler_tpu/bridge \
+	  -I kubernetes_scheduler_tpu/bridge kubernetes_scheduler_tpu/bridge/schedule.proto
+
+build:
+	docker build -f Dockerfile.host -t $(IMAGE_REPO)/host:$(TAG) .
+	docker build -f Dockerfile.sidecar -t $(IMAGE_REPO)/sidecar:$(TAG) .
+
+push: build
+	docker push $(IMAGE_REPO)/host:$(TAG)
+	docker push $(IMAGE_REPO)/sidecar:$(TAG)
+
+clean:
+	rm -rf native/build
+	find . -name __pycache__ -type d -exec rm -rf {} +

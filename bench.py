@@ -77,9 +77,11 @@ def tpu_rate(snapshot, pods) -> float:
     with cycle k's execution."""
     import jax
     from kubernetes_scheduler_tpu.engine import schedule_windows, stack_windows
+    from kubernetes_scheduler_tpu.utils.padding import pad_pod_batch
 
+    n_padded = -(-N_PODS // WINDOW) * WINDOW
     snapshot = jax.device_put(snapshot)
-    pods_w = jax.device_put(stack_windows(pods, WINDOW))
+    pods_w = jax.device_put(stack_windows(pad_pod_batch(pods, n_padded), WINDOW))
 
     out = schedule_windows(snapshot, pods_w, assigner="auction")
     jax.block_until_ready(out)  # compile + warm

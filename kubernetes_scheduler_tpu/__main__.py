@@ -1,0 +1,5 @@
+import sys
+
+from kubernetes_scheduler_tpu.cli import main
+
+sys.exit(main())

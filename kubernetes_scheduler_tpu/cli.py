@@ -1,0 +1,222 @@
+"""Process entry: the cmd/scheduler/main.go analog.
+
+Subcommands:
+
+    scheduler  run the scheduling loop (simulated cluster or injectable
+               sources), the reference's single binary role
+    sidecar    run the gRPC engine server (the TPU half of the pod pair)
+    bench      the BASELINE.md throughput benchmark (one JSON line)
+    config     print the effective SchedulerConfig as JSON
+    policies   list registered score policies and plugins
+
+The reference's main() seeds the RNG, builds the cobra command through the
+register shim and executes it (cmd/scheduler/main.go:12-21); here the
+register shim is kubernetes_scheduler_tpu.register and the "embedded
+upstream framework" is host.Scheduler.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import logging
+import sys
+import time
+
+from kubernetes_scheduler_tpu.utils.config import SchedulerConfig
+
+log = logging.getLogger("yoda_tpu.cli")
+
+
+def _load_config(args) -> SchedulerConfig:
+    cfg = (
+        SchedulerConfig.from_json(args.config)
+        if getattr(args, "config", None)
+        else SchedulerConfig()
+    )
+    for key in ("policy", "assigner", "normalizer", "batch_window"):
+        v = getattr(args, key, None)
+        if v is not None:
+            cfg = dataclasses.replace(cfg, **{key: v})
+    if getattr(args, "no_tpu", False):
+        cfg.feature_gates.tpu_batch_score = False
+    return cfg
+
+
+def _add_config_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--config", help="SchedulerConfig JSON file")
+    p.add_argument("--policy", choices=None, help="score policy override")
+    p.add_argument("--assigner", choices=("greedy", "auction"))
+    p.add_argument("--normalizer", choices=("min_max", "softmax", "none"))
+    p.add_argument("--batch-window", type=int, dest="batch_window")
+    p.add_argument(
+        "--no-tpu",
+        action="store_true",
+        help="feature-gate TPUBatchScore=false: scalar fallback path only",
+    )
+
+
+def cmd_scheduler(args) -> int:
+    from kubernetes_scheduler_tpu.host.scheduler import Scheduler
+    from kubernetes_scheduler_tpu.sim.host_gen import gen_host_cluster, gen_host_pods
+
+    cfg = _load_config(args)
+    nodes, advisor = gen_host_cluster(
+        args.nodes, seed=args.seed, gpu=args.gpu, constraints=args.constraints
+    )
+    pods = gen_host_pods(
+        args.pods, seed=args.seed + 1, gpu=args.gpu, constraints=args.constraints
+    )
+
+    engine = None
+    if args.engine and args.engine != "local":
+        from kubernetes_scheduler_tpu.bridge.client import RemoteEngine
+
+        engine = RemoteEngine(args.engine)
+
+    running: list = []
+    sched = Scheduler(
+        cfg,
+        advisor=advisor,
+        list_nodes=lambda: nodes,
+        list_running_pods=lambda: running,
+        engine=engine,
+    )
+    elector = None
+    if args.lease:
+        from kubernetes_scheduler_tpu.host.leader import FileLease, LeaderElector
+
+        elector = LeaderElector(FileLease(args.lease), identity=args.lease_identity)
+        log.info("waiting for leadership on %s", args.lease)
+        elector.acquire_blocking()
+
+    exporter = None
+    if args.metrics_port:
+        from kubernetes_scheduler_tpu.host.observe import MetricsExporter
+
+        exporter = MetricsExporter(sched)
+        exporter.serve(args.metrics_port)
+
+    for pod in pods:
+        sched.submit(pod)
+    t0 = time.perf_counter()
+    cycles = sched.run_until_empty(max_cycles=args.max_cycles)
+    dt = time.perf_counter() - t0
+    for binding in sched.binder.bindings:
+        running.append(binding.pod)
+    bound = sum(c.pods_bound for c in cycles)
+    unsched = sum(c.pods_unschedulable for c in cycles)
+    print(
+        json.dumps(
+            {
+                "cycles": len(cycles),
+                "pods_bound": bound,
+                "pods_unschedulable": unsched,
+                "seconds": round(dt, 3),
+                "pods_per_sec": round(bound / dt, 1) if dt > 0 else None,
+                "fallback_cycles": sum(c.used_fallback for c in cycles),
+            }
+        )
+    )
+    if elector is not None:
+        elector.release()
+    if exporter is not None and not args.serve_forever:
+        exporter.close()
+    if args.serve_forever and exporter is not None:
+        log.info("metrics on :%d; ctrl-c to exit", args.metrics_port)
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            exporter.close()
+    return 0
+
+
+def cmd_sidecar(args) -> int:
+    from kubernetes_scheduler_tpu.bridge import server
+
+    argv = ["--port", str(args.port)]
+    if args.mesh_devices:
+        argv += ["--mesh-devices", str(args.mesh_devices)]
+    return server.main(argv)
+
+
+def cmd_bench(args) -> int:
+    import importlib
+
+    bench = importlib.import_module("bench")
+    bench.main()
+    return 0
+
+
+def cmd_config(args) -> int:
+    print(json.dumps(_load_config(args).to_dict(), indent=2))
+    return 0
+
+
+def cmd_policies(args) -> int:
+    from kubernetes_scheduler_tpu import register
+    from kubernetes_scheduler_tpu.models.policy import HEURISTIC_POLICIES
+
+    for name, info in sorted(HEURISTIC_POLICIES.items()):
+        live = "live" if info.live_in_reference else "alternate"
+        print(f"policy   {name:22s} [{live}] {info.description}  ({info.reference})")
+    for name in register.registered_plugins():
+        print(f"plugin   {name}")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="yoda-tpu")
+    p.add_argument("-v", "--verbose", action="count", default=0)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    ps = sub.add_parser("scheduler", help="run the scheduling loop")
+    _add_config_flags(ps)
+    ps.add_argument("--nodes", type=int, default=100)
+    ps.add_argument("--pods", type=int, default=200)
+    ps.add_argument("--seed", type=int, default=0)
+    ps.add_argument("--gpu", action="store_true")
+    ps.add_argument("--constraints", action="store_true")
+    ps.add_argument("--max-cycles", type=int, default=1000)
+    ps.add_argument(
+        "--engine",
+        default="local",
+        help='"local" (in-process) or a gRPC target like "localhost:50051"',
+    )
+    ps.add_argument("--lease", help="leader-election lease file path")
+    ps.add_argument("--lease-identity", default=None)
+    ps.add_argument("--metrics-port", type=int, default=0)
+    ps.add_argument("--serve-forever", action="store_true")
+    ps.set_defaults(fn=cmd_scheduler)
+
+    pc = sub.add_parser("sidecar", help="run the gRPC engine server")
+    pc.add_argument("--port", type=int, default=50051)
+    pc.add_argument("--mesh-devices", type=int, default=0)
+    pc.set_defaults(fn=cmd_sidecar)
+
+    pb = sub.add_parser("bench", help="run the throughput benchmark")
+    pb.set_defaults(fn=cmd_bench)
+
+    pf = sub.add_parser("config", help="print effective config")
+    _add_config_flags(pf)
+    pf.set_defaults(fn=cmd_config)
+
+    pp = sub.add_parser("policies", help="list policies and plugins")
+    pp.set_defaults(fn=cmd_policies)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose >= 2 else
+        logging.INFO if args.verbose == 1 else logging.WARNING,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

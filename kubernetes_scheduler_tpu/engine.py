@@ -432,11 +432,14 @@ def schedule_windows(
     internal per-window temporaries here — XLA dead-code-eliminates the
     ScheduleResult fields the scan does not carry out.
 
-    normalizer defaults to "none" (unlike schedule_batch): min-max and
-    softmax are strictly monotonic per pod row, so assignments are
-    unchanged, and skipping them saves a [p, n] pass per window. Pass
-    "min_max"/"softmax" to reproduce schedule_batch's score tensors
-    exactly (they are still discarded here).
+    normalizer defaults to "none" (unlike schedule_batch): greedy picks
+    per-row argmaxes, unchanged under any monotone row normalization, and
+    the auction min-maxes rows internally, making it invariant under
+    per-row affine rescaling (min_max gives identical decisions; softmax
+    is monotone-but-nonaffine, so auction decisions may differ between
+    near-ties). Skipping normalization saves a [p, n] pass per window;
+    pass "min_max"/"softmax" to reproduce schedule_batch's configuration
+    exactly.
     """
 
     def step(carry, w):

@@ -1,0 +1,193 @@
+"""Lease-based leader election: active/passive scheduler replicas.
+
+The reference gets HA from upstream kube-scheduler's lease leader election
+(deploy/yoda-scheduler.yaml:10-17: leaderElect on resourceLock
+"endpointsleases" in kube-system). This module provides the same
+active/passive failover contract with a pluggable lease backend:
+
+- `FileLease` — a shared-filesystem lease for simulation, tests, and
+  single-host pod pairs (atomic claim via O_EXCL + fsync'd renew records).
+- a Kubernetes coordination.k8s.io/Lease backend slots in behind the same
+  `Lease` protocol where a cluster client is available.
+
+Semantics mirror k8s.io/client-go leaderelection: a lease carries (holder
+identity, acquire time, renew time, duration); a candidate acquires when
+the lease is unheld or expired; the holder renews every `retry_period`
+and loses leadership when renewal fails or the lease was stolen.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import threading
+import time
+from typing import Protocol
+
+log = logging.getLogger("yoda_tpu.leader")
+
+
+@dataclasses.dataclass
+class LeaseRecord:
+    holder: str
+    acquired_at: float
+    renewed_at: float
+    duration: float
+
+    def expired(self, now: float) -> bool:
+        return now > self.renewed_at + self.duration
+
+
+class Lease(Protocol):
+    def read(self) -> LeaseRecord | None: ...
+    def try_claim(self, record: LeaseRecord, previous: LeaseRecord | None) -> bool: ...
+    def clear(self, holder: str) -> None: ...
+
+
+class FileLease:
+    """Lease on a shared filesystem. Claims are atomic: a new lease file is
+    written to a temp path and linked into place only if the current
+    content still matches `previous` (compare-and-swap under an O_EXCL
+    lock file)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock_path = path + ".lock"
+
+    def read(self) -> LeaseRecord | None:
+        try:
+            with open(self.path) as f:
+                return LeaseRecord(**json.load(f))
+        except (FileNotFoundError, json.JSONDecodeError, TypeError):
+            return None
+
+    def _locked(self):
+        class _Lock:
+            def __enter__(inner):
+                deadline = time.monotonic() + 5.0
+                while True:
+                    try:
+                        inner.fd = os.open(
+                            self._lock_path, os.O_CREAT | os.O_EXCL | os.O_WRONLY
+                        )
+                        return inner
+                    except FileExistsError:
+                        if time.monotonic() > deadline:
+                            # stale lock (holder died mid-claim): break it
+                            try:
+                                os.unlink(self._lock_path)
+                            except FileNotFoundError:
+                                pass
+                        time.sleep(0.05)
+
+            def __exit__(inner, *exc):
+                os.close(inner.fd)
+                try:
+                    os.unlink(self._lock_path)
+                except FileNotFoundError:
+                    pass
+
+        return _Lock()
+
+    def try_claim(
+        self, record: LeaseRecord, previous: LeaseRecord | None
+    ) -> bool:
+        with self._locked():
+            current = self.read()
+            cur_key = (current.holder, current.renewed_at) if current else None
+            prev_key = (previous.holder, previous.renewed_at) if previous else None
+            if cur_key != prev_key:
+                return False
+            tmp = f"{self.path}.{record.holder}.{os.getpid()}.tmp"
+            with open(tmp, "w") as f:
+                json.dump(dataclasses.asdict(record), f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+            return True
+
+    def clear(self, holder: str) -> None:
+        with self._locked():
+            current = self.read()
+            if current and current.holder == holder:
+                try:
+                    os.unlink(self.path)
+                except FileNotFoundError:
+                    pass
+
+
+class LeaderElector:
+    """client-go leaderelection.LeaderElector analog.
+
+    acquire_blocking() returns once this identity holds the lease; a
+    daemon thread renews it every `retry_period`. is_leader() flips False
+    if renewal is lost (a standby stole an expired lease) — the scheduler
+    loop must check it each cycle and stop binding when not leading.
+    """
+
+    def __init__(
+        self,
+        lease: Lease,
+        *,
+        identity: str | None = None,
+        lease_duration: float = 15.0,
+        retry_period: float = 2.0,
+    ):
+        self.lease = lease
+        self.identity = identity or f"{os.uname().nodename}-{os.getpid()}"
+        self.lease_duration = lease_duration
+        self.retry_period = retry_period
+        self._leading = threading.Event()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def is_leader(self) -> bool:
+        return self._leading.is_set()
+
+    def _try_acquire_once(self) -> bool:
+        now = time.time()
+        current = self.lease.read()
+        if current and current.holder == self.identity:
+            acquired = current.acquired_at
+        elif current and not current.expired(now):
+            return False
+        else:
+            acquired = now
+        record = LeaseRecord(
+            holder=self.identity,
+            acquired_at=acquired,
+            renewed_at=now,
+            duration=self.lease_duration,
+        )
+        return self.lease.try_claim(record, current)
+
+    def acquire_blocking(self, timeout: float | None = None) -> bool:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while not self._stop.is_set():
+            if self._try_acquire_once():
+                self._leading.set()
+                log.info("acquired leadership as %s", self.identity)
+                self._thread = threading.Thread(target=self._renew_loop, daemon=True)
+                self._thread.start()
+                return True
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            time.sleep(self.retry_period)
+        return False
+
+    def _renew_loop(self) -> None:
+        while not self._stop.wait(self.retry_period):
+            if not self._try_acquire_once():
+                log.warning("lost leadership (%s)", self.identity)
+                self._leading.clear()
+                return
+
+    def release(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.retry_period * 2)
+        if self._leading.is_set():
+            self.lease.clear(self.identity)
+            self._leading.clear()

@@ -1,0 +1,164 @@
+"""Observability: metrics export, cycle timing spans, device profiling.
+
+The reference *consumes* metrics but exports none — its own metrics
+endpoint is disabled (MetricsBindAddress: "", scheduler.go:64) and its
+only introspection is leveled klog spam (SURVEY.md §5). This module
+provides what that design was missing, around the north-star numbers in
+BASELINE.json:
+
+- `render_prometheus` / `MetricsExporter`: scheduling throughput, bind
+  latency p50/p99, batch sizes, engine (device) step time, fallback
+  count, in Prometheus text exposition format on /metrics — so the same
+  Prometheus the advisor scrapes from can scrape the scheduler back.
+- `CycleTracer`: structured per-cycle spans (host snapshot build, device
+  step, bind fan-out) logged as JSON lines.
+- `profile_device_step`: wraps one engine call in a jax.profiler trace
+  for XLA-level inspection (op time on the MXU/VPU, transfer time).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import http.server
+import json
+import logging
+import threading
+import time
+
+log = logging.getLogger("yoda_tpu.observe")
+
+PREFIX = "yoda_tpu"
+
+
+def _quantile(sorted_vals: list[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(int(q * (len(sorted_vals) - 1) + 0.5), len(sorted_vals) - 1)
+    return sorted_vals[i]
+
+
+def summarize(metrics) -> dict:
+    """Aggregate a list of host.scheduler.CycleMetrics."""
+    cycles = [m for m in metrics if m.pods_in > 0]
+    lat = sorted(m.cycle_seconds for m in cycles)
+    eng = sorted(m.engine_seconds for m in cycles if m.engine_seconds > 0)
+    total_s = sum(lat)
+    bound = sum(m.pods_bound for m in cycles)
+    return {
+        "cycles_total": len(cycles),
+        "pods_bound_total": bound,
+        "pods_unschedulable_total": sum(m.pods_unschedulable for m in cycles),
+        "fallback_cycles_total": sum(1 for m in cycles if m.used_fallback),
+        "scheduling_pods_per_sec": bound / total_s if total_s > 0 else 0.0,
+        "bind_latency_p50_seconds": _quantile(lat, 0.50),
+        "bind_latency_p99_seconds": _quantile(lat, 0.99),
+        "engine_step_p50_seconds": _quantile(eng, 0.50),
+        "engine_step_p99_seconds": _quantile(eng, 0.99),
+        "batch_size_mean": (sum(m.pods_in for m in cycles) / len(cycles))
+        if cycles
+        else 0.0,
+    }
+
+
+_HELP = {
+    "cycles_total": "Scheduling cycles with at least one pending pod",
+    "pods_bound_total": "Pods bound to nodes",
+    "pods_unschedulable_total": "Pod placements rejected (requeued with backoff)",
+    "fallback_cycles_total": "Cycles served by the scalar fallback path",
+    "scheduling_pods_per_sec": "Bound pods per second of cycle time",
+    "bind_latency_p50_seconds": "Median end-to-end cycle latency",
+    "bind_latency_p99_seconds": "p99 end-to-end cycle latency",
+    "engine_step_p50_seconds": "Median device (engine) step time",
+    "engine_step_p99_seconds": "p99 device (engine) step time",
+    "batch_size_mean": "Mean pods per scheduling window",
+}
+
+
+def render_prometheus(metrics) -> str:
+    out = []
+    for key, value in summarize(metrics).items():
+        name = f"{PREFIX}_{key}"
+        kind = "counter" if key.endswith("_total") else "gauge"
+        out.append(f"# HELP {name} {_HELP[key]}")
+        out.append(f"# TYPE {name} {kind}")
+        out.append(f"{name} {value}")
+    return "\n".join(out) + "\n"
+
+
+class MetricsExporter:
+    """Serves /metrics (Prometheus text format) and /healthz for a live
+    Scheduler, on a daemon thread."""
+
+    def __init__(self, scheduler):
+        self.scheduler = scheduler
+        self._server: http.server.ThreadingHTTPServer | None = None
+
+    def serve(self, port: int) -> int:
+        exporter = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path == "/metrics":
+                    body = render_prometheus(exporter.scheduler.metrics).encode()
+                    ctype = "text/plain; version=0.0.4"
+                elif self.path == "/healthz":
+                    body, ctype = b"ok\n", "text/plain"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):
+                log.debug("metrics http: " + fmt, *args)
+
+        self._server = http.server.ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        threading.Thread(target=self._server.serve_forever, daemon=True).start()
+        return self._server.server_address[1]
+
+    def close(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+
+class CycleTracer:
+    """Structured timing spans for one scheduling cycle, emitted as one
+    JSON line (the replacement for the reference's klog.V(4) spam)."""
+
+    def __init__(self, sink=None):
+        self.sink = sink or (lambda line: log.info("%s", line))
+        self._spans: dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def span(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._spans[name] = self._spans.get(name, 0.0) + (
+                time.perf_counter() - t0
+            )
+
+    def emit(self, **fields) -> None:
+        record = {"ts": time.time(), **fields}
+        record.update(
+            {f"span_{k}_seconds": round(v, 6) for k, v in self._spans.items()}
+        )
+        self.sink(json.dumps(record))
+        self._spans.clear()
+
+
+def profile_device_step(engine_call, out_dir: str):
+    """Run one engine call under a jax.profiler trace; the resulting
+    TensorBoard protobufs in `out_dir` break the step into XLA ops."""
+    import jax
+
+    with jax.profiler.trace(out_dir):
+        result = engine_call()
+        jax.block_until_ready(result)
+    return result

@@ -166,3 +166,43 @@ def train_step(
     updates, opt_state = tx.update(grads, state.opt_state, state.params)
     params = optax.apply_updates(state.params, updates)
     return TrainState(params, opt_state, state.step + 1), loss
+
+
+def save_checkpoint(path: str, state: TrainState) -> None:
+    """Persist a TrainState with orbax (the checkpoint/resume subsystem the
+    reference lacks entirely — SURVEY.md §5; here it carries the learned
+    scorer across sidecar restarts, which are otherwise stateless)."""
+    import os
+    import shutil
+
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    # write-to-temp + rename so a crash mid-save never destroys the last
+    # good checkpoint
+    tmp = f"{path}.tmp.{os.getpid()}"
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(os.path.join(tmp, "state"), state)
+    backup = f"{path}.old.{os.getpid()}"
+    if os.path.exists(path):
+        os.replace(path, backup)
+    os.replace(tmp, path)
+    if os.path.exists(backup):
+        shutil.rmtree(backup)
+
+
+def restore_checkpoint(path: str, like: TrainState) -> TrainState:
+    """Restore a TrainState saved by save_checkpoint; `like` supplies the
+    tree structure/shapes (from init_train_state on the same model)."""
+    import os
+
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        restored = ckptr.restore(
+            os.path.join(os.path.abspath(path), "state"), target=like
+        )
+    return TrainState(*restored) if not isinstance(restored, TrainState) else restored

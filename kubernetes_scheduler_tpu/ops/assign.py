@@ -224,10 +224,12 @@ def auction_assign(
     """Price-guided parallel auction: rounds of bid → admit → reprice.
 
     Each round every unassigned pod bids on its argmax feasible node by
-    *value* = score − price; per node, bidders are admitted in priority
-    order while their cumulative request fits remaining capacity
-    (segmented prefix-sum admission, no [p,n,r] intermediate). Nodes that
-    rejected bidders raise their price by `price_frac · score-range`, so
+    *value* = score − price (scores first min-max'd per row, so pricing is
+    invariant under per-row affine rescaling of the input); per node,
+    bidders are admitted in priority order while their cumulative request
+    fits remaining capacity (segmented prefix-sum admission, no [p,n,r]
+    intermediate). Nodes that rejected bidders raise their price by
+    `price_frac` (of the unit row range), so
     contending pods spread to their next-best nodes instead of re-bidding
     a full node (Bertsekas-auction ε-complementary slackness; without
     prices, P pods with similar preference orders fill one node per round
@@ -243,14 +245,18 @@ def auction_assign(
     under adversarial ties.
     """
     p, n = scores.shape
-    hi = jnp.where(feasible, scores, -jnp.inf).max()
-    lo = jnp.where(feasible, scores, jnp.inf).min()
-    # no feasible entry at all -> scale degenerates to the floor; the loop
-    # exits on round 1 anyway (no bids)
-    scale = jnp.where(
-        jnp.isfinite(hi) & jnp.isfinite(lo), jnp.maximum(hi - lo, 1e-6), 1e-6
-    )
-    step = price_frac * scale
+    # Per-row min-max to [0, 1] over feasible entries before pricing. Bids
+    # only compare within a row, but the price vector is SHARED across
+    # pods — without this, a pod whose raw row spans [0, 1000] and one
+    # spanning [0, 1] react to the same price bump wildly differently.
+    # This also makes the auction invariant under any per-row monotone
+    # normalization (min_max / softmax / none give identical decisions).
+    row_hi = jnp.where(feasible, scores, -jnp.inf).max(axis=1, keepdims=True)
+    row_lo = jnp.where(feasible, scores, jnp.inf).min(axis=1, keepdims=True)
+    row_ok = jnp.isfinite(row_hi) & jnp.isfinite(row_lo)
+    denom = jnp.where(row_ok, jnp.maximum(row_hi - row_lo, 1e-6), 1.0)
+    scores = jnp.where(row_ok, (scores - jnp.where(row_ok, row_lo, 0.0)) / denom, 0.0)
+    step = jnp.asarray(price_frac, scores.dtype)
     # Deterministic sub-step tie-break jitter: without it, pods with
     # identical score rows (homogeneous clusters) bid in lockstep — one
     # admission per round — and a round budget strands schedulable pods.
@@ -258,7 +264,7 @@ def auction_assign(
     # near-ties.
     jitter = (
         jax.random.uniform(jax.random.key(0), (p, n), scores.dtype)
-        * (0.01 * step)
+        * (0.01 * price_frac)
     )
 
     def round_body(state):

@@ -4,3 +4,7 @@ from kubernetes_scheduler_tpu.sim.cluster_gen import (
     gen_config,
     gen_pods,
 )
+from kubernetes_scheduler_tpu.sim.host_gen import (
+    gen_host_cluster,
+    gen_host_pods,
+)

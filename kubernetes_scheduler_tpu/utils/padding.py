@@ -41,3 +41,18 @@ def pad_to_bucket(arr: np.ndarray, axis: int = 0, *, floor: int = 8, fill=0):
     mask = np.zeros(size, bool)
     mask[: arr.shape[axis]] = True
     return pad_axis(arr, size, axis, fill), mask
+
+
+def pad_pod_batch(pods, size: int):
+    """Pad every array of a PodBatch along the pod axis to `size`, with
+    pod_mask False on the padding (all other fields zero-filled — the
+    engine masks padded pods out of feasibility and assignment)."""
+    p = pods.request.shape[0]
+    if p > size:
+        raise ValueError(f"pod count {p} > target {size}")
+    if p == size:
+        return pods
+    return type(pods)(
+        *[pad_axis(np.asarray(f), size, 0) for f in pods]
+    )._replace(pod_mask=np.concatenate([np.asarray(pods.pod_mask),
+                                        np.zeros(size - p, bool)]))

@@ -1,0 +1,85 @@
+"""CLI surface (cmd/scheduler/main.go + pkg/register analogs) and the
+object-level simulators behind it."""
+
+import json
+
+import pytest
+
+from kubernetes_scheduler_tpu import register
+from kubernetes_scheduler_tpu.cli import build_parser, main
+from kubernetes_scheduler_tpu.host.plugins import ScalarYodaPlugin
+from kubernetes_scheduler_tpu.sim.host_gen import gen_host_cluster, gen_host_pods
+
+
+def test_register_gate():
+    assert register.YODA in register.registered_plugins()
+    plugin = register.make_plugin(register.YODA, utils={})
+    assert isinstance(plugin, ScalarYodaPlugin)
+    with pytest.raises(ValueError, match="unknown plugin"):
+        register.make_plugin("nope")
+    # later registration shadows (app.WithPlugin override semantics)
+    register.register_plugin("custom", lambda **kw: ScalarYodaPlugin(utils={}))
+    assert "custom" in register.registered_plugins()
+
+
+def test_host_generators_shapes():
+    nodes, advisor = gen_host_cluster(7, gpu=True, constraints=True)
+    assert len(nodes) == 7
+    assert len(advisor.fetch()) == 7
+    assert any(n.cards for n in nodes)
+    pods = gen_host_pods(13, constraints=True)
+    assert len(pods) == 13
+    assert all(p.annotations.get("diskIO") for p in pods)
+
+
+def test_cli_config_roundtrip(capsys, tmp_path):
+    main(["config", "--policy", "free_capacity", "--batch-window", "64"])
+    out = json.loads(capsys.readouterr().out)
+    assert out["policy"] == "free_capacity"
+    assert out["batch_window"] == 64
+    # file + flag override layering
+    cfg_file = tmp_path / "cfg.json"
+    cfg_file.write_text(json.dumps({"policy": "balanced_diskio", "batch_window": 8}))
+    main(["config", "--config", str(cfg_file), "--batch-window", "16"])
+    out = json.loads(capsys.readouterr().out)
+    assert out["policy"] == "balanced_diskio"
+    assert out["batch_window"] == 16
+
+
+def test_cli_policies_lists_all(capsys):
+    main(["policies"])
+    out = capsys.readouterr().out
+    for name in ("balanced_cpu_diskio", "balanced_diskio", "free_capacity", "card"):
+        assert name in out
+    assert "yoda-tpu" in out
+
+
+def test_cli_scheduler_end_to_end(capsys):
+    rc = main(
+        [
+            "scheduler", "--nodes", "12", "--pods", "30",
+            "--batch-window", "10", "--constraints",
+        ]
+    )
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["pods_bound"] + out["pods_unschedulable"] == 30
+    assert out["cycles"] >= 3
+    assert out["fallback_cycles"] == 0
+
+
+def test_cli_scheduler_no_tpu_fallback(capsys):
+    main(
+        [
+            "scheduler", "--nodes", "6", "--pods", "8",
+            "--batch-window", "8", "--no-tpu",
+        ]
+    )
+    out = json.loads(capsys.readouterr().out)
+    assert out["fallback_cycles"] == out["cycles"] >= 1
+    assert out["pods_bound"] + out["pods_unschedulable"] == 8
+
+
+def test_parser_rejects_unknown_subcommand():
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(["frobnicate"])

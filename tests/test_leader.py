@@ -1,0 +1,61 @@
+"""Leader election: the active/passive failover contract
+(deploy/yoda-scheduler.yaml:10-17 semantics on a pluggable lease)."""
+
+import time
+
+from kubernetes_scheduler_tpu.host.leader import FileLease, LeaderElector, LeaseRecord
+
+
+def test_file_lease_claim_and_cas(tmp_path):
+    lease = FileLease(str(tmp_path / "lease"))
+    assert lease.read() is None
+    rec = LeaseRecord(holder="a", acquired_at=1.0, renewed_at=1.0, duration=15.0)
+    assert lease.try_claim(rec, None)
+    got = lease.read()
+    assert got.holder == "a"
+    # stale CAS (previous=None while held) must fail
+    rec_b = LeaseRecord(holder="b", acquired_at=2.0, renewed_at=2.0, duration=15.0)
+    assert not lease.try_claim(rec_b, None)
+    # correct CAS succeeds
+    assert lease.try_claim(rec_b, got)
+    assert lease.read().holder == "b"
+    # clear only by holder
+    lease.clear("a")
+    assert lease.read() is not None
+    lease.clear("b")
+    assert lease.read() is None
+
+
+def test_elector_single_holder(tmp_path):
+    path = str(tmp_path / "lease")
+    a = LeaderElector(
+        FileLease(path), identity="a", lease_duration=5.0, retry_period=0.05
+    )
+    b = LeaderElector(
+        FileLease(path), identity="b", lease_duration=5.0, retry_period=0.05
+    )
+    assert a.acquire_blocking(timeout=2.0)
+    assert a.is_leader()
+    # b cannot acquire while a holds
+    assert not b.acquire_blocking(timeout=0.3)
+    assert not b.is_leader()
+    # a releases -> b takes over
+    a.release()
+    assert b.acquire_blocking(timeout=2.0)
+    assert b.is_leader()
+    b.release()
+
+
+def test_elector_steals_expired_lease(tmp_path):
+    path = str(tmp_path / "lease")
+    lease = FileLease(path)
+    # a crashed holder: renewed long ago, short duration
+    stale = LeaseRecord(
+        holder="dead", acquired_at=time.time() - 60,
+        renewed_at=time.time() - 60, duration=1.0,
+    )
+    assert lease.try_claim(stale, None)
+    b = LeaderElector(lease, identity="b", lease_duration=5.0, retry_period=0.05)
+    assert b.acquire_blocking(timeout=2.0)
+    assert lease.read().holder == "b"
+    b.release()

@@ -96,3 +96,33 @@ def test_graft_entry_contract():
     out = jax.jit(fn)(*args)
     assert int(out.n_assigned) > 0
     g.dryrun_multichip(8)
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    from kubernetes_scheduler_tpu.models.learned import (
+        restore_checkpoint,
+        save_checkpoint,
+    )
+
+    state, model, tx = init_train_state(jax.random.key(0))
+    snap = gen_cluster(16, seed=0)
+    pods = gen_pods(4, seed=1)
+    pod_x, node_x = make_features(snap, pods)
+    teacher = compute_scores(snap, pods, "balanced_cpu_diskio")
+    state, _ = jax.jit(functools.partial(train_step, model=model, tx=tx))(
+        state, pod_x=pod_x, node_x=node_x, teacher_scores=teacher,
+        node_mask=snap.node_mask, pod_mask=pods.pod_mask,
+    )
+    save_checkpoint(str(tmp_path / "ckpt"), state)
+
+    fresh, model2, _ = init_train_state(jax.random.key(1))
+    restored = restore_checkpoint(str(tmp_path / "ckpt"), fresh)
+    assert int(restored.step) == 1
+    jax.tree_util.tree_map(
+        np.testing.assert_allclose, restored.params, state.params
+    )
+    # restored params drive the model identically
+    np.testing.assert_allclose(
+        np.asarray(model2.apply(restored.params, pod_x, node_x)),
+        np.asarray(model.apply(state.params, pod_x, node_x)),
+    )

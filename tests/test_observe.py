@@ -1,0 +1,78 @@
+"""Observability: metric aggregation, Prometheus exposition, spans."""
+
+import json
+import urllib.request
+
+from kubernetes_scheduler_tpu.host.observe import (
+    CycleTracer,
+    MetricsExporter,
+    render_prometheus,
+    summarize,
+)
+from kubernetes_scheduler_tpu.host.scheduler import CycleMetrics
+
+
+def make_metrics():
+    return [
+        CycleMetrics(pods_in=10, pods_bound=9, pods_unschedulable=1,
+                     cycle_seconds=0.10, engine_seconds=0.04),
+        CycleMetrics(pods_in=20, pods_bound=20, pods_unschedulable=0,
+                     cycle_seconds=0.30, engine_seconds=0.10,
+                     used_fallback=True),
+        CycleMetrics(),  # empty cycle: excluded from aggregates
+    ]
+
+
+def test_summarize():
+    s = summarize(make_metrics())
+    assert s["cycles_total"] == 2
+    assert s["pods_bound_total"] == 29
+    assert s["pods_unschedulable_total"] == 1
+    assert s["fallback_cycles_total"] == 1
+    assert abs(s["scheduling_pods_per_sec"] - 29 / 0.4) < 1e-6
+    assert s["bind_latency_p99_seconds"] == 0.30
+    assert s["batch_size_mean"] == 15.0
+
+
+def test_render_prometheus_format():
+    text = render_prometheus(make_metrics())
+    assert "# TYPE yoda_tpu_pods_bound_total counter" in text
+    assert "# TYPE yoda_tpu_bind_latency_p99_seconds gauge" in text
+    assert "yoda_tpu_pods_bound_total 29" in text
+    # every sample line parses as "name value"
+    for line in text.strip().splitlines():
+        if not line.startswith("#"):
+            name, value = line.split()
+            float(value)
+
+
+def test_metrics_exporter_http():
+    class FakeScheduler:
+        metrics = make_metrics()
+
+    exporter = MetricsExporter(FakeScheduler())
+    port = exporter.serve(0)
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics") as r:
+            body = r.read().decode()
+        assert "yoda_tpu_pods_bound_total 29" in body
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz") as r:
+            assert r.read() == b"ok\n"
+    finally:
+        exporter.close()
+
+
+def test_cycle_tracer_spans():
+    lines = []
+    tracer = CycleTracer(sink=lines.append)
+    with tracer.span("snapshot"):
+        pass
+    with tracer.span("engine"):
+        pass
+    tracer.emit(cycle=1, pods=5)
+    rec = json.loads(lines[0])
+    assert rec["cycle"] == 1
+    assert "span_snapshot_seconds" in rec and "span_engine_seconds" in rec
+    # spans reset between cycles
+    tracer.emit(cycle=2)
+    assert "span_engine_seconds" not in json.loads(lines[1])

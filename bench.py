@@ -31,6 +31,8 @@ N_PODS = int(os.environ.get("BENCH_PODS", 16_384))
 WINDOW = int(os.environ.get("BENCH_WINDOW", 1024))
 BASELINE_PODS = int(os.environ.get("BENCH_BASELINE_PODS", 64))
 REPS = int(os.environ.get("BENCH_REPS", 4))
+# fused Pallas score+feasibility kernel (identical decisions; fewer HBM passes)
+FUSED = os.environ.get("BENCH_FUSED", "1") != "0"
 
 
 def baseline_rate(snapshot, pods) -> float:
@@ -83,7 +85,7 @@ def tpu_rate(snapshot, pods) -> float:
     snapshot = jax.device_put(snapshot)
     pods_w = jax.device_put(stack_windows(pad_pod_batch(pods, n_padded), WINDOW))
 
-    out = schedule_windows(snapshot, pods_w, assigner="auction")
+    out = schedule_windows(snapshot, pods_w, assigner="auction", fused=FUSED)
     jax.block_until_ready(out)  # compile + warm
     assigned = int(out.n_assigned)
     if assigned == 0:
@@ -96,7 +98,7 @@ def tpu_rate(snapshot, pods) -> float:
 
     t0 = time.perf_counter()
     for _ in range(REPS):
-        out = schedule_windows(snapshot, pods_w, assigner="auction")
+        out = schedule_windows(snapshot, pods_w, assigner="auction", fused=FUSED)
     jax.block_until_ready(out)
     dt = time.perf_counter() - t0
     return REPS * N_PODS / dt

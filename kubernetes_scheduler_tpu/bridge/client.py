@@ -77,12 +77,14 @@ class RemoteEngine:
         policy: str = "balanced_cpu_diskio",
         assigner: str = "greedy",
         normalizer: str = "min_max",
+        fused: bool = False,
     ) -> engine.ScheduleResult:
         request = pb.ScheduleRequest(
             policy=policy,
             assigner=assigner,
             normalizer=normalizer,
             decisions_only=self.decisions_only,
+            fused=fused,
         )
         codec.pack_fields(snapshot, request.snapshot)
         codec.pack_fields(pods, request.pods)

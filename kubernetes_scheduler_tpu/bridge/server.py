@@ -57,6 +57,9 @@ class EngineService:
         t0 = time.perf_counter()
         try:
             if self._sharded_fn is not None:
+                # `fused` is a decision-identical optimization hint; the
+                # sharded engine has no fused path, so serve unfused rather
+                # than degrade the deployment to the host's scalar fallback
                 asked = {
                     "policy": request.policy,
                     "assigner": request.assigner,
@@ -78,6 +81,7 @@ class EngineService:
                     policy=request.policy or "balanced_cpu_diskio",
                     assigner=request.assigner or "greedy",
                     normalizer=request.normalizer or "min_max",
+                    fused=request.fused,
                 )
         except ValueError as e:  # unknown policy/assigner/normalizer
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))

@@ -48,6 +48,7 @@ from kubernetes_scheduler_tpu.ops.constraints import (
     taint_toleration_fit,
 )
 from kubernetes_scheduler_tpu.ops.normalize import softmax_normalize
+from kubernetes_scheduler_tpu.ops.assign import NEG
 
 POLICIES = ("balanced_cpu_diskio", "balanced_diskio", "free_capacity", "card")
 ASSIGNERS = ("greedy", "auction")
@@ -331,8 +332,40 @@ def compute_free_capacity(snapshot: SnapshotArrays) -> jnp.ndarray:
     )
 
 
+def _fused_masked_scores(
+    snapshot: SnapshotArrays, pods: PodBatch, *, include_pod_affinity: bool
+) -> jnp.ndarray:
+    """[p, n] score-where-feasible-else-NEG via the fused Pallas kernel
+    (ops/pallas_fused.py): score + resource fit in one tiled VMEM pass,
+    remaining constraint families (cards, taints, node/pod affinity)
+    ANDed on top. Only the balanced_cpu_diskio policy has a fused kernel."""
+    from kubernetes_scheduler_tpu.ops.pallas_fused import fused_masked_score
+
+    stats = utilization_stats(snapshot.disk_io, snapshot.cpu_pct, snapshot.node_mask)
+    masked = fused_masked_score(
+        stats.u, stats.v, snapshot.node_mask,
+        snapshot.allocatable, snapshot.requested,
+        pods.request[:, 0], pods.r_io, pods.request, pods.pod_mask,
+    )
+    gpu_fits, _ = card_fit(
+        snapshot.cards, snapshot.card_mask, snapshot.card_healthy,
+        pods.want_number, pods.want_memory, pods.want_clock,
+    )
+    other = gpu_fits & taint_toleration_fit(
+        snapshot.taints, snapshot.taint_mask, pods.tolerations, pods.tol_mask
+    ) & node_affinity_fit(
+        snapshot.node_labels, snapshot.node_label_mask,
+        pods.na_key, pods.na_op, pods.na_vals, pods.na_val_mask, pods.na_mask,
+    )
+    if include_pod_affinity:
+        other = other & pod_affinity_fit(
+            snapshot.domain_counts, pods.affinity_sel, pods.anti_affinity_sel
+        )
+    return jnp.where(other, masked, NEG)
+
+
 @functools.partial(
-    jax.jit, static_argnames=("policy", "assigner", "normalizer")
+    jax.jit, static_argnames=("policy", "assigner", "normalizer", "fused")
 )
 def schedule_batch(
     snapshot: SnapshotArrays,
@@ -341,6 +374,7 @@ def schedule_batch(
     policy: str = "balanced_cpu_diskio",
     assigner: str = "greedy",
     normalizer: str = "min_max",
+    fused: bool = False,
 ) -> ScheduleResult:
     """One scheduling cycle for the whole pending window, on device.
 
@@ -348,19 +382,47 @@ def schedule_batch(
     path (dynamic AffinityState). The auction path applies it statically
     against pre-window counts only — callers with window-internal selector
     interactions should use greedy (host.scheduler enforces this).
+
+    fused=True routes score + resource-fit through the fused Pallas kernel
+    (one HBM pass instead of three). Requires policy="balanced_cpu_diskio"
+    and normalizer="none" (the masked matrix carries NEG sentinels, which
+    min_max/softmax would fold into their statistics); assignments are
+    identical to the unfused path — both assigners are invariant under
+    per-row monotone rescaling and read infeasible entries as NEG anyway.
+    Contract deviation: in fused replies `scores`/`raw_scores` ARE the
+    masked matrix (NEG in infeasible cells) — the unmasked policy score is
+    never materialized, that being the point of the fusion. Consumers that
+    need scores across infeasible cells (e.g. models/learned.py teacher
+    matrices) must use fused=False.
     """
-    raw = compute_scores(snapshot, pods, policy)
-    feasible = compute_feasibility(
-        snapshot, pods, include_pod_affinity=(assigner != "greedy")
-    )
-    if normalizer == "min_max":
-        norm = min_max_normalize(raw, snapshot.node_mask)
-    elif normalizer == "softmax":
-        norm = softmax_normalize(raw, snapshot.node_mask)
-    elif normalizer == "none":
+    if fused:
+        if policy != "balanced_cpu_diskio":
+            raise ValueError(
+                f"fused kernel only implements balanced_cpu_diskio, not {policy!r}"
+            )
+        if normalizer != "none":
+            raise ValueError(
+                "fused=True requires normalizer='none' (masked NEG sentinels "
+                "would skew min_max/softmax statistics)"
+            )
+        raw = _fused_masked_scores(
+            snapshot, pods, include_pod_affinity=(assigner != "greedy")
+        )
+        feasible = raw > NEG * 0.5
         norm = raw
     else:
-        raise ValueError(f"unknown normalizer {normalizer!r}")
+        raw = compute_scores(snapshot, pods, policy)
+        feasible = compute_feasibility(
+            snapshot, pods, include_pod_affinity=(assigner != "greedy")
+        )
+        if normalizer == "min_max":
+            norm = min_max_normalize(raw, snapshot.node_mask)
+        elif normalizer == "softmax":
+            norm = softmax_normalize(raw, snapshot.node_mask)
+        elif normalizer == "none":
+            norm = raw
+        else:
+            raise ValueError(f"unknown normalizer {normalizer!r}")
 
     free = compute_free_capacity(snapshot)
     if assigner == "greedy":
@@ -410,7 +472,7 @@ def stack_windows(pods: PodBatch, window: int) -> PodBatch:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("policy", "assigner", "normalizer")
+    jax.jit, static_argnames=("policy", "assigner", "normalizer", "fused")
 )
 def schedule_windows(
     snapshot: SnapshotArrays,
@@ -419,6 +481,7 @@ def schedule_windows(
     policy: str = "balanced_cpu_diskio",
     assigner: str = "auction",
     normalizer: str = "none",
+    fused: bool = False,
 ) -> WindowsResult:
     """Schedule many windows in ONE device program: lax.scan over the
     window axis, carrying node capacity AND (anti)affinity domain counts
@@ -448,7 +511,8 @@ def schedule_windows(
             requested=requested, domain_counts=domain_counts
         )
         res = schedule_batch(
-            snap, w, policy=policy, assigner=assigner, normalizer=normalizer
+            snap, w, policy=policy, assigner=assigner, normalizer=normalizer,
+            fused=fused,
         )
         # fold this window's placements into the domain counts so the next
         # window's (anti)affinity sees them (the sequential host loop gets

@@ -155,6 +155,13 @@ class Scheduler:
             # against stale pre-window counts
             log.info("window has inter-pod affinity interactions; using greedy")
             assigner = "greedy"
+        # the fused Pallas path is an optimization with identical decisions;
+        # silently unavailable outside its (policy, normalizer) domain
+        fused = (
+            self.config.feature_gates.fused_kernel
+            and self.config.policy == "balanced_cpu_diskio"
+            and self.config.normalizer == "none"
+        )
         t0 = time.perf_counter()
         res = self.engine.schedule_batch(
             snapshot,
@@ -162,6 +169,7 @@ class Scheduler:
             policy=self.config.policy,
             assigner=assigner,
             normalizer=self.config.normalizer,
+            fused=fused,
         )
         idx = np.asarray(res.node_idx)
         m.engine_seconds = time.perf_counter() - t0

@@ -1,0 +1,159 @@
+"""Fused Pallas TPU kernel: policy score + resource feasibility in one pass.
+
+The hot [p, n] pipeline of the batch engine is HBM-bandwidth-bound: the live
+policy score (ops/score.balanced_cpu_diskio, vectorizing
+pkg/yoda/score/algorithm.go:99-119) and the NodeResourcesFit mask
+(ops/feasibility.resource_fit, vectorizing algorithm.go:209-262) each stream
+a [p, n]-shaped intermediate through HBM, and the assignment step reads both
+to build `where(feasible, score, NEG)`. This kernel fuses all three into ONE
+tiled pass: each (TILE_P, TILE_N) block loads the per-pod and per-node
+vectors once into VMEM, evaluates score + fit on the VPU, and writes only
+the final masked-score block — one [p, n] HBM write instead of three
+[p, n] round-trips.
+
+Layout: per-pod and per-node feature vectors are passed transposed —
+[k, p] and [k, n] with the batch axis in lanes — so every block's last
+dimension is the 128-aligned tile axis and the tiny feature axis (2-8 rows)
+sits in sublanes. The [p, n] output tiles map directly onto the VPU's
+(8, 128) native shape.
+
+On non-TPU backends the same kernel runs through the Pallas interpreter
+(tests) — semantics, including padding behavior, are identical.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from kubernetes_scheduler_tpu.ops.assign import NEG
+from kubernetes_scheduler_tpu.ops.score import MAX_RAW_SCORE, alpha_beta
+
+TILE_P = 256
+TILE_N = 1024
+
+
+def _fused_kernel(pod_sc_ref, node_ft_ref, pod_req_ref, alloc_ref, reqd_ref,
+                  out_ref, *, n_res: int):
+    """One (TILE_P, TILE_N) block of masked scores.
+
+    pod_sc_ref:  [3, TILE_P]  rows = (alpha, beta, pod_mask)
+    node_ft_ref: [3, TILE_N]  rows = (u, v, node_mask)
+    pod_req_ref: [n_res, TILE_P]   pod requests, resource-major
+    alloc_ref:   [n_res, TILE_N]   node allocatable
+    reqd_ref:    [n_res, TILE_N]   node requested (non-zero defaults applied)
+    out_ref:     [TILE_P, TILE_N]  score where feasible else NEG
+    """
+    alpha = pod_sc_ref[0, :][:, None]      # [TILE_P, 1]
+    beta = pod_sc_ref[1, :][:, None]
+    pmask = pod_sc_ref[2, :][:, None] > 0.0
+    u = node_ft_ref[0, :][None, :]         # [1, TILE_N]
+    v = node_ft_ref[1, :][None, :]
+    nmask = node_ft_ref[2, :][None, :] > 0.0
+
+    # BalancedCpuDiskIOPriority (algorithm.go:105-111), one VPU expression
+    score = MAX_RAW_SCORE - MAX_RAW_SCORE * jnp.abs(alpha * v - beta * u)
+
+    # NodeResourcesFit with the unrequested-resource bypass
+    # (algorithm.go:211-215): static unroll over the small resource axis
+    fit = pmask & nmask
+    for i in range(n_res):
+        req = pod_req_ref[i, :][:, None]       # [TILE_P, 1]
+        ok = (reqd_ref[i, :][None, :] + req <= alloc_ref[i, :][None, :]) | (
+            req == 0.0
+        )
+        fit = fit & ok
+
+    out_ref[:, :] = jnp.where(fit, score, NEG)
+
+
+def _pad_axis(x: jnp.ndarray, axis: int, to: int) -> jnp.ndarray:
+    size = x.shape[axis]
+    rem = (-size) % to
+    if rem == 0:
+        return x
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, rem)
+    return jnp.pad(x, pad)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("tile_p", "tile_n", "interpret")
+)
+def fused_masked_score(
+    u: jnp.ndarray,
+    v: jnp.ndarray,
+    node_mask: jnp.ndarray,
+    alloc: jnp.ndarray,
+    reqd: jnp.ndarray,
+    r_cpu: jnp.ndarray,
+    r_io: jnp.ndarray,
+    pod_request: jnp.ndarray,
+    pod_mask: jnp.ndarray,
+    *,
+    tile_p: int = TILE_P,
+    tile_n: int = TILE_N,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Masked score matrix [p, n]: balanced_cpu_diskio where the pod fits
+    the node (resource_fit & node_mask & pod_mask), NEG elsewhere.
+
+    u, v:        [n] utilization (disk_io/50, cpu/100 — ops/stats.py)
+    node_mask:   [n] bool
+    alloc, reqd: [n, r] float32
+    r_cpu, r_io: [p] pod CPU request (milli) and diskIO annotation (MB/s)
+    pod_request: [p, r] float32 with non-zero defaults
+    pod_mask:    [p] bool
+
+    Semantically identical to
+        where(resource_fit(...) & masks, balanced_cpu_diskio(...), NEG)
+    (pinned by tests/test_pallas.py); padded rows/cols return NEG.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    p, n = pod_request.shape[0], alloc.shape[0]
+    n_res = alloc.shape[1]
+
+    alpha, beta = alpha_beta(r_cpu, r_io)
+
+    pod_sc = _pad_axis(
+        jnp.stack([alpha, beta, pod_mask.astype(jnp.float32)]), 1, tile_p
+    )
+    node_ft = _pad_axis(
+        jnp.stack(
+            [
+                u.astype(jnp.float32),
+                v.astype(jnp.float32),
+                node_mask.astype(jnp.float32),
+            ]
+        ),
+        1,
+        tile_n,
+    )
+    pod_req_t = _pad_axis(pod_request.astype(jnp.float32).T, 1, tile_p)
+    alloc_t = _pad_axis(alloc.astype(jnp.float32).T, 1, tile_n)
+    reqd_t = _pad_axis(reqd.astype(jnp.float32).T, 1, tile_n)
+
+    pp, nn = pod_sc.shape[1], node_ft.shape[1]
+    grid = (pp // tile_p, nn // tile_n)
+    pod_side = lambda i, j: (0, i)  # noqa: E731 — block index, node-invariant
+    node_side = lambda i, j: (0, j)  # noqa: E731
+
+    out = pl.pallas_call(
+        functools.partial(_fused_kernel, n_res=n_res),
+        out_shape=jax.ShapeDtypeStruct((pp, nn), jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((3, tile_p), pod_side),
+            pl.BlockSpec((3, tile_n), node_side),
+            pl.BlockSpec((n_res, tile_p), pod_side),
+            pl.BlockSpec((n_res, tile_n), node_side),
+            pl.BlockSpec((n_res, tile_n), node_side),
+        ],
+        out_specs=pl.BlockSpec((tile_p, tile_n), lambda i, j: (i, j)),
+        interpret=interpret,
+    )(pod_sc, node_ft, pod_req_t, alloc_t, reqd_t)
+    return out[:p, :n]

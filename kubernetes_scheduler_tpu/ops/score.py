@@ -34,6 +34,20 @@ ALLOCATE_WEIGHT = 3.0
 MAX_RAW_SCORE = 10.0
 
 
+def alpha_beta(r_cpu: jnp.ndarray, r_io: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(alpha[p], beta[p]) pod weights of the live policy
+    (algorithm.go:105-106): beta = 1/(1 + Rcpu/Rio), alpha = 1 - beta.
+    A missing/zero diskIO annotation reproduces the Go Rcpu/0 = +Inf limit
+    (beta = 0, alpha = 1) explicitly. Shared by the unfused kernel below
+    and the fused Pallas kernel (ops/pallas_fused.py) so the two paths
+    cannot drift."""
+    r_cpu = r_cpu.astype(jnp.float32)
+    r_io = r_io.astype(jnp.float32)
+    safe_io = jnp.where(r_io > 0, r_io, 1.0)
+    beta = jnp.where(r_io > 0, 1.0 / (1.0 + r_cpu / safe_io), 0.0)
+    return 1.0 - beta, beta
+
+
 def balanced_cpu_diskio(
     stats: UtilizationStats,
     r_cpu: jnp.ndarray,
@@ -61,11 +75,7 @@ def balanced_cpu_diskio(
 
     Returns S[p, n] float32.
     """
-    r_cpu = r_cpu.astype(jnp.float32)
-    r_io = r_io.astype(jnp.float32)
-    safe_io = jnp.where(r_io > 0, r_io, 1.0)
-    beta = jnp.where(r_io > 0, 1.0 / (1.0 + r_cpu / safe_io), 0.0)  # [p]
-    alpha = 1.0 - beta
+    alpha, beta = alpha_beta(r_cpu, r_io)
     load = jnp.abs(
         alpha[:, None] * stats.v[None, :] - beta[:, None] * stats.u[None, :]
     )

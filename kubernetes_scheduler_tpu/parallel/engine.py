@@ -112,7 +112,9 @@ def _sharded_greedy(
     cols = jnp.arange(s)
     # the scan body mixes per-shard (varying) values into the update chain,
     # so the carry must start out marked varying for the vma checker
-    added0 = jax.lax.pvary(jnp.zeros((n_global, s), jnp.float32), NODE_AXIS)
+    added0 = jax.lax.pcast(
+        jnp.zeros((n_global, s), jnp.float32), NODE_AXIS, to="varying"
+    )
 
     def step(carry, i):
         free, added = carry

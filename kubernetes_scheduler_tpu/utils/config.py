@@ -28,6 +28,10 @@ class FeatureGates:
     # use the C++ host runtime (native/) for the queue and the scalar
     # fallback cycle; off -> pure-Python equivalents, same decisions
     native_host: bool = True
+    # route score + resource-fit through the fused Pallas kernel
+    # (ops/pallas_fused.py) when policy/normalizer permit; decisions are
+    # identical, the [p, n] pass is one HBM round-trip instead of three
+    fused_kernel: bool = True
 
 
 @dataclass

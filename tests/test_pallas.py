@@ -1,0 +1,145 @@
+"""The fused Pallas kernel must match the unfused op composition exactly.
+
+Runs through the Pallas interpreter on CPU (conftest forces the cpu
+backend); on TPU the same kernel compiles via Mosaic with identical
+semantics.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from kubernetes_scheduler_tpu.ops import (
+    balanced_cpu_diskio,
+    resource_fit,
+    utilization_stats,
+)
+from kubernetes_scheduler_tpu.ops.assign import NEG
+from kubernetes_scheduler_tpu.ops.pallas_fused import fused_masked_score
+
+RNG = np.random.default_rng(7)
+
+
+def make_problem(p, n, r=3):
+    alloc = RNG.uniform(10, 100, (n, r)).astype(np.float32)
+    reqd = (alloc * RNG.uniform(0, 1, (n, r))).astype(np.float32)
+    disk_io = RNG.uniform(0, 50, n).astype(np.float32)
+    cpu = RNG.uniform(0, 100, n).astype(np.float32)
+    pod_req = RNG.uniform(0, 40, (p, r)).astype(np.float32)
+    # exercise the unrequested-resource bypass
+    pod_req[RNG.uniform(size=(p, r)) < 0.3] = 0.0
+    r_cpu = pod_req[:, 0] * 10
+    r_io = RNG.uniform(0, 30, p).astype(np.float32)
+    r_io[RNG.uniform(size=p) < 0.25] = 0.0  # missing diskIO annotation
+    return alloc, reqd, disk_io, cpu, pod_req, r_cpu, r_io
+
+
+def reference_masked(alloc, reqd, disk_io, cpu, pod_req, r_cpu, r_io,
+                     node_mask, pod_mask):
+    stats = utilization_stats(
+        jnp.asarray(disk_io), jnp.asarray(cpu), jnp.asarray(node_mask)
+    )
+    score = balanced_cpu_diskio(stats, jnp.asarray(r_cpu), jnp.asarray(r_io))
+    fits = resource_fit(
+        jnp.asarray(alloc), jnp.asarray(reqd), jnp.asarray(pod_req),
+        jnp.asarray(node_mask),
+    )
+    fits = fits & jnp.asarray(pod_mask)[:, None]
+    return np.asarray(jnp.where(fits, score, NEG))
+
+
+@pytest.mark.parametrize("p,n", [(4, 16), (17, 130), (64, 300)])
+def test_fused_matches_composition(p, n):
+    alloc, reqd, disk_io, cpu, pod_req, r_cpu, r_io = make_problem(p, n)
+    node_mask = np.ones(n, bool)
+    node_mask[-max(1, n // 7):] = False
+    pod_mask = np.ones(p, bool)
+    pod_mask[-1] = False
+    stats = utilization_stats(
+        jnp.asarray(disk_io), jnp.asarray(cpu), jnp.asarray(node_mask)
+    )
+    got = np.asarray(
+        fused_masked_score(
+            stats.u, stats.v, jnp.asarray(node_mask),
+            jnp.asarray(alloc), jnp.asarray(reqd),
+            jnp.asarray(r_cpu), jnp.asarray(r_io),
+            jnp.asarray(pod_req), jnp.asarray(pod_mask),
+            tile_p=8, tile_n=128,
+        )
+    )
+    want = reference_masked(
+        alloc, reqd, disk_io, cpu, pod_req, r_cpu, r_io, node_mask, pod_mask
+    )
+    feas_got = got > NEG * 0.5
+    feas_want = want > NEG * 0.5
+    np.testing.assert_array_equal(feas_got, feas_want)
+    np.testing.assert_allclose(
+        got[feas_want], want[feas_want], rtol=1e-5, atol=1e-5
+    )
+    assert (got[~feas_want] == NEG).all()
+
+
+def test_fused_padding_is_masked():
+    p, n = 5, 37
+    alloc, reqd, disk_io, cpu, pod_req, r_cpu, r_io = make_problem(p, n)
+    got = np.asarray(
+        fused_masked_score(
+            jnp.asarray(disk_io / 50.0), jnp.asarray(cpu / 100.0),
+            jnp.ones(n, bool),
+            jnp.asarray(alloc), jnp.asarray(reqd),
+            jnp.asarray(r_cpu), jnp.asarray(r_io),
+            jnp.asarray(pod_req), jnp.ones(p, bool),
+            tile_p=8, tile_n=128,
+        )
+    )
+    assert got.shape == (p, n)
+
+
+@pytest.mark.parametrize("features", [{}, {"constraints": True}, {"gpu": True}])
+@pytest.mark.parametrize("assigner", ["greedy", "auction"])
+def test_fused_engine_decisions_match_unfused(features, assigner):
+    from kubernetes_scheduler_tpu.engine import schedule_batch
+    from kubernetes_scheduler_tpu.sim import gen_cluster, gen_pods
+
+    snap = gen_cluster(96, seed=3, **features)
+    pods = gen_pods(24, seed=4, **features)
+    base = schedule_batch(
+        snap, pods, assigner=assigner, normalizer="none", fused=False
+    )
+    got = schedule_batch(
+        snap, pods, assigner=assigner, normalizer="none", fused=True
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.feasible), np.asarray(base.feasible)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.node_idx), np.asarray(base.node_idx)
+    )
+
+
+def test_fused_windows_match_unfused():
+    from kubernetes_scheduler_tpu.engine import schedule_windows, stack_windows
+    from kubernetes_scheduler_tpu.sim import gen_cluster, gen_pods
+
+    snap = gen_cluster(64, seed=5)
+    pods = stack_windows(gen_pods(32, seed=6), 8)
+    base = schedule_windows(snap, pods, fused=False)
+    got = schedule_windows(snap, pods, fused=True)
+    np.testing.assert_array_equal(
+        np.asarray(got.node_idx), np.asarray(base.node_idx)
+    )
+    assert int(got.n_assigned) == int(base.n_assigned)
+
+
+def test_fused_rejects_incompatible_options():
+    from kubernetes_scheduler_tpu.engine import schedule_batch
+    from kubernetes_scheduler_tpu.sim import gen_cluster, gen_pods
+
+    snap = gen_cluster(8, seed=0)
+    pods = gen_pods(2, seed=1)
+    with pytest.raises(ValueError, match="normalizer"):
+        schedule_batch(snap, pods, normalizer="min_max", fused=True)
+    with pytest.raises(ValueError, match="fused kernel"):
+        schedule_batch(
+            snap, pods, policy="free_capacity", normalizer="none", fused=True
+        )

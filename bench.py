@@ -104,8 +104,69 @@ def tpu_rate(snapshot, pods) -> float:
     return REPS * N_PODS / dt
 
 
+def suite_rate(name: str) -> dict:
+    """One BASELINE.md config end-to-end: pods/s on the batch engine and
+    the vs-baseline ratio, with the same windowed schedule_windows program
+    as the headline metric. Constraint configs use the greedy assigner
+    (exact window-internal (anti)affinity, matching host.scheduler's
+    enforcement); others use the auction."""
+    import jax
+    from kubernetes_scheduler_tpu.engine import schedule_windows, stack_windows
+    from kubernetes_scheduler_tpu.sim import gen_config
+    from kubernetes_scheduler_tpu.sim.cluster_gen import BENCH_CONFIGS
+    from kubernetes_scheduler_tpu.utils.padding import pad_pod_batch
+
+    cfg = BENCH_CONFIGS[name]
+    snapshot, pods = gen_config(name, seed=0)
+    n_pods = cfg["n_pods"]
+    window = min(1024, max(8, n_pods))
+    n_padded = -(-n_pods // window) * window
+    constrained = bool(cfg.get("constraints"))
+    assigner = "greedy" if constrained else "auction"
+    fused = FUSED and not cfg.get("gpu")  # card policy has no fused kernel
+    snapshot = jax.device_put(snapshot)
+    pods_w = jax.device_put(stack_windows(pad_pod_batch(pods, n_padded), window))
+
+    def run():
+        return schedule_windows(
+            snapshot, pods_w, assigner=assigner, fused=fused,
+            policy="card" if cfg.get("gpu") else "balanced_cpu_diskio",
+        )
+
+    out = run()
+    jax.block_until_ready(out)  # compile + warm
+    assigned = int(out.n_assigned)
+    reps = max(1, min(REPS, 65_536 // n_pods))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = run()
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    rate = reps * n_pods / dt
+    base = baseline_rate(snapshot, pods)
+    return {
+        "config": name,
+        "pods": n_pods,
+        "nodes": cfg["n_nodes"],
+        "assigner": assigner,
+        "assigned": assigned,
+        "pods_per_sec": round(rate, 1),
+        "vs_baseline": round(rate / base, 2),
+    }
+
+
 def main():
     from kubernetes_scheduler_tpu.sim import gen_cluster, gen_pods
+
+    if "--suite" in sys.argv:
+        from kubernetes_scheduler_tpu.sim.cluster_gen import BENCH_CONFIGS
+
+        results = [suite_rate(name) for name in BENCH_CONFIGS]
+        with open("BENCH_SUITE.json", "w") as f:
+            json.dump(results, f, indent=2)
+        for r in results:
+            print(json.dumps(r))
+        return
 
     snapshot = gen_cluster(N_NODES, seed=0)
     pods = gen_pods(N_PODS, seed=1)

@@ -153,6 +153,13 @@ def main(argv=None):
         default=0,
         help="shard the node axis over this many devices (0 = single device)",
     )
+    parser.add_argument(
+        "--mesh-hosts",
+        type=int,
+        default=1,
+        help="with --mesh-devices: split the mesh into this many host groups "
+        "(2-D dcn x node hierarchical collectives for multi-host slices)",
+    )
     parser.add_argument("--policy", default="balanced_cpu_diskio")
     args = parser.parse_args(argv)
 
@@ -161,10 +168,25 @@ def main(argv=None):
     if args.mesh_devices > 1:
         from jax.sharding import Mesh
         from kubernetes_scheduler_tpu.parallel.engine import make_sharded_schedule_fn
-        from kubernetes_scheduler_tpu.parallel.mesh import NODE_AXIS
+        from kubernetes_scheduler_tpu.parallel.mesh import (
+            DCN_AXIS, NODE_AXIS, make_mesh_multihost,
+        )
 
-        mesh = Mesh(np.asarray(jax.devices()[: args.mesh_devices]), (NODE_AXIS,))
-        sharded_fn = make_sharded_schedule_fn(mesh, policy=args.policy)
+        if args.mesh_hosts > 1:
+            if args.mesh_devices % args.mesh_hosts:
+                raise SystemExit("--mesh-devices must divide by --mesh-hosts")
+            mesh = make_mesh_multihost(
+                args.mesh_hosts, args.mesh_devices // args.mesh_hosts
+            )
+            node_axes: tuple[str, ...] | str = (DCN_AXIS, NODE_AXIS)
+        else:
+            mesh = Mesh(
+                np.asarray(jax.devices()[: args.mesh_devices]), (NODE_AXIS,)
+            )
+            node_axes = NODE_AXIS
+        sharded_fn = make_sharded_schedule_fn(
+            mesh, policy=args.policy, node_axes=node_axes
+        )
         sharded_opts = {"policy": args.policy, "normalizer": "min_max"}
     else:
         sharded_opts = None

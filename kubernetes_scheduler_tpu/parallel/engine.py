@@ -47,28 +47,28 @@ from kubernetes_scheduler_tpu.ops.stats import CPU_DIVISOR, DISK_IO_DIVISOR, Uti
 from kubernetes_scheduler_tpu.parallel.mesh import NODE_AXIS
 
 
-def _sharded_stats(snapshot: SnapshotArrays) -> UtilizationStats:
+def _sharded_stats(snapshot: SnapshotArrays, axes) -> UtilizationStats:
     """utilization_stats with psum reductions over the node shards."""
     mask = snapshot.node_mask.astype(jnp.float32)
-    n_valid = jnp.maximum(jax.lax.psum(mask.sum(), NODE_AXIS), 1.0)
+    n_valid = jnp.maximum(jax.lax.psum(mask.sum(), axes), 1.0)
     u = snapshot.disk_io / DISK_IO_DIVISOR
     v = snapshot.cpu_pct / CPU_DIVISOR
-    u_avg = jax.lax.psum((u * mask).sum(), NODE_AXIS) / n_valid
-    m_var = jax.lax.psum((((u - u_avg) ** 2) * mask).sum(), NODE_AXIS) / n_valid
+    u_avg = jax.lax.psum((u * mask).sum(), axes) / n_valid
+    m_var = jax.lax.psum((((u - u_avg) ** 2) * mask).sum(), axes) / n_valid
     return UtilizationStats(u=u, v=v, u_avg=u_avg, m_var=m_var, n_valid=n_valid)
 
 
 def _sharded_scores(
-    snapshot: SnapshotArrays, pods: PodBatch, policy: str
+    snapshot: SnapshotArrays, pods: PodBatch, policy: str, axes
 ) -> jnp.ndarray:
-    stats = _sharded_stats(snapshot)
+    stats = _sharded_stats(snapshot, axes)
     if policy == "balanced_cpu_diskio":
         return balanced_cpu_diskio(stats, pods.request[:, 0], pods.r_io)
     if policy == "balanced_diskio":
         m = balanced_diskio_m(stats, snapshot.disk_io, pods.r_io)
         m_hi, m_lo = balanced_diskio_local_bounds(m, snapshot.node_mask)
-        m_hi = jax.lax.pmax(m_hi, NODE_AXIS)
-        m_lo = jax.lax.pmin(m_lo, NODE_AXIS)
+        m_hi = jax.lax.pmax(m_hi, axes)
+        m_lo = jax.lax.pmin(m_lo, axes)
         return balanced_diskio_from_m(m, m_hi, m_lo)
     if policy == "free_capacity":
         s = free_capacity(snapshot.cpu_pct, snapshot.mem_pct, snapshot.disk_io)
@@ -81,7 +81,7 @@ def _sharded_scores(
         local_max = local_max_card_values(
             snapshot.cards, per_card & node_fits[:, :, None]
         )
-        maxima = jnp.maximum(jax.lax.pmax(local_max, NODE_AXIS), 1.0)
+        maxima = jnp.maximum(jax.lax.pmax(local_max, axes), 1.0)
         return card_score(snapshot.cards, snapshot.card_mask, per_card, maxima)
     raise ValueError(f"unknown policy {policy!r}")
 
@@ -92,6 +92,7 @@ def _sharded_greedy(
     pods: PodBatch,
     free0: jnp.ndarray,
     snapshot: SnapshotArrays,
+    axes,
 ):
     """Exact greedy over the sharded node axis.
 
@@ -103,9 +104,9 @@ def _sharded_greedy(
     inter-pod-affinity counts identically.
     """
     n_local = norm.shape[1]
-    n_devices = jax.lax.psum(1, NODE_AXIS)
+    n_devices = jax.lax.psum(1, axes)
     n_global = n_local * n_devices
-    offset = jax.lax.axis_index(NODE_AXIS).astype(jnp.int32) * n_local
+    offset = jax.lax.axis_index(axes).astype(jnp.int32) * n_local
     order = _priority_order(pods.priority, pods.pod_mask)
     p = norm.shape[0]
     s = snapshot.domain_counts.shape[1]
@@ -113,7 +114,7 @@ def _sharded_greedy(
     # the scan body mixes per-shard (varying) values into the update chain,
     # so the carry must start out marked varying for the vma checker
     added0 = jax.lax.pcast(
-        jnp.zeros((n_global, s), jnp.float32), NODE_AXIS, to="varying"
+        jnp.zeros((n_global, s), jnp.float32), axes, to="varying"
     )
 
     def step(carry, i):
@@ -130,8 +131,8 @@ def _sharded_greedy(
         row = jnp.where(mask, norm[i], NEG)
         local_best = row.max()
         local_arg = jnp.argmax(row).astype(jnp.int32) + offset
-        cand_s = jax.lax.all_gather(local_best, NODE_AXIS)  # [D]
-        cand_i = jax.lax.all_gather(local_arg, NODE_AXIS)   # [D]
+        cand_s = jax.lax.all_gather(local_best, axes)  # [D]
+        cand_i = jax.lax.all_gather(local_arg, axes)   # [D]
         # Every shard with no feasible node contributes exactly NEG, so
         # "any feasible anywhere" falls out of the gathered maxima — no
         # extra psum collective needed in this latency-bound scan body.
@@ -145,7 +146,7 @@ def _sharded_greedy(
         # broadcast the chosen node's domain ids (owning shard contributes
         # id+1, others 0; -1 after psum means "not found")
         local_dom = snapshot.domain_id[jnp.clip(local_idx, 0, n_local - 1)]  # [S]
-        dom = jax.lax.psum(jnp.where(mine, local_dom + 1, 0), NODE_AXIS) - 1
+        dom = jax.lax.psum(jnp.where(mine, local_dom + 1, 0), axes) - 1
         inc = jnp.where(
             found & (dom >= 0), pods.pod_matches[i].astype(jnp.float32), 0.0
         )
@@ -157,7 +158,7 @@ def _sharded_greedy(
     # picks are computed identically on every shard, but the replication
     # checker cannot see that through all_gather/argmax; a pmax over equal
     # values is the identity and makes replication provable.
-    node_idx = jax.lax.pmax(node_idx, NODE_AXIS)
+    node_idx = jax.lax.pmax(node_idx, axes)
     return node_idx, free_after
 
 
@@ -166,6 +167,7 @@ def make_sharded_schedule_fn(
     *,
     policy: str = "balanced_cpu_diskio",
     normalizer: str = "min_max",
+    node_axes: str | tuple[str, ...] = NODE_AXIS,
 ):
     """Build a jitted shard_map'd schedule function for `mesh`.
 
@@ -173,9 +175,20 @@ def make_sharded_schedule_fn(
     [n, ...] arrays, axis 1 for the returned [p, n] score matrices) and all
     per-pod arrays replicated. The returned function has the same signature
     and result type as engine.schedule_batch.
-    """
 
-    node = P(NODE_AXIS)
+    node_axes: mesh axis (or axis tuple) the cluster-node dimension shards
+    over. For a multi-host slice pass a mesh from make_mesh_multihost and
+    node_axes=(DCN_AXIS, NODE_AXIS): every collective then runs over the
+    combined axis and XLA lowers it hierarchically — the big per-shard
+    reductions ride ICI, only the tiny cross-host residual (scalar stats,
+    one (score, index) candidate pair per host group) crosses DCN.
+    """
+    axes = node_axes if isinstance(node_axes, tuple) else (node_axes,)
+    missing = [a for a in axes if a not in mesh.axis_names]
+    if missing:
+        raise ValueError(f"mesh {mesh.axis_names} lacks axes {missing}")
+
+    node = P(axes)
     rep = P()
     # every per-node array shards on its leading node axis; per-pod arrays
     # are replicated
@@ -183,15 +196,15 @@ def make_sharded_schedule_fn(
     pod_specs = PodBatch(**{f: rep for f in PodBatch._fields})
     out_specs = ScheduleResult(
         node_idx=rep,
-        scores=P(None, NODE_AXIS),
-        raw_scores=P(None, NODE_AXIS),
-        feasible=P(None, NODE_AXIS),
+        scores=P(None, axes),
+        raw_scores=P(None, axes),
+        feasible=P(None, axes),
         free_after=node,
         n_assigned=rep,
     )
 
     def body(snapshot: SnapshotArrays, pods: PodBatch) -> ScheduleResult:
-        raw = _sharded_scores(snapshot, pods, policy)
+        raw = _sharded_scores(snapshot, pods, policy, axes)
         # purely local/elementwise on the node axis — reuse the
         # single-device implementation so the two paths cannot diverge.
         # Inter-pod affinity is excluded from the static mask: the greedy
@@ -200,16 +213,16 @@ def make_sharded_schedule_fn(
 
         if normalizer == "min_max":
             hi, lo = score_bounds(raw, snapshot.node_mask)
-            hi = jax.lax.pmax(hi, NODE_AXIS)
-            lo = jax.lax.pmin(lo, NODE_AXIS)
+            hi = jax.lax.pmax(hi, axes)
+            lo = jax.lax.pmin(lo, axes)
             norm = min_max_normalize(raw, snapshot.node_mask, bounds=(hi, lo))
         elif normalizer == "softmax":
             # masked softmax with a global denominator
             neg = jnp.asarray(-1e30, raw.dtype)
             logits = jnp.where(snapshot.node_mask[None, :], raw, neg)
-            z = jax.lax.pmax(logits.max(axis=1, keepdims=True), NODE_AXIS)
+            z = jax.lax.pmax(logits.max(axis=1, keepdims=True), axes)
             e = jnp.exp(logits - z)
-            denom = jax.lax.psum(e.sum(axis=1, keepdims=True), NODE_AXIS)
+            denom = jax.lax.psum(e.sum(axis=1, keepdims=True), axes)
             norm = e / denom
         elif normalizer == "none":
             norm = raw
@@ -217,7 +230,7 @@ def make_sharded_schedule_fn(
             raise ValueError(f"unknown normalizer {normalizer!r}")
 
         free0 = compute_free_capacity(snapshot)
-        node_idx, free_after = _sharded_greedy(norm, feasible, pods, free0, snapshot)
+        node_idx, free_after = _sharded_greedy(norm, feasible, pods, free0, snapshot, axes)
         return ScheduleResult(
             node_idx=node_idx,
             scores=norm,

@@ -12,6 +12,10 @@ import jax
 from jax.sharding import Mesh
 
 NODE_AXIS = "node"
+# Outer (cross-host) mesh axis for slices spanning hosts: collectives over
+# (DCN_AXIS, NODE_AXIS) are lowered hierarchically by XLA — reductions ride
+# ICI within a host first, then the small cross-host residual rides DCN.
+DCN_AXIS = "dcn"
 
 
 def make_mesh(n_devices: int | None = None, *, axis: str = NODE_AXIS) -> Mesh:
@@ -20,3 +24,31 @@ def make_mesh(n_devices: int | None = None, *, axis: str = NODE_AXIS) -> Mesh:
     if n_devices is not None:
         devs = devs[:n_devices]
     return Mesh(np.asarray(devs), (axis,))
+
+
+def make_mesh_multihost(
+    n_hosts: int,
+    devices_per_host: int | None = None,
+    *,
+    outer_axis: str = DCN_AXIS,
+    axis: str = NODE_AXIS,
+) -> Mesh:
+    """2-D (hosts, devices-per-host) mesh for slices spanning hosts.
+
+    The cluster-node dimension shards over the PRODUCT of both axes
+    (PartitionSpec((outer_axis, axis))) — the sharded engine takes
+    node_axes=(outer_axis, axis) and every psum/pmax/all_gather runs over
+    the combined axis, hierarchically (ICI inner, DCN outer). Device order
+    follows jax.devices(), which groups by host, so the inner axis is
+    intra-host ICI as long as devices_per_host divides the per-host device
+    count."""
+    devs = jax.devices()
+    if devices_per_host is None:
+        devices_per_host = len(devs) // n_hosts
+    need = n_hosts * devices_per_host
+    if len(devs) < need:
+        raise ValueError(f"need {need} devices, have {len(devs)}")
+    return Mesh(
+        np.asarray(devs[:need]).reshape(n_hosts, devices_per_host),
+        (outer_axis, axis),
+    )

@@ -201,3 +201,34 @@ def test_sharded_engine_padded_nodes():
         rtol=1e-5, atol=1e-4,
     )
     assert (np.asarray(sharded.node_idx) < n_real).all()
+
+
+@pytest.mark.parametrize("shape", [(2, 4), (4, 2)])
+def test_multihost_mesh_matches_single_device(shape):
+    """2-D (dcn, node) hierarchical mesh — the multi-host layout — must
+    produce the same decisions as single-device."""
+    from kubernetes_scheduler_tpu.parallel.mesh import (
+        DCN_AXIS, NODE_AXIS, make_mesh_multihost,
+    )
+
+    from kubernetes_scheduler_tpu.sim import gen_cluster, gen_pods
+
+    snapshot = gen_cluster(64, seed=21, constraints=True)
+    pods = gen_pods(6, seed=22, constraints=True)
+    single = schedule_batch(snapshot, pods)
+    mesh = make_mesh_multihost(*shape)
+    assert mesh.axis_names == (DCN_AXIS, NODE_AXIS)
+    fn = make_sharded_schedule_fn(mesh, node_axes=(DCN_AXIS, NODE_AXIS))
+    sharded = fn(snapshot, pods)
+    np.testing.assert_array_equal(
+        np.asarray(sharded.feasible), np.asarray(single.feasible)
+    )
+    assert np.asarray(sharded.node_idx).tolist() == np.asarray(single.node_idx).tolist()
+    np.testing.assert_allclose(
+        np.asarray(sharded.free_after), np.asarray(single.free_after), atol=1e-3
+    )
+
+
+def test_sharded_fn_rejects_missing_axis():
+    with pytest.raises(ValueError, match="lacks axes"):
+        make_sharded_schedule_fn(make_mesh(8), node_axes=("dcn", "node"))

@@ -85,7 +85,7 @@ def tpu_rate(snapshot, pods) -> float:
     snapshot = jax.device_put(snapshot)
     pods_w = jax.device_put(stack_windows(pad_pod_batch(pods, n_padded), WINDOW))
 
-    out = schedule_windows(snapshot, pods_w, assigner="auction", fused=FUSED)
+    out = schedule_windows(snapshot, pods_w, assigner="auction", fused=FUSED, affinity_aware=False)
     jax.block_until_ready(out)  # compile + warm
     assigned = int(out.n_assigned)
     if assigned == 0:
@@ -98,7 +98,7 @@ def tpu_rate(snapshot, pods) -> float:
 
     t0 = time.perf_counter()
     for _ in range(REPS):
-        out = schedule_windows(snapshot, pods_w, assigner="auction", fused=FUSED)
+        out = schedule_windows(snapshot, pods_w, assigner="auction", fused=FUSED, affinity_aware=False)
     jax.block_until_ready(out)
     dt = time.perf_counter() - t0
     return REPS * N_PODS / dt
@@ -107,9 +107,7 @@ def tpu_rate(snapshot, pods) -> float:
 def suite_rate(name: str) -> dict:
     """One BASELINE.md config end-to-end: pods/s on the batch engine and
     the vs-baseline ratio, with the same windowed schedule_windows program
-    as the headline metric. Constraint configs use the greedy assigner
-    (exact window-internal (anti)affinity, matching host.scheduler's
-    enforcement); others use the auction."""
+    as the headline metric."""
     import jax
     from kubernetes_scheduler_tpu.engine import schedule_windows, stack_windows
     from kubernetes_scheduler_tpu.sim import gen_config
@@ -121,8 +119,11 @@ def suite_rate(name: str) -> dict:
     n_pods = cfg["n_pods"]
     window = min(1024, max(8, n_pods))
     n_padded = -(-n_pods // window) * window
-    constrained = bool(cfg.get("constraints"))
-    assigner = "greedy" if constrained else "auction"
+    # the auction enforces hard (anti)affinity exactly (dynamic round
+    # masks + conflict eviction), so constraint configs use it too;
+    # selector-free configs skip the dynamic machinery entirely
+    assigner = "auction"
+    affinity_aware = bool(cfg.get("constraints"))
     fused = FUSED and not cfg.get("gpu")  # card policy has no fused kernel
     snapshot = jax.device_put(snapshot)
     pods_w = jax.device_put(stack_windows(pad_pod_batch(pods, n_padded), window))
@@ -131,6 +132,7 @@ def suite_rate(name: str) -> dict:
         return schedule_windows(
             snapshot, pods_w, assigner=assigner, fused=fused,
             policy="card" if cfg.get("gpu") else "balanced_cpu_diskio",
+            affinity_aware=affinity_aware,
         )
 
     out = run()

@@ -78,6 +78,7 @@ class RemoteEngine:
         assigner: str = "greedy",
         normalizer: str = "min_max",
         fused: bool = False,
+        affinity_aware: bool = True,
     ) -> engine.ScheduleResult:
         request = pb.ScheduleRequest(
             policy=policy,
@@ -85,6 +86,7 @@ class RemoteEngine:
             normalizer=normalizer,
             decisions_only=self.decisions_only,
             fused=fused,
+            affinity_aware=affinity_aware,
         )
         codec.pack_fields(snapshot, request.snapshot)
         codec.pack_fields(pods, request.pods)

@@ -82,6 +82,7 @@ class EngineService:
                     assigner=request.assigner or "greedy",
                     normalizer=request.normalizer or "min_max",
                     fused=request.fused,
+                    affinity_aware=request.affinity_aware,
                 )
         except ValueError as e:  # unknown policy/assigner/normalizer
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))

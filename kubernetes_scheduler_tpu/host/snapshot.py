@@ -189,7 +189,7 @@ class SnapshotBuilder:
                 labels[i, j] = (self.label_keys.id(k), self.label_values.id(v))
                 label_mask[i, j] = True
 
-        domain_counts, domain_id = self._domain_counts(
+        domain_counts, domain_id, avoid_counts = self._domain_counts(
             nodes, running_pods, pending_pods or [], n
         )
 
@@ -200,7 +200,7 @@ class SnapshotBuilder:
             card_mask=card_mask, card_healthy=card_healthy, taints=taints,
             taint_mask=taint_mask, node_labels=labels,
             node_label_mask=label_mask, domain_counts=domain_counts,
-            domain_id=domain_id,
+            domain_id=domain_id, avoid_counts=avoid_counts,
         )
 
     def _selector_id(self, term) -> int:
@@ -214,7 +214,7 @@ class SnapshotBuilder:
 
     def _domain_counts(
         self, nodes: list[Node], running: list[Pod], pending: list[Pod], n: int
-    ) -> tuple[np.ndarray, np.ndarray]:
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         """For every distinct (selector, topology_key) used by the pending
         window: count running pods matching the selector, aggregated over
         each node's topology domain (exact for matchLabels selectors —
@@ -224,21 +224,32 @@ class SnapshotBuilder:
         Also returns domain_id[n, S]: each node's topology domain for
         selector s, encoded as the index of the first node in that domain,
         so the engine's in-window placement counts stay statically shaped
-        (ops/assign.py AffinityState)."""
+        (ops/assign.py AffinityState); and avoid_counts[n, S]: running
+        AVOIDERS per domain — pods whose required anti-affinity terms use
+        selector s — gating the reverse anti-affinity direction (upstream
+        InterPodAffinity checks existing pods' anti terms against the
+        incoming pod too)."""
         for pod in pending:
             for term in pod.pod_affinity:
                 self._selector_id(term)
+        # running pods' anti terms also define selectors (reverse direction)
+        for pod in running:
+            for term in pod.pod_affinity:
+                if term.anti:
+                    self._selector_id(term)
         s = self._selector_slots()
         counts = np.zeros((n, s), np.float32)
+        avoid = np.zeros((n, s), np.float32)
         # default: every node is its own (hostname) domain
         domain_id = np.tile(
             np.arange(n, dtype=np.int32)[:, None], (1, s)
         )
         if not self.selectors:
-            return counts, domain_id
+            return counts, domain_id, avoid
         node_index = {nd.name: i for i, nd in enumerate(nodes)}
         # per-node raw counts
         raw = np.zeros((len(nodes), s), np.float32)
+        raw_avoid = np.zeros((len(nodes), s), np.float32)
         for pod in running:
             i = node_index.get(pod.node_name)
             if i is None:
@@ -246,19 +257,25 @@ class SnapshotBuilder:
             for (items, _topo), sid in self.selectors.items():
                 if all(pod.labels.get(k) == v for k, v in items):
                     raw[i, sid] += 1
+            for term in pod.pod_affinity:
+                if term.anti:
+                    raw_avoid[i, self._selector_id(term)] += 1
         # aggregate over topology domains
         for (_items, topo), sid in self.selectors.items():
             domains: dict[str, float] = {}
+            domains_a: dict[str, float] = {}
             first: dict[str, int] = {}
             for i, nd in enumerate(nodes):
                 d = nd.name if topo == "kubernetes.io/hostname" else nd.labels.get(topo, "")
                 domains[d] = domains.get(d, 0.0) + raw[i, sid]
+                domains_a[d] = domains_a.get(d, 0.0) + raw_avoid[i, sid]
                 first.setdefault(d, i)
             for i, nd in enumerate(nodes):
                 d = nd.name if topo == "kubernetes.io/hostname" else nd.labels.get(topo, "")
                 counts[i, sid] = domains[d]
+                avoid[i, sid] = domains_a[d]
                 domain_id[i, sid] = first[d]
-        return counts, domain_id
+        return counts, domain_id, avoid
 
     # ---- pod side ------------------------------------------------------
 

@@ -34,7 +34,13 @@ from kubernetes_scheduler_tpu.engine import (
     compute_free_capacity,
 )
 from kubernetes_scheduler_tpu.ops import card_fit, card_score, free_capacity
-from kubernetes_scheduler_tpu.ops.assign import NEG, _priority_order, affinity_ok_from_counts
+from kubernetes_scheduler_tpu.ops.assign import (
+    NEG,
+    _priority_order,
+    affinity_ok_from_counts,
+    anti_reverse_ok,
+    pod_has_anti_onehot,
+)
 from kubernetes_scheduler_tpu.ops.collect import local_max_card_values
 from kubernetes_scheduler_tpu.ops.normalize import min_max_normalize, score_bounds, softmax_normalize
 from kubernetes_scheduler_tpu.ops.score import (
@@ -111,14 +117,19 @@ def _sharded_greedy(
     p = norm.shape[0]
     s = snapshot.domain_counts.shape[1]
     cols = jnp.arange(s)
+    from kubernetes_scheduler_tpu.engine import match_matrix
+
+    matches = match_matrix(pods, s)
+    has_anti = pod_has_anti_onehot(pods.anti_affinity_sel, s)
     # the scan body mixes per-shard (varying) values into the update chain,
     # so the carry must start out marked varying for the vma checker
     added0 = jax.lax.pcast(
-        jnp.zeros((n_global, s), jnp.float32), axes, to="varying"
+        jnp.zeros((2, n_global, s), jnp.float32), axes, to="varying"
     )
 
     def step(carry, i):
-        free, added = carry
+        free, added2 = carry
+        added, added_avoid = added2[0], added2[1]
         req = pods.request[i]
         cap_ok = ((req[None, :] <= free) | (req[None, :] == 0)).all(-1)
         # live inter-pod affinity counts: base (local) + in-window
@@ -127,6 +138,10 @@ def _sharded_greedy(
         aff_ok = affinity_ok_from_counts(
             cnt, pods.affinity_sel[i], pods.anti_affinity_sel[i]
         )
+        avoid_cnt = (
+            snapshot.avoid_counts + added_avoid[snapshot.domain_id, cols[None, :]]
+        )
+        aff_ok = aff_ok & anti_reverse_ok(avoid_cnt, matches[i])
         mask = feasible[i] & cap_ok & aff_ok & pods.pod_mask[i]
         row = jnp.where(mask, norm[i], NEG)
         local_best = row.max()
@@ -147,11 +162,17 @@ def _sharded_greedy(
         # id+1, others 0; -1 after psum means "not found")
         local_dom = snapshot.domain_id[jnp.clip(local_idx, 0, n_local - 1)]  # [S]
         dom = jax.lax.psum(jnp.where(mine, local_dom + 1, 0), axes) - 1
-        inc = jnp.where(
-            found & (dom >= 0), pods.pod_matches[i].astype(jnp.float32), 0.0
+        dom_c = jnp.clip(dom, 0, n_global - 1)
+        ok = found & (dom >= 0)
+        inc = jnp.where(ok, matches[i].astype(jnp.float32), 0.0)
+        inc_a = jnp.where(ok, has_anti[i].astype(jnp.float32), 0.0)
+        added2 = jnp.stack(
+            [
+                added.at[dom_c, cols].add(inc),
+                added_avoid.at[dom_c, cols].add(inc_a),
+            ]
         )
-        added = added.at[jnp.clip(dom, 0, n_global - 1), cols].add(inc)
-        return (free, added), jnp.where(found, chosen, jnp.int32(-1))
+        return (free, added2), jnp.where(found, chosen, jnp.int32(-1))
 
     (free_after, _), picks = jax.lax.scan(step, (free0, added0), order)
     node_idx = jnp.full((p,), -1, jnp.int32).at[order].set(picks)

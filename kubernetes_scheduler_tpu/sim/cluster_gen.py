@@ -102,6 +102,10 @@ def gen_cluster(
             domain_counts=(rng.random((n_nodes, n_selectors)) < 0.3).astype(
                 np.float32
             ) * rng.integers(1, 5, (n_nodes, n_selectors)),
+            # sparse running avoiders exercising the reverse anti direction
+            avoid_counts=(rng.random((n_nodes, n_selectors)) < 0.03).astype(
+                np.float32
+            ),
         )
     return make_snapshot(
         allocatable=alloc,
@@ -181,6 +185,9 @@ def gen_pods(
                 rng.integers(0, n_selectors, (n_pods, 1)),
                 -1,
             ),
+            # pending pods themselves match selectors, so placements inside
+            # one window interact (the hard case for batched assignment)
+            pod_matches=rng.random((n_pods, n_selectors)) < 0.15,
         )
     return make_pod_batch(
         request=request,

@@ -136,3 +136,135 @@ def test_auction_capacity_safe_and_complete():
     for i in np.where(idx < 0)[0]:
         for j in range(free.shape[0]):
             assert not (feasible[i, j] and (pod_req[i] <= free[j]).all())
+
+
+def _final_affinity_violations(node_idx, snap, pods):
+    """Count hard (anti)affinity violations in the FINAL state: for every
+    placed pod, its anti selectors must match zero OTHER pods (pre-existing
+    or window-placed) in its node's topology domain."""
+    import numpy as np
+
+    dom_id = np.asarray(snap.domain_id)          # [n, S]
+    base = np.asarray(snap.domain_counts)        # [n, S]
+    matches = np.asarray(pods.pod_matches)       # [p, S']
+    anti = np.asarray(pods.anti_affinity_sel)    # [p, K]
+    idx = np.asarray(node_idx)
+    s = base.shape[1]
+    if matches.shape[1] < s:  # default no-op pod_matches is [p, 1]
+        matches = np.pad(matches, ((0, 0), (0, s - matches.shape[1])))
+    # final counts per (representative domain row, selector)
+    added = np.zeros_like(base)
+    for i, j in enumerate(idx):
+        if j >= 0:
+            added[dom_id[j], np.arange(s)] += matches[i]
+    has_anti = np.zeros((len(idx), s), bool)
+    for i, row in enumerate(anti):
+        for t in row:
+            if 0 <= t < s:
+                has_anti[i, t] = True
+    added_avoid = np.zeros_like(base)
+    for i, j in enumerate(idx):
+        if j >= 0:
+            added_avoid[dom_id[j], np.arange(s)] += has_anti[i]
+    base_avoid = np.asarray(getattr(snap, "avoid_counts"))
+    viol = 0
+    for i, j in enumerate(idx):
+        if j < 0:
+            continue
+        cnt = base[j] + added[dom_id[j], np.arange(s)]
+        own = matches[i]
+        for t in anti[i]:
+            # forward: my anti selector matches another pod in my domain
+            if 0 <= t < s and cnt[t] - own[t] > 0:
+                viol += 1
+        # reverse: another avoider (running or placed) in my domain
+        # forbids a selector I match
+        avoid_cnt = base_avoid[j] + added_avoid[dom_id[j], np.arange(s)] - has_anti[i]
+        if ((avoid_cnt > 0) & own).any():
+            viol += 1
+    return viol
+
+
+def test_auction_affinity_exact_no_final_violations():
+    import numpy as np
+    from kubernetes_scheduler_tpu.engine import schedule_batch
+    from kubernetes_scheduler_tpu.sim import gen_cluster, gen_pods
+
+    for seed in (0, 4, 10, 22):
+        snap = gen_cluster(64, seed=seed, constraints=True)
+        pods = gen_pods(48, seed=seed + 1, constraints=True)
+        res = schedule_batch(snap, pods, assigner="auction", normalizer="none")
+        assert _final_affinity_violations(res.node_idx, snap, pods) == 0
+        # quality: within a few placements of exact greedy
+        g = schedule_batch(snap, pods, assigner="greedy", normalizer="none")
+        assert int(res.n_assigned) >= int(g.n_assigned) - 3, (
+            seed, int(res.n_assigned), int(g.n_assigned))
+
+
+def test_auction_spread_pods_one_per_domain():
+    """Self-anti-affinity (pod matches its own anti selector): at most one
+    per topology domain, even when all arrive in one window."""
+    import numpy as np
+    import jax.numpy as jnp
+    from kubernetes_scheduler_tpu.engine import (
+        make_pod_batch, make_snapshot, schedule_batch,
+    )
+
+    n, p, s = 8, 6, 2
+    # two domains of 4 nodes each (representative rows 0 and 4)
+    dom = np.repeat([0, 4], 4)[:, None] * np.ones((1, s), np.int32)
+    snap = make_snapshot(
+        allocatable=np.full((n, 3), 100.0, np.float32),
+        requested=np.zeros((n, 3), np.float32),
+        disk_io=np.linspace(0, 40, n), cpu_pct=np.linspace(0, 90, n),
+        mem_pct=np.zeros(n),
+        domain_counts=np.zeros((n, s), np.float32),
+        domain_id=dom.astype(np.int32),
+    )
+    matches = np.zeros((p, s), bool); matches[:, 0] = True
+    pods = make_pod_batch(
+        request=np.full((p, 3), 1.0, np.float32),
+        anti_affinity_sel=np.full((p, 1), 0, np.int32),
+        pod_matches=matches,
+        priority=np.arange(p),
+    )
+    res = schedule_batch(snap, pods, assigner="auction", normalizer="none")
+    idx = np.asarray(res.node_idx)
+    placed = idx[idx >= 0]
+    assert len(placed) == 2, idx  # one per domain
+    assert len({0 if j < 4 else 1 for j in placed}) == 2
+    assert _final_affinity_violations(res.node_idx, snap, pods) == 0
+    # highest-priority pods won the two slots
+    assert set(np.where(idx >= 0)[0]) == {p - 1, p - 2}, idx
+
+
+def test_auction_spread_survives_negative_priority():
+    """Survivor election in same-round conflict groups must work for
+    negative scv/priority labels (rank-based int32 key, not raw priority)."""
+    import numpy as np
+    from kubernetes_scheduler_tpu.engine import (
+        make_pod_batch, make_snapshot, schedule_batch,
+    )
+
+    n, p, s = 8, 6, 2
+    dom = np.repeat([0, 4], 4)[:, None] * np.ones((1, s), np.int32)
+    snap = make_snapshot(
+        allocatable=np.full((n, 3), 100.0, np.float32),
+        requested=np.zeros((n, 3), np.float32),
+        disk_io=np.linspace(0, 40, n), cpu_pct=np.linspace(0, 90, n),
+        mem_pct=np.zeros(n),
+        domain_counts=np.zeros((n, s), np.float32),
+        domain_id=dom.astype(np.int32),
+    )
+    matches = np.zeros((p, s), bool); matches[:, 0] = True
+    pods = make_pod_batch(
+        request=np.full((p, 3), 1.0, np.float32),
+        anti_affinity_sel=np.full((p, 1), 0, np.int32),
+        pod_matches=matches,
+        priority=np.arange(p) - 10,  # all negative
+    )
+    res = schedule_batch(snap, pods, assigner="auction", normalizer="none")
+    idx = np.asarray(res.node_idx)
+    placed = idx[idx >= 0]
+    assert len(placed) == 2, idx
+    assert _final_affinity_violations(res.node_idx, snap, pods) == 0

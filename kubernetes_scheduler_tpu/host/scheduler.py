@@ -122,7 +122,15 @@ class Scheduler:
         running = self.list_running_pods()
         utils = self.advisor.fetch()
 
-        if self.config.feature_gates.tpu_batch_score and nodes:
+        # adaptive dispatch: tiny cycles are device-latency-bound; the
+        # scalar host path (C++ when native) wins below min_device_work.
+        # Only when the scalar path's capability surface suffices — it
+        # implements the live yoda formula + resource fit, not the
+        # taint/affinity/GPU constraint families.
+        use_device = len(window) * len(nodes) >= self.config.min_device_work or (
+            not self._scalar_sufficient(window, nodes)
+        )
+        if self.config.feature_gates.tpu_batch_score and nodes and use_device:
             try:
                 self._run_batched(window, nodes, running, utils, m)
             except Exception:
@@ -136,6 +144,19 @@ class Scheduler:
         m.cycle_seconds = time.perf_counter() - t0
         self.metrics.append(m)
         return m
+
+    @staticmethod
+    def _scalar_sufficient(window, nodes) -> bool:
+        """True when this cycle uses no constraint family beyond the scalar
+        path's surface (live score + resource fit)."""
+        if any(nd.taints or nd.cards for nd in nodes):
+            return False
+        for pod in window:
+            if pod.tolerations or pod.node_affinity or pod.pod_affinity:
+                return False
+            if any(k.startswith("scv/") and k != "scv/priority" for k in pod.labels):
+                return False
+        return True
 
     def _run_batched(self, window, nodes, running, utils, m: CycleMetrics):
         # snapshot FIRST: build_snapshot registers every selector the cycle

@@ -61,6 +61,11 @@ class SchedulerConfig:
     initial_backoff_seconds: float = 1.0
     max_backoff_seconds: float = 10.0
     mesh_devices: int | None = None  # None = single device
+    # adaptive dispatch: below this pods x nodes product a cycle runs the
+    # host scalar path (C++ when native_host) instead of the device — tiny
+    # problems are device-dispatch-latency-bound (a 1-pod x 3-node cycle
+    # is ~25us in C++ vs ~20ms of device round-trip)
+    min_device_work: int = 1 << 20
     feature_gates: FeatureGates = field(default_factory=FeatureGates)
     advisor: AdvisorConfig = field(default_factory=AdvisorConfig)
 

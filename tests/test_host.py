@@ -195,6 +195,9 @@ def test_domain_counts_topology_aggregation():
 
 
 def make_sched(nodes, running, utils, **cfg):
+    # min_device_work=0: tests drive the batched path on tiny clusters that
+    # adaptive dispatch would otherwise (correctly) route to the scalar path
+    cfg.setdefault("min_device_work", 0)
     config = SchedulerConfig(batch_window=64, **cfg)
     return Scheduler(
         config,
@@ -274,3 +277,26 @@ def test_scheduler_constraints_respected_in_loop():
     s.run_cycle()
     bound = {b.pod.name: b.node_name for b in s.binder.bindings}
     assert bound == {"tolerant": "tainted", "picky": "plain"}
+
+
+def test_adaptive_dispatch_tiny_cycle_uses_scalar():
+    """Below min_device_work a constraint-free cycle runs the scalar host
+    path (device dispatch latency dominates tiny problems); pods with
+    constraint families the scalar path lacks stay on the device."""
+    nodes = [make_node(f"n{i}", cpu=8000) for i in range(3)]
+    utils = {f"n{i}": NodeUtil(cpu_pct=10, disk_io=5) for i in range(3)}
+    s = make_sched(nodes, [], utils, min_device_work=1 << 20)
+    s.submit(make_pod("p0", cpu=100, annotations={"diskIO": "5"}))
+    m = s.run_cycle()
+    assert m.pods_bound == 1 and m.used_fallback  # scalar dispatch
+
+    from kubernetes_scheduler_tpu.host.types import PodAffinityTerm
+
+    s2 = make_sched(nodes, [], utils, min_device_work=1 << 20)
+    pod = make_pod("p1", cpu=100)
+    pod.pod_affinity = [
+        PodAffinityTerm(match_labels={"app": "x"}, topology_key="zone", anti=True)
+    ]
+    s2.submit(pod)
+    m2 = s2.run_cycle()
+    assert m2.pods_bound == 1 and not m2.used_fallback  # device dispatch

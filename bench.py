@@ -86,7 +86,8 @@ def tpu_rate(snapshot, pods) -> float:
     pods_w = jax.device_put(stack_windows(pad_pod_batch(pods, n_padded), WINDOW))
 
     out = schedule_windows(snapshot, pods_w, assigner="auction", fused=FUSED, affinity_aware=False)
-    jax.block_until_ready(out)  # compile + warm
+    # int() readback forces completion — on a tunneled device
+    # block_until_ready alone does not synchronize
     assigned = int(out.n_assigned)
     if assigned == 0:
         raise RuntimeError("benchmark scheduled zero pods")
@@ -99,7 +100,12 @@ def tpu_rate(snapshot, pods) -> float:
     t0 = time.perf_counter()
     for _ in range(REPS):
         out = schedule_windows(snapshot, pods_w, assigner="auction", fused=FUSED, affinity_aware=False)
-    jax.block_until_ready(out)
+    # scalar readback of the LAST backlog: the device stream executes
+    # in order, so its completion covers all REPS executions, while the
+    # enqueues still pipeline (block_until_ready does not synchronize on
+    # a tunneled platform and would under-measure)
+    if int(out.n_assigned) <= 0:
+        raise RuntimeError("timed run scheduled zero pods")
     dt = time.perf_counter() - t0
     return REPS * N_PODS / dt
 
@@ -180,13 +186,13 @@ def suite_rate(name: str) -> dict:
         )
 
     out = run()
-    jax.block_until_ready(out)  # compile + warm
-    assigned = int(out.n_assigned)
+    assigned = int(out.n_assigned)  # readback = real sync (see tpu_rate)
     reps = max(1, min(REPS, 65_536 // n_pods))
     t0 = time.perf_counter()
     for _ in range(reps):
         out = run()
-    jax.block_until_ready(out)
+    if int(out.n_assigned) <= 0:
+        raise RuntimeError("timed run scheduled zero pods")
     dt = time.perf_counter() - t0
     rate = reps * n_pods / dt
     base = baseline_rate(snapshot, pods)

@@ -421,20 +421,36 @@ def auction_assign(
 
     s_dim = 0 if affinity is None else affinity.domain_counts.shape[1]
     cols_s = jnp.arange(s_dim)[None, :] if affinity is not None else None
+    # the feasibility-masked jittered score matrix is round-invariant on
+    # the no-affinity path — build it once outside the loop. (A fused
+    # Pallas bid kernel folding capacity+price+argmax into one pass was
+    # measured SLOWER end-to-end than this XLA-fused body — per-round
+    # kernel-launch overhead inside the while_loop outweighs the saved
+    # HBM traffic — so the round body stays plain XLA.)
+    sj = jnp.where(feasible, scores + jitter, NEG) if affinity is None else None
 
     def round_body(state):
         assigned, free, price, added, added_avoid, _, _round = state
         active = pod_mask & (assigned < 0)
-        cap_ok = (
-            (pod_request[:, None, :] <= free[None, :, :])
-            | (pod_request[:, None, :] == 0)
-        ).all(-1)
-        mask = feasible & cap_ok & active[:, None]
-        if affinity is not None:
+        if affinity is None:
+            cap_ok = (
+                (pod_request[:, None, :] <= free[None, :, :])
+                | (pod_request[:, None, :] == 0)
+            ).all(-1)
+            mask = (sj > NEG * 0.5) & cap_ok & active[:, None]
+            row = jnp.where(mask, sj - price[None, :], NEG)
+            bid = jnp.argmax(row, axis=1).astype(jnp.int32)
+            has_bid = mask.any(axis=1)
+        else:
+            cap_ok = (
+                (pod_request[:, None, :] <= free[None, :, :])
+                | (pod_request[:, None, :] == 0)
+            ).all(-1)
+            mask = feasible & cap_ok & active[:, None]
             mask = mask & _affinity_round_mask(affinity, added, added_avoid)
-        row = jnp.where(mask, scores + jitter - price[None, :], NEG)
-        bid = jnp.argmax(row, axis=1).astype(jnp.int32)          # [p]
-        has_bid = mask.any(axis=1)
+            row = jnp.where(mask, scores + jitter - price[None, :], NEG)
+            bid = jnp.argmax(row, axis=1).astype(jnp.int32)      # [p]
+            has_bid = mask.any(axis=1)
         admitted = _segmented_admission(
             bid, has_bid, pod_request, free, priority
         )

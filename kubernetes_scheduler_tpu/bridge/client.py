@@ -79,6 +79,7 @@ class RemoteEngine:
         normalizer: str = "min_max",
         fused: bool = False,
         affinity_aware: bool = True,
+        soft: bool = False,
     ) -> engine.ScheduleResult:
         request = pb.ScheduleRequest(
             policy=policy,
@@ -87,6 +88,7 @@ class RemoteEngine:
             decisions_only=self.decisions_only,
             fused=fused,
             affinity_aware=affinity_aware,
+            soft=soft,
         )
         codec.pack_fields(snapshot, request.snapshot)
         codec.pack_fields(pods, request.pods)

@@ -83,6 +83,7 @@ class EngineService:
                     normalizer=request.normalizer or "min_max",
                     fused=request.fused,
                     affinity_aware=request.affinity_aware,
+                    soft=request.soft,
                 )
         except ValueError as e:  # unknown policy/assigner/normalizer
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))

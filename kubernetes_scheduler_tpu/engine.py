@@ -46,7 +46,10 @@ from kubernetes_scheduler_tpu.ops.assign import (
 )
 from kubernetes_scheduler_tpu.ops.constraints import (
     node_affinity_fit,
+    node_affinity_preference,
     pod_affinity_fit,
+    pod_affinity_preference,
+    prefer_no_schedule_penalty,
     taint_toleration_fit,
 )
 from kubernetes_scheduler_tpu.ops.normalize import softmax_normalize
@@ -91,6 +94,13 @@ class SnapshotArrays(NamedTuple):
     # existing-anti-affinity check), symmetric to domain_counts gating
     # the incoming pod's own anti terms.
     avoid_counts: jnp.ndarray
+    # [n, S] float32 summed WEIGHTS of running pods' PREFERRED
+    # (anti-)affinity terms using selector s in node n's domain — the
+    # symmetric half of upstream InterPodAffinity scoring: an incoming pod
+    # matching s gains pref_attract[n, s] and loses pref_avoid[n, s]
+    # (engine.compute_soft_scores).
+    pref_attract: jnp.ndarray
+    pref_avoid: jnp.ndarray
 
 
 class PodBatch(NamedTuple):
@@ -114,6 +124,18 @@ class PodBatch(NamedTuple):
     affinity_sel: jnp.ndarray      # [p, K] int32 selector ids, -1 pad
     anti_affinity_sel: jnp.ndarray  # [p, K] int32 selector ids, -1 pad
     pod_matches: jnp.ndarray       # [p, S] bool — pod's labels match selector s
+    # soft (preferred) constraints — score terms, never masks
+    # (compute_soft_scores; upstream preferredDuringScheduling semantics)
+    pna_key: jnp.ndarray           # [p, Ep] preferred node-affinity expr keys
+    pna_op: jnp.ndarray            # [p, Ep]
+    pna_vals: jnp.ndarray          # [p, Ep, V]
+    pna_val_mask: jnp.ndarray      # [p, Ep, V] bool
+    pna_mask: jnp.ndarray          # [p, Ep] bool
+    pna_weight: jnp.ndarray        # [p, Ep] float32 term weights
+    pref_affinity_sel: jnp.ndarray   # [p, K] int32 selector ids, -1 pad
+    pref_affinity_weight: jnp.ndarray  # [p, K] float32
+    pref_anti_sel: jnp.ndarray       # [p, K] int32 selector ids, -1 pad
+    pref_anti_weight: jnp.ndarray    # [p, K] float32
 
 
 def make_snapshot(
@@ -136,6 +158,8 @@ def make_snapshot(
     domain_counts=None,
     domain_id=None,
     avoid_counts=None,
+    pref_attract=None,
+    pref_avoid=None,
 ) -> SnapshotArrays:
     """SnapshotArrays with no-op defaults for everything optional (no cards,
     no taints, no labels, no selector counts)."""
@@ -189,6 +213,16 @@ def make_snapshot(
             if avoid_counts is None
             else jnp.asarray(avoid_counts, jnp.float32)
         ),
+        pref_attract=(
+            z(n, 1 if domain_counts is None else jnp.asarray(domain_counts).shape[1])
+            if pref_attract is None
+            else jnp.asarray(pref_attract, jnp.float32)
+        ),
+        pref_avoid=(
+            z(n, 1 if domain_counts is None else jnp.asarray(domain_counts).shape[1])
+            if pref_avoid is None
+            else jnp.asarray(pref_avoid, jnp.float32)
+        ),
     )
 
 
@@ -211,9 +245,19 @@ def make_pod_batch(
     affinity_sel=None,
     anti_affinity_sel=None,
     pod_matches=None,
+    pna_key=None,
+    pna_op=None,
+    pna_vals=None,
+    pna_val_mask=None,
+    pna_mask=None,
+    pna_weight=None,
+    pref_affinity_sel=None,
+    pref_affinity_weight=None,
+    pref_anti_sel=None,
+    pref_anti_weight=None,
 ) -> PodBatch:
     """PodBatch with no-op defaults (no GPU demand, no tolerations, no
-    affinity requirements)."""
+    affinity requirements, no preferences)."""
     p = request.shape[0]
     z = lambda *shape: jnp.zeros(shape, jnp.float32)  # noqa: E731
     zi = lambda *shape: jnp.zeros(shape, jnp.int32)  # noqa: E731
@@ -248,6 +292,38 @@ def make_pod_batch(
         affinity_sel=jnp.full((p, 1), -1, jnp.int32) if affinity_sel is None else jnp.asarray(affinity_sel, jnp.int32),
         anti_affinity_sel=jnp.full((p, 1), -1, jnp.int32) if anti_affinity_sel is None else jnp.asarray(anti_affinity_sel, jnp.int32),
         pod_matches=zb(p, 1) if pod_matches is None else jnp.asarray(pod_matches, bool),
+        pna_key=zi(p, 1) if pna_key is None else jnp.asarray(pna_key, jnp.int32),
+        pna_op=zi(p, 1) if pna_op is None else jnp.asarray(pna_op, jnp.int32),
+        pna_vals=zi(p, 1, 1) if pna_vals is None else jnp.asarray(pna_vals, jnp.int32),
+        pna_val_mask=(
+            (zb(p, 1, 1) if pna_vals is None
+             else jnp.ones(jnp.asarray(pna_vals).shape, bool))
+            if pna_val_mask is None else jnp.asarray(pna_val_mask, bool)
+        ),
+        pna_mask=(
+            (zb(p, 1) if pna_key is None
+             else jnp.ones(jnp.asarray(pna_key).shape, bool))
+            if pna_mask is None else jnp.asarray(pna_mask, bool)
+        ),
+        pna_weight=(
+            (z(p, 1) if pna_key is None
+             else jnp.ones(jnp.asarray(pna_key).shape, jnp.float32))
+            if pna_weight is None else jnp.asarray(pna_weight, jnp.float32)
+        ),
+        pref_affinity_sel=jnp.full((p, 1), -1, jnp.int32) if pref_affinity_sel is None else jnp.asarray(pref_affinity_sel, jnp.int32),
+        pref_affinity_weight=(
+            (z(p, 1) if pref_affinity_sel is None
+             else jnp.ones(jnp.asarray(pref_affinity_sel).shape, jnp.float32))
+            if pref_affinity_weight is None
+            else jnp.asarray(pref_affinity_weight, jnp.float32)
+        ),
+        pref_anti_sel=jnp.full((p, 1), -1, jnp.int32) if pref_anti_sel is None else jnp.asarray(pref_anti_sel, jnp.int32),
+        pref_anti_weight=(
+            (z(p, 1) if pref_anti_sel is None
+             else jnp.ones(jnp.asarray(pref_anti_sel).shape, jnp.float32))
+            if pref_anti_weight is None
+            else jnp.asarray(pref_anti_weight, jnp.float32)
+        ),
     )
 
 
@@ -367,6 +443,48 @@ def make_affinity_state(snapshot: SnapshotArrays, pods: PodBatch) -> AffinitySta
     )
 
 
+def compute_soft_scores(
+    snapshot: SnapshotArrays,
+    pods: PodBatch,
+    *,
+    taint_penalty_weight: float = 1.0,
+) -> jnp.ndarray:
+    """[p, n] float32 soft-constraint score term: upstream's preferred
+    (scoring, never filtering) constraint families —
+
+    - preferred node affinity: +weight per satisfied preferred expression
+      (NodeAffinity scoring)
+    - preferred inter-pod (anti)affinity: ±weight per preferred selector
+      with a topology-domain match (InterPodAffinity scoring)
+    - PreferNoSchedule taints: −taint_penalty_weight per untolerated soft
+      taint (TaintToleration scoring)
+
+    Added onto the normalized policy score when schedule_batch runs with
+    soft=True; weights are interpreted relative to the active score range
+    (min_max → [0, 100]), mirroring upstream's weighted score summation.
+    """
+    na = node_affinity_preference(
+        snapshot.node_labels, snapshot.node_label_mask,
+        pods.pna_key, pods.pna_op, pods.pna_vals, pods.pna_val_mask,
+        pods.pna_mask, pods.pna_weight,
+    )
+    pa = pod_affinity_preference(
+        snapshot.domain_counts,
+        pods.pref_affinity_sel, pods.pref_affinity_weight,
+        pods.pref_anti_sel, pods.pref_anti_weight,
+    )
+    pen = prefer_no_schedule_penalty(
+        snapshot.taints, snapshot.taint_mask, pods.tolerations, pods.tol_mask
+    )
+    # symmetric half: EXISTING pods' preferred terms scored against the
+    # incoming pod's labels (upstream InterPodAffinity's existing-term
+    # scoring) — the incoming pod gains/loses the summed weights of
+    # attracting/avoiding preferred terms whose selector it matches
+    matches = match_matrix(pods, snapshot.pref_attract.shape[1]).astype(jnp.float32)
+    sym = matches @ (snapshot.pref_attract - snapshot.pref_avoid).T  # [p, n]
+    return na + pa + sym - taint_penalty_weight * pen
+
+
 def compute_free_capacity(snapshot: SnapshotArrays) -> jnp.ndarray:
     """[n, r] free capacity for assignment; padded nodes get 0."""
     return jnp.where(
@@ -412,7 +530,9 @@ def _fused_masked_scores(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("policy", "assigner", "normalizer", "fused", "affinity_aware"),
+    static_argnames=(
+        "policy", "assigner", "normalizer", "fused", "affinity_aware", "soft"
+    ),
 )
 def schedule_batch(
     snapshot: SnapshotArrays,
@@ -423,6 +543,7 @@ def schedule_batch(
     normalizer: str = "min_max",
     fused: bool = False,
     affinity_aware: bool = True,
+    soft: bool = False,
 ) -> ScheduleResult:
     """One scheduling cycle for the whole pending window, on device.
 
@@ -481,6 +602,11 @@ def schedule_batch(
         else:
             raise ValueError(f"unknown normalizer {normalizer!r}")
 
+    if soft:
+        # preferred constraints are score terms layered on the normalized
+        # policy score (upstream: weighted sum of scoring plugins). On the
+        # fused path NEG-masked cells stay ~NEG (weights << 1e30)
+        norm = norm + compute_soft_scores(snapshot, pods)
     free = compute_free_capacity(snapshot)
     affinity = make_affinity_state(snapshot, pods) if affinity_aware else None
     if assigner == "greedy":
@@ -526,7 +652,9 @@ def stack_windows(pods: PodBatch, window: int) -> PodBatch:
 
 @functools.partial(
     jax.jit,
-    static_argnames=("policy", "assigner", "normalizer", "fused", "affinity_aware"),
+    static_argnames=(
+        "policy", "assigner", "normalizer", "fused", "affinity_aware", "soft"
+    ),
 )
 def schedule_windows(
     snapshot: SnapshotArrays,
@@ -537,6 +665,7 @@ def schedule_windows(
     normalizer: str = "none",
     fused: bool = False,
     affinity_aware: bool = True,
+    soft: bool = False,
 ) -> WindowsResult:
     """Schedule many windows in ONE device program: lax.scan over the
     window axis, carrying node capacity AND (anti)affinity domain counts
@@ -568,7 +697,7 @@ def schedule_windows(
         )
         res = schedule_batch(
             snap, w, policy=policy, assigner=assigner, normalizer=normalizer,
-            fused=fused, affinity_aware=affinity_aware,
+            fused=fused, affinity_aware=affinity_aware, soft=soft,
         )
         # fold this window's placements into the domain match AND avoider
         # counts so the next window's (anti)affinity sees them (the

@@ -152,7 +152,10 @@ class Scheduler:
         if any(nd.taints or nd.cards for nd in nodes):
             return False
         for pod in window:
-            if pod.tolerations or pod.node_affinity or pod.pod_affinity:
+            if (
+                pod.tolerations or pod.node_affinity or pod.pod_affinity
+                or pod.preferred_node_affinity
+            ):
                 return False
             if any(k.startswith("scv/") and k != "scv/priority" for k in pod.labels):
                 return False
@@ -177,6 +180,19 @@ class Scheduler:
         # constrains on one; otherwise static pre-window counts are exact
         # and ~2x cheaper.
         assigner = self.config.assigner
+        # preferred (soft) constraints become score terms only when present
+        # (window preferences, running pods' preferred terms, soft taints)
+        soft = (
+            any(
+                pd.preferred_node_affinity
+                or any(t.preferred for t in pd.pod_affinity)
+                for pd in window
+            )
+            or any(t.preferred for pd in running for t in pd.pod_affinity)
+            or any(
+                t.effect == "PreferNoSchedule" for nd in nodes for t in nd.taints
+            )
+        )
         affinity_aware = bool(
             np.asarray(pods_batch.pod_matches).any()
             and (
@@ -200,6 +216,7 @@ class Scheduler:
             normalizer=self.config.normalizer,
             fused=fused,
             affinity_aware=affinity_aware,
+            soft=soft,
         )
         idx = np.asarray(res.node_idx)
         m.engine_seconds = time.perf_counter() - t0

@@ -189,7 +189,8 @@ class SnapshotBuilder:
                 labels[i, j] = (self.label_keys.id(k), self.label_values.id(v))
                 label_mask[i, j] = True
 
-        domain_counts, domain_id, avoid_counts = self._domain_counts(
+        (domain_counts, domain_id, avoid_counts,
+         pref_attract, pref_avoid) = self._domain_counts(
             nodes, running_pods, pending_pods or [], n
         )
 
@@ -201,6 +202,7 @@ class SnapshotBuilder:
             taint_mask=taint_mask, node_labels=labels,
             node_label_mask=label_mask, domain_counts=domain_counts,
             domain_id=domain_id, avoid_counts=avoid_counts,
+            pref_attract=pref_attract, pref_avoid=pref_avoid,
         )
 
     def _selector_id(self, term) -> int:
@@ -214,7 +216,7 @@ class SnapshotBuilder:
 
     def _domain_counts(
         self, nodes: list[Node], running: list[Pod], pending: list[Pod], n: int
-    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
         """For every distinct (selector, topology_key) used by the pending
         window: count running pods matching the selector, aggregated over
         each node's topology domain (exact for matchLabels selectors —
@@ -232,24 +234,30 @@ class SnapshotBuilder:
         for pod in pending:
             for term in pod.pod_affinity:
                 self._selector_id(term)
-        # running pods' anti terms also define selectors (reverse direction)
+        # running pods' terms also define selectors: REQUIRED anti terms
+        # gate the reverse hard direction; PREFERRED terms feed the
+        # symmetric soft scoring (pref_attract/pref_avoid)
         for pod in running:
             for term in pod.pod_affinity:
-                if term.anti:
+                if term.preferred or term.anti:
                     self._selector_id(term)
         s = self._selector_slots()
         counts = np.zeros((n, s), np.float32)
         avoid = np.zeros((n, s), np.float32)
+        attract_w = np.zeros((n, s), np.float32)
+        avoid_w = np.zeros((n, s), np.float32)
         # default: every node is its own (hostname) domain
         domain_id = np.tile(
             np.arange(n, dtype=np.int32)[:, None], (1, s)
         )
         if not self.selectors:
-            return counts, domain_id, avoid
+            return counts, domain_id, avoid, attract_w, avoid_w
         node_index = {nd.name: i for i, nd in enumerate(nodes)}
         # per-node raw counts
         raw = np.zeros((len(nodes), s), np.float32)
         raw_avoid = np.zeros((len(nodes), s), np.float32)
+        raw_attract_w = np.zeros((len(nodes), s), np.float32)
+        raw_avoid_w = np.zeros((len(nodes), s), np.float32)
         for pod in running:
             i = node_index.get(pod.node_name)
             if i is None:
@@ -258,24 +266,28 @@ class SnapshotBuilder:
                 if all(pod.labels.get(k) == v for k, v in items):
                     raw[i, sid] += 1
             for term in pod.pod_affinity:
-                if term.anti:
-                    raw_avoid[i, self._selector_id(term)] += 1
+                sid = self._selector_id(term)
+                if term.preferred:
+                    (raw_avoid_w if term.anti else raw_attract_w)[i, sid] += term.weight
+                elif term.anti:
+                    raw_avoid[i, sid] += 1
         # aggregate over topology domains
         for (_items, topo), sid in self.selectors.items():
-            domains: dict[str, float] = {}
-            domains_a: dict[str, float] = {}
+            sums: dict[str, list[float]] = {}
             first: dict[str, int] = {}
             for i, nd in enumerate(nodes):
                 d = nd.name if topo == "kubernetes.io/hostname" else nd.labels.get(topo, "")
-                domains[d] = domains.get(d, 0.0) + raw[i, sid]
-                domains_a[d] = domains_a.get(d, 0.0) + raw_avoid[i, sid]
+                acc = sums.setdefault(d, [0.0, 0.0, 0.0, 0.0])
+                acc[0] += raw[i, sid]
+                acc[1] += raw_avoid[i, sid]
+                acc[2] += raw_attract_w[i, sid]
+                acc[3] += raw_avoid_w[i, sid]
                 first.setdefault(d, i)
             for i, nd in enumerate(nodes):
                 d = nd.name if topo == "kubernetes.io/hostname" else nd.labels.get(topo, "")
-                counts[i, sid] = domains[d]
-                avoid[i, sid] = domains_a[d]
+                counts[i, sid], avoid[i, sid], attract_w[i, sid], avoid_w[i, sid] = sums[d]
                 domain_id[i, sid] = first[d]
-        return counts, domain_id, avoid
+        return counts, domain_id, avoid, attract_w, avoid_w
 
     # ---- pod side ------------------------------------------------------
 
@@ -312,6 +324,27 @@ class SnapshotBuilder:
         )
         aff = np.full((p, k_max), -1, np.int32)
         anti = np.full((p, k_max), -1, np.int32)
+        pref_aff = np.full((p, k_max), -1, np.int32)
+        pref_aff_w = np.zeros((p, k_max), np.float32)
+        pref_anti = np.full((p, k_max), -1, np.int32)
+        pref_anti_w = np.zeros((p, k_max), np.float32)
+        ep_max = bucket_size(
+            max((len(pd.preferred_node_affinity) for pd in pods), default=0),
+            floor=1, multiple=1,
+        )
+        pv_max = bucket_size(
+            max(
+                (len(w.expr.values) for pd in pods for w in pd.preferred_node_affinity),
+                default=0,
+            ),
+            floor=1, multiple=1,
+        )
+        pna_key = np.zeros((p, ep_max), np.int32)
+        pna_op = np.zeros((p, ep_max), np.int32)
+        pna_vals = np.zeros((p, ep_max, pv_max), np.int32)
+        pna_val_mask = np.zeros((p, ep_max, pv_max), bool)
+        pna_mask = np.zeros((p, ep_max), bool)
+        pna_weight = np.zeros((p, ep_max), np.float32)
 
         for i, pod in enumerate(pods):
             for j, res in enumerate(names):
@@ -353,7 +386,20 @@ class SnapshotBuilder:
                     na_val_mask[i, j, q] = True
             for j, term in enumerate(pod.pod_affinity):
                 sid = self._selector_id(term)
-                (anti if term.anti else aff)[i, j] = sid
+                if term.preferred:
+                    (pref_anti if term.anti else pref_aff)[i, j] = sid
+                    (pref_anti_w if term.anti else pref_aff_w)[i, j] = term.weight
+                else:
+                    (anti if term.anti else aff)[i, j] = sid
+            for j, wexpr in enumerate(pod.preferred_node_affinity):
+                e = wexpr.expr
+                pna_key[i, j] = self.label_keys.id(e.key)
+                pna_op[i, j] = _NA_OPS[e.operator]
+                pna_mask[i, j] = True
+                pna_weight[i, j] = wexpr.weight
+                for q, v in enumerate(e.values):
+                    pna_vals[i, j, q] = self.label_values.id(v)
+                    pna_val_mask[i, j, q] = True
 
         # pod_matches: does pending pod p's label set satisfy selector s —
         # the engine needs this to update in-window domain counts when the
@@ -372,4 +418,9 @@ class SnapshotBuilder:
             na_key=na_key, na_op=na_op, na_vals=na_vals,
             na_val_mask=na_val_mask, na_mask=na_mask, affinity_sel=aff,
             anti_affinity_sel=anti, pod_matches=pod_matches,
+            pna_key=pna_key, pna_op=pna_op, pna_vals=pna_vals,
+            pna_val_mask=pna_val_mask, pna_mask=pna_mask,
+            pna_weight=pna_weight, pref_affinity_sel=pref_aff,
+            pref_affinity_weight=pref_aff_w, pref_anti_sel=pref_anti,
+            pref_anti_weight=pref_anti_w,
         )

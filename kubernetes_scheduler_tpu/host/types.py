@@ -62,6 +62,20 @@ class PodAffinityTerm:
     match_labels: dict[str, str]
     topology_key: str = "kubernetes.io/hostname"
     anti: bool = False
+    # preferredDuringSchedulingIgnoredDuringExecution: a score term with
+    # this weight instead of a hard filter (engine.compute_soft_scores)
+    preferred: bool = False
+    weight: int = 1
+
+
+@dataclass
+class WeightedExpression:
+    """One preferred node-affinity term: a weighted matchExpression
+    (preferredDuringScheduling...; the upstream term's expression list is
+    modeled as one expression per weighted term)."""
+
+    expr: MatchExpression
+    weight: int = 1
 
 
 @dataclass
@@ -76,6 +90,7 @@ class Pod:
     tolerations: list[Toleration] = field(default_factory=list)
     node_affinity: list[MatchExpression] = field(default_factory=list)
     pod_affinity: list[PodAffinityTerm] = field(default_factory=list)
+    preferred_node_affinity: list[WeightedExpression] = field(default_factory=list)
     node_name: str | None = None  # set once bound
     scheduler_name: str = "yoda-tpu"
 

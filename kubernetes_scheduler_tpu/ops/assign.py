@@ -432,20 +432,16 @@ def auction_assign(
     def round_body(state):
         assigned, free, price, added, added_avoid, _, _round = state
         active = pod_mask & (assigned < 0)
+        cap_ok = (
+            (pod_request[:, None, :] <= free[None, :, :])
+            | (pod_request[:, None, :] == 0)
+        ).all(-1)
         if affinity is None:
-            cap_ok = (
-                (pod_request[:, None, :] <= free[None, :, :])
-                | (pod_request[:, None, :] == 0)
-            ).all(-1)
             mask = (sj > NEG * 0.5) & cap_ok & active[:, None]
             row = jnp.where(mask, sj - price[None, :], NEG)
             bid = jnp.argmax(row, axis=1).astype(jnp.int32)
             has_bid = mask.any(axis=1)
         else:
-            cap_ok = (
-                (pod_request[:, None, :] <= free[None, :, :])
-                | (pod_request[:, None, :] == 0)
-            ).all(-1)
             mask = feasible & cap_ok & active[:, None]
             mask = mask & _affinity_round_mask(affinity, added, added_avoid)
             row = jnp.where(mask, scores + jitter - price[None, :], NEG)

@@ -50,21 +50,17 @@ OP_EXISTS = 2
 OP_NOT_EXISTS = 3
 
 
-def taint_toleration_fit(
+def _taints_tolerated(
     taints: jnp.ndarray,
-    taint_mask: jnp.ndarray,
     tolerations: jnp.ndarray,
     tol_mask: jnp.ndarray,
 ) -> jnp.ndarray:
-    """F[p, n]: no untolerated NoSchedule/NoExecute taint.
-
-    A toleration matches a taint iff
+    """[p, n, T] bool: taint t of node n is tolerated by some toleration of
+    pod p — upstream v1.Toleration.ToleratesTaint semantics:
       (tol.key == -1 and tol.op == Exists) or
       (tol.key == taint.key and
        (tol.op == Exists or tol.value == taint.value))
-    and (tol.effect == 0 or tol.effect == taint.effect)
-    — upstream v1.Toleration.ToleratesTaint semantics.
-    PreferNoSchedule taints never filter (scoring concern only).
+    and (tol.effect == 0 or tol.effect == taint.effect).
     """
     t_key = taints[..., 0][None, :, :, None]    # [1, n, T, 1]
     t_val = taints[..., 1][None, :, :, None]
@@ -80,12 +76,38 @@ def taint_toleration_fit(
     )
     eff_ok = (o_eff == 0) | (o_eff == t_eff)
     matches = key_ok & eff_ok & tol_mask[:, None, None, :]  # [p, n, T, L]
-    tolerated = matches.any(-1)                              # [p, n, T]
+    return matches.any(-1)                                   # [p, n, T]
 
+
+def taint_toleration_fit(
+    taints: jnp.ndarray,
+    taint_mask: jnp.ndarray,
+    tolerations: jnp.ndarray,
+    tol_mask: jnp.ndarray,
+) -> jnp.ndarray:
+    """F[p, n]: no untolerated NoSchedule/NoExecute taint.
+    PreferNoSchedule taints never filter (scoring concern only — see
+    prefer_no_schedule_penalty)."""
+    tolerated = _taints_tolerated(taints, tolerations, tol_mask)
     hard = taint_mask[None, :, :] & (
         (taints[..., 2] == NO_SCHEDULE) | (taints[..., 2] == NO_EXECUTE)
     )[None, :, :]
     return ~(hard & ~tolerated).any(-1)
+
+
+def prefer_no_schedule_penalty(
+    taints: jnp.ndarray,
+    taint_mask: jnp.ndarray,
+    tolerations: jnp.ndarray,
+    tol_mask: jnp.ndarray,
+) -> jnp.ndarray:
+    """[p, n] float32: count of untolerated PreferNoSchedule taints —
+    upstream TaintToleration's scoring input (its score prefers nodes with
+    fewer intolerable soft taints). Callers subtract a weighted multiple
+    from the score matrix."""
+    tolerated = _taints_tolerated(taints, tolerations, tol_mask)
+    soft = taint_mask[None, :, :] & (taints[..., 2] == PREFER_NO_SCHEDULE)[None, :, :]
+    return (soft & ~tolerated).sum(-1).astype(jnp.float32)
 
 
 def node_affinity_fit(
@@ -108,6 +130,23 @@ def node_affinity_fit(
     label absent OR value not in set; Exists — label present;
     DoesNotExist — label absent.
     """
+    ok = _expressions_satisfied(
+        node_labels, node_label_mask, expr_key, expr_op, expr_vals, expr_val_mask
+    )
+    ok = ok | ~expr_mask[:, :, None]
+    return ok.all(1)  # [p, n]
+
+
+def _expressions_satisfied(
+    node_labels: jnp.ndarray,
+    node_label_mask: jnp.ndarray,
+    expr_key: jnp.ndarray,
+    expr_op: jnp.ndarray,
+    expr_vals: jnp.ndarray,
+    expr_val_mask: jnp.ndarray,
+) -> jnp.ndarray:
+    """[p, E, n] bool: node satisfies each matchExpression (no padding
+    handling — callers apply their expr masks)."""
     n_key = node_labels[..., 0]  # [n, Ln]
     n_val = node_labels[..., 1]
 
@@ -124,7 +163,7 @@ def node_affinity_fit(
     key_val_match = (key_eq[..., None] & val_in_set).any((-1, -2))  # [p, E, n]
 
     op = expr_op[:, :, None]
-    ok = jnp.where(
+    return jnp.where(
         op == OP_IN,
         key_val_match,
         jnp.where(
@@ -133,8 +172,55 @@ def node_affinity_fit(
             jnp.where(op == OP_EXISTS, has_key, ~has_key),
         ),
     )  # [p, E, n]
-    ok = ok | ~expr_mask[:, :, None]
-    return ok.all(1)  # [p, n]
+
+
+def node_affinity_preference(
+    node_labels: jnp.ndarray,
+    node_label_mask: jnp.ndarray,
+    expr_key: jnp.ndarray,
+    expr_op: jnp.ndarray,
+    expr_vals: jnp.ndarray,
+    expr_val_mask: jnp.ndarray,
+    expr_mask: jnp.ndarray,
+    expr_weight: jnp.ndarray,
+) -> jnp.ndarray:
+    """[p, n] float32: sum of weights of satisfied PREFERRED node-affinity
+    expressions (preferredDuringSchedulingIgnoredDuringExecution — upstream
+    NodeAffinity scoring; one weighted expression per term, the common
+    single-expression case of the upstream weighted-term list).
+    """
+    ok = _expressions_satisfied(
+        node_labels, node_label_mask, expr_key, expr_op, expr_vals, expr_val_mask
+    )
+    w = jnp.where(expr_mask, expr_weight.astype(jnp.float32), 0.0)  # [p, E]
+    return (ok * w[:, :, None]).sum(1)  # [p, n]
+
+
+def pod_affinity_preference(
+    domain_counts: jnp.ndarray,
+    pref_affinity_sel: jnp.ndarray,
+    pref_affinity_weight: jnp.ndarray,
+    pref_anti_sel: jnp.ndarray,
+    pref_anti_weight: jnp.ndarray,
+) -> jnp.ndarray:
+    """[p, n] float32: weighted preferred inter-pod (anti)affinity —
+    upstream InterPodAffinity scoring: +weight for each preferred selector
+    with a match in the node's topology domain, −weight for each preferred
+    anti selector with a match. Selector ids are -1 padded; out-of-range
+    ids contribute nothing (unlike the hard path, a stale preference must
+    not make a pod unschedulable)."""
+    s = domain_counts.shape[1]
+
+    def term(sel, weight, sign):
+        ok = (sel >= 0) & (sel < s)                            # [p, K]
+        idx = jnp.clip(sel, 0, max(s - 1, 0))
+        present = domain_counts[:, idx] > 0                    # [n, p, K]
+        w = jnp.where(ok, weight.astype(jnp.float32), 0.0)     # [p, K]
+        return sign * (present * w[None, :, :]).sum(-1).T      # [p, n]
+
+    return term(pref_affinity_sel, pref_affinity_weight, 1.0) + term(
+        pref_anti_sel, pref_anti_weight, -1.0
+    )
 
 
 def pod_affinity_fit(

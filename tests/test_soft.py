@@ -1,0 +1,205 @@
+"""Soft (preferred) constraints: score terms, never masks.
+
+Covers the three upstream preferred families (NodeAffinity preferred
+terms, InterPodAffinity preferred terms, TaintToleration's
+PreferNoSchedule scoring) at the kernel, engine, and host-loop levels.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from kubernetes_scheduler_tpu.engine import (
+    make_pod_batch,
+    make_snapshot,
+    schedule_batch,
+)
+from kubernetes_scheduler_tpu.ops.constraints import (
+    NO_SCHEDULE,
+    OP_IN,
+    PREFER_NO_SCHEDULE,
+    TOL_EQUAL,
+    node_affinity_preference,
+    pod_affinity_preference,
+    prefer_no_schedule_penalty,
+)
+
+
+def test_prefer_no_schedule_penalty_counts():
+    # node 0: one PreferNoSchedule taint; node 1: one NoSchedule (hard,
+    # not counted); node 2: two PreferNoSchedule
+    taints = np.zeros((3, 2, 3), np.int32)
+    mask = np.zeros((3, 2), bool)
+    taints[0, 0] = (5, 1, PREFER_NO_SCHEDULE); mask[0, 0] = True
+    taints[1, 0] = (5, 1, NO_SCHEDULE); mask[1, 0] = True
+    taints[2, 0] = (5, 1, PREFER_NO_SCHEDULE); mask[2, 0] = True
+    taints[2, 1] = (6, 0, PREFER_NO_SCHEDULE); mask[2, 1] = True
+    # pod 0: no tolerations; pod 1 tolerates key 5 value 1
+    tols = np.zeros((2, 1, 4), np.int32)
+    tol_mask = np.zeros((2, 1), bool)
+    tols[1, 0] = (5, 1, TOL_EQUAL, 0); tol_mask[1, 0] = True
+    pen = np.asarray(prefer_no_schedule_penalty(
+        jnp.asarray(taints), jnp.asarray(mask),
+        jnp.asarray(tols), jnp.asarray(tol_mask),
+    ))
+    np.testing.assert_array_equal(pen, [[1, 0, 2], [0, 0, 1]])
+
+
+def test_node_affinity_preference_weights():
+    # nodes: 0 has (k=3, v=7); 1 has (k=3, v=8); 2 has nothing
+    labels = np.zeros((3, 1, 2), np.int32)
+    lmask = np.zeros((3, 1), bool)
+    labels[0, 0] = (3, 7); lmask[0, 0] = True
+    labels[1, 0] = (3, 8); lmask[1, 0] = True
+    # pod prefers k=3 in {7} with weight 10
+    key = np.full((1, 1), 3, np.int32)
+    op = np.full((1, 1), OP_IN, np.int32)
+    vals = np.full((1, 1, 1), 7, np.int32)
+    got = np.asarray(node_affinity_preference(
+        jnp.asarray(labels), jnp.asarray(lmask),
+        jnp.asarray(key), jnp.asarray(op), jnp.asarray(vals),
+        jnp.ones((1, 1, 1), bool), jnp.ones((1, 1), bool),
+        jnp.full((1, 1), 10.0),
+    ))
+    np.testing.assert_array_equal(got, [[10.0, 0.0, 0.0]])
+
+
+def test_pod_affinity_preference_signs():
+    counts = jnp.asarray([[2.0, 0.0], [0.0, 1.0]])  # [n=2, S=2]
+    got = np.asarray(pod_affinity_preference(
+        counts,
+        jnp.asarray([[0]]), jnp.asarray([[5.0]]),      # prefer near sel 0, w=5
+        jnp.asarray([[1]]), jnp.asarray([[3.0]]),      # prefer away from sel 1, w=3
+    ))
+    # node 0: sel0 present (+5), sel1 absent (0) => 5; node 1: -3
+    np.testing.assert_array_equal(got, [[5.0, -3.0]])
+    # out-of-range / padded ids contribute nothing (never unschedulable)
+    got2 = np.asarray(pod_affinity_preference(
+        counts, jnp.asarray([[7]]), jnp.asarray([[5.0]]),
+        jnp.asarray([[-1]]), jnp.asarray([[3.0]]),
+    ))
+    np.testing.assert_array_equal(got2, [[0.0, 0.0]])
+
+
+def _uniform_snapshot(n, labels=None, lmask=None, taints=None, tmask=None):
+    return make_snapshot(
+        allocatable=np.full((n, 3), 100.0, np.float32),
+        requested=np.zeros((n, 3), np.float32),
+        disk_io=np.full(n, 10.0), cpu_pct=np.full(n, 20.0),
+        mem_pct=np.zeros(n),
+        node_labels=labels, node_label_mask=lmask,
+        taints=taints, taint_mask=tmask,
+    )
+
+
+def test_engine_soft_breaks_tie_toward_preferred_node():
+    n = 4
+    labels = np.zeros((n, 1, 2), np.int32)
+    lmask = np.zeros((n, 1), bool)
+    labels[2, 0] = (9, 4); lmask[2, 0] = True  # only node 2 has the label
+    snap = _uniform_snapshot(n, labels=labels, lmask=lmask)
+    pods = make_pod_batch(
+        request=np.full((1, 3), 1.0, np.float32),
+        pna_key=np.full((1, 1), 9, np.int32),
+        pna_op=np.full((1, 1), OP_IN, np.int32),
+        pna_vals=np.full((1, 1, 1), 4, np.int32),
+        pna_weight=np.full((1, 1), 5.0, np.float32),
+    )
+    off = schedule_batch(snap, pods, soft=False)
+    on = schedule_batch(snap, pods, soft=True)
+    assert int(off.node_idx[0]) == 0  # uniform scores: first argmax
+    assert int(on.node_idx[0]) == 2   # preference breaks the tie
+
+
+def test_engine_soft_avoids_prefer_no_schedule_taint():
+    n = 3
+    taints = np.zeros((n, 1, 3), np.int32)
+    tmask = np.zeros((n, 1), bool)
+    taints[0, 0] = (1, 1, PREFER_NO_SCHEDULE); tmask[0, 0] = True
+    snap = _uniform_snapshot(n, taints=taints, tmask=tmask)
+    pods = make_pod_batch(request=np.full((1, 3), 1.0, np.float32))
+    on = schedule_batch(snap, pods, soft=True)
+    assert int(on.node_idx[0]) != 0  # steered off the soft-tainted node
+    off = schedule_batch(snap, pods, soft=False)
+    assert int(off.node_idx[0]) == 0  # hard path ignores PreferNoSchedule
+
+
+def test_host_loop_preferred_terms_end_to_end():
+    from kubernetes_scheduler_tpu.host.advisor import NodeUtil
+    from kubernetes_scheduler_tpu.host.scheduler import Scheduler
+    from kubernetes_scheduler_tpu.host.types import (
+        Container, MatchExpression, Node, Pod, PodAffinityTerm,
+        WeightedExpression,
+    )
+    from kubernetes_scheduler_tpu.utils.config import SchedulerConfig
+
+    nodes = [
+        Node(name=f"n{i}", allocatable={"cpu": 8000.0, "memory": 32 * 2**30,
+                                        "pods": 110},
+             labels={"disk": "ssd"} if i == 2 else {})
+        for i in range(4)
+    ]
+    running = [Pod(name="db", labels={"app": "db"}, node_name="n3")]
+
+    class A:
+        def fetch(self):
+            return {nd.name: NodeUtil(cpu_pct=10.0, disk_io=5.0) for nd in nodes}
+
+    cfg = SchedulerConfig(min_device_work=0)
+    cfg.feature_gates.native_host = False
+    sched = Scheduler(cfg, advisor=A(), list_nodes=lambda: nodes,
+                      list_running_pods=lambda: running)
+    # prefers ssd nodes AND proximity to the db pod; ssd weight dominates
+    sched.submit(Pod(
+        name="web",
+        containers=[Container(requests={"cpu": 100.0})],
+        preferred_node_affinity=[
+            WeightedExpression(MatchExpression("disk", "In", ["ssd"]), weight=50)
+        ],
+        pod_affinity=[PodAffinityTerm(match_labels={"app": "db"},
+                                      preferred=True, weight=10)],
+    ))
+    m = sched.run_cycle()
+    assert m.pods_bound == 1 and not m.used_fallback
+    assert sched.binder.bindings[0].node_name == "n2"
+
+
+def test_running_pods_preferred_terms_score_symmetrically():
+    """Upstream InterPodAffinity also scores EXISTING pods' preferred terms
+    against the incoming pod: a running pod with a preferred anti term
+    pushes matching incomers away; a preferred affinity term pulls them."""
+    from kubernetes_scheduler_tpu.host.advisor import NodeUtil
+    from kubernetes_scheduler_tpu.host.scheduler import Scheduler
+    from kubernetes_scheduler_tpu.host.types import (
+        Container, Node, Pod, PodAffinityTerm,
+    )
+    from kubernetes_scheduler_tpu.utils.config import SchedulerConfig
+
+    nodes = [
+        Node(name=f"n{i}", allocatable={"cpu": 8000.0, "memory": 32 * 2**30,
+                                        "pods": 110})
+        for i in range(3)
+    ]
+    running = [
+        # latency-sensitive pod on n0 prefers web pods keep away
+        Pod(name="solo", node_name="n0",
+            pod_affinity=[PodAffinityTerm(match_labels={"app": "web"},
+                                          anti=True, preferred=True, weight=40)]),
+        # cache pod on n2 prefers web pods nearby
+        Pod(name="cache", node_name="n2",
+            pod_affinity=[PodAffinityTerm(match_labels={"app": "web"},
+                                          preferred=True, weight=20)]),
+    ]
+
+    class A:
+        def fetch(self):
+            return {nd.name: NodeUtil(cpu_pct=10.0, disk_io=5.0) for nd in nodes}
+
+    cfg = SchedulerConfig(min_device_work=0)
+    cfg.feature_gates.native_host = False
+    s = Scheduler(cfg, advisor=A(), list_nodes=lambda: nodes,
+                  list_running_pods=lambda: running)
+    s.submit(Pod(name="w", labels={"app": "web"},
+                 containers=[Container(requests={"cpu": 100.0})]))
+    m = s.run_cycle()
+    assert m.pods_bound == 1 and not m.used_fallback
+    assert s.binder.bindings[0].node_name == "n2"  # pulled to cache, pushed off solo

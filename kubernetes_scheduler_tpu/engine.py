@@ -593,15 +593,43 @@ def schedule_batch(
         feasible = compute_feasibility(
             snapshot, pods, include_pod_affinity=not affinity_aware
         )
-        if normalizer == "min_max":
-            norm = min_max_normalize(raw, snapshot.node_mask)
-        elif normalizer == "softmax":
-            norm = softmax_normalize(raw, snapshot.node_mask)
-        elif normalizer == "none":
-            norm = raw
-        else:
-            raise ValueError(f"unknown normalizer {normalizer!r}")
+        norm = normalize_scores(raw, snapshot.node_mask, normalizer)
 
+    return finish_cycle(
+        snapshot, pods, raw, norm, feasible,
+        assigner=assigner, affinity_aware=affinity_aware, soft=soft,
+    )
+
+
+def normalize_scores(
+    raw: jnp.ndarray, node_mask: jnp.ndarray, normalizer: str
+) -> jnp.ndarray:
+    """Dispatch over NORMALIZERS; shared by schedule_batch and the learned
+    engine so normalizer semantics cannot diverge."""
+    if normalizer == "min_max":
+        return min_max_normalize(raw, node_mask)
+    if normalizer == "softmax":
+        return softmax_normalize(raw, node_mask)
+    if normalizer == "none":
+        return raw
+    raise ValueError(f"unknown normalizer {normalizer!r}")
+
+
+def finish_cycle(
+    snapshot: SnapshotArrays,
+    pods: PodBatch,
+    raw: jnp.ndarray,
+    norm: jnp.ndarray,
+    feasible: jnp.ndarray,
+    *,
+    assigner: str = "greedy",
+    affinity_aware: bool = True,
+    soft: bool = False,
+) -> ScheduleResult:
+    """Shared cycle tail: soft score terms → assignment → result. Any
+    scorer composes with the full constraint/assignment machinery through
+    this — schedule_batch's policies and the learned two-tower scorer
+    (models/learned.LearnedEngine) both land here."""
     if soft:
         # preferred constraints are score terms layered on the normalized
         # policy score (upstream: weighted sum of scoring plugins). On the

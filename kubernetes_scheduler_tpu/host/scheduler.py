@@ -78,6 +78,33 @@ class Scheduler:
     ):
         self.config = config
         self.advisor = advisor
+        if config.policy == "learned":
+            from kubernetes_scheduler_tpu.models.learned import (
+                LearnedEngine,
+                init_train_state,
+                load_learned_engine,
+            )
+
+            if engine is not None and not isinstance(engine, LearnedEngine):
+                # a remote/in-process heuristic engine cannot evaluate the
+                # learned policy (no parameters); failing loud beats every
+                # cycle erroring into the scalar yoda fallback forever
+                raise ValueError(
+                    "policy='learned' requires a LearnedEngine; got "
+                    f"{type(engine).__name__} (remote sidecars do not serve "
+                    "the learned policy)"
+                )
+            if engine is None and config.learned_checkpoint:
+                engine = load_learned_engine(config.learned_checkpoint)
+            elif engine is None:
+                import jax as _jax
+
+                log.warning(
+                    "policy='learned' with no learned_checkpoint: scheduling "
+                    "with freshly initialized (UNTRAINED) scorer parameters"
+                )
+                state, model, _ = init_train_state(_jax.random.key(0))
+                engine = LearnedEngine(state.params, model=model)
         self.engine = engine or LocalEngine()
         self.binder = binder or RecordingBinder()
         self.list_nodes = list_nodes
@@ -124,11 +151,13 @@ class Scheduler:
 
         # adaptive dispatch: tiny cycles are device-latency-bound; the
         # scalar host path (C++ when native) wins below min_device_work.
-        # Only when the scalar path's capability surface suffices — it
-        # implements the live yoda formula + resource fit, not the
-        # taint/affinity/GPU constraint families.
-        use_device = len(window) * len(nodes) >= self.config.min_device_work or (
-            not self._scalar_sufficient(window, nodes)
+        # Only when the scalar path's decisions match — it implements the
+        # live yoda formula + resource fit, so any other policy or any
+        # taint/affinity/GPU constraint family stays on the engine.
+        use_device = (
+            self.config.policy != "balanced_cpu_diskio"
+            or len(window) * len(nodes) >= self.config.min_device_work
+            or not self._scalar_sufficient(window, nodes)
         )
         if self.config.feature_gates.tpu_batch_score and nodes and use_device:
             try:

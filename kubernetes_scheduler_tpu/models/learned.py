@@ -206,3 +206,80 @@ def restore_checkpoint(path: str, like: TrainState) -> TrainState:
             os.path.join(os.path.abspath(path), "state"), target=like
         )
     return TrainState(*restored) if not isinstance(restored, TrainState) else restored
+
+
+class LearnedEngine:
+    """LocalEngine-compatible engine scoring with the two-tower model.
+
+    Drop-in for host.Scheduler's `engine` attribute: same schedule_batch
+    surface as engine.LocalEngine, but the policy score matrix comes from
+    one pod_emb @ node_emb^T contraction (MXU) instead of a heuristic
+    formula. Feasibility, normalization, (anti)affinity and assignment
+    reuse the exact engine machinery (engine.finish_cycle), so every hard
+    and soft constraint holds identically. The `policy` argument is
+    accepted and ignored — this engine IS the policy ("learned").
+    """
+
+    def __init__(self, params, *, model: NodeScorer | None = None):
+        import functools
+
+        from kubernetes_scheduler_tpu.engine import (
+            compute_feasibility,
+            finish_cycle,
+            normalize_scores,
+        )
+
+        self.model = model or NodeScorer()
+        self.params = params
+
+        @functools.partial(
+            jax.jit,
+            static_argnames=("assigner", "normalizer", "affinity_aware", "soft"),
+        )
+        def _run(params, snapshot, pods, *, assigner, normalizer,
+                 affinity_aware, soft):
+            pod_x, node_x = make_features(snapshot, pods)
+            raw = self.model.apply(params, pod_x, node_x)
+            feasible = compute_feasibility(
+                snapshot, pods, include_pod_affinity=not affinity_aware
+            )
+            norm = normalize_scores(raw, snapshot.node_mask, normalizer)
+            return finish_cycle(
+                snapshot, pods, raw, norm, feasible,
+                assigner=assigner, affinity_aware=affinity_aware, soft=soft,
+            )
+
+        self._run = _run
+
+    def schedule_batch(
+        self,
+        snapshot,
+        pods,
+        *,
+        policy: str = "learned",
+        assigner: str = "greedy",
+        normalizer: str = "min_max",
+        fused: bool = False,  # no fused kernel for the learned scorer
+        affinity_aware: bool = True,
+        soft: bool = False,
+    ):
+        return self._run(
+            self.params, snapshot, pods, assigner=assigner,
+            normalizer=normalizer, affinity_aware=affinity_aware, soft=soft,
+        )
+
+    def healthy(self) -> bool:
+        return True
+
+    def close(self) -> None:
+        pass
+
+
+def load_learned_engine(
+    checkpoint_path: str, *, model: NodeScorer | None = None
+) -> LearnedEngine:
+    """Restore a trained scorer into a ready LearnedEngine."""
+    model = model or NodeScorer()
+    like, _, _ = init_train_state(jax.random.key(0), model=model)
+    state = restore_checkpoint(checkpoint_path, like)
+    return LearnedEngine(state.params, model=model)

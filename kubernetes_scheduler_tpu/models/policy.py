@@ -44,6 +44,13 @@ HEURISTIC_POLICIES = {
         reference="pkg/yoda/score/algorithm.go:264-291",
         live_in_reference=False,
     ),
+    "learned": PolicyInfo(
+        name="learned",
+        description="two-tower learned scorer (models/learned.py), distilled"
+        " from any heuristic policy over the full advisor feature set",
+        reference="beyond reference (SURVEY.md has no learned path)",
+        live_in_reference=False,
+    ),
 }
 
 

@@ -61,6 +61,9 @@ class SchedulerConfig:
     initial_backoff_seconds: float = 1.0
     max_backoff_seconds: float = 10.0
     mesh_devices: int | None = None  # None = single device
+    # policy="learned": restore the two-tower scorer from this orbax
+    # checkpoint (models/learned.py); None = fresh (untrained) parameters
+    learned_checkpoint: str | None = None
     # adaptive dispatch: below this pods x nodes product a cycle runs the
     # host scalar path (C++ when native_host) instead of the device — tiny
     # problems are device-dispatch-latency-bound (a 1-pod x 3-node cycle

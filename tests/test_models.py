@@ -22,7 +22,8 @@ import functools
 
 def test_policy_registry():
     assert set(HEURISTIC_POLICIES) == {
-        "balanced_cpu_diskio", "balanced_diskio", "free_capacity", "card"
+        "balanced_cpu_diskio", "balanced_diskio", "free_capacity", "card",
+        "learned",
     }
     assert get_policy("balanced_cpu_diskio").live_in_reference
     with pytest.raises(ValueError):

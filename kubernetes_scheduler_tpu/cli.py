@@ -24,6 +24,8 @@ import logging
 import sys
 import time
 
+import numpy as np
+
 from kubernetes_scheduler_tpu.utils.config import SchedulerConfig
 
 log = logging.getLogger("yoda_tpu.cli")
@@ -35,7 +37,9 @@ def _load_config(args) -> SchedulerConfig:
         if getattr(args, "config", None)
         else SchedulerConfig()
     )
-    for key in ("policy", "assigner", "normalizer", "batch_window"):
+    for key in (
+        "policy", "assigner", "normalizer", "batch_window", "learned_checkpoint"
+    ):
         v = getattr(args, key, None)
         if v is not None:
             cfg = dataclasses.replace(cfg, **{key: v})
@@ -50,6 +54,11 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--assigner", choices=("greedy", "auction"))
     p.add_argument("--normalizer", choices=("min_max", "softmax", "none"))
     p.add_argument("--batch-window", type=int, dest="batch_window")
+    p.add_argument(
+        "--learned-checkpoint",
+        dest="learned_checkpoint",
+        help="orbax checkpoint for policy=learned (models/learned.py)",
+    )
     p.add_argument(
         "--no-tpu",
         action="store_true",
@@ -116,6 +125,14 @@ def cmd_scheduler(args) -> int:
                 "seconds": round(dt, 3),
                 "pods_per_sec": round(bound / dt, 1) if dt > 0 else None,
                 "fallback_cycles": sum(c.used_fallback for c in cycles),
+                # bind latency = full cycle wall time (queue pop -> binds),
+                # the BASELINE.md north-star latency metric
+                "cycle_p50_ms": round(
+                    1e3 * float(np.percentile([c.cycle_seconds for c in cycles], 50)), 2
+                ) if cycles else None,
+                "cycle_p99_ms": round(
+                    1e3 * float(np.percentile([c.cycle_seconds for c in cycles], 99)), 2
+                ) if cycles else None,
             }
         )
     )

@@ -85,6 +85,12 @@ class Scheduler:
                 load_learned_engine,
             )
 
+            if not config.feature_gates.tpu_batch_score:
+                raise ValueError(
+                    "policy='learned' requires the engine path "
+                    "(feature_gates.tpu_batch_score=True); the scalar "
+                    "fallback only implements the yoda formula"
+                )
             if engine is not None and not isinstance(engine, LearnedEngine):
                 # a remote/in-process heuristic engine cannot evaluate the
                 # learned policy (no parameters); failing loud beats every
@@ -163,7 +169,12 @@ class Scheduler:
             try:
                 self._run_batched(window, nodes, running, utils, m)
             except Exception:
-                log.exception("engine cycle failed; falling back to scalar path")
+                log.exception(
+                    "engine cycle failed; falling back to scalar path "
+                    "(NOTE: the fallback scores with the yoda formula "
+                    "regardless of config.policy=%r)",
+                    self.config.policy,
+                )
                 m.used_fallback = True
                 self._run_scalar(window, nodes, utils, m)
         else:

@@ -90,6 +90,19 @@ def pod_resource_request(pod: Pod, resource: str) -> float:
     return total + pod.overhead.get(resource, 0.0)
 
 
+def pod_request_vector(pod: Pod, names: tuple[str, ...]) -> np.ndarray:
+    """[len(names)] request vector, memoized on the pod object — pod specs
+    are immutable in k8s, and long-running pods are re-summed into the
+    `requested` matrix EVERY cycle, so this turns the builder's hottest
+    loop into a dict hit after each pod's first cycle."""
+    cache = pod.__dict__.get("_req_vec_cache")
+    if cache is not None and cache[0] == names:
+        return cache[1]
+    vec = np.array([pod_resource_request(pod, r) for r in names], np.float32)
+    pod.__dict__["_req_vec_cache"] = (names, vec)
+    return vec
+
+
 @dataclass
 class SnapshotBuilder:
     """Builds (SnapshotArrays, PodBatch) with shared interning tables.
@@ -148,13 +161,14 @@ class SnapshotBuilder:
                 net_down[i] = u.net_down
 
         # NonZeroRequested accumulation over running pods (algorithm.go:219-221)
+        names_t = tuple(names)
+        pods_col = names.index("pods")
         for pod in running_pods:
             if pod.node_name not in node_index:
                 continue
             i = node_index[pod.node_name]
-            for j, res in enumerate(names):
-                requested[i, j] += pod_resource_request(pod, res)
-            requested[i, names.index("pods")] += 1
+            requested[i] += pod_request_vector(pod, names_t)
+            requested[i, pods_col] += 1
 
         # cards
         c_max = bucket_size(max((len(nd.cards) for nd in nodes), default=0), floor=1, multiple=1)
@@ -346,10 +360,11 @@ class SnapshotBuilder:
         pna_mask = np.zeros((p, ep_max), bool)
         pna_weight = np.zeros((p, ep_max), np.float32)
 
+        names_t = tuple(names)
+        pods_col = names.index("pods")
         for i, pod in enumerate(pods):
-            for j, res in enumerate(names):
-                request[i, j] = pod_resource_request(pod, res)
-            request[i, names.index("pods")] = 1
+            request[i] = pod_request_vector(pod, names_t)
+            request[i, pods_col] = 1
             # diskIO annotation (algorithm.go:103; unparsable -> 0)
             r_io[i] = parse_float_or_zero(pod.annotations.get("diskIO"))
             # scv/priority label (sort.go:12-18)

@@ -17,6 +17,11 @@ class PolicyInfo:
     description: str
     reference: str  # file:line in /root/reference
     live_in_reference: bool
+    # True: engine.compute_scores evaluates it by name (engine.POLICIES).
+    # False: needs a dedicated engine carrying state (models/learned.py
+    # LearnedEngine holds the scorer parameters) — host.Scheduler builds
+    # it from config; sending the name to a stock engine raises.
+    engine_schedulable: bool = True
 
 
 HEURISTIC_POLICIES = {
@@ -50,8 +55,13 @@ HEURISTIC_POLICIES = {
         " from any heuristic policy over the full advisor feature set",
         reference="beyond reference (SURVEY.md has no learned path)",
         live_in_reference=False,
+        engine_schedulable=False,
     ),
 }
+
+# back-compat / clearer name: the registry holds every selectable policy,
+# heuristic or learned
+POLICY_REGISTRY = HEURISTIC_POLICIES
 
 
 def get_policy(name: str) -> PolicyInfo:

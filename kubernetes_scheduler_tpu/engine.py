@@ -47,10 +47,12 @@ from kubernetes_scheduler_tpu.ops.assign import (
 from kubernetes_scheduler_tpu.ops.constraints import (
     node_affinity_fit,
     node_affinity_preference,
+    node_name_fit,
     pod_affinity_fit,
     pod_affinity_preference,
     prefer_no_schedule_penalty,
     taint_toleration_fit,
+    topology_spread_fit,
 )
 from kubernetes_scheduler_tpu.ops.normalize import softmax_normalize
 from kubernetes_scheduler_tpu.ops.assign import NEG
@@ -136,6 +138,12 @@ class PodBatch(NamedTuple):
     pref_affinity_weight: jnp.ndarray  # [p, K] float32
     pref_anti_sel: jnp.ndarray       # [p, K] int32 selector ids, -1 pad
     pref_anti_weight: jnp.ndarray    # [p, K] float32
+    # upstream NodeName / PodTopologySpread filters (hostPort conflicts —
+    # upstream NodePorts — are capacity-1 pseudo-resource columns built by
+    # host.snapshot, needing no engine support)
+    target_node: jnp.ndarray         # [p] int32: -1 unpinned, else node idx
+    spread_sel: jnp.ndarray          # [p, Ks] int32 selector ids, -1 pad
+    spread_max: jnp.ndarray          # [p, Ks] int32 maxSkew per constraint
 
 
 def make_snapshot(
@@ -255,6 +263,9 @@ def make_pod_batch(
     pref_affinity_weight=None,
     pref_anti_sel=None,
     pref_anti_weight=None,
+    target_node=None,
+    spread_sel=None,
+    spread_max=None,
 ) -> PodBatch:
     """PodBatch with no-op defaults (no GPU demand, no tolerations, no
     affinity requirements, no preferences)."""
@@ -323,6 +334,13 @@ def make_pod_batch(
              else jnp.ones(jnp.asarray(pref_anti_sel).shape, jnp.float32))
             if pref_anti_weight is None
             else jnp.asarray(pref_anti_weight, jnp.float32)
+        ),
+        target_node=jnp.full((p,), -1, jnp.int32) if target_node is None else jnp.asarray(target_node, jnp.int32),
+        spread_sel=jnp.full((p, 1), -1, jnp.int32) if spread_sel is None else jnp.asarray(spread_sel, jnp.int32),
+        spread_max=(
+            (jnp.ones((p, 1), jnp.int32) if spread_sel is None
+             else jnp.ones(jnp.asarray(spread_sel).shape, jnp.int32))
+            if spread_max is None else jnp.asarray(spread_max, jnp.int32)
         ),
     )
 
@@ -407,7 +425,11 @@ def compute_feasibility(
         pods.na_key, pods.na_op, pods.na_vals, pods.na_val_mask, pods.na_mask,
     )
     out = fits & gpu_fits & taint_ok & na_ok & pods.pod_mask[:, None]
+    out = out & node_name_fit(pods.target_node, snapshot.allocatable.shape[0])
     if include_pod_affinity:
+        # domain-count-based families evaluated statically against
+        # pre-window counts (the affinity_aware=True paths instead thread
+        # live counts through the assigners)
         out = out & pod_affinity_fit(
             snapshot.domain_counts, pods.affinity_sel, pods.anti_affinity_sel
         )
@@ -415,6 +437,10 @@ def compute_feasibility(
         # InterPodAffinity checks existing pods' anti terms too)
         matches = match_matrix(pods, snapshot.avoid_counts.shape[1])
         out = out & ~anti_reverse_bad(matches, snapshot.avoid_counts)
+        out = out & topology_spread_fit(
+            snapshot.domain_counts, snapshot.node_mask,
+            pods.spread_sel, pods.spread_max,
+        )
     return out
 
 
@@ -440,6 +466,9 @@ def make_affinity_state(snapshot: SnapshotArrays, pods: PodBatch) -> AffinitySta
         anti_affinity_sel=pods.anti_affinity_sel,
         avoid_counts=snapshot.avoid_counts,
         pod_has_anti=pod_has_anti_onehot(pods.anti_affinity_sel, s),
+        spread_sel=pods.spread_sel,
+        spread_max=pods.spread_max,
+        node_mask=snapshot.node_mask,
     )
 
 
@@ -519,12 +548,17 @@ def _fused_masked_scores(
         snapshot.node_labels, snapshot.node_label_mask,
         pods.na_key, pods.na_op, pods.na_vals, pods.na_val_mask, pods.na_mask,
     )
+    other = other & node_name_fit(pods.target_node, snapshot.allocatable.shape[0])
     if include_pod_affinity:
         other = other & pod_affinity_fit(
             snapshot.domain_counts, pods.affinity_sel, pods.anti_affinity_sel
         )
         matches = match_matrix(pods, snapshot.avoid_counts.shape[1])
         other = other & ~anti_reverse_bad(matches, snapshot.avoid_counts)
+        other = other & topology_spread_fit(
+            snapshot.domain_counts, snapshot.node_mask,
+            pods.spread_sel, pods.spread_max,
+        )
     return jnp.where(other, masked, NEG)
 
 

@@ -194,7 +194,8 @@ class Scheduler:
         for pod in window:
             if (
                 pod.tolerations or pod.node_affinity or pod.pod_affinity
-                or pod.preferred_node_affinity
+                or pod.preferred_node_affinity or pod.topology_spread
+                or pod.host_ports or pod.target_node is not None
             ):
                 return False
             if any(k.startswith("scv/") and k != "scv/priority" for k in pod.labels):
@@ -238,6 +239,7 @@ class Scheduler:
             and (
                 (np.asarray(pods_batch.affinity_sel) >= 0).any()
                 or (np.asarray(pods_batch.anti_affinity_sel) >= 0).any()
+                or (np.asarray(pods_batch.spread_sel) >= 0).any()
             )
         )
         # the fused Pallas path is an optimization with identical decisions;

@@ -115,10 +115,32 @@ class SnapshotBuilder:
     label_keys: Interner = field(default_factory=Interner)
     label_values: Interner = field(default_factory=Interner)
     selectors: dict[tuple, int] = field(default_factory=dict)
+    # hostPort conflict state (upstream NodePorts): each distinct hostPort
+    # in flight becomes a capacity-1 pseudo-resource column, so the
+    # engine's existing capacity machinery (greedy decrement, auction
+    # admission, cross-window carry) enforces conflicts exactly. Slot
+    # COUNT is bucketed so shapes (and compiles) stay stable while port
+    # membership changes cycle to cycle.
+    _port_slots: int = 0
+    _port_index: dict = field(default_factory=dict)  # port -> column offset
+    # node-name -> index of the latest snapshot (for target_node encoding)
+    _node_index: dict = field(default_factory=dict)
 
     @property
     def resource_names(self) -> list[str]:
-        return list(CANONICAL_NAMES) + self.extended_resources
+        return (
+            list(CANONICAL_NAMES)
+            + self.extended_resources
+            + [f"hostport/{i}" for i in range(self._port_slots)]
+        )
+
+    def _assign_port_slots(self, running: list[Pod], pending: list[Pod]) -> None:
+        ports = sorted(
+            {pt for pod in [*running, *pending] for pt in pod.host_ports}
+        )
+        if len(ports) > self._port_slots:
+            self._port_slots = bucket_size(len(ports), floor=1, multiple=1)
+        self._port_index = {pt: i for i, pt in enumerate(ports)}
 
     # ---- node side ----------------------------------------------------
 
@@ -130,8 +152,10 @@ class SnapshotBuilder:
         *,
         pending_pods: list[Pod] | None = None,
     ) -> SnapshotArrays:
+        self._assign_port_slots(running_pods, pending_pods or [])
         names = self.resource_names
         r = len(names)
+        n_port0 = len(names) - self._port_slots  # first port column
         n_real = len(nodes)
         n = bucket_size(n_real)
 
@@ -146,8 +170,9 @@ class SnapshotBuilder:
         mask[:n_real] = True
 
         node_index = {nd.name: i for i, nd in enumerate(nodes)}
+        self._node_index = node_index
         for i, nd in enumerate(nodes):
-            for j, res in enumerate(names):
+            for j, res in enumerate(names[:n_port0]):
                 if res == "cpu":
                     alloc[i, j] = nd.allocatable.get("cpu", 0.0)  # millicores
                 else:
@@ -159,6 +184,8 @@ class SnapshotBuilder:
                 mem_pct[i] = u.mem_pct
                 net_up[i] = u.net_up
                 net_down[i] = u.net_down
+        # every real node offers each hostPort slot exactly once
+        alloc[:n_real, n_port0:] = 1.0
 
         # NonZeroRequested accumulation over running pods (algorithm.go:219-221)
         names_t = tuple(names)
@@ -169,6 +196,8 @@ class SnapshotBuilder:
             i = node_index[pod.node_name]
             requested[i] += pod_request_vector(pod, names_t)
             requested[i, pods_col] += 1
+            for pt in pod.host_ports:
+                requested[i, n_port0 + self._port_index[pt]] += 1
 
         # cards
         c_max = bucket_size(max((len(nd.cards) for nd in nodes), default=0), floor=1, multiple=1)
@@ -248,6 +277,8 @@ class SnapshotBuilder:
         for pod in pending:
             for term in pod.pod_affinity:
                 self._selector_id(term)
+            for sc in pod.topology_spread:
+                self._selector_id(sc)
         # running pods' terms also define selectors: REQUIRED anti terms
         # gate the reverse hard direction; PREFERRED terms feed the
         # symmetric soft scoring (pref_attract/pref_avoid)
@@ -342,6 +373,13 @@ class SnapshotBuilder:
         pref_aff_w = np.zeros((p, k_max), np.float32)
         pref_anti = np.full((p, k_max), -1, np.int32)
         pref_anti_w = np.zeros((p, k_max), np.float32)
+        ks_max = bucket_size(
+            max((len(pd.topology_spread) for pd in pods), default=0),
+            floor=1, multiple=1,
+        )
+        spread_sel = np.full((p, ks_max), -1, np.int32)
+        spread_max = np.ones((p, ks_max), np.int32)
+        target_node = np.full(p, -1, np.int32)
         ep_max = bucket_size(
             max((len(pd.preferred_node_affinity) for pd in pods), default=0),
             floor=1, multiple=1,
@@ -362,9 +400,21 @@ class SnapshotBuilder:
 
         names_t = tuple(names)
         pods_col = names.index("pods")
+        n_port0 = len(names) - self._port_slots
         for i, pod in enumerate(pods):
             request[i] = pod_request_vector(pod, names_t)
             request[i, pods_col] = 1
+            for pt in pod.host_ports:
+                # ports outside the table mean build_snapshot did not see
+                # this window (_assign_port_slots) — fail loud
+                request[i, n_port0 + self._port_index[pt]] = 1
+            if pod.target_node is not None:
+                # unknown node name -> out-of-range index: infeasible
+                # everywhere (constraints.node_name_fit)
+                target_node[i] = self._node_index.get(pod.target_node, p + 2**20)
+            for j, sc in enumerate(pod.topology_spread):
+                spread_sel[i, j] = self._selector_id(sc)
+                spread_max[i, j] = sc.max_skew
             # diskIO annotation (algorithm.go:103; unparsable -> 0)
             r_io[i] = parse_float_or_zero(pod.annotations.get("diskIO"))
             # scv/priority label (sort.go:12-18)
@@ -437,5 +487,6 @@ class SnapshotBuilder:
             pna_val_mask=pna_val_mask, pna_mask=pna_mask,
             pna_weight=pna_weight, pref_affinity_sel=pref_aff,
             pref_affinity_weight=pref_aff_w, pref_anti_sel=pref_anti,
-            pref_anti_weight=pref_anti_w,
+            pref_anti_weight=pref_anti_w, target_node=target_node,
+            spread_sel=spread_sel, spread_max=spread_max,
         )

@@ -69,6 +69,20 @@ class PodAffinityTerm:
 
 
 @dataclass
+class SpreadConstraint:
+    """topologySpreadConstraints entry (hard DoNotSchedule semantics):
+    placements of pods matching `match_labels` may not skew across
+    `topology_key` domains by more than `max_skew`. Skew here is measured
+    against the minimum count over all schedulable nodes' domains (upstream
+    additionally filters domains by the pod's node affinity — documented
+    simplification)."""
+
+    match_labels: dict[str, str]
+    topology_key: str = "kubernetes.io/hostname"
+    max_skew: int = 1
+
+
+@dataclass
 class WeightedExpression:
     """One preferred node-affinity term: a weighted matchExpression
     (preferredDuringScheduling...; the upstream term's expression list is
@@ -91,6 +105,12 @@ class Pod:
     node_affinity: list[MatchExpression] = field(default_factory=list)
     pod_affinity: list[PodAffinityTerm] = field(default_factory=list)
     preferred_node_affinity: list[WeightedExpression] = field(default_factory=list)
+    topology_spread: list[SpreadConstraint] = field(default_factory=list)
+    # spec.nodeName: pin to one node (upstream NodeName filter)
+    target_node: str | None = None
+    # hostPorts requested by any container (upstream NodePorts filter);
+    # encoded as capacity-1 pseudo-resource columns by the snapshot builder
+    host_ports: list[int] = field(default_factory=list)
     node_name: str | None = None  # set once bound
     scheduler_name: str = "yoda-tpu"
 

@@ -72,6 +72,11 @@ class AffinityState(NamedTuple):
     anti_affinity_sel: jnp.ndarray
     avoid_counts: jnp.ndarray
     pod_has_anti: jnp.ndarray
+    # hard topologySpreadConstraints (upstream PodTopologySpread) — also
+    # count-based, so they share the live-count machinery:
+    spread_sel: jnp.ndarray   # [p, Ks] int32 selector ids, -1 pad
+    spread_max: jnp.ndarray   # [p, Ks] int32 maxSkew
+    node_mask: jnp.ndarray    # [n] bool (for the min-over-domains term)
 
 
 def pod_has_anti_onehot(anti_affinity_sel: jnp.ndarray, s: int) -> jnp.ndarray:
@@ -98,6 +103,42 @@ def affinity_ok_from_counts(
     anti_ok = ((cnt[:, t] == 0) | (t_sel[None, :] < 0)).all(-1)
     valid = ~((a_sel >= s).any() | (t_sel >= s).any())
     return aff_ok & anti_ok & valid
+
+
+def spread_ok_from_counts(
+    cnt: jnp.ndarray,
+    node_mask: jnp.ndarray,
+    spread_sel: jnp.ndarray,
+    spread_max: jnp.ndarray,
+) -> jnp.ndarray:
+    """[n] bool: one pod's hard spread constraints hold on each node given
+    live counts cnt[n, S]: count + 1 − min over schedulable domains <=
+    maxSkew (ops/constraints.topology_spread_fit against live counts)."""
+    s = cnt.shape[1]
+    big = jnp.asarray(jnp.finfo(jnp.float32).max, jnp.float32)
+    dmin = jnp.where(node_mask[:, None], cnt, big).min(0)         # [S]
+    sel = jnp.clip(spread_sel, 0, max(s - 1, 0))                  # [K]
+    skew = cnt[:, sel] + 1.0 - dmin[sel][None, :]                 # [n, K]
+    ok = (skew <= spread_max[None, :]) | (spread_sel < 0)[None, :]
+    valid = ~(spread_sel >= s).any()
+    return ok.all(-1) & valid
+
+
+def spread_ok_batched(
+    cnt: jnp.ndarray,
+    node_mask: jnp.ndarray,
+    spread_sel: jnp.ndarray,
+    spread_max: jnp.ndarray,
+) -> jnp.ndarray:
+    """[p, n] bool batched spread_ok_from_counts (spread_sel/max [p, K])."""
+    s = cnt.shape[1]
+    big = jnp.asarray(jnp.finfo(jnp.float32).max, jnp.float32)
+    dmin = jnp.where(node_mask[:, None], cnt, big).min(0)         # [S]
+    sel = jnp.clip(spread_sel, 0, max(s - 1, 0))                  # [p, K]
+    skew = cnt[:, sel] + 1.0 - dmin[sel][None, :, :]              # [n, p, K]
+    ok = (skew <= spread_max[None, :, :]) | (spread_sel < 0)[None, :, :]
+    valid = ~(spread_sel >= s).any(-1)                            # [p]
+    return ok.all(-1).T & valid[:, None]
 
 
 def anti_reverse_ok(avoid_cnt: jnp.ndarray, matches: jnp.ndarray) -> jnp.ndarray:
@@ -128,7 +169,13 @@ def _affinity_row_ok(
     cnt = aff.domain_counts + added[aff.domain_id, cols]     # [n, S]
     own = affinity_ok_from_counts(cnt, aff.affinity_sel[i], aff.anti_affinity_sel[i])
     avoid_cnt = aff.avoid_counts + added_avoid[aff.domain_id, cols]
-    return own & anti_reverse_ok(avoid_cnt, aff.pod_matches[i])
+    return (
+        own
+        & anti_reverse_ok(avoid_cnt, aff.pod_matches[i])
+        & spread_ok_from_counts(
+            cnt, aff.node_mask, aff.spread_sel[i], aff.spread_max[i]
+        )
+    )
 
 
 def _affinity_update(
@@ -280,7 +327,8 @@ def _affinity_round_mask(
     )                                                              # [p]
     avoid_cnt = aff.avoid_counts + added_avoid[aff.domain_id, cols]
     rev_bad = anti_reverse_bad(aff.pod_matches, avoid_cnt)         # [p, n]
-    return (aff_ok & anti_ok).T & valid[:, None] & ~rev_bad
+    spread = spread_ok_batched(cnt, aff.node_mask, aff.spread_sel, aff.spread_max)
+    return (aff_ok & anti_ok).T & valid[:, None] & ~rev_bad & spread
 
 
 def _evict_round_conflicts(
@@ -348,7 +396,43 @@ def _evict_round_conflicts(
     keep_t = jnp.take_along_axis(keep_s, tc, axis=1)               # [p, K]
 
     survive_t = keep_t & ~hard_blocked_t
-    return (viol_t & ~survive_t).any(-1)                           # [p]
+    evict = (viol_t & ~survive_t).any(-1)                          # [p]
+
+    # same-round SPREAD conflicts: each bid passed the pre-round skew mask,
+    # but joint placements into one domain can exceed maxSkew together.
+    # Keep the (priority desc, index asc) max among this round's admitted
+    # CONTRIBUTORS (pods matching the selector and carrying the
+    # constraint) per (domain, selector); everyone else violated re-bids
+    # against counts that include the survivors — masks shrink, no
+    # livelock. Violated non-contributors always re-bid (keeping them
+    # blocks nothing).
+    sp_sel = aff.spread_sel                                        # [p, Kс]
+    spc = jnp.clip(sp_sel, 0, max(s - 1, 0))
+    live_cnt = aff.domain_counts + adds[aff.domain_id, jnp.arange(s)[None, :]]
+    big = jnp.asarray(jnp.finfo(jnp.float32).max, jnp.float32)
+    dmin = jnp.where(aff.node_mask[:, None], live_cnt, big).min(0)  # [S]
+    cnt_mine = aff.domain_counts[bid] + adds[dom_p, cols]           # [p, S]
+    skew_t = (
+        jnp.take_along_axis(cnt_mine, spc, axis=1)
+        - dmin[spc]
+    )                                                               # [p, Kc]
+    viol_sp = admitted[:, None] & (sp_sel >= 0) & (
+        skew_t > aff.spread_max.astype(jnp.float32)
+    )
+    rows_sp = jnp.arange(p)[:, None]
+    has_spread = (
+        jnp.zeros((p, s), bool).at[rows_sp, spc].max(sp_sel >= 0)
+    )                                                               # [p, S]
+    member_sp = admitted[:, None] & has_spread & aff.pod_matches    # [p, S]
+    keyf_sp = jnp.where(member_sp, key[:, None], 0)
+    gmax_sp = (
+        jnp.zeros(aff.domain_counts.shape, jnp.int32)
+        .at[dom_p, cols]
+        .max(keyf_sp)
+    )
+    keep_sp_s = member_sp & (keyf_sp == gmax_sp[dom_p, cols])       # [p, S]
+    survive_sp = jnp.take_along_axis(keep_sp_s, spc, axis=1)        # [p, Kc]
+    return evict | (viol_sp & ~survive_sp).any(-1)
 
 
 def auction_assign(

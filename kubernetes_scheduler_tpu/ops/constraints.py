@@ -223,6 +223,40 @@ def pod_affinity_preference(
     )
 
 
+def node_name_fit(target_node: jnp.ndarray, n: int) -> jnp.ndarray:
+    """F[p, n] for spec.nodeName pinning (upstream NodeName filter):
+    target_node[p] int32 — -1 unpinned (every node ok), an index pins to
+    that node, any value >= n (the host's encoding for a pinned-but-absent
+    node name) matches nothing and the pod surfaces as unschedulable."""
+    cols = jnp.arange(n)[None, :]
+    return (target_node[:, None] < 0) | (cols == target_node[:, None])
+
+
+def topology_spread_fit(
+    domain_counts: jnp.ndarray,
+    node_mask: jnp.ndarray,
+    spread_sel: jnp.ndarray,
+    spread_max: jnp.ndarray,
+) -> jnp.ndarray:
+    """F[p, n]: hard topologySpreadConstraints (upstream PodTopologySpread,
+    DoNotSchedule): placing the pod in node n's domain must keep
+        count(domain, selector) + 1 − min over schedulable domains <= maxSkew
+    for every constraint. domain_counts[n, s] are per-node-replicated domain
+    totals, so the min over valid nodes equals the min over present domains.
+    Selector ids are -1 padded; out-of-range ids are unsatisfiable (stale
+    pod batch — same stance as pod_affinity_fit)."""
+    s = domain_counts.shape[1]
+    big = jnp.asarray(jnp.finfo(jnp.float32).max, jnp.float32)
+    dmin = jnp.where(node_mask[:, None], domain_counts, big).min(0)  # [S]
+    sel = jnp.clip(spread_sel, 0, max(s - 1, 0))                     # [p, K]
+    skew = (
+        domain_counts[:, sel] + 1.0 - dmin[sel][None, :, :]
+    )                                                                # [n, p, K]
+    ok = (skew <= spread_max[None, :, :]) | (spread_sel < 0)[None, :, :]
+    valid = ~(spread_sel >= s).any(-1)                               # [p]
+    return ok.all(-1).T & valid[:, None]
+
+
 def pod_affinity_fit(
     domain_counts: jnp.ndarray,
     affinity_sel: jnp.ndarray,

@@ -142,6 +142,18 @@ def _sharded_greedy(
             snapshot.avoid_counts + added_avoid[snapshot.domain_id, cols[None, :]]
         )
         aff_ok = aff_ok & anti_reverse_ok(avoid_cnt, matches[i])
+        # hard topology spread with a GLOBAL min over the sharded node axis
+        big = jnp.asarray(jnp.finfo(jnp.float32).max, jnp.float32)
+        dmin = jax.lax.pmin(
+            jnp.where(snapshot.node_mask[:, None], cnt, big).min(0), axes
+        )                                                          # [S]
+        spc = jnp.clip(pods.spread_sel[i], 0, max(s - 1, 0))
+        skew = cnt[:, spc] + 1.0 - dmin[spc][None, :]
+        sp_ok = (
+            (skew <= pods.spread_max[i][None, :].astype(jnp.float32))
+            | (pods.spread_sel[i] < 0)[None, :]
+        ).all(-1) & ~(pods.spread_sel[i] >= s).any()
+        aff_ok = aff_ok & sp_ok
         mask = feasible[i] & cap_ok & aff_ok & pods.pod_mask[i]
         row = jnp.where(mask, norm[i], NEG)
         local_best = row.max()

@@ -163,7 +163,7 @@ class Scheduler:
         use_device = (
             self.config.policy != "balanced_cpu_diskio"
             or len(window) * len(nodes) >= self.config.min_device_work
-            or not self._scalar_sufficient(window, nodes)
+            or not self._scalar_sufficient(window, nodes, running)
         )
         if self.config.feature_gates.tpu_batch_score and nodes and use_device:
             try:
@@ -186,9 +186,15 @@ class Scheduler:
         return m
 
     @staticmethod
-    def _scalar_sufficient(window, nodes) -> bool:
+    def _scalar_sufficient(window, nodes, running) -> bool:
         """True when this cycle uses no constraint family beyond the scalar
-        path's surface (live score + resource fit)."""
+        path's surface (live score + resource fit).
+
+        Running pods matter too: a running pod's REQUIRED anti-affinity
+        forbids matching pending pods from its domain (the reverse
+        direction upstream InterPodAffinity enforces), and its PREFERRED
+        terms contribute score — both engine-only capabilities, so any
+        running pod with pod_affinity terms forces the engine path."""
         if any(nd.taints or nd.cards for nd in nodes):
             return False
         for pod in window:
@@ -200,6 +206,8 @@ class Scheduler:
                 return False
             if any(k.startswith("scv/") and k != "scv/priority" for k in pod.labels):
                 return False
+        if any(pod.pod_affinity for pod in running):
+            return False
         return True
 
     def _run_batched(self, window, nodes, running, utils, m: CycleMetrics):

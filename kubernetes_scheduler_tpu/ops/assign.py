@@ -336,9 +336,14 @@ def _evict_round_conflicts(
     admitted: jnp.ndarray,
     bid: jnp.ndarray,
     priority: jnp.ndarray,
+    added: jnp.ndarray,
 ) -> jnp.ndarray:
     """[p] bool: admitted pods whose hard anti-affinity is violated by
     OTHER same-round placements, minus one survivor per conflict group.
+    `added` [n, S] carries prior rounds' permanent placements; spread skew
+    is a TOTAL-count constraint, so the check below must see base + added
+    + this round's adds (anti-affinity needs only same-round adds — the
+    pre-bid mask already rules out violations against base + added).
 
     The pre-bid mask guarantees no violation against base + previous
     rounds; only pods admitted in the SAME round can conflict. A pod p
@@ -408,10 +413,11 @@ def _evict_round_conflicts(
     # blocks nothing).
     sp_sel = aff.spread_sel                                        # [p, Kс]
     spc = jnp.clip(sp_sel, 0, max(s - 1, 0))
-    live_cnt = aff.domain_counts + adds[aff.domain_id, jnp.arange(s)[None, :]]
+    carry = added + adds                                            # [n, S]
+    live_cnt = aff.domain_counts + carry[aff.domain_id, jnp.arange(s)[None, :]]
     big = jnp.asarray(jnp.finfo(jnp.float32).max, jnp.float32)
     dmin = jnp.where(aff.node_mask[:, None], live_cnt, big).min(0)  # [S]
-    cnt_mine = aff.domain_counts[bid] + adds[dom_p, cols]           # [p, S]
+    cnt_mine = aff.domain_counts[bid] + carry[dom_p, cols]          # [p, S]
     skew_t = (
         jnp.take_along_axis(cnt_mine, spc, axis=1)
         - dmin[spc]
@@ -536,7 +542,7 @@ def auction_assign(
         )
         if affinity is not None:
             admitted = admitted & ~_evict_round_conflicts(
-                affinity, admitted, bid, priority
+                affinity, admitted, bid, priority, added
             )
             dom_bid = affinity.domain_id[bid]
             added = added.at[dom_bid, cols_s].add(

@@ -242,7 +242,21 @@ def make_sharded_schedule_fn(
         # single-device implementation so the two paths cannot diverge.
         # Inter-pod affinity is excluded from the static mask: the greedy
         # scan evaluates it dynamically (base + in-window counts).
-        feasible = compute_feasibility(snapshot, pods, include_pod_affinity=False)
+        # spec.nodeName pinning is GLOBAL (target_node indexes the full
+        # node axis) but feasibility columns are shard-LOCAL: translate by
+        # this shard's offset, mapping out-of-shard targets to the
+        # matches-nothing encoding (n_local) — NOT to a negative value,
+        # which node_name_fit reads as "unpinned".
+        n_local = snapshot.allocatable.shape[0]
+        offset = jax.lax.axis_index(axes).astype(jnp.int32) * n_local
+        local = pods.target_node - offset
+        local = jnp.where((local < 0) | (local >= n_local), n_local, local)
+        pods_local = pods._replace(
+            target_node=jnp.where(pods.target_node < 0, pods.target_node, local)
+        )
+        feasible = compute_feasibility(
+            snapshot, pods_local, include_pod_affinity=False
+        )
 
         if normalizer == "min_max":
             hi, lo = score_bounds(raw, snapshot.node_mask)

@@ -268,3 +268,50 @@ def test_auction_spread_survives_negative_priority():
     placed = idx[idx >= 0]
     assert len(placed) == 2, idx
     assert _final_affinity_violations(res.node_idx, snap, pods) == 0
+
+
+def test_auction_spread_hard_across_rounds():
+    """Hard maxSkew must hold across AUCTION ROUNDS, not just within one.
+
+    Repro for the cross-round carry bug: maxSkew=2, one matching pod lands
+    in domain A in round 1 (capacity-1 node), two more pods are admitted
+    to A's second node in round 2 — each individually legal (skew 1+1=2)
+    but jointly skew 3. The round-conflict eviction must see prior rounds'
+    `added` carry, elect one survivor, and re-route the other to domain B.
+    Semantics: upstream PodTopologySpread DoNotSchedule.
+    """
+    from kubernetes_scheduler_tpu.ops.assign import AffinityState
+
+    n, p, s = 4, 3, 1
+    # nodes 0,1 = domain A (rep row 0); nodes 2,3 = domain B (rep row 2)
+    aff = AffinityState(
+        domain_counts=jnp.zeros((n, s), jnp.float32),
+        domain_id=jnp.asarray([[0], [0], [2], [2]], jnp.int32),
+        pod_matches=jnp.ones((p, s), bool),
+        affinity_sel=jnp.full((p, 1), -1, jnp.int32),
+        anti_affinity_sel=jnp.full((p, 1), -1, jnp.int32),
+        avoid_counts=jnp.zeros((n, s), jnp.float32),
+        pod_has_anti=jnp.zeros((p, s), bool),
+        spread_sel=jnp.zeros((p, 1), jnp.int32),
+        spread_max=jnp.full((p, 1), 2, jnp.int32),
+        node_mask=jnp.ones((n,), bool),
+    )
+    # all pods prefer node0 > node1 > node2 > node3; node0 fits ONE pod,
+    # so round 1 places only the top-priority pod there and rounds 2+ spill
+    # the rest onto node1 (same domain) — the cross-round interaction.
+    scores = jnp.tile(jnp.asarray([[10.0, 9.0, 5.0, 4.9]], jnp.float32), (p, 1))
+    res = auction_assign(
+        scores,
+        jnp.ones((p, n), bool),
+        jnp.ones((p, 1), jnp.float32),
+        jnp.asarray([[1.0], [10.0], [10.0], [10.0]], jnp.float32),
+        jnp.asarray([3, 2, 1], jnp.int32),
+        jnp.ones((p,), bool),
+        rounds=16,
+        affinity=aff,
+    )
+    idx = np.asarray(res.node_idx)
+    assert (idx >= 0).all(), idx  # domain B has room — nobody strands
+    in_a = int((idx <= 1).sum())
+    # skew = count(A) - count(B); placing 3 in A would be skew 3 > maxSkew 2
+    assert in_a == 2, idx

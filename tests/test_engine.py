@@ -232,3 +232,63 @@ def test_multihost_mesh_matches_single_device(shape):
 def test_sharded_fn_rejects_missing_axis():
     with pytest.raises(ValueError, match="lacks axes"):
         make_sharded_schedule_fn(make_mesh(8), node_axes=("dcn", "node"))
+
+
+def test_dense_node_name_pinning():
+    """spec.nodeName (upstream NodeName filter) on the dense path: a pinned
+    pod lands on its node even when higher-scoring nodes exist; pinning to
+    an absent node (encoded >= n) makes the pod unschedulable; -1 leaves
+    the pod unconstrained."""
+    n, p = 16, 3
+    snapshot, pods = random_state(n, p)
+    free = schedule_batch(snapshot, pods)
+    pin = int(np.asarray(free.node_idx)[1])
+    # pin pod 0 to a node pod 1 would otherwise win, pod 2 to an absent one
+    target = np.array([pin, -1, n + 7], np.int32)
+    pods = pods._replace(target_node=jnp.asarray(target))
+    res = schedule_batch(snapshot, pods)
+    idx = np.asarray(res.node_idx)
+    feas = np.asarray(res.feasible)
+    assert idx[0] == pin, idx
+    assert feas[0].sum() <= 1 and feas[0][pin]
+    assert idx[2] == -1 and not feas[2].any()
+    assert idx[1] >= 0  # unpinned pod unaffected by others' pins
+
+
+@pytest.mark.parametrize("assigner", ["greedy", "auction"])
+def test_dense_node_name_pinning_assigners(assigner):
+    """Pinning must hold under both dense assigners."""
+    n, p = 12, 4
+    snapshot, pods = random_state(n, p)
+    target = np.array([5, -1, 5, n + 1], np.int32)
+    pods = pods._replace(target_node=jnp.asarray(target))
+    res = schedule_batch(snapshot, pods, assigner=assigner)
+    idx = np.asarray(res.node_idx)
+    assert idx[0] in (5, -1) and idx[2] in (5, -1)
+    assert idx[3] == -1
+    # both pods pinned to node 5 cannot land elsewhere, and capacity
+    # permitting at least one of them takes it
+    assert (idx[0] == 5) or (idx[2] == 5)
+
+
+def test_sharded_node_name_matches_single_device():
+    """target_node is a GLOBAL index; the sharded path must translate it to
+    shard-local columns (a global pin must not vanish off-shard or match
+    one node on every shard). Pins cover every shard of the 8-way mesh plus
+    the absent-node encoding."""
+    assert jax.device_count() == 8
+    n, p = 64, 10
+    snapshot, pods = random_state(n, p)
+    # pins: one per shard boundary region, an absent node, and unpinned
+    target = np.array([0, 7, 8, 15, 33, 56, 63, n + 2, -1, -1], np.int32)
+    pods = pods._replace(target_node=jnp.asarray(target))
+    single = schedule_batch(snapshot, pods)
+    sharded = make_sharded_schedule_fn(make_mesh(8))(snapshot, pods)
+    np.testing.assert_array_equal(
+        np.asarray(sharded.feasible), np.asarray(single.feasible)
+    )
+    assert np.asarray(sharded.node_idx).tolist() == np.asarray(single.node_idx).tolist()
+    idx = np.asarray(sharded.node_idx)
+    for i in range(8):
+        assert idx[i] in (target[i], -1)
+    assert idx[7] == -1

@@ -300,3 +300,30 @@ def test_adaptive_dispatch_tiny_cycle_uses_scalar():
     s2.submit(pod)
     m2 = s2.run_cycle()
     assert m2.pods_bound == 1 and not m2.used_fallback  # device dispatch
+
+
+def test_running_avoider_forces_engine_path_and_blocks_domain():
+    """Adaptive dispatch must consider RUNNING pods: a running pod with a
+    required anti-affinity term (an avoider) forbids matching pending pods
+    from its domain — engine-only reverse InterPodAffinity. The scalar
+    path would silently drop it, so the cycle must route to the engine
+    even below min_device_work, and the avoider's node must be refused."""
+    nodes = [make_node(f"n{i}", cpu=8000) for i in range(3)]
+    # make the avoider's node n0 the score-optimal target so the test
+    # fails loud (pod lands on n0) if the engine path is skipped
+    utils = {
+        "n0": NodeUtil(cpu_pct=10, disk_io=2),
+        "n1": NodeUtil(cpu_pct=80, disk_io=40),
+        "n2": NodeUtil(cpu_pct=85, disk_io=45),
+    }
+    guard = make_pod("guard", cpu=100, node_name="n0")
+    guard.pod_affinity = [
+        PodAffinityTerm(match_labels={"app": "web"}, anti=True)
+    ]
+    s = make_sched(nodes, [guard], utils, min_device_work=1 << 20)
+    s.submit(make_pod("web-0", cpu=100, labels={"app": "web"},
+                      annotations={"diskIO": "5"}))
+    m = s.run_cycle()
+    assert not m.used_fallback  # running avoider forced the engine path
+    bound = {b.pod.name: b.node_name for b in s.binder.bindings}
+    assert bound["web-0"] != "n0", bound

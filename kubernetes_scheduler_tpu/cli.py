@@ -66,11 +66,132 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
     )
 
 
+def _kube_config(args):
+    """Resolve API-server connection: explicit flags > kubeconfig file >
+    in-cluster service account > default kubeconfig (the GetConfigOrDie
+    resolution order, pkg/yoda/scheduler.go:58)."""
+    from kubernetes_scheduler_tpu.kube import KubeConfig
+
+    if args.kube_server:
+        # token_path (not a one-shot read): survives kubelet rotation of
+        # projected service-account tokens
+        return KubeConfig(
+            base_url=args.kube_server,
+            token_path=args.kube_token_file,
+            ca_path=args.kube_ca,
+            insecure=args.kube_insecure,
+            namespace=args.kube_namespace or "default",
+        )
+    if args.kubeconfig:
+        return KubeConfig.from_kubeconfig(args.kubeconfig)
+    try:
+        return KubeConfig.in_cluster()
+    except (RuntimeError, FileNotFoundError):
+        return KubeConfig.from_kubeconfig()
+
+
+def cmd_scheduler_kube(args, cfg) -> int:
+    """Live-cluster mode: list/watch via the API server, bind via the
+    Binding subresource, leader-elect on the cluster Lease."""
+    from kubernetes_scheduler_tpu.host.advisor import PrometheusAdvisor
+    from kubernetes_scheduler_tpu.host.leader import LeaderElector
+    from kubernetes_scheduler_tpu.host.scheduler import Scheduler
+    from kubernetes_scheduler_tpu.kube import (
+        KubeBinder,
+        KubeClient,
+        KubeClusterSource,
+        KubeLease,
+    )
+    from kubernetes_scheduler_tpu.kube.source import InformerCache, run_kube_loop
+
+    client = KubeClient(_kube_config(args))
+    # informer-style cache: nodes + assigned pods maintained by watch
+    # threads, so cycles read local state instead of re-listing the
+    # cluster each time (the upstream snapshot-from-informers pattern)
+    cache = InformerCache(client, watch_timeout=args.watch_timeout).start()
+    if not cache.wait_synced(timeout=60.0):
+        log.error("informer cache failed to sync within 60s")
+        return 1
+    source = KubeClusterSource(
+        client,
+        scheduler_name=cfg.scheduler_name,
+        namespace=args.kube_namespace,
+        cache=cache,
+    )
+    engine = None
+    if args.engine and args.engine != "local":
+        from kubernetes_scheduler_tpu.bridge.client import RemoteEngine
+
+        engine = RemoteEngine(args.engine)
+    sched = Scheduler(
+        cfg,
+        advisor=PrometheusAdvisor(cfg.advisor.prometheus_host),
+        binder=KubeBinder(client, cache=cache),
+        list_nodes=source.list_nodes,
+        list_running_pods=source.list_running_pods,
+        engine=engine,
+    )
+    # exporter FIRST: a standby replica blocks in acquire_blocking below,
+    # and it must serve /healthz + /metrics for its whole standby life
+    # (the deploy manifest's readinessProbe) — upstream kube-scheduler
+    # serves healthz while passive too
+    exporter = None
+    if args.metrics_port:
+        from kubernetes_scheduler_tpu.host.observe import MetricsExporter
+
+        exporter = MetricsExporter(sched)
+        exporter.serve(args.metrics_port)
+    elector = None
+    if args.lease_kube or args.lease:
+        if args.lease_kube:
+            lease = KubeLease(client, name=f"{cfg.scheduler_name}-scheduler")
+        else:
+            # --lease (file) stays honored under --source=kube: silently
+            # ignoring it would run an HA pair with NO leader election
+            from kubernetes_scheduler_tpu.host.leader import FileLease
+
+            lease = FileLease(args.lease)
+        elector = LeaderElector(lease, identity=args.lease_identity)
+        log.info("waiting for leadership")
+        elector.acquire_blocking()
+    try:
+        cycles = run_kube_loop(
+            sched,
+            source,
+            max_cycles=None if args.serve_forever else args.max_cycles,
+            elector=elector,
+            exit_when_idle=not args.serve_forever,
+            watch_timeout=args.watch_timeout,
+        )
+    except KeyboardInterrupt:
+        cycles = sched.totals["cycles"]
+    finally:
+        cache.stop()
+        if elector is not None:
+            elector.release()
+        if exporter is not None:
+            exporter.close()
+    # totals, not the (bounded) metrics window: run-lifetime counts
+    print(
+        json.dumps(
+            {
+                "cycles": cycles,
+                "pods_bound": sched.totals["pods_bound"],
+                "pods_unschedulable": sched.totals["pods_unschedulable"],
+                "pods_dropped": sched.totals["pods_dropped"],
+            }
+        )
+    )
+    return 0
+
+
 def cmd_scheduler(args) -> int:
     from kubernetes_scheduler_tpu.host.scheduler import Scheduler
     from kubernetes_scheduler_tpu.sim.host_gen import gen_host_cluster, gen_host_pods
 
     cfg = _load_config(args)
+    if args.source == "kube":
+        return cmd_scheduler_kube(args, cfg)
     nodes, advisor = gen_host_cluster(
         args.nodes, seed=args.seed, gpu=args.gpu, constraints=args.constraints
     )
@@ -202,7 +323,33 @@ def build_parser() -> argparse.ArgumentParser:
         default="local",
         help='"local" (in-process) or a gRPC target like "localhost:50051"',
     )
+    ps.add_argument(
+        "--source",
+        choices=("sim", "kube"),
+        default="sim",
+        help='"sim" (generated cluster) or "kube" (live API server)',
+    )
+    ps.add_argument("--kubeconfig", help="kubeconfig path for --source kube")
+    ps.add_argument("--kube-server", help="API server URL (overrides kubeconfig)")
+    ps.add_argument("--kube-token-file", help="bearer token file for --kube-server")
+    ps.add_argument("--kube-ca", help="CA bundle for --kube-server")
+    ps.add_argument("--kube-insecure", action="store_true")
+    ps.add_argument(
+        "--kube-namespace",
+        help="schedule only this namespace (default: all)",
+    )
+    ps.add_argument(
+        "--watch-timeout",
+        type=float,
+        default=30.0,
+        help="seconds per bounded pending-pod watch stream",
+    )
     ps.add_argument("--lease", help="leader-election lease file path")
+    ps.add_argument(
+        "--lease-kube",
+        action="store_true",
+        help="leader-elect on the cluster coordination.k8s.io Lease",
+    )
     ps.add_argument("--lease-identity", default=None)
     ps.add_argument("--metrics-port", type=int, default=0)
     ps.add_argument("--serve-forever", action="store_true")

@@ -5,10 +5,12 @@ The reference gets HA from upstream kube-scheduler's lease leader election
 "endpointsleases" in kube-system). This module provides the same
 active/passive failover contract with a pluggable lease backend:
 
-- `FileLease` — a shared-filesystem lease for simulation, tests, and
-  single-host pod pairs (atomic claim via O_EXCL + fsync'd renew records).
-- a Kubernetes coordination.k8s.io/Lease backend slots in behind the same
-  `Lease` protocol where a cluster client is available.
+- `FileLease` (here) — a shared-filesystem lease for simulation, tests,
+  and single-host pod pairs (atomic claim via O_EXCL + fsync'd renew
+  records).
+- `kube.lease.KubeLease` — the Kubernetes coordination.k8s.io/v1 backend
+  behind the same `Lease` protocol (resourceVersion CAS on the cluster
+  Lease object), selected with `--lease-kube`.
 
 Semantics mirror k8s.io/client-go leaderelection: a lease carries (holder
 identity, acquire time, renew time, duration); a candidate acquires when
@@ -134,11 +136,21 @@ class LeaderElector:
         identity: str | None = None,
         lease_duration: float = 15.0,
         retry_period: float = 2.0,
+        renew_deadline: float | None = None,
     ):
         self.lease = lease
         self.identity = identity or f"{os.uname().nodename}-{os.getpid()}"
         self.lease_duration = lease_duration
         self.retry_period = retry_period
+        # client-go keeps renewDeadline (10s) strictly below leaseDuration
+        # (15s): the holder declares itself non-leader BEFORE the instant
+        # a standby may steal the expired lease, so there is no
+        # dual-leader window. Default 2/3; the clamp below holds for
+        # explicit values too — a deadline >= the lease duration would
+        # reopen the window the deadline exists to close.
+        if renew_deadline is None:
+            renew_deadline = max(lease_duration * (2.0 / 3.0), retry_period * 1.5)
+        self.renew_deadline = min(renew_deadline, lease_duration * 0.9)
         self._leading = threading.Event()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -163,13 +175,25 @@ class LeaderElector:
         )
         return self.lease.try_claim(record, current)
 
+    def _try_acquire_safe(self) -> bool:
+        """Acquire/renew attempt that treats backend errors as failure.
+
+        Network-backed leases (kube.lease.KubeLease) can raise on a
+        transient API outage; an exception must not kill the renew thread
+        while is_leader() still reads True (silent split-brain)."""
+        try:
+            return self._try_acquire_once()
+        except Exception as e:
+            log.warning("lease backend error (%s): %s", self.identity, e)
+            return False
+
     def acquire_blocking(self, timeout: float | None = None) -> bool:
         deadline = None if timeout is None else time.monotonic() + timeout
         while not self._stop.is_set():
-            if self._try_acquire_once():
+            if self._try_acquire_safe():
                 self._leading.set()
                 log.info("acquired leadership as %s", self.identity)
-                self._thread = threading.Thread(target=self._renew_loop, daemon=True)
+                self._thread = threading.Thread(target=self._run_loop, daemon=True)
                 self._thread.start()
                 return True
             if deadline is not None and time.monotonic() > deadline:
@@ -177,12 +201,32 @@ class LeaderElector:
             time.sleep(self.retry_period)
         return False
 
-    def _renew_loop(self) -> None:
+    def _run_loop(self) -> None:
+        """Renew while leading; on loss, keep trying to re-acquire.
+
+        Loss is TIME-based, like client-go: one failed renew (a transient
+        API hiccup) keeps leadership until `renew_deadline` — strictly
+        shorter than the lease duration, so this holder stops scheduling
+        before the instant a standby may steal the expired lease (no
+        dual-leader window). The loop then stays in candidate mode so a
+        recovered replica resumes scheduling without a process restart
+        (the caller's loop pauses on is_leader()==False rather than
+        exiting)."""
+        # monotonic: the deadline measures LOCAL elapsed time since the
+        # last successful renew; wall-clock (time.time) would stretch the
+        # window across an NTP step-back, reopening the dual-leader gap
+        last_renew = time.monotonic()
         while not self._stop.wait(self.retry_period):
-            if not self._try_acquire_once():
+            if self._try_acquire_safe():
+                last_renew = time.monotonic()
+                if not self._leading.is_set():
+                    log.info("re-acquired leadership as %s", self.identity)
+                    self._leading.set()
+            elif self._leading.is_set() and (
+                time.monotonic() - last_renew > self.renew_deadline
+            ):
                 log.warning("lost leadership (%s)", self.identity)
                 self._leading.clear()
-                return
 
     def release(self) -> None:
         self._stop.set()

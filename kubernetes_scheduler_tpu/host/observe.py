@@ -37,18 +37,38 @@ def _quantile(sorted_vals: list[float], q: float) -> float:
     return sorted_vals[i]
 
 
-def summarize(metrics) -> dict:
-    """Aggregate a list of host.scheduler.CycleMetrics."""
+def summarize(metrics, totals: dict | None = None) -> dict:
+    """Aggregate host.scheduler.CycleMetrics.
+
+    `totals` (Scheduler.totals) supplies the monotonic run counters when
+    given; the metrics window is a bounded deque, so summing it would
+    make the *_total Prometheus counters decrease after eviction (every
+    decrease reads as a counter reset to rate()/increase()). Quantiles
+    and rates always come from the recent window — that is what a
+    latency percentile should mean on a long-lived process anyway."""
     cycles = [m for m in metrics if m.pods_in > 0]
     lat = sorted(m.cycle_seconds for m in cycles)
     eng = sorted(m.engine_seconds for m in cycles if m.engine_seconds > 0)
     total_s = sum(lat)
     bound = sum(m.pods_bound for m in cycles)
+    if totals is None:
+        totals = {
+            "cycles": len(cycles),
+            "pods_bound": bound,
+            "pods_unschedulable": sum(m.pods_unschedulable for m in cycles),
+            "pods_dropped": sum(m.pods_dropped for m in cycles),
+            "fallback_cycles": sum(1 for m in cycles if m.used_fallback),
+            "fetch_failures": sum(
+                1 for m in cycles if getattr(m, "fetch_failed", False)
+            ),
+        }
     return {
-        "cycles_total": len(cycles),
-        "pods_bound_total": bound,
-        "pods_unschedulable_total": sum(m.pods_unschedulable for m in cycles),
-        "fallback_cycles_total": sum(1 for m in cycles if m.used_fallback),
+        "cycles_total": totals["cycles"],
+        "pods_bound_total": totals["pods_bound"],
+        "pods_unschedulable_total": totals["pods_unschedulable"],
+        "pods_dropped_total": totals.get("pods_dropped", 0),
+        "fallback_cycles_total": totals["fallback_cycles"],
+        "fetch_failures_total": totals.get("fetch_failures", 0),
         "scheduling_pods_per_sec": bound / total_s if total_s > 0 else 0.0,
         "bind_latency_p50_seconds": _quantile(lat, 0.50),
         "bind_latency_p99_seconds": _quantile(lat, 0.99),
@@ -64,7 +84,9 @@ _HELP = {
     "cycles_total": "Scheduling cycles with at least one pending pod",
     "pods_bound_total": "Pods bound to nodes",
     "pods_unschedulable_total": "Pod placements rejected (requeued with backoff)",
+    "pods_dropped_total": "Pods forgotten after a bind-time lifecycle race (404/409)",
     "fallback_cycles_total": "Cycles served by the scalar fallback path",
+    "fetch_failures_total": "Cycles aborted by a cluster-source/advisor fetch failure (window requeued)",
     "scheduling_pods_per_sec": "Bound pods per second of cycle time",
     "bind_latency_p50_seconds": "Median end-to-end cycle latency",
     "bind_latency_p99_seconds": "p99 end-to-end cycle latency",
@@ -74,9 +96,9 @@ _HELP = {
 }
 
 
-def render_prometheus(metrics) -> str:
+def render_prometheus(metrics, totals: dict | None = None) -> str:
     out = []
-    for key, value in summarize(metrics).items():
+    for key, value in summarize(metrics, totals).items():
         name = f"{PREFIX}_{key}"
         kind = "counter" if key.endswith("_total") else "gauge"
         out.append(f"# HELP {name} {_HELP[key]}")
@@ -99,7 +121,12 @@ class MetricsExporter:
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):
                 if self.path == "/metrics":
-                    body = render_prometheus(exporter.scheduler.metrics).encode()
+                    sched = exporter.scheduler
+                    if hasattr(sched, "metrics_snapshot"):
+                        window, totals = sched.metrics_snapshot()
+                    else:
+                        window, totals = list(sched.metrics), None
+                    body = render_prometheus(window, totals).encode()
                     ctype = "text/plain; version=0.0.4"
                 elif self.path == "/healthz":
                     body, ctype = b"ok\n", "text/plain"

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import heapq
 import itertools
+import threading
 import time
 from dataclasses import dataclass, field
 
@@ -34,6 +35,11 @@ class _Entry:
 
 
 class SchedulingQueue:
+    """Thread-safe: the live-cluster loop (kube/source.run_kube_loop)
+    feeds submissions from a watch thread while the scheduling thread
+    pops windows — the same producer/consumer split as the upstream
+    scheduling queue."""
+
     def __init__(
         self,
         *,
@@ -48,42 +54,50 @@ class SchedulingQueue:
         self.initial_backoff = initial_backoff
         self.max_backoff = max_backoff
         self._clock = clock
+        self._lock = threading.RLock()
 
     def _key(self, pod: Pod) -> tuple:
         return (-pod_priority(pod), next(self._seq))
 
     def push(self, pod: Pod) -> None:
-        heapq.heappush(self._active, _Entry(self._key(pod), pod))
+        with self._lock:
+            heapq.heappush(self._active, _Entry(self._key(pod), pod))
 
     def requeue_unschedulable(self, pod: Pod) -> None:
         """Failed cycle -> backoff queue with exponential delay."""
-        uid = f"{pod.namespace}/{pod.name}"
-        attempt = self._attempts.get(uid, 0) + 1
-        self._attempts[uid] = attempt
-        delay = min(self.initial_backoff * 2 ** (attempt - 1), self.max_backoff)
-        heapq.heappush(
-            self._backoff, (self._clock() + delay, next(self._seq), pod)
-        )
+        with self._lock:
+            uid = f"{pod.namespace}/{pod.name}"
+            attempt = self._attempts.get(uid, 0) + 1
+            self._attempts[uid] = attempt
+            delay = min(
+                self.initial_backoff * 2 ** (attempt - 1), self.max_backoff
+            )
+            heapq.heappush(
+                self._backoff, (self._clock() + delay, next(self._seq), pod)
+            )
 
     def mark_scheduled(self, pod: Pod) -> None:
-        self._attempts.pop(f"{pod.namespace}/{pod.name}", None)
+        with self._lock:
+            self._attempts.pop(f"{pod.namespace}/{pod.name}", None)
 
     def _drain_backoff(self) -> None:
         now = self._clock()
         while self._backoff and self._backoff[0][0] <= now:
             _, _, pod = heapq.heappop(self._backoff)
-            self.push(pod)
+            heapq.heappush(self._active, _Entry(self._key(pod), pod))
 
     def pop_window(self, max_pods: int) -> list[Pod]:
         """Highest-priority window of pending pods for one engine cycle."""
-        self._drain_backoff()
-        out = []
-        while self._active and len(out) < max_pods:
-            out.append(heapq.heappop(self._active).pod)
-        return out
+        with self._lock:
+            self._drain_backoff()
+            out = []
+            while self._active and len(out) < max_pods:
+                out.append(heapq.heappop(self._active).pod)
+            return out
 
     def __len__(self) -> int:
-        return len(self._active) + len(self._backoff)
+        with self._lock:
+            return len(self._active) + len(self._backoff)
 
 
 class NativeBackedQueue:
@@ -115,6 +129,9 @@ class NativeBackedQueue:
         # be dropped once no copy is queued AND the pod is done (so a uid
         # pushed twice survives the first copy's mark_scheduled)
         self._outstanding: dict[int, int] = {}
+        # same producer/consumer contract as SchedulingQueue; the lock
+        # also serializes entry to the (single-threaded) C++ queue
+        self._lock = threading.RLock()
 
     def _handle(self, pod: Pod) -> int:
         uid = f"{pod.namespace}/{pod.name}"
@@ -133,35 +150,40 @@ class NativeBackedQueue:
                 self._by_uid.pop(f"{pod.namespace}/{pod.name}", None)
 
     def push(self, pod: Pod) -> None:
-        h = self._handle(pod)
-        self._outstanding[h] = self._outstanding.get(h, 0) + 1
-        self._q.push(h, pod_priority(pod))
+        with self._lock:
+            h = self._handle(pod)
+            self._outstanding[h] = self._outstanding.get(h, 0) + 1
+            self._q.push(h, pod_priority(pod))
 
     def requeue_unschedulable(self, pod: Pod) -> None:
-        h = self._handle(pod)
-        self._outstanding[h] = self._outstanding.get(h, 0) + 1
-        self._q.requeue_unschedulable(h, pod_priority(pod), self._clock())
+        with self._lock:
+            h = self._handle(pod)
+            self._outstanding[h] = self._outstanding.get(h, 0) + 1
+            self._q.requeue_unschedulable(h, pod_priority(pod), self._clock())
 
     def mark_scheduled(self, pod: Pod) -> None:
-        uid = f"{pod.namespace}/{pod.name}"
-        h = self._by_uid.get(uid)
-        if h is not None:
-            self._q.mark_scheduled(h)
-            self._drop_if_done(h)
+        with self._lock:
+            uid = f"{pod.namespace}/{pod.name}"
+            h = self._by_uid.get(uid)
+            if h is not None:
+                self._q.mark_scheduled(h)
+                self._drop_if_done(h)
 
     def pop_window(self, max_pods: int) -> list[Pod]:
-        handles = self._q.pop_window(max_pods, self._clock())
-        out = []
-        for h in handles:
-            h = int(h)
-            pod = self._pods.get(h)
-            self._outstanding[h] = self._outstanding.get(h, 1) - 1
-            if pod is not None:
-                out.append(pod)
-        return out
+        with self._lock:
+            handles = self._q.pop_window(max_pods, self._clock())
+            out = []
+            for h in handles:
+                h = int(h)
+                pod = self._pods.get(h)
+                self._outstanding[h] = self._outstanding.get(h, 1) - 1
+                if pod is not None:
+                    out.append(pod)
+            return out
 
     def __len__(self) -> int:
-        return len(self._q)
+        with self._lock:
+            return len(self._q)
 
 
 def make_queue(

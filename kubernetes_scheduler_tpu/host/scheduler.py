@@ -17,6 +17,7 @@ failure flips one cycle to scalar rather than stalling scheduling.
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Callable
@@ -60,9 +61,17 @@ class CycleMetrics:
     pods_in: int = 0
     pods_bound: int = 0
     pods_unschedulable: int = 0
+    # pods forgotten after a bind-time lifecycle race (deleted -> 404,
+    # bound by a racer -> 409) — routine churn, NOT scheduling failures,
+    # so they get their own counter and never pollute pods_unschedulable
+    pods_dropped: int = 0
     cycle_seconds: float = 0.0
     engine_seconds: float = 0.0
     used_fallback: bool = False
+    # cluster-source/advisor fetch failed; window requeued, nothing ran.
+    # Distinct from used_fallback so an advisor outage cannot masquerade
+    # as scalar-fallback (TPU-path) degradation on dashboards
+    fetch_failed: bool = False
 
 
 class Scheduler:
@@ -134,7 +143,40 @@ class Scheduler:
         self.builder = SnapshotBuilder(
             extended_resources=list(config.extended_resources)
         )
-        self.metrics: list[CycleMetrics] = []
+        # bounded: a long-lived process keeps the last window of cycle
+        # metrics (latency quantiles), while monotonic run totals live in
+        # self.totals — Prometheus counters must never decrease, and the
+        # rolling window alone would make them sawtooth after eviction
+        from collections import deque
+
+        self.metrics: deque[CycleMetrics] = deque(maxlen=8192)
+        self.totals = {
+            "cycles": 0,
+            "pods_bound": 0,
+            "pods_unschedulable": 0,
+            "pods_dropped": 0,
+            "fallback_cycles": 0,
+            "fetch_failures": 0,
+        }
+        # appends/reads cross threads (scheduling loop vs /metrics scrape;
+        # deque raises on mutation during iteration, unlike list)
+        self._metrics_lock = threading.Lock()
+
+    def _record(self, m: CycleMetrics) -> None:
+        with self._metrics_lock:
+            self.metrics.append(m)
+            self.totals["cycles"] += 1
+            self.totals["pods_bound"] += m.pods_bound
+            self.totals["pods_unschedulable"] += m.pods_unschedulable
+            self.totals["pods_dropped"] += m.pods_dropped
+            self.totals["fallback_cycles"] += int(m.used_fallback)
+            self.totals["fetch_failures"] += int(m.fetch_failed)
+
+    def metrics_snapshot(self) -> tuple[list[CycleMetrics], dict]:
+        """Point-in-time copy for exporters (safe against the scheduling
+        thread appending mid-iteration)."""
+        with self._metrics_lock:
+            return list(self.metrics), dict(self.totals)
 
     def submit(self, pod: Pod) -> None:
         self.queue.push(pod)
@@ -147,13 +189,30 @@ class Scheduler:
         window = self.queue.pop_window(self.config.batch_window)
         m.pods_in = len(window)
         if not window:
+            # empty cycles (backoff waits, idle polls) are not recorded:
+            # a serve-forever loop would otherwise grow self.metrics
+            # without bound on pure idle time
             m.cycle_seconds = time.perf_counter() - t0
-            self.metrics.append(m)
             return m
 
-        nodes = self.list_nodes()
-        running = self.list_running_pods()
-        utils = self.advisor.fetch()
+        try:
+            nodes = self.list_nodes()
+            running = self.list_running_pods()
+            utils = self.advisor.fetch()
+        except Exception:
+            # a cluster-source or advisor outage (API server blip,
+            # Prometheus restart) must not LOSE the popped window: requeue
+            # it with backoff and surface a failed, fallback-marked cycle
+            # (the reference's PreScore error path makes pods retriable
+            # the same way, scheduler.go:106-109)
+            log.exception("cycle state fetch failed; requeueing window")
+            for pod in window:
+                self.queue.requeue_unschedulable(pod)
+            m.pods_unschedulable = len(window)
+            m.fetch_failed = True
+            m.cycle_seconds = time.perf_counter() - t0
+            self._record(m)
+            return m
 
         # adaptive dispatch: tiny cycles are device-latency-bound; the
         # scalar host path (C++ when native) wins below min_device_work.
@@ -182,7 +241,7 @@ class Scheduler:
             self._run_scalar(window, nodes, utils, m)
 
         m.cycle_seconds = time.perf_counter() - t0
-        self.metrics.append(m)
+        self._record(m)
         return m
 
     @staticmethod
@@ -209,6 +268,33 @@ class Scheduler:
         if any(pod.pod_affinity for pod in running):
             return False
         return True
+
+    def _bind(self, pod, node_name: str, m: CycleMetrics) -> None:
+        """Bind with upstream error semantics: a 404/409 from the API
+        server means the pod is gone or already bound (routine lifecycle
+        races) — forget it; any other bind failure requeues with backoff.
+        A binder error must never escape the cycle (it would kill the
+        serve-forever loop on one racing pod)."""
+        try:
+            self.binder.bind(pod, node_name)
+        except Exception as e:
+            status = getattr(e, "status", None)
+            if status in (404, 409):
+                log.warning(
+                    "bind %s -> %s rejected (HTTP %s); dropping pod",
+                    pod.name, node_name, status,
+                )
+                self.queue.mark_scheduled(pod)
+                m.pods_dropped += 1
+            else:
+                log.warning(
+                    "bind %s -> %s failed (%s); requeueing", pod.name, node_name, e
+                )
+                self.queue.requeue_unschedulable(pod)
+                m.pods_unschedulable += 1
+            return
+        self.queue.mark_scheduled(pod)
+        m.pods_bound += 1
 
     def _run_batched(self, window, nodes, running, utils, m: CycleMetrics):
         # snapshot FIRST: build_snapshot registers every selector the cycle
@@ -286,9 +372,7 @@ class Scheduler:
         for i, pod in enumerate(window):
             j = int(idx[i])
             if j >= 0:
-                self.binder.bind(pod, nodes[j].name)
-                self.queue.mark_scheduled(pod)
-                m.pods_bound += 1
+                self._bind(pod, nodes[j].name, m)
             else:
                 self.queue.requeue_unschedulable(pod)
                 m.pods_unschedulable += 1
@@ -312,9 +396,7 @@ class Scheduler:
             plugin.cache.flush()
             best = scalar_schedule_one(plugin, pod, nodes, free) if nodes else None
             if best is not None:
-                self.binder.bind(pod, best)
-                self.queue.mark_scheduled(pod)
-                m.pods_bound += 1
+                self._bind(pod, best, m)
             else:
                 self.queue.requeue_unschedulable(pod)
                 m.pods_unschedulable += 1
@@ -351,9 +433,7 @@ class Scheduler:
         for i, pod in enumerate(window):
             j = int(idx[i])
             if j >= 0:
-                self.binder.bind(pod, nodes[j].name)
-                self.queue.mark_scheduled(pod)
-                m.pods_bound += 1
+                self._bind(pod, nodes[j].name, m)
             else:
                 self.queue.requeue_unschedulable(pod)
                 m.pods_unschedulable += 1

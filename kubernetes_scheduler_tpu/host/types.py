@@ -96,6 +96,9 @@ class WeightedExpression:
 class Pod:
     name: str
     namespace: str = "default"
+    # metadata.uid: the identity that survives delete-and-recreate under
+    # the same name; None for simulated pods (falls back to ns/name)
+    uid: str | None = None
     labels: dict[str, str] = field(default_factory=dict)
     annotations: dict[str, str] = field(default_factory=dict)
     containers: list[Container] = field(default_factory=list)

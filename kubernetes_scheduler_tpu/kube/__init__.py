@@ -1,0 +1,28 @@
+"""Kubernetes API boundary: the live-cluster half of the host.
+
+The reference is a drop-in scheduler for a real cluster: it embeds the
+upstream kube-scheduler binary (cmd/scheduler/main.go:12-21), talks to the
+API server via client-go with QPS/Burst 1000 (pkg/yoda/scheduler.go:58-60),
+and ships RBAC for nodes/pods/bindings/leases
+(deploy/yoda-scheduler.yaml:91-251). This package is that boundary rebuilt
+on the stdlib (no client-go, no vendored client): a rate-limited REST
+client, list/watch cluster sources feeding host.Scheduler's injectable
+callables, a Binding POST binder, and a coordination.k8s.io/v1 Lease
+backend for host.leader.LeaderElector.
+"""
+
+from kubernetes_scheduler_tpu.kube.client import KubeApiError, KubeClient, KubeConfig
+from kubernetes_scheduler_tpu.kube.convert import node_from_api, pod_from_api
+from kubernetes_scheduler_tpu.kube.source import KubeBinder, KubeClusterSource
+from kubernetes_scheduler_tpu.kube.lease import KubeLease
+
+__all__ = [
+    "KubeApiError",
+    "KubeBinder",
+    "KubeClient",
+    "KubeClusterSource",
+    "KubeConfig",
+    "KubeLease",
+    "node_from_api",
+    "pod_from_api",
+]

@@ -1,0 +1,204 @@
+"""v1.Pod / v1.Node JSON -> host types.
+
+The reference consumes these through client-go structs and schedutil
+(CalculatePodResourceRequest, score/algorithm.go:238-262 reads
+container/initContainer requests + overhead); here the same fields are
+extracted from raw API JSON into host.types objects, with quantities
+canonicalized the way the snapshot builder expects (cpu in millicores,
+memory/storage in bytes, counts as floats).
+
+Documented simplifications (each is a capability note, not an accident):
+- node-affinity `nodeSelectorTerms` are OR-of-ANDs upstream; the host
+  model is a single AND list, so the FIRST term's expressions are taken
+  (plus `nodeSelector`, which upstream also ANDs in).
+- pod-(anti)affinity label selectors support matchLabels (the form the
+  SCV-era workloads use); matchExpressions on pod selectors are skipped.
+- GPU cards come from the SCV CRD in the reference (filter.go:8); the
+  core API carries no card inventory, so nodes converted here have no
+  cards unless an SCV-style annotation ("scv/cards": JSON list) is set.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+
+from kubernetes_scheduler_tpu.host.types import (
+    Card,
+    Container,
+    MatchExpression,
+    Node,
+    Pod,
+    PodAffinityTerm,
+    SpreadConstraint,
+    Taint,
+    Toleration,
+    WeightedExpression,
+    parse_cpu_milli,
+    parse_quantity,
+)
+
+log = logging.getLogger("yoda_tpu.kube")
+
+BYTES_RESOURCES = ("memory", "ephemeral-storage", "storage")
+
+
+def _requests(resources: dict | None) -> dict[str, float]:
+    reqs = (resources or {}).get("requests") or {}
+    out: dict[str, float] = {}
+    for name, q in reqs.items():
+        if name == "cpu":
+            out[name] = parse_cpu_milli(q)
+        else:
+            out[name] = parse_quantity(q)
+    return out
+
+
+def _container(c: dict) -> Container:
+    return Container(requests=_requests(c.get("resources")))
+
+
+def _match_expr(e: dict) -> MatchExpression:
+    return MatchExpression(
+        key=e["key"], operator=e["operator"], values=list(e.get("values") or [])
+    )
+
+
+def _pod_affinity_terms(spec: dict, *, anti: bool) -> list[PodAffinityTerm]:
+    sect = (spec.get("affinity") or {}).get(
+        "podAntiAffinity" if anti else "podAffinity"
+    ) or {}
+    out: list[PodAffinityTerm] = []
+    for term in sect.get("requiredDuringSchedulingIgnoredDuringExecution") or []:
+        labels = (term.get("labelSelector") or {}).get("matchLabels") or {}
+        if labels:
+            out.append(
+                PodAffinityTerm(
+                    match_labels=dict(labels),
+                    topology_key=term.get("topologyKey", "kubernetes.io/hostname"),
+                    anti=anti,
+                )
+            )
+    for wt in sect.get("preferredDuringSchedulingIgnoredDuringExecution") or []:
+        term = wt.get("podAffinityTerm") or {}
+        labels = (term.get("labelSelector") or {}).get("matchLabels") or {}
+        if labels:
+            out.append(
+                PodAffinityTerm(
+                    match_labels=dict(labels),
+                    topology_key=term.get("topologyKey", "kubernetes.io/hostname"),
+                    anti=anti,
+                    preferred=True,
+                    weight=int(wt.get("weight", 1)),
+                )
+            )
+    return out
+
+
+def pod_from_api(obj: dict) -> Pod:
+    meta = obj.get("metadata") or {}
+    spec = obj.get("spec") or {}
+    node_aff = (spec.get("affinity") or {}).get("nodeAffinity") or {}
+    required: list[MatchExpression] = [
+        MatchExpression(key=k, operator="In", values=[v])
+        for k, v in (spec.get("nodeSelector") or {}).items()
+    ]
+    terms = (
+        node_aff.get("requiredDuringSchedulingIgnoredDuringExecution") or {}
+    ).get("nodeSelectorTerms") or []
+    if terms:
+        required.extend(_match_expr(e) for e in terms[0].get("matchExpressions") or [])
+        if len(terms) > 1:
+            log.debug(
+                "pod %s: %d nodeSelectorTerms; only the first is enforced",
+                meta.get("name"), len(terms),
+            )
+    preferred = [
+        WeightedExpression(expr=_match_expr(e), weight=int(wt.get("weight", 1)))
+        for wt in node_aff.get("preferredDuringSchedulingIgnoredDuringExecution") or []
+        for e in (wt.get("preference") or {}).get("matchExpressions") or []
+    ]
+    spread = [
+        SpreadConstraint(
+            match_labels=dict(
+                (c.get("labelSelector") or {}).get("matchLabels") or {}
+            ),
+            topology_key=c.get("topologyKey", "kubernetes.io/hostname"),
+            max_skew=int(c.get("maxSkew", 1)),
+        )
+        for c in spec.get("topologySpreadConstraints") or []
+        if c.get("whenUnsatisfiable", "DoNotSchedule") == "DoNotSchedule"
+        and (c.get("labelSelector") or {}).get("matchLabels")
+    ]
+    host_ports = [
+        int(p["hostPort"])
+        for c in spec.get("containers") or []
+        for p in c.get("ports") or []
+        if p.get("hostPort")
+    ]
+    node_name = spec.get("nodeName") or None
+    phase = (obj.get("status") or {}).get("phase", "")
+    return Pod(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default"),
+        uid=meta.get("uid"),
+        labels=dict(meta.get("labels") or {}),
+        annotations=dict(meta.get("annotations") or {}),
+        containers=[_container(c) for c in spec.get("containers") or []],
+        init_containers=[_container(c) for c in spec.get("initContainers") or []],
+        overhead=_requests({"requests": spec.get("overhead") or {}}),
+        tolerations=[
+            Toleration(
+                key=t.get("key"),
+                value=t.get("value", ""),
+                operator=t.get("operator", "Equal"),
+                effect=t.get("effect", ""),
+            )
+            for t in spec.get("tolerations") or []
+        ],
+        node_affinity=required,
+        pod_affinity=(
+            _pod_affinity_terms(spec, anti=False)
+            + _pod_affinity_terms(spec, anti=True)
+        ),
+        preferred_node_affinity=preferred,
+        topology_spread=spread,
+        # a PENDING pod carrying spec.nodeName is pinned (upstream
+        # NodeName filter); once running the same field records placement
+        target_node=node_name if phase in ("", "Pending") else None,
+        host_ports=host_ports,
+        node_name=node_name,
+        scheduler_name=spec.get("schedulerName", "default-scheduler"),
+    )
+
+
+def node_from_api(obj: dict) -> Node:
+    meta = obj.get("metadata") or {}
+    spec = obj.get("spec") or {}
+    status = obj.get("status") or {}
+    allocatable: dict[str, float] = {}
+    for name, q in (status.get("allocatable") or {}).items():
+        allocatable[name] = (
+            parse_cpu_milli(q) if name == "cpu" else parse_quantity(q)
+        )
+    cards: list[Card] = []
+    raw = (meta.get("annotations") or {}).get("scv/cards")
+    if raw:
+        try:
+            cards = [Card(**c) for c in json.loads(raw)]
+        except (json.JSONDecodeError, TypeError) as e:
+            log.warning("node %s: bad scv/cards annotation: %s", meta.get("name"), e)
+    return Node(
+        name=meta.get("name", ""),
+        labels=dict(meta.get("labels") or {}),
+        taints=[
+            Taint(
+                key=t["key"],
+                value=t.get("value", ""),
+                effect=t.get("effect", "NoSchedule"),
+            )
+            for t in spec.get("taints") or []
+        ],
+        allocatable=allocatable,
+        cards=cards,
+    )

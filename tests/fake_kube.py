@@ -1,0 +1,326 @@
+"""An httptest-style fake Kubernetes API server (stdlib http.server).
+
+Implements just enough surface for the kube boundary tests: node/pod
+lists with fieldSelector filtering, a bounded pod watch stream, the
+Binding subresource POST, and coordination.k8s.io Leases with
+resourceVersion compare-and-swap (409 on stale writes) — the semantics
+KubeClient/KubeClusterSource/KubeBinder/KubeLease rely on.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+_BIND_RE = re.compile(r"^/api/v1/namespaces/([^/]+)/pods/([^/]+)/binding$")
+_LEASE_RE = re.compile(
+    r"^/apis/coordination\.k8s\.io/v1/namespaces/([^/]+)/leases(?:/([^/]+))?$"
+)
+
+
+class FakeKube:
+    def __init__(self, *, token: str | None = None):
+        self.lock = threading.Lock()
+        self.nodes: list[dict] = []
+        self.pods: dict[str, dict] = {}     # "ns/name" -> pod object
+        self.leases: dict[str, dict] = {}   # "ns/name" -> lease object
+        self.bindings: list[tuple[str, str]] = []
+        # node -> {cpu_pct, mem_pct, disk_io, net_up, net_down}: served
+        # Prometheus-style from POST /api/v1/query so one fixture covers
+        # both the API server and the metrics endpoint
+        self.prom: dict[str, dict[str, float]] = {}
+        self.token = token
+        self._rv = 0
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), self._handler())
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "FakeKube":
+        self.thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+
+    @property
+    def url(self) -> str:
+        host, port = self.server.server_address
+        return f"http://{host}:{port}"
+
+    def next_rv(self) -> str:
+        self._rv += 1
+        return str(self._rv)
+
+    # -- state helpers ---------------------------------------------------
+
+    def add_node(self, obj: dict) -> None:
+        with self.lock:
+            self.nodes.append(obj)
+
+    def add_pod(self, obj: dict) -> None:
+        meta = obj.setdefault("metadata", {})
+        meta.setdefault("namespace", "default")
+        key = f"{meta['namespace']}/{meta['name']}"
+        with self.lock:
+            self.pods[key] = obj
+
+    # -- request handling ------------------------------------------------
+
+    def _match_field_selector(self, pod: dict, selector: str) -> bool:
+        spec = pod.get("spec") or {}
+        for clause in filter(None, selector.split(",")):
+            if "!=" in clause:
+                key, val = clause.split("!=", 1)
+                op = "ne"
+            else:
+                key, val = clause.split("=", 1)
+                op = "eq"
+            actual = {
+                "spec.nodeName": spec.get("nodeName") or "",
+                "spec.schedulerName": spec.get("schedulerName") or "",
+                "status.phase": (pod.get("status") or {}).get("phase") or "",
+            }.get(key, "")
+            if op == "eq" and actual != val:
+                return False
+            if op == "ne" and actual == val:
+                return False
+        return True
+
+    def _handler(self):
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code: int, obj: dict | None = None):
+                body = json.dumps(obj or {}).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _read_raw(self) -> bytes:
+                n = int(self.headers.get("Content-Length") or 0)
+                return self.rfile.read(n) if n else b""
+
+            def _read_body(self) -> dict:
+                raw = self._read_raw()
+                return json.loads(raw) if raw else {}
+
+            def _auth_ok(self) -> bool:
+                if fake.token is None:
+                    return True
+                return (
+                    self.headers.get("Authorization")
+                    == f"Bearer {fake.token}"
+                )
+
+            def do_GET(self):
+                if not self._auth_ok():
+                    return self._send(401, {"message": "unauthorized"})
+                parsed = urllib.parse.urlparse(self.path)
+                params = dict(urllib.parse.parse_qsl(parsed.query))
+                path = parsed.path
+                if path == "/api/v1/nodes":
+                    with fake.lock:
+                        return self._send(200, {"items": list(fake.nodes)})
+                m = _LEASE_RE.match(path)
+                if m and m.group(2):
+                    with fake.lock:
+                        obj = fake.leases.get(f"{m.group(1)}/{m.group(2)}")
+                    if obj is None:
+                        return self._send(404, {"message": "not found"})
+                    return self._send(200, obj)
+                if path == "/api/v1/pods" or re.match(
+                    r"^/api/v1/namespaces/[^/]+/pods$", path
+                ):
+                    ns = None
+                    if path != "/api/v1/pods":
+                        ns = path.split("/")[4]
+                    sel = params.get("fieldSelector", "")
+                    with fake.lock:
+                        items = [
+                            p
+                            for key, p in fake.pods.items()
+                            if (ns is None or key.startswith(ns + "/"))
+                            and fake._match_field_selector(p, sel)
+                        ]
+                    if params.get("watch") == "true":
+                        # bounded stream: one ADDED event per matching pod
+                        self.send_response(200)
+                        self.send_header("Content-Type", "application/json")
+                        self.end_headers()
+                        for p in items:
+                            line = json.dumps(
+                                {"type": "ADDED", "object": p}
+                            ).encode() + b"\n"
+                            self.wfile.write(line)
+                        return
+                    return self._send(200, {"items": items})
+                return self._send(404, {"message": f"no route {path}"})
+
+            def do_POST(self):
+                path = urllib.parse.urlparse(self.path).path
+                if path == "/api/v1/query":  # Prometheus, not k8s: no auth
+                    form = urllib.parse.parse_qs(
+                        self._read_raw().decode("utf-8", "replace")
+                    )
+                    query = (form.get("query") or [""])[0]
+                    series = {
+                        "cpu_usage": "cpu_pct",
+                        "MemTotal": "mem_pct",
+                        "node_disk": "disk_io",
+                        "transmit": "net_up",
+                        "receive": "net_down",
+                    }
+                    name = next(
+                        (v for k, v in series.items() if k in query), None
+                    )
+                    with fake.lock:
+                        result = [
+                            {
+                                "metric": {"kubernetes_io_hostname": node},
+                                "value": [0, str(vals.get(name, 0.0))],
+                            }
+                            for node, vals in fake.prom.items()
+                        ]
+                    return self._send(
+                        200, {"data": {"resultType": "vector", "result": result}}
+                    )
+                if not self._auth_ok():
+                    return self._send(401, {"message": "unauthorized"})
+                m = _BIND_RE.match(path)
+                if m:
+                    ns, name = m.group(1), m.group(2)
+                    body = self._read_body()
+                    target = (body.get("target") or {}).get("name")
+                    want_uid = (body.get("metadata") or {}).get("uid")
+                    with fake.lock:
+                        pod = fake.pods.get(f"{ns}/{name}")
+                        if pod is None:
+                            return self._send(404, {"message": "pod not found"})
+                        have_uid = (pod.get("metadata") or {}).get("uid")
+                        if want_uid and have_uid and want_uid != have_uid:
+                            # real API-server UID precondition: the name
+                            # now belongs to a different (recreated) pod
+                            return self._send(
+                                409, {"message": "uid precondition failed"}
+                            )
+                        if (pod.get("spec") or {}).get("nodeName"):
+                            return self._send(
+                                409, {"message": "pod already bound"}
+                            )
+                        pod.setdefault("spec", {})["nodeName"] = target
+                        fake.bindings.append((f"{ns}/{name}", target))
+                    return self._send(201, {"status": "Success"})
+                m = _LEASE_RE.match(path)
+                if m and not m.group(2):
+                    body = self._read_body()
+                    name = (body.get("metadata") or {}).get("name")
+                    key = f"{m.group(1)}/{name}"
+                    with fake.lock:
+                        if key in fake.leases:
+                            return self._send(409, {"message": "exists"})
+                        body.setdefault("metadata", {})[
+                            "resourceVersion"
+                        ] = fake.next_rv()
+                        fake.leases[key] = body
+                    return self._send(201, body)
+                return self._send(404, {"message": f"no route {path}"})
+
+            def do_PUT(self):
+                if not self._auth_ok():
+                    return self._send(401, {"message": "unauthorized"})
+                path = urllib.parse.urlparse(self.path).path
+                m = _LEASE_RE.match(path)
+                if m and m.group(2):
+                    key = f"{m.group(1)}/{m.group(2)}"
+                    body = self._read_body()
+                    sent_rv = (body.get("metadata") or {}).get("resourceVersion")
+                    with fake.lock:
+                        current = fake.leases.get(key)
+                        if current is None:
+                            return self._send(404, {"message": "not found"})
+                        cur_rv = (current.get("metadata") or {}).get(
+                            "resourceVersion"
+                        )
+                        if sent_rv != cur_rv:
+                            return self._send(409, {"message": "conflict"})
+                        body.setdefault("metadata", {})[
+                            "resourceVersion"
+                        ] = fake.next_rv()
+                        fake.leases[key] = body
+                    return self._send(200, body)
+                return self._send(404, {"message": f"no route {path}"})
+
+            def do_DELETE(self):
+                if not self._auth_ok():
+                    return self._send(401, {"message": "unauthorized"})
+                path = urllib.parse.urlparse(self.path).path
+                m = _LEASE_RE.match(path)
+                if m and m.group(2):
+                    key = f"{m.group(1)}/{m.group(2)}"
+                    with fake.lock:
+                        if fake.leases.pop(key, None) is None:
+                            return self._send(404, {"message": "not found"})
+                    return self._send(200, {"status": "Success"})
+                return self._send(404, {"message": f"no route {path}"})
+
+        return Handler
+
+
+def make_node_obj(name: str, *, cpu="8", memory="32Gi", labels=None, taints=None):
+    return {
+        "metadata": {"name": name, "labels": labels or {}},
+        "spec": {"taints": taints or []},
+        "status": {
+            "allocatable": {"cpu": cpu, "memory": memory, "pods": "110"}
+        },
+    }
+
+
+def make_pod_obj(
+    name: str,
+    *,
+    namespace="default",
+    scheduler_name="yoda-tpu",
+    cpu="500m",
+    memory="1Gi",
+    node_name=None,
+    labels=None,
+    annotations=None,
+    extra_spec=None,
+    uid=None,
+):
+    spec = {
+        "schedulerName": scheduler_name,
+        "containers": [
+            {
+                "name": "main",
+                "resources": {"requests": {"cpu": cpu, "memory": memory}},
+            }
+        ],
+    }
+    if node_name:
+        spec["nodeName"] = node_name
+    spec.update(extra_spec or {})
+    return {
+        "metadata": {
+            "name": name,
+            "namespace": namespace,
+            "uid": uid or f"uid-{namespace}-{name}",
+            "labels": labels or {},
+            "annotations": annotations or {},
+        },
+        "spec": spec,
+        "status": {"phase": "Running" if node_name else "Pending"},
+    }

@@ -1,0 +1,517 @@
+"""Kubernetes API boundary: conversion, list/watch/bind e2e, Lease CAS.
+
+Hermetic: every test runs against tests/fake_kube.FakeKube, an
+httptest-style stdlib server with resourceVersion CAS on leases and
+fieldSelector filtering on pods — no cluster required (the reference's
+own tests hit live services; SURVEY.md §4 calls for fixing that here).
+"""
+
+import time
+
+import pytest
+
+from kubernetes_scheduler_tpu.host import NodeUtil, Scheduler, StaticAdvisor
+from kubernetes_scheduler_tpu.host.leader import LeaderElector, LeaseRecord
+from kubernetes_scheduler_tpu.kube import (
+    KubeApiError,
+    KubeBinder,
+    KubeClient,
+    KubeClusterSource,
+    KubeConfig,
+    KubeLease,
+    node_from_api,
+    pod_from_api,
+)
+from kubernetes_scheduler_tpu.kube.source import run_kube_loop
+from kubernetes_scheduler_tpu.utils.config import SchedulerConfig
+from tests.fake_kube import FakeKube, make_node_obj, make_pod_obj
+
+
+@pytest.fixture()
+def fake():
+    srv = FakeKube().start()
+    yield srv
+    srv.stop()
+
+
+def client_for(fake, **kw):
+    return KubeClient(KubeConfig(base_url=fake.url, **kw))
+
+
+# ---- conversion ---------------------------------------------------------
+
+
+def test_pod_from_api_full_spec():
+    obj = {
+        "metadata": {
+            "name": "web-0",
+            "namespace": "prod",
+            "labels": {"app": "web", "scv/priority": "3"},
+            "annotations": {"diskIO": "10"},
+        },
+        "spec": {
+            "schedulerName": "yoda-tpu",
+            "nodeSelector": {"disk": "ssd"},
+            "containers": [
+                {
+                    "resources": {
+                        "requests": {"cpu": "500m", "memory": "2Gi"}
+                    },
+                    "ports": [{"containerPort": 80, "hostPort": 8080}],
+                },
+                {"resources": {"requests": {"cpu": "1"}}},
+            ],
+            "initContainers": [
+                {"resources": {"requests": {"memory": "4Gi"}}}
+            ],
+            "overhead": {"cpu": "100m"},
+            "tolerations": [
+                {"key": "gpu", "operator": "Exists", "effect": "NoSchedule"}
+            ],
+            "affinity": {
+                "nodeAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": {
+                        "nodeSelectorTerms": [
+                            {
+                                "matchExpressions": [
+                                    {
+                                        "key": "zone",
+                                        "operator": "In",
+                                        "values": ["a", "b"],
+                                    }
+                                ]
+                            }
+                        ]
+                    },
+                    "preferredDuringSchedulingIgnoredDuringExecution": [
+                        {
+                            "weight": 7,
+                            "preference": {
+                                "matchExpressions": [
+                                    {"key": "fast", "operator": "Exists"}
+                                ]
+                            },
+                        }
+                    ],
+                },
+                "podAntiAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": [
+                        {
+                            "labelSelector": {"matchLabels": {"app": "web"}},
+                            "topologyKey": "zone",
+                        }
+                    ]
+                },
+                "podAffinity": {
+                    "preferredDuringSchedulingIgnoredDuringExecution": [
+                        {
+                            "weight": 5,
+                            "podAffinityTerm": {
+                                "labelSelector": {
+                                    "matchLabels": {"app": "cache"}
+                                },
+                                "topologyKey": "kubernetes.io/hostname",
+                            },
+                        }
+                    ]
+                },
+            },
+            "topologySpreadConstraints": [
+                {
+                    "maxSkew": 2,
+                    "topologyKey": "zone",
+                    "whenUnsatisfiable": "DoNotSchedule",
+                    "labelSelector": {"matchLabels": {"app": "web"}},
+                },
+                {
+                    "maxSkew": 1,
+                    "topologyKey": "zone",
+                    "whenUnsatisfiable": "ScheduleAnyway",
+                    "labelSelector": {"matchLabels": {"app": "web"}},
+                },
+            ],
+        },
+        "status": {"phase": "Pending"},
+    }
+    pod = pod_from_api(obj)
+    assert pod.name == "web-0" and pod.namespace == "prod"
+    assert pod.labels["scv/priority"] == "3"
+    assert pod.annotations["diskIO"] == "10"
+    # cpu -> millicores, memory -> bytes
+    assert pod.containers[0].requests == {"cpu": 500.0, "memory": 2 * 2**30}
+    assert pod.containers[1].requests == {"cpu": 1000.0}
+    assert pod.init_containers[0].requests == {"memory": 4 * 2**30}
+    assert pod.overhead == {"cpu": 100.0}
+    assert pod.tolerations[0].operator == "Exists"
+    # nodeSelector AND first nodeSelectorTerm
+    ops = {(e.key, e.operator) for e in pod.node_affinity}
+    assert ops == {("disk", "In"), ("zone", "In")}
+    assert pod.preferred_node_affinity[0].weight == 7
+    terms = {(t.topology_key, t.anti, t.preferred) for t in pod.pod_affinity}
+    assert ("zone", True, False) in terms
+    assert ("kubernetes.io/hostname", False, True) in terms
+    # only DoNotSchedule spread constraints become hard constraints
+    assert len(pod.topology_spread) == 1
+    assert pod.topology_spread[0].max_skew == 2
+    assert pod.host_ports == [8080]
+    assert pod.node_name is None and pod.target_node is None
+
+
+def test_pod_from_api_pinned_and_running():
+    pending = pod_from_api(
+        {
+            "metadata": {"name": "p"},
+            "spec": {"nodeName": "n3", "containers": []},
+            "status": {"phase": "Pending"},
+        }
+    )
+    assert pending.target_node == "n3"  # upstream NodeName filter input
+    running = pod_from_api(
+        {
+            "metadata": {"name": "r"},
+            "spec": {"nodeName": "n3", "containers": []},
+            "status": {"phase": "Running"},
+        }
+    )
+    assert running.target_node is None and running.node_name == "n3"
+
+
+def test_node_from_api():
+    node = node_from_api(
+        {
+            "metadata": {
+                "name": "n0",
+                "labels": {"zone": "a"},
+                "annotations": {
+                    "scv/cards": '[{"clock": 1500, "free_memory": 8000, '
+                    '"total_memory": 16000, "health": "Healthy"}]'
+                },
+            },
+            "spec": {
+                "taints": [{"key": "gpu", "value": "yes", "effect": "NoSchedule"}]
+            },
+            "status": {
+                "allocatable": {
+                    "cpu": "7500m",
+                    "memory": "30Gi",
+                    "pods": "110",
+                    "nvidia.com/gpu": "2",
+                }
+            },
+        }
+    )
+    assert node.allocatable["cpu"] == 7500.0
+    assert node.allocatable["memory"] == 30 * 2**30
+    assert node.allocatable["nvidia.com/gpu"] == 2.0
+    assert node.taints[0].key == "gpu"
+    assert node.cards[0].clock == 1500 and node.cards[0].health == "Healthy"
+
+
+# ---- client + source against the fake API server ------------------------
+
+
+def test_list_nodes_running_pending(fake):
+    fake.add_node(make_node_obj("n0"))
+    fake.add_node(make_node_obj("n1", taints=[{"key": "x", "effect": "NoSchedule"}]))
+    fake.add_pod(make_pod_obj("running-1", node_name="n0"))
+    fake.add_pod(make_pod_obj("pending-1"))
+    fake.add_pod(make_pod_obj("other-sched", scheduler_name="default-scheduler"))
+    src = KubeClusterSource(client_for(fake), scheduler_name="yoda-tpu")
+    assert [n.name for n in src.list_nodes()] == ["n0", "n1"]
+    assert [p.name for p in src.list_running_pods()] == ["running-1"]
+    assert [p.name for p in src.list_pending_pods()] == ["pending-1"]
+    # watch yields the same pending set (bounded ADDED stream)
+    assert [p.name for p in src.watch_pending(timeout_seconds=5)] == ["pending-1"]
+
+
+def test_bearer_token_enforced():
+    srv = FakeKube(token="sekret").start()
+    try:
+        with pytest.raises(KubeApiError) as ei:
+            KubeClient(KubeConfig(base_url=srv.url)).get("/api/v1/nodes")
+        assert ei.value.status == 401
+        ok = KubeClient(KubeConfig(base_url=srv.url, token="sekret"))
+        assert ok.get("/api/v1/nodes") == {"items": []}
+    finally:
+        srv.stop()
+
+
+def test_binder_posts_binding_and_conflicts(fake):
+    fake.add_pod(make_pod_obj("p0"))
+    client = client_for(fake)
+    binder = KubeBinder(client)
+    pod = pod_from_api(fake.pods["default/p0"])
+    binder.bind(pod, "n5")
+    assert fake.bindings == [("default/p0", "n5")]
+    assert fake.pods["default/p0"]["spec"]["nodeName"] == "n5"
+    # double bind -> 409 surfaces as KubeApiError
+    with pytest.raises(KubeApiError) as ei:
+        binder.bind(pod, "n6")
+    assert ei.value.status == 409
+
+
+def test_kube_loop_watch_cycle_bind_e2e(fake):
+    """The VERDICT-prescribed e2e: fake API server driving
+    watch -> cycle -> bind. Nodes and pending pods live only on the
+    server; the scheduler sees them through KubeClusterSource and the
+    placements land back on the server through KubeBinder."""
+    for i in range(4):
+        fake.add_node(make_node_obj(f"n{i}"))
+    fake.add_pod(make_pod_obj("running-0", node_name="n0", cpu="2"))
+    for i in range(6):
+        fake.add_pod(
+            make_pod_obj(
+                f"job-{i}", cpu="250m", labels={"scv/priority": str(i % 3)},
+                annotations={"diskIO": "5"},
+            )
+        )
+    client = client_for(fake)
+    src = KubeClusterSource(client, scheduler_name="yoda-tpu")
+    binder = KubeBinder(client)
+    utils = {f"n{i}": NodeUtil(cpu_pct=10 * i, disk_io=3 * i) for i in range(4)}
+    sched = Scheduler(
+        SchedulerConfig(batch_window=64, min_device_work=0),
+        advisor=StaticAdvisor(utils),
+        binder=binder,
+        list_nodes=src.list_nodes,
+        list_running_pods=src.list_running_pods,
+    )
+    cycles = run_kube_loop(
+        sched, src,
+        max_cycles=4, idle_sleep=0.01, watch_timeout=5,
+        stop=lambda: len(fake.bindings) >= 6,
+    )
+    assert cycles >= 1
+    assert sorted(k for k, _ in fake.bindings) == [
+        f"default/job-{i}" for i in range(6)
+    ]
+    for _, node in fake.bindings:
+        assert node in {f"n{i}" for i in range(4)}
+    # server state reflects every placement; nothing is pending anymore
+    assert [p.name for p in src.list_pending_pods()] == []
+
+
+# ---- Lease backend ------------------------------------------------------
+
+
+def test_kube_lease_cas_and_elector(fake):
+    client = client_for(fake)
+    a = KubeLease(client, name="sched", namespace="kube-system")
+    b = KubeLease(client, name="sched", namespace="kube-system")
+    now = time.time()
+    rec_a = LeaseRecord(holder="A", acquired_at=now, renewed_at=now, duration=5)
+    assert a.read() is None
+    assert a.try_claim(rec_a, None)
+    got = b.read()
+    assert got.holder == "A" and abs(got.renewed_at - now) < 0.01
+    # stale CAS: B claims with previous=None while A holds -> refused
+    rec_b = LeaseRecord(holder="B", acquired_at=now, renewed_at=now, duration=5)
+    assert not b.try_claim(rec_b, None)
+    # A renews against its own previous
+    rec_a2 = LeaseRecord(
+        holder="A", acquired_at=now, renewed_at=now + 1, duration=5
+    )
+    assert a.try_claim(rec_a2, got)
+    # B steals with the correct previous (as after expiry)
+    cur = b.read()
+    assert b.try_claim(
+        LeaseRecord(holder="B", acquired_at=now + 2, renewed_at=now + 2, duration=5),
+        cur,
+    )
+    assert a.read().holder == "B"
+    b.clear("B")
+    assert a.read() is None
+
+
+def test_kube_lease_leader_election_failover(fake):
+    """Two replicas on the cluster Lease: standby acquires only after the
+    active holder's lease expires — client-go failover semantics on the
+    coordination.k8s.io backend."""
+    client = client_for(fake)
+    active = LeaderElector(
+        KubeLease(client, name="ha"), identity="active",
+        lease_duration=0.5, retry_period=0.05,
+    )
+    standby = LeaderElector(
+        KubeLease(client, name="ha"), identity="standby",
+        lease_duration=0.5, retry_period=0.05,
+    )
+    assert active.acquire_blocking(timeout=2)
+    assert not standby.acquire_blocking(timeout=0.2)
+    # active dies without releasing: stop renewals, keep the lease record
+    active._stop.set()
+    assert standby.acquire_blocking(timeout=5)
+    assert standby.is_leader()
+    standby.release()
+
+
+def test_cli_source_kube_one_shot(fake, capsys, tmp_path):
+    """`scheduler --source kube` end-to-end: flags -> KubeClient ->
+    watch -> cycle -> Binding POSTs -> one-shot idle exit. The fake
+    server doubles as the Prometheus endpoint, so the live
+    PrometheusAdvisor path is exercised too."""
+    import json as _json
+
+    from kubernetes_scheduler_tpu.cli import main
+
+    for i in range(3):
+        fake.add_node(make_node_obj(f"n{i}"))
+        fake.prom[f"n{i}"] = {"cpu_pct": 10.0 * i, "disk_io": 4.0 * i}
+    for i in range(4):
+        fake.add_pod(make_pod_obj(f"w-{i}", cpu="200m", annotations={"diskIO": "5"}))
+    host = fake.url.removeprefix("http://")
+    cfg_file = tmp_path / "cfg.json"
+    cfg_file.write_text(
+        _json.dumps({"batch_window": 64, "min_device_work": 0,
+                     "advisor": {"prometheus_host": host}})
+    )
+    rc = main(
+        [
+            "scheduler",
+            "--source", "kube",
+            "--kube-server", fake.url,
+            "--config", str(cfg_file),
+            "--watch-timeout", "5",
+        ]
+    )
+    assert rc == 0
+    out = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["pods_bound"] == 4 and out["pods_unschedulable"] == 0
+    assert sorted(k for k, _ in fake.bindings) == [
+        f"default/w-{i}" for i in range(4)
+    ]
+
+
+def test_bind_race_does_not_kill_cycle(fake):
+    """Routine lifecycle races on bind (pod deleted -> 404, bound by a
+    racer -> 409) must drop the pod and keep the cycle alive; transient
+    errors requeue with backoff. A binder exception previously escaped
+    run_cycle and killed the serve-forever loop."""
+    for i in range(3):
+        fake.add_node(make_node_obj(f"n{i}"))
+    for name in ("ok-0", "gone-1", "ok-2"):
+        fake.add_pod(make_pod_obj(name, cpu="200m", annotations={"diskIO": "2"}))
+    client = client_for(fake)
+    src = KubeClusterSource(client)
+    sched = Scheduler(
+        SchedulerConfig(batch_window=64, min_device_work=0),
+        advisor=StaticAdvisor(
+            {f"n{i}": NodeUtil(cpu_pct=10 * i, disk_io=i) for i in range(3)}
+        ),
+        binder=KubeBinder(client),
+        list_nodes=src.list_nodes,
+        list_running_pods=src.list_running_pods,
+    )
+    for pod in src.list_pending_pods():
+        sched.submit(pod)
+    # user deletes one pod between queue admission and the bind POST
+    del fake.pods["default/gone-1"]
+    m = sched.run_cycle()
+    assert m.pods_bound == 2 and m.pods_dropped == 1
+    assert m.pods_unschedulable == 0  # a race is churn, not a failure
+    assert sorted(k for k, _ in fake.bindings) == [
+        "default/ok-0", "default/ok-2"
+    ]
+    assert len(sched.queue) == 0  # 404 drops; no eternal rebind loop
+
+
+def test_informer_cache_sync_and_assume(fake):
+    """InformerCache serves nodes/assigned pods from local state, applies
+    relist reconciliation, and `assume` makes a just-bound pod visible to
+    the very next cycle (capacity cannot be double-sold while the watch
+    echo is in flight)."""
+    from kubernetes_scheduler_tpu.kube.source import InformerCache
+
+    fake.add_node(make_node_obj("n0"))
+    fake.add_pod(make_pod_obj("sys", node_name="n0", cpu="1"))
+    cache = InformerCache(client_for(fake), watch_timeout=2).start()
+    try:
+        assert cache.wait_synced(timeout=10)
+        assert [n.name for n in cache.nodes()] == ["n0"]
+        assert [p.name for p in cache.running_pods()] == ["sys"]
+        # bind through a cache-aware binder: immediately visible
+        fake.add_pod(make_pod_obj("w0", cpu="200m"))
+        binder = KubeBinder(client_for(fake), cache=cache)
+        pod = pod_from_api(fake.pods["default/w0"])
+        binder.bind(pod, "n0")
+        names = {p.name for p in cache.running_pods()}
+        assert "w0" in names  # assumed before any watch echo
+        # relist reconciliation: server-side delete eventually drops it
+        del fake.pods["default/w0"]
+        deadline = time.time() + 10
+        while "w0" in {p.name for p in cache.running_pods()}:
+            assert time.time() < deadline, "relist never dropped deleted pod"
+            time.sleep(0.05)
+    finally:
+        cache.stop()
+
+
+def test_cli_kube_uses_informer_cache(fake, capsys, tmp_path):
+    """The CLI kube path schedules from the informer cache (running pod
+    on the server consumes capacity seen by the cycle)."""
+    import json as _json
+
+    from kubernetes_scheduler_tpu.cli import main
+
+    fake.add_node(make_node_obj("only", cpu="1"))
+    fake.prom["only"] = {"cpu_pct": 10.0, "disk_io": 1.0}
+    fake.add_pod(make_pod_obj("hog", node_name="only", cpu="900m"))
+    fake.add_pod(make_pod_obj("wants", cpu="500m", annotations={"diskIO": "1"}))
+    host = fake.url.removeprefix("http://")
+    cfg_file = tmp_path / "cfg.json"
+    cfg_file.write_text(
+        _json.dumps({"batch_window": 8, "min_device_work": 0,
+                     "max_backoff_seconds": 0.2, "initial_backoff_seconds": 0.1,
+                     "advisor": {"prometheus_host": host}})
+    )
+    rc = main(
+        ["scheduler", "--source", "kube", "--kube-server", fake.url,
+         "--config", str(cfg_file), "--watch-timeout", "2"]
+    )
+    assert rc == 0
+    out = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    # the hog (seen only via the informer) fills the node: wants cannot fit
+    assert out["pods_bound"] == 0 and fake.bindings == []
+
+
+def test_token_file_rotation(tmp_path):
+    """File-backed bearer tokens are re-read after rotation (projected
+    service-account tokens rotate ~hourly; a stale one 401s forever)."""
+    srv = FakeKube(token="tok-v2").start()
+    try:
+        tf = tmp_path / "token"
+        tf.write_text("tok-v1")
+        client = KubeClient(KubeConfig(base_url=srv.url, token_path=str(tf)))
+        with pytest.raises(KubeApiError):
+            client.get("/api/v1/nodes")
+        tf.write_text("tok-v2")
+        client._token_cache = None  # expire the 60s cache (test shortcut)
+        assert client.get("/api/v1/nodes") == {"items": []}
+    finally:
+        srv.stop()
+
+
+def test_stale_pod_cannot_bind_recreated_name(fake):
+    """Delete-and-recreate under the same name: the stale queued Pod's
+    UID-preconditioned bind must 409 (never placing the successor, which
+    may have a wildly different spec), and the recreation — a new UID —
+    must be schedulable as itself."""
+    fake.add_node(make_node_obj("n0"))
+    fake.add_pod(make_pod_obj("web", cpu="100m", uid="uid-old"))
+    client = client_for(fake)
+    src = KubeClusterSource(client)
+    binder = KubeBinder(client)
+    stale = pod_from_api(fake.pods["default/web"])
+    # user deletes and recreates the name with a different spec/UID
+    del fake.pods["default/web"]
+    fake.add_pod(make_pod_obj("web", cpu="30", uid="uid-new"))
+    with pytest.raises(KubeApiError) as ei:
+        binder.bind(stale, "n0")
+    assert ei.value.status == 409
+    assert fake.bindings == []           # successor untouched
+    fresh = pod_from_api(fake.pods["default/web"])
+    binder.bind(fresh, "n0")             # the recreation binds as itself
+    assert fake.bindings == [("default/web", "n0")]
+    # scheduling identities differ, so the feeder would resubmit it
+    from kubernetes_scheduler_tpu.kube.source import pod_key
+    assert pod_key(stale) != pod_key(fresh)

@@ -59,3 +59,45 @@ def test_elector_steals_expired_lease(tmp_path):
     assert b.acquire_blocking(timeout=2.0)
     assert lease.read().holder == "b"
     b.release()
+
+
+def test_elector_survives_backend_errors_then_recovers(tmp_path):
+    """A transient lease-backend error must not kill the renew thread with
+    leadership still set (silent split-brain), and leadership must only
+    drop after the lease duration elapses without a successful renew."""
+    import time
+
+    from kubernetes_scheduler_tpu.host.leader import FileLease, LeaderElector
+
+    class FlakyLease(FileLease):
+        fail = False
+
+        def try_claim(self, record, previous):
+            if self.fail:
+                raise ConnectionError("api server down")
+            return super().try_claim(record, previous)
+
+        def read(self):
+            if self.fail:
+                raise ConnectionError("api server down")
+            return super().read()
+
+    lease = FlakyLease(str(tmp_path / "lease"))
+    el = LeaderElector(
+        lease, identity="a", lease_duration=0.6, retry_period=0.05
+    )
+    assert el.acquire_blocking(timeout=2)
+    # outage shorter than the lease: leadership retained
+    lease.fail = True
+    time.sleep(0.2)
+    assert el.is_leader()
+    # outage outlives the lease: leadership dropped, thread stays alive
+    time.sleep(0.8)
+    assert not el.is_leader()
+    # backend recovers (lease expired meanwhile): re-acquired in place
+    lease.fail = False
+    deadline = time.time() + 3
+    while not el.is_leader() and time.time() < deadline:
+        time.sleep(0.05)
+    assert el.is_leader()
+    el.release()

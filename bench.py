@@ -28,23 +28,41 @@ import numpy as np
 
 N_NODES = int(os.environ.get("BENCH_NODES", 10_000))
 N_PODS = int(os.environ.get("BENCH_PODS", 16_384))
-WINDOW = int(os.environ.get("BENCH_WINDOW", 1024))
+WINDOW = int(os.environ.get("BENCH_WINDOW", 512))
 BASELINE_PODS = int(os.environ.get("BENCH_BASELINE_PODS", 64))
 REPS = int(os.environ.get("BENCH_REPS", 4))
 # fused Pallas score+feasibility kernel (identical decisions; fewer HBM passes)
 FUSED = os.environ.get("BENCH_FUSED", "1") != "0"
+# auction price step as a fraction of the unit score range. 1/16 is the
+# quality-first host default; the bench uses the measured throughput knee
+# (PARITY.md: rounds-to-converge scales ~1/price_frac, and the placement
+# score cost of 1.0 is ~2% of mean vs sequential greedy)
+PRICE_FRAC = float(os.environ.get("BENCH_PRICE_FRAC", 1.0))
 
 
 def baseline_rate(snapshot, pods) -> float:
-    """Pods/sec of the sequential per-pod reference design (numpy)."""
+    """Pods/sec of the sequential per-pod reference design (numpy).
+
+    Measured in steady state: tiny configs repeat the whole pod set until
+    the measurement covers ~100ms of work — a single 1-pod iteration
+    would time interpreter warmup, not the design."""
     alloc = np.asarray(snapshot.allocatable)
-    requested = np.asarray(snapshot.requested).copy()
+    requested0 = np.asarray(snapshot.requested)
     disk_io = np.asarray(snapshot.disk_io)
     cpu_pct = np.asarray(snapshot.cpu_pct)
     req = np.asarray(pods.request)[:BASELINE_PODS]
     r_io = np.asarray(pods.r_io)[:BASELINE_PODS]
 
+    reps = max(1, 512 // max(len(req), 1))
     t0 = time.perf_counter()
+    for _ in range(reps):
+        requested = requested0.copy()
+        _baseline_pass(req, r_io, alloc, requested, disk_io, cpu_pct)
+    dt = time.perf_counter() - t0
+    return reps * len(req) / dt
+
+
+def _baseline_pass(req, r_io, alloc, requested, disk_io, cpu_pct):
     for i in range(len(req)):
         # per-cycle statistics (algorithm.go:67-89 recomputes these per pod)
         u = disk_io / 50.0
@@ -67,8 +85,6 @@ def baseline_rate(snapshot, pods) -> float:
         j = int(np.argmax(s))
         if np.isfinite(s[j]):
             requested[j] += req[i]
-    dt = time.perf_counter() - t0
-    return len(req) / dt
 
 
 def tpu_rate(snapshot, pods) -> float:
@@ -85,7 +101,9 @@ def tpu_rate(snapshot, pods) -> float:
     snapshot = jax.device_put(snapshot)
     pods_w = jax.device_put(stack_windows(pad_pod_batch(pods, n_padded), WINDOW))
 
-    out = schedule_windows(snapshot, pods_w, assigner="auction", fused=FUSED, affinity_aware=False)
+    kw = dict(assigner="auction", fused=FUSED, affinity_aware=False,
+              auction_price_frac=PRICE_FRAC)
+    out = schedule_windows(snapshot, pods_w, **kw)
     # int() readback forces completion — on a tunneled device
     # block_until_ready alone does not synchronize
     assigned = int(out.n_assigned)
@@ -99,7 +117,7 @@ def tpu_rate(snapshot, pods) -> float:
 
     t0 = time.perf_counter()
     for _ in range(REPS):
-        out = schedule_windows(snapshot, pods_w, assigner="auction", fused=FUSED, affinity_aware=False)
+        out = schedule_windows(snapshot, pods_w, **kw)
     # scalar readback of the LAST backlog: the device stream executes
     # in order, so its completion covers all REPS executions, while the
     # enqueues still pipeline (block_until_ready does not synchronize on
@@ -167,7 +185,10 @@ def suite_rate(name: str) -> dict:
         return native_rate(name, cfg)
     snapshot, pods = gen_config(name, seed=0)
     n_pods = cfg["n_pods"]
-    window = min(1024, max(8, n_pods))
+    # windows: measured knees (PARITY.md) — constraint configs amortize the
+    # per-round dynamic-affinity cost best at 1024; selector-free configs
+    # converge in fewer rounds per window at 512
+    window = min(1024 if cfg.get("constraints") else 512, max(8, n_pods))
     n_padded = -(-n_pods // window) * window
     # the auction enforces hard (anti)affinity exactly (dynamic round
     # masks + conflict eviction), so constraint configs use it too;
@@ -183,6 +204,7 @@ def suite_rate(name: str) -> dict:
             snapshot, pods_w, assigner=assigner, fused=fused,
             policy="card" if cfg.get("gpu") else "balanced_cpu_diskio",
             affinity_aware=affinity_aware,
+            auction_price_frac=PRICE_FRAC,
         )
 
     out = run()
@@ -260,9 +282,76 @@ def loop_rate() -> dict:
     }
 
 
+_PROBE_SRC = (
+    "import os, jax\n"
+    "p = os.environ.get('JAX_PLATFORMS')\n"
+    "if p: jax.config.update('jax_platforms', p)\n"
+    "d = jax.devices()\n"
+    "print(d[0].platform, len(d))\n"
+)
+
+
+def _pin_platform():
+    """Honor JAX_PLATFORMS even under a sitecustomize platform pin (the
+    env var alone is defeated by it; the config update is not)."""
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+
+
+def _backend_diag():
+    """Probe backend init in a SUBPROCESS with a deadline, emitting a
+    diagnostic JSON line BEFORE any metric so a red bench is attributable
+    from the artifact alone. BENCH_r01 died with rc=1 and no evidence;
+    a wedged device tunnel is worse — jax.devices() hangs, so an
+    in-process probe could never report anything. One clean retry (fresh
+    subprocess) covers transient init flakes."""
+    import subprocess
+
+    for attempt in (1, 2):
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c", _PROBE_SRC],
+                capture_output=True, text=True, timeout=240,
+            )
+        except subprocess.TimeoutExpired:
+            print(
+                json.dumps(
+                    {"diag": "backend_probe_timeout", "attempt": attempt,
+                     "timeout_s": 240}
+                ),
+                flush=True,
+            )
+            continue
+        if probe.returncode == 0 and probe.stdout.strip():
+            plat, count = probe.stdout.split()[-2:]
+            print(
+                json.dumps(
+                    {"diag": "backend", "platform": plat,
+                     "device_count": int(count), "attempt": attempt}
+                ),
+                flush=True,
+            )
+            _pin_platform()
+            return
+        print(
+            json.dumps(
+                {"diag": "backend_init_failed", "attempt": attempt,
+                 "rc": probe.returncode,
+                 "error": (probe.stderr or "")[-300:]}
+            ),
+            flush=True,
+        )
+        time.sleep(5)
+    sys.exit(1)
+
+
 def main():
     from kubernetes_scheduler_tpu.sim import gen_cluster, gen_pods
 
+    _backend_diag()
     if "--loop" in sys.argv:
         print(json.dumps(loop_rate()))
         return

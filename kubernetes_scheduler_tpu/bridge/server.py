@@ -40,8 +40,18 @@ class EngineService:
     device access (the batched design needs no cross-request locking —
     contrast the reference's RWMutex around Score, scheduler.go:147-149)."""
 
-    def __init__(self, *, sharded_fn=None, sharded_opts: dict | None = None):
+    def __init__(
+        self,
+        *,
+        sharded_fn=None,
+        sharded_opts: dict | None = None,
+        sharded_fn_soft=None,
+    ):
         self._sharded_fn = sharded_fn
+        # soft (preferred-constraint) variant: request.soft selects it, so
+        # a host that detects preferred terms is served them rather than
+        # getting silently-unscored placements
+        self._sharded_fn_soft = sharded_fn_soft
         # options baked into sharded_fn at startup; requests asking for
         # anything else must fail loud, not be silently overridden
         self._sharded_opts = sharded_opts or {}
@@ -73,7 +83,17 @@ class EngineService:
                             f"sidecar's sharded engine is fixed to "
                             f"{key}={have!r}; request asked for {want!r}",
                         )
-                res = self._sharded_fn(snapshot, pods)
+                fn = self._sharded_fn
+                if request.soft:
+                    if self._sharded_fn_soft is None:
+                        context.abort(
+                            grpc.StatusCode.INVALID_ARGUMENT,
+                            "request asked for soft (preferred-constraint) "
+                            "scoring but this sidecar's sharded engine was "
+                            "built without a soft variant",
+                        )
+                    fn = self._sharded_fn_soft
+                res = fn(snapshot, pods)
             else:
                 res = engine.schedule_batch(
                     snapshot,
@@ -111,11 +131,16 @@ def make_server(
     *,
     sharded_fn=None,
     sharded_opts: dict | None = None,
+    sharded_fn_soft=None,
     max_workers: int = 1,
 ) -> tuple[grpc.Server, int, EngineService]:
     """Build (server, bound_port, service). max_workers=1 keeps device
     access single-writer; raise it only for a CPU-only sidecar."""
-    service = EngineService(sharded_fn=sharded_fn, sharded_opts=sharded_opts)
+    service = EngineService(
+        sharded_fn=sharded_fn,
+        sharded_opts=sharded_opts,
+        sharded_fn_soft=sharded_fn_soft,
+    )
     handlers = grpc.method_handlers_generic_handler(
         SERVICE,
         {
@@ -189,14 +214,19 @@ def main(argv=None):
         sharded_fn = make_sharded_schedule_fn(
             mesh, policy=args.policy, node_axes=node_axes
         )
+        sharded_fn_soft = make_sharded_schedule_fn(
+            mesh, policy=args.policy, node_axes=node_axes, soft=True
+        )
         sharded_opts = {"policy": args.policy, "normalizer": "min_max"}
     else:
+        sharded_fn_soft = None
         sharded_opts = None
 
     server, port, _ = make_server(
         f"{args.host}:{args.port}",
         sharded_fn=sharded_fn,
         sharded_opts=sharded_opts,
+        sharded_fn_soft=sharded_fn_soft,
     )
     server.start()
     log.info(

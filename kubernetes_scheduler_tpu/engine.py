@@ -578,6 +578,8 @@ def schedule_batch(
     fused: bool = False,
     affinity_aware: bool = True,
     soft: bool = False,
+    auction_rounds: int = 1024,
+    auction_price_frac: float = 1.0 / 16.0,
 ) -> ScheduleResult:
     """One scheduling cycle for the whole pending window, on device.
 
@@ -632,6 +634,7 @@ def schedule_batch(
     return finish_cycle(
         snapshot, pods, raw, norm, feasible,
         assigner=assigner, affinity_aware=affinity_aware, soft=soft,
+        auction_rounds=auction_rounds, auction_price_frac=auction_price_frac,
     )
 
 
@@ -659,6 +662,8 @@ def finish_cycle(
     assigner: str = "greedy",
     affinity_aware: bool = True,
     soft: bool = False,
+    auction_rounds: int = 1024,
+    auction_price_frac: float = 1.0 / 16.0,
 ) -> ScheduleResult:
     """Shared cycle tail: soft score terms → assignment → result. Any
     scorer composes with the full constraint/assignment machinery through
@@ -679,6 +684,7 @@ def finish_cycle(
     else:
         res = auction_assign(
             norm, feasible, pods.request, free, pods.priority, pods.pod_mask,
+            rounds=auction_rounds, price_frac=auction_price_frac,
             affinity=affinity,
         )
     return ScheduleResult(
@@ -715,7 +721,8 @@ def stack_windows(pods: PodBatch, window: int) -> PodBatch:
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "policy", "assigner", "normalizer", "fused", "affinity_aware", "soft"
+        "policy", "assigner", "normalizer", "fused", "affinity_aware", "soft",
+        "auction_rounds", "auction_price_frac",
     ),
 )
 def schedule_windows(
@@ -728,6 +735,8 @@ def schedule_windows(
     fused: bool = False,
     affinity_aware: bool = True,
     soft: bool = False,
+    auction_rounds: int = 1024,
+    auction_price_frac: float = 1.0 / 16.0,
 ) -> WindowsResult:
     """Schedule many windows in ONE device program: lax.scan over the
     window axis, carrying node capacity AND (anti)affinity domain counts
@@ -760,6 +769,8 @@ def schedule_windows(
         res = schedule_batch(
             snap, w, policy=policy, assigner=assigner, normalizer=normalizer,
             fused=fused, affinity_aware=affinity_aware, soft=soft,
+            auction_rounds=auction_rounds,
+            auction_price_frac=auction_price_frac,
         )
         # fold this window's placements into the domain match AND avoider
         # counts so the next window's (anti)affinity sees them (the

@@ -121,6 +121,21 @@ class Scheduler:
                 state, model, _ = init_train_state(_jax.random.key(0))
                 engine = LearnedEngine(state.params, model=model)
         self.engine = engine or LocalEngine()
+        # auction knobs ride only engines whose call surface takes them
+        # (LocalEngine's **kw does; the gRPC bridge's wire protocol does
+        # not) — gating on the SIGNATURE, not on config values, so a
+        # non-default knob against a remote engine degrades to defaults
+        # instead of TypeError-ing every cycle into the scalar fallback
+        import inspect
+
+        try:
+            params = inspect.signature(self.engine.schedule_batch).parameters
+            self._engine_takes_auction_kw = "auction_price_frac" in params or any(
+                p.kind is inspect.Parameter.VAR_KEYWORD
+                for p in params.values()
+            )
+        except (TypeError, ValueError):
+            self._engine_takes_auction_kw = False
         self.binder = binder or RecordingBinder()
         self.list_nodes = list_nodes
         self.list_running_pods = list_running_pods
@@ -143,6 +158,12 @@ class Scheduler:
         self.builder = SnapshotBuilder(
             extended_resources=list(config.extended_resources)
         )
+        if config.adaptive_dispatch:
+            from kubernetes_scheduler_tpu.utils.adaptive import AdaptiveDispatch
+
+            self._dispatch = AdaptiveDispatch(config.min_device_work)
+        else:
+            self._dispatch = None
         # bounded: a long-lived process keeps the last window of cycle
         # metrics (latency quantiles), while monotonic run totals live in
         # self.totals — Prometheus counters must never decrease, and the
@@ -215,18 +236,32 @@ class Scheduler:
             return m
 
         # adaptive dispatch: tiny cycles are device-latency-bound; the
-        # scalar host path (C++ when native) wins below min_device_work.
+        # scalar host path (C++ when native) wins below the crossover.
         # Only when the scalar path's decisions match — it implements the
         # live yoda formula + resource fit, so any other policy or any
-        # taint/affinity/GPU constraint family stays on the engine.
-        use_device = (
-            self.config.policy != "balanced_cpu_diskio"
-            or len(window) * len(nodes) >= self.config.min_device_work
-            or not self._scalar_sufficient(window, nodes, running)
+        # taint/affinity/GPU constraint family stays on the engine. The
+        # crossover itself is learned from observed per-path latencies
+        # when adaptive_dispatch is on (utils/adaptive.py); cells below
+        # min_device_work route scalar until both models are fitted.
+        cells = len(window) * len(nodes)
+        scalar_eligible = (
+            self.config.policy == "balanced_cpu_diskio"
+            and self._scalar_sufficient(window, nodes, running)
         )
+        if not scalar_eligible:
+            use_device = True
+        elif self._dispatch is not None:
+            use_device = self._dispatch.decide(cells)
+        else:
+            use_device = cells >= self.config.min_device_work
+        t_path = time.perf_counter()
         if self.config.feature_gates.tpu_batch_score and nodes and use_device:
             try:
                 self._run_batched(window, nodes, running, utils, m)
+                if self._dispatch is not None and scalar_eligible:
+                    self._dispatch.observe(
+                        True, cells, time.perf_counter() - t_path
+                    )
             except Exception:
                 log.exception(
                     "engine cycle failed; falling back to scalar path "
@@ -239,6 +274,10 @@ class Scheduler:
         else:
             m.used_fallback = True
             self._run_scalar(window, nodes, utils, m)
+            if self._dispatch is not None and scalar_eligible:
+                self._dispatch.observe(
+                    False, cells, time.perf_counter() - t_path
+                )
 
         m.cycle_seconds = time.perf_counter() - t0
         self._record(m)
@@ -343,6 +382,12 @@ class Scheduler:
             and self.config.policy == "balanced_cpu_diskio"
             and self.config.normalizer == "none"
         )
+        kw = {}
+        if self._engine_takes_auction_kw:
+            kw = dict(
+                auction_rounds=self.config.auction_rounds,
+                auction_price_frac=self.config.auction_price_frac,
+            )
         t0 = time.perf_counter()
         res = self.engine.schedule_batch(
             snapshot,
@@ -353,6 +398,7 @@ class Scheduler:
             fused=fused,
             affinity_aware=affinity_aware,
             soft=soft,
+            **kw,
         )
         idx = np.asarray(res.node_idx)
         m.engine_seconds = time.perf_counter() - t0

@@ -271,7 +271,7 @@ def _segmented_admission(
     has_bid: jnp.ndarray,
     pod_request: jnp.ndarray,
     free: jnp.ndarray,
-    priority: jnp.ndarray,
+    by_prio: jnp.ndarray,
 ) -> jnp.ndarray:
     """[p] bool: per node, admit bidders in (priority desc, index asc)
     order while the cumulative request including self fits the node's
@@ -280,15 +280,21 @@ def _segmented_admission(
     O(p·log p + p·r): sort bidders by (node, -priority), segmented
     prefix-sum of requests within each node's group, compare against that
     node's capacity — no [p, n, r] intermediate.
+
+    `by_prio` is the priority-descending (stable) pod order, computed
+    ONCE outside the auction loop: device sorts are the expensive part of
+    a round (a [p] sort lowers to ~log^2 p sorting-network passes), and
+    priority never changes between rounds, so the only per-round sort is
+    the node grouping — non-bidders are keyed past the last node instead
+    of masked into the priority key.
     """
     p = bid.shape[0]
-    # sort by priority first (stable), then by node (stable) -> grouped by
-    # node, within each group by priority desc then index asc
-    key = jnp.where(has_bid, priority.astype(jnp.int32), jnp.int32(-(2**31) + 1))
-    by_prio = jnp.argsort(-key, stable=True)
-    by_node = jnp.argsort(bid[by_prio], stable=True)
+    n = free.shape[0]
+    has_s = has_bid[by_prio]
+    bid_p = jnp.where(has_s, bid[by_prio], jnp.int32(n))         # [p]
+    by_node = jnp.argsort(bid_p, stable=True)
     order = by_prio[by_node]                                     # [p]
-    bid_s = bid[order]
+    bid_s = bid_p[by_node]
     req_s = jnp.where(has_bid[order][:, None], pod_request[order], 0.0)
     total = jnp.cumsum(req_s, axis=0)                            # [p, r]
     # segment start: running max of indices where the node id changes
@@ -303,7 +309,7 @@ def _segmented_admission(
         (start > 0)[:, None], total[jnp.maximum(start - 1, 0)], 0.0
     )
     cum = total - base                                           # [p, r] incl. self
-    cap = free[bid_s]                                            # [p, r]
+    cap = free[jnp.minimum(bid_s, n - 1)]                        # [p, r]
     # unrequested-resource bypass (cum==0 -> no admitted bidder needs it)
     fits = ((cum <= cap) | (cum == 0)).all(-1) & has_bid[order]
     return jnp.zeros((p,), bool).at[order].set(fits)
@@ -314,28 +320,42 @@ def _affinity_round_mask(
 ) -> jnp.ndarray:
     """[p, n] bool: every (anti)affinity constraint of each pod — own
     selectors and existing avoiders' reverse terms — holds on each node
-    against live counts (base + in-window). Batched _affinity_row_ok."""
+    against live counts (base + in-window). Batched _affinity_row_ok.
+
+    MXU formulation: presence is binarized at the tiny [n, S] count table
+    and each pod's required/forbidden selector SET becomes a one-hot row,
+    so the per-round [p, n] masks are two [p, S] x [S, n] matmuls instead
+    of [n, p, K] gathers — the gathers were the dominant HBM traffic of
+    the auction's dynamic-affinity rounds (~5x the static path at
+    5k pods x 5k nodes). The one-hot operands are round-invariant; XLA's
+    loop-invariant code motion hoists them out of the while_loop."""
     s = aff.domain_counts.shape[1]
     cols = jnp.arange(s)[None, :]
     cnt = aff.domain_counts + added[aff.domain_id, cols]          # [n, S]
-    a = jnp.clip(aff.affinity_sel, 0, max(s - 1, 0))              # [p, K]
-    t = jnp.clip(aff.anti_affinity_sel, 0, max(s - 1, 0))
-    aff_ok = ((cnt[:, a] > 0) | (aff.affinity_sel < 0)[None]).all(-1)   # [n, p]
-    anti_ok = ((cnt[:, t] == 0) | (aff.anti_affinity_sel < 0)[None]).all(-1)
+    present = (cnt > 0).astype(jnp.float32)                       # [n, S]
+    # required selectors: ALL present <=> presence count reaches the
+    # pod's distinct-required count (one-hot union handles -1 padding
+    # and duplicate ids identically to the gathered all())
+    a_hot = pod_has_anti_onehot(aff.affinity_sel, s).astype(jnp.float32)
+    n_req = a_hot.sum(-1, keepdims=True)                          # [p, 1]
+    aff_ok = (a_hot @ present.T) >= n_req                         # [p, n]
+    # forbidden selectors: ANY present violates
+    t_hot = aff.pod_has_anti.astype(jnp.float32)
+    anti_ok = (t_hot @ present.T) == 0.0                          # [p, n]
     valid = ~(
         (aff.affinity_sel >= s).any(-1) | (aff.anti_affinity_sel >= s).any(-1)
     )                                                              # [p]
     avoid_cnt = aff.avoid_counts + added_avoid[aff.domain_id, cols]
     rev_bad = anti_reverse_bad(aff.pod_matches, avoid_cnt)         # [p, n]
     spread = spread_ok_batched(cnt, aff.node_mask, aff.spread_sel, aff.spread_max)
-    return (aff_ok & anti_ok).T & valid[:, None] & ~rev_bad & spread
+    return aff_ok & anti_ok & valid[:, None] & ~rev_bad & spread
 
 
 def _evict_round_conflicts(
     aff: AffinityState,
     admitted: jnp.ndarray,
     bid: jnp.ndarray,
-    priority: jnp.ndarray,
+    prio_key: jnp.ndarray,
     added: jnp.ndarray,
 ) -> jnp.ndarray:
     """[p] bool: admitted pods whose hard anti-affinity is violated by
@@ -361,10 +381,22 @@ def _evict_round_conflicts(
     contrib = jnp.where(
         admitted[:, None], aff.pod_matches.astype(jnp.float32), 0.0
     )
-    adds = (
-        jnp.zeros_like(aff.domain_counts).at[dom_p, cols].add(contrib)
-    )                                                              # [n, S]
-    cnt_other = adds[dom_p, cols] - contrib                        # [p, S]
+    # No [n, S] scatters in here: TPU scatters serialize per update, and
+    # four of them per auction round were ~45% of the constraint-config
+    # backlog time. Per-(domain, selector) aggregates go through a dense
+    # same-domain tensor when the window is small enough (a few MXU/VPU
+    # passes), the scatter form otherwise.
+    use_dense = p * p * s <= (1 << 25)
+    if use_dense:
+        same = dom_p[:, None, :] == dom_p[None, :, :]              # [p, q, S]
+        samef = same.astype(jnp.float32)
+        cnt_incl = jnp.einsum("pqs,qs->ps", samef, contrib)        # [p, S]
+    else:
+        adds = (
+            jnp.zeros_like(aff.domain_counts).at[dom_p, cols].add(contrib)
+        )                                                          # [n, S]
+        cnt_incl = adds[dom_p, cols]
+    cnt_other = cnt_incl - contrib                                 # [p, S]
 
     t_sel = aff.anti_affinity_sel                                  # [p, K]
     tc = jnp.clip(t_sel, 0, max(s - 1, 0))
@@ -377,27 +409,35 @@ def _evict_round_conflicts(
     contrib_nv = jnp.where(
         (admitted[:, None] & aff.pod_matches & ~has_anti), 1.0, 0.0
     )
-    adds_nv = jnp.zeros_like(aff.domain_counts).at[dom_p, cols].add(contrib_nv)
-    hard_blocked_t = jnp.take_along_axis(adds_nv[dom_p, cols], tc, axis=1) > 0
+    if use_dense:
+        blocked_full = jnp.einsum("pqs,qs->ps", samef, contrib_nv) > 0
+    else:
+        adds_nv = jnp.zeros_like(aff.domain_counts).at[dom_p, cols].add(
+            contrib_nv
+        )
+        blocked_full = adds_nv[dom_p, cols] > 0
+    hard_blocked_t = jnp.take_along_axis(blocked_full, tc, axis=1)
 
     # avoider-matcher groups: keep the (priority desc, index asc) max.
-    # Key = p - rank in priority order: always in [1, p], exact in int32
-    # (a direct (priority+1)*p - i encoding overflows int32 / loses
+    # prio_key = p - rank in priority order: always in [1, p], exact in
+    # int32 (a direct (priority+1)*p - i encoding overflows int32 / loses
     # precision under a float cast for large p x priority, and goes
-    # non-positive for negative priority labels)
-    order = jnp.argsort(-priority.astype(jnp.int32), stable=True)
-    rank = jnp.zeros((p,), jnp.int32).at[order].set(
-        jnp.arange(p, dtype=jnp.int32)
-    )
-    key = p - rank                                                 # [1, p]
+    # non-positive for negative priority labels). Computed ONCE outside
+    # the auction loop — the rank argsort is round-invariant and device
+    # sorts inside a while_loop were the auction's dominant round cost.
+    key = prio_key                                                 # [1, p]
     member = admitted[:, None] & has_anti & aff.pod_matches        # [p, S]
     keyf = jnp.where(member, key[:, None], 0)
-    gmax = (
-        jnp.zeros(aff.domain_counts.shape, jnp.int32)
-        .at[dom_p, cols]
-        .max(keyf)
-    )
-    keep_s = member & (keyf == gmax[dom_p, cols])                  # [p, S]
+    if use_dense:
+        gmax_at = jnp.max(jnp.where(same, keyf[None, :, :], 0), axis=1)
+    else:
+        gmax = (
+            jnp.zeros(aff.domain_counts.shape, jnp.int32)
+            .at[dom_p, cols]
+            .max(keyf)
+        )
+        gmax_at = gmax[dom_p, cols]
+    keep_s = member & (keyf == gmax_at)                            # [p, S]
     keep_t = jnp.take_along_axis(keep_s, tc, axis=1)               # [p, K]
 
     survive_t = keep_t & ~hard_blocked_t
@@ -413,11 +453,18 @@ def _evict_round_conflicts(
     # blocks nothing).
     sp_sel = aff.spread_sel                                        # [p, Kс]
     spc = jnp.clip(sp_sel, 0, max(s - 1, 0))
-    carry = added + adds                                            # [n, S]
-    live_cnt = aff.domain_counts + carry[aff.domain_id, jnp.arange(s)[None, :]]
+    # dmin from base + prior-round carry only (this round's adds can only
+    # RAISE counts, so omitting them under-estimates dmin and the skew
+    # check is conservative: a borderline pod may be over-evicted once and
+    # re-bids next round against counts whose carry has absorbed the adds
+    # — at most one extra round, never a missed violation. In exchange
+    # the eviction path needs NO [n, S] scatter at all.)
+    live_cnt = aff.domain_counts + added[aff.domain_id, jnp.arange(s)[None, :]]
     big = jnp.asarray(jnp.finfo(jnp.float32).max, jnp.float32)
     dmin = jnp.where(aff.node_mask[:, None], live_cnt, big).min(0)  # [S]
-    cnt_mine = aff.domain_counts[bid] + carry[dom_p, cols]          # [p, S]
+    cnt_mine = (
+        aff.domain_counts[bid] + added[dom_p, cols] + cnt_incl
+    )                                                               # [p, S]
     skew_t = (
         jnp.take_along_axis(cnt_mine, spc, axis=1)
         - dmin[spc]
@@ -431,12 +478,16 @@ def _evict_round_conflicts(
     )                                                               # [p, S]
     member_sp = admitted[:, None] & has_spread & aff.pod_matches    # [p, S]
     keyf_sp = jnp.where(member_sp, key[:, None], 0)
-    gmax_sp = (
-        jnp.zeros(aff.domain_counts.shape, jnp.int32)
-        .at[dom_p, cols]
-        .max(keyf_sp)
-    )
-    keep_sp_s = member_sp & (keyf_sp == gmax_sp[dom_p, cols])       # [p, S]
+    if use_dense:
+        gmax_sp_at = jnp.max(jnp.where(same, keyf_sp[None, :, :], 0), axis=1)
+    else:
+        gmax_sp = (
+            jnp.zeros(aff.domain_counts.shape, jnp.int32)
+            .at[dom_p, cols]
+            .max(keyf_sp)
+        )
+        gmax_sp_at = gmax_sp[dom_p, cols]
+    keep_sp_s = member_sp & (keyf_sp == gmax_sp_at)                 # [p, S]
     survive_sp = jnp.take_along_axis(keep_sp_s, spc, axis=1)        # [p, Kc]
     return evict | (viol_sp & ~survive_sp).any(-1)
 
@@ -511,6 +562,14 @@ def auction_assign(
 
     s_dim = 0 if affinity is None else affinity.domain_counts.shape[1]
     cols_s = jnp.arange(s_dim)[None, :] if affinity is not None else None
+    # priority order and its rank key are round-invariant; hoisted here so
+    # each round pays ONE device sort (the node grouping in admission)
+    # instead of three
+    by_prio = _priority_order(priority, pod_mask)
+    rank = jnp.zeros((p,), jnp.int32).at[by_prio].set(
+        jnp.arange(p, dtype=jnp.int32)
+    )
+    prio_key = p - rank
     # the feasibility-masked jittered score matrix is round-invariant on
     # the no-affinity path — build it once outside the loop. (A fused
     # Pallas bid kernel folding capacity+price+argmax into one pass was
@@ -538,11 +597,11 @@ def auction_assign(
             bid = jnp.argmax(row, axis=1).astype(jnp.int32)      # [p]
             has_bid = mask.any(axis=1)
         admitted = _segmented_admission(
-            bid, has_bid, pod_request, free, priority
+            bid, has_bid, pod_request, free, by_prio
         )
         if affinity is not None:
             admitted = admitted & ~_evict_round_conflicts(
-                affinity, admitted, bid, priority, added
+                affinity, admitted, bid, prio_key, added
             )
             dom_bid = affinity.domain_id[bid]
             added = added.at[dom_bid, cols_s].add(

@@ -201,6 +201,7 @@ def make_sharded_schedule_fn(
     policy: str = "balanced_cpu_diskio",
     normalizer: str = "min_max",
     node_axes: str | tuple[str, ...] = NODE_AXIS,
+    soft: bool = False,
 ):
     """Build a jitted shard_map'd schedule function for `mesh`.
 
@@ -215,6 +216,27 @@ def make_sharded_schedule_fn(
     combined axis and XLA lowers it hierarchically — the big per-shard
     reductions ride ICI, only the tiny cross-host residual (scalar stats,
     one (score, index) candidate pair per host group) crosses DCN.
+
+    soft=True layers the preferred-constraint score terms
+    (engine.compute_soft_scores) onto the normalized score, exactly like
+    schedule_batch(soft=True): every soft family reads node-LOCAL state
+    (labels, taints, per-node-replicated domain counts, preferred-term
+    matrices), so the term shards along the node axis with no extra
+    collective; normalization bounds are already global (pmax/pmin), so
+    weight-vs-range semantics match the dense path bit-for-bit.
+
+    Capability stance (documented deviations from the dense engine):
+    - assigner is GREEDY only. The auction's per-round segmented
+      admission sorts pods by destination NODE — a global sort across
+      the sharded axis every round. Sharding the node axis is the
+      regime where per-shard work is large and rounds are few, which is
+      exactly where greedy's one-candidate-election-per-pod collective
+      pattern is cheaper; an auction variant would need a distributed
+      sort per round and is deliberately out of scope.
+    - one window per call (no schedule_windows fusion): the capacity and
+      affinity carries between windows are local state here (free /
+      added2 in _sharded_greedy's scan) — callers loop over windows and
+      keep the returned free_after, paying one dispatch per window.
     """
     axes = node_axes if isinstance(node_axes, tuple) else (node_axes,)
     missing = [a for a in axes if a not in mesh.axis_names]
@@ -275,6 +297,11 @@ def make_sharded_schedule_fn(
             norm = raw
         else:
             raise ValueError(f"unknown normalizer {normalizer!r}")
+
+        if soft:
+            from kubernetes_scheduler_tpu.engine import compute_soft_scores
+
+            norm = norm + compute_soft_scores(snapshot, pods)
 
         free0 = compute_free_capacity(snapshot)
         node_idx, free_after = _sharded_greedy(norm, feasible, pods, free0, snapshot, axes)

@@ -1,0 +1,141 @@
+"""Adaptive scalar-vs-device dispatch model.
+
+The right `min_device_work` threshold is deployment-dependent: the device
+path's fixed cost is ~20ms against a tunneled dev chip but ~1ms against a
+colocated sidecar, while the C++ scalar path is ~1-2ns per pod x node
+cell — so any STATIC pods*nodes threshold is wrong somewhere (ADVICE r1
+#4: the shipped 1<<20 default was never validated). Instead the scheduler
+can learn both paths' latency models online and route each cycle to the
+predicted-faster path.
+
+Model: per path, t(cells) = overhead + rate * cells, fitted by recursive
+least squares on (cells, seconds) observations from real cycles. The
+scalar path's overhead is ~0 and the device path's is its dispatch
+round-trip, so two parameters per path capture exactly the regime split
+the static threshold approximates. Until a path has enough observations
+the caller falls back to the static threshold, and a periodic exploration
+cycle keeps the underdog path's estimate fresh.
+"""
+
+from __future__ import annotations
+
+CELL_SCALE = 1.0e6  # cells normalized to millions: keeps RLS well-conditioned
+
+
+class PathModel:
+    """RLS fit of t = overhead + rate * (cells / CELL_SCALE)."""
+
+    def __init__(self, forget: float = 0.98):
+        self.theta = [0.0, 0.0]
+        # generous prior covariance: first few observations dominate
+        self.p = [[1e6, 0.0], [0.0, 1e6]]
+        self.forget = forget
+        self.n_obs = 0
+
+    def observe(self, cells: int, seconds: float) -> None:
+        if cells <= 0 or seconds <= 0:
+            return
+        x = (1.0, cells / CELL_SCALE)
+        lam = self.forget
+        p = self.p
+        # k = P x / (lam + x' P x)
+        px0 = p[0][0] * x[0] + p[0][1] * x[1]
+        px1 = p[1][0] * x[0] + p[1][1] * x[1]
+        denom = lam + x[0] * px0 + x[1] * px1
+        k0, k1 = px0 / denom, px1 / denom
+        err = seconds - (self.theta[0] * x[0] + self.theta[1] * x[1])
+        self.theta[0] += k0 * err
+        self.theta[1] += k1 * err
+        # P = (P - k x' P) / lam
+        self.p = [
+            [(p[0][0] - k0 * px0) / lam, (p[0][1] - k0 * px1) / lam],
+            [(p[1][0] - k1 * px0) / lam, (p[1][1] - k1 * px1) / lam],
+        ]
+        self.n_obs += 1
+
+    def predict(self, cells: int) -> float:
+        t = self.theta[0] + self.theta[1] * (cells / CELL_SCALE)
+        # a partially-fitted model can dip negative; clamp to "free"
+        return max(t, 0.0)
+
+
+class AdaptiveDispatch:
+    """Route a cycle to the path with the lower predicted latency.
+
+    decide(cells) -> True for the device path. Falls back to the static
+    pods*nodes threshold until BOTH paths have >= min_obs observations;
+    every `explore_every`-th decision routes to the other path so a
+    path that lost early never starves of fresh observations (latency
+    regimes shift: sidecar restarts, thermal throttling, host load).
+    """
+
+    def __init__(
+        self,
+        static_threshold: int,
+        *,
+        min_obs: int = 3,
+        explore_every: int = 32,
+        explore_ratio_cap: float = 10.0,
+    ):
+        self.static_threshold = static_threshold
+        self.scalar = PathModel()
+        self.device = PathModel()
+        self.min_obs = min_obs
+        self.explore_every = explore_every
+        # exploration is bounded: flip to the underdog only when its
+        # predicted time is within this factor of the winner's — a path
+        # predicted 100x slower (e.g. a Python scalar rescore of a
+        # 10M-cell window) is a latency spike, not an experiment
+        self.explore_ratio_cap = explore_ratio_cap
+        self._decisions = 0
+        self._device_warmups = 0
+        self._device_outliers = 0
+
+    def observe(self, used_device: bool, cells: int, seconds: float) -> None:
+        if used_device and self._device_warmups < 1:
+            # the first device cycle pays the jit compile (seconds, vs a
+            # ~ms steady-state dispatch); fitting it would poison the
+            # overhead estimate for hundreds of cycles under forget=0.98
+            self._device_warmups += 1
+            return
+        if used_device and self.device.n_obs >= self.min_obs:
+            # later XLA retraces (window/node bucket changes) pay the
+            # compile again: a sample far above the fitted prediction is
+            # a compile spike, not steady-state latency — but THREE in a
+            # row is a real regime shift and must be believed, or a
+            # genuinely degraded device path would never be re-modeled
+            pred = self.device.predict(cells)
+            if seconds > 10.0 * max(pred, 1e-4):
+                self._device_outliers += 1
+                if self._device_outliers < 3:
+                    return
+            else:
+                self._device_outliers = 0
+        (self.device if used_device else self.scalar).observe(cells, seconds)
+
+    def decide(self, cells: int) -> bool:
+        self._decisions += 1
+        fitted = (
+            self.scalar.n_obs >= self.min_obs
+            and self.device.n_obs >= self.min_obs
+        )
+        if not fitted:
+            # cold start: static threshold, but force early samples of the
+            # un-observed path so the model can take over. Forced SCALAR
+            # samples are bounded to near-threshold sizes — a scalar pass
+            # over a 25M-cell window is a multi-second spike, the exact
+            # thing explore_ratio_cap forbids post-fit (the device side
+            # needs no such bound: its cost is overhead-dominated)
+            if self.scalar.n_obs < self.min_obs <= self.device.n_obs:
+                return not (cells <= 4 * max(self.static_threshold, 1))
+            if self.device.n_obs < self.min_obs <= self.scalar.n_obs:
+                return True
+            return cells >= self.static_threshold
+        t_dev = self.device.predict(cells)
+        t_sca = self.scalar.predict(cells)
+        choice = t_dev <= t_sca
+        if self._decisions % self.explore_every == 0:
+            worse, better = max(t_dev, t_sca), min(t_dev, t_sca)
+            if worse <= self.explore_ratio_cap * max(better, 1e-6):
+                return not choice
+        return choice

@@ -49,6 +49,14 @@ class SchedulerConfig:
     assigner: str = "greedy"
     normalizer: str = "min_max"
     batch_window: int = 1024
+    # auction assigner knobs (ops/assign.auction_assign). price_frac is the
+    # quality/throughput dial: rounds-to-converge scales ~1/price_frac
+    # while mean placement score degrades ~2% from 1/16 to 1.0 (measured,
+    # PARITY.md); 1/16 keeps host scheduling quality-first. Non-default
+    # values apply to the in-process engine only — the gRPC bridge serves
+    # the defaults (knobs are not in the wire protocol).
+    auction_rounds: int = 1024
+    auction_price_frac: float = 1.0 / 16.0
     # resource -> weight, all 1 like the reference (scheduler.go:75-77)
     resource_weights: dict = field(
         default_factory=lambda: {
@@ -67,8 +75,15 @@ class SchedulerConfig:
     # adaptive dispatch: below this pods x nodes product a cycle runs the
     # host scalar path (C++ when native_host) instead of the device — tiny
     # problems are device-dispatch-latency-bound (a 1-pod x 3-node cycle
-    # is ~25us in C++ vs ~20ms of device round-trip)
+    # is ~25us in C++ vs ~20ms of device round-trip). With
+    # adaptive_dispatch=True this is only the COLD-START prior: the
+    # scheduler fits per-path latency models (overhead + rate x cells,
+    # utils/adaptive.py) from its own cycles and routes each cycle to the
+    # predicted-faster path, because the true crossover is
+    # deployment-dependent (tunneled dev chip ~20ms dispatch vs colocated
+    # sidecar ~1ms — a 20x shift in the break-even point).
     min_device_work: int = 1 << 20
+    adaptive_dispatch: bool = True
     feature_gates: FeatureGates = field(default_factory=FeatureGates)
     advisor: AdvisorConfig = field(default_factory=AdvisorConfig)
 

@@ -1,0 +1,94 @@
+"""Adaptive scalar-vs-device dispatch: the learned crossover."""
+
+from kubernetes_scheduler_tpu.utils.adaptive import AdaptiveDispatch, PathModel
+
+
+def test_path_model_fits_affine_latency():
+    m = PathModel()
+    # device-like: 20ms dispatch + 2ns/cell
+    for cells in (1_000, 50_000, 2_000_000, 8_000_000, 300, 5_000_000):
+        m.observe(cells, 0.020 + 2e-9 * cells)
+    assert abs(m.predict(0) - 0.020) < 0.002
+    assert abs(m.predict(10_000_000) - 0.040) < 0.004
+
+
+def test_dispatch_learns_deployment_specific_crossover():
+    """Same static prior, two deployments: against a tunneled chip (20ms
+    dispatch) the crossover sits ~10M cells; against a colocated sidecar
+    (1ms) it sits ~0.5M. The model must find both from observations."""
+    for overhead, crossover_cells in ((0.020, 10_000_000), (0.001, 500_000)):
+        d = AdaptiveDispatch(1 << 20, explore_every=10**9)
+        scalar_rate = 2e-9   # ~C++ scalar ns/cell
+        device_rate = 1e-11  # device compute amortized
+        d.observe(True, 1_000, 5.0)   # jit-compile warmup, discarded
+        for cells in (1_000, 100_000, 3_000_000, 20_000_000, 40_000):
+            d.observe(False, cells, scalar_rate * cells)
+            d.observe(True, cells, overhead + device_rate * cells)
+        # well below crossover -> scalar; well above -> device
+        assert not d.decide(crossover_cells // 20), overhead
+        assert d.decide(crossover_cells * 20), overhead
+
+
+def test_dispatch_cold_start_uses_threshold_then_samples_both():
+    d = AdaptiveDispatch(1 << 20, min_obs=2)
+    assert not d.decide(100)          # below threshold
+    assert d.decide(1 << 21)          # above threshold
+    # feed only scalar observations: it must force device samples
+    d.observe(False, 1000, 1e-5)
+    d.observe(False, 1000, 1e-5)
+    assert d.decide(100)              # forced device exploration
+    d.observe(True, 1000, 3.0)        # first device cycle = jit compile
+    d.observe(True, 1000, 2e-2)
+    d.observe(True, 1000, 2e-2)
+    # both fitted: tiny cycle -> scalar (20ms device overhead dominates);
+    # the 3s compile warmup was discarded, not fitted
+    assert not d.decide(1000)
+    assert d.device.predict(1000) < 0.5
+
+
+def test_dispatch_periodic_exploration_flips_choice_within_cap():
+    d = AdaptiveDispatch(0, min_obs=1, explore_every=5)
+    d.observe(True, 1000, 9.0)        # warmup discard
+    d.observe(False, 1000, 1e-3)
+    d.observe(True, 1000, 2e-3)       # underdog within the 10x cap
+    choices = [d.decide(1000) for _ in range(10)]
+    assert choices.count(True) == 2   # every 5th flips to the underdog
+    assert choices.count(False) == 8
+
+
+def test_dispatch_exploration_suppressed_beyond_cap():
+    """A path predicted 1000x slower is never 'explored' into — that
+    would be a recurring latency spike, not an experiment."""
+    d = AdaptiveDispatch(0, min_obs=1, explore_every=5)
+    d.observe(True, 1000, 9.0)        # warmup discard
+    d.observe(False, 1_000_000, 2.0)  # scalar: 2s (python rescore loop)
+    d.observe(True, 1_000_000, 2e-3)
+    choices = [d.decide(1_000_000) for _ in range(20)]
+    assert all(choices)               # device always, no scalar spikes
+
+
+def test_cold_start_forced_scalar_bounded():
+    """Forced cold-start scalar sampling must not route a huge window
+    through the scalar path (the unbounded-latency-spike case)."""
+    d = AdaptiveDispatch(1 << 20, min_obs=2)
+    d.observe(True, 1 << 22, 9.0)     # warmup discard
+    d.observe(True, 1 << 22, 2e-2)
+    d.observe(True, 1 << 22, 2e-2)
+    # device fitted, scalar unobserved: force scalar only near threshold
+    assert not d.decide(1 << 20)      # forced scalar sample (bounded size)
+    assert d.decide(1 << 26)          # 64x threshold: stays on device
+
+
+def test_retrace_compile_spike_filtered_but_regime_shift_believed():
+    d = AdaptiveDispatch(0, min_obs=2)
+    d.observe(True, 1000, 9.0)        # first-compile warmup
+    for _ in range(3):
+        d.observe(True, 1000, 2e-2)
+        d.observe(False, 1000, 1e-3)
+    base = d.device.predict(1000)
+    d.observe(True, 1000, 5.0)        # retrace spike: filtered
+    assert abs(d.device.predict(1000) - base) < 1e-3
+    # three consecutive slow samples = the device really got slower
+    d.observe(True, 1000, 5.0)
+    d.observe(True, 1000, 5.0)
+    assert d.device.predict(1000) > 0.5

@@ -292,3 +292,69 @@ def test_sharded_node_name_matches_single_device():
     for i in range(8):
         assert idx[i] in (target[i], -1)
     assert idx[7] == -1
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_sharded_full_constraint_parity_sweep(seed):
+    """Randomized dense-vs-sharded parity across EVERY constraint family
+    at once: taints/tolerations, node affinity, inter-pod (anti)affinity
+    with in-window interaction, topology spread, spec.nodeName pinning,
+    and soft (preferred) terms — on the 8-device mesh. The sharded engine
+    must make byte-identical decisions to the dense greedy path."""
+    from kubernetes_scheduler_tpu.sim import gen_cluster, gen_pods
+
+    assert jax.device_count() == 8
+    rng = np.random.default_rng(100 + seed)
+    n, p = 64, 12
+    snapshot = gen_cluster(n, seed=seed, constraints=True)
+    pods = gen_pods(p, seed=seed + 1, constraints=True)
+    # spread constraints on ~25% of pods
+    pods = pods._replace(
+        spread_sel=jnp.asarray(
+            np.where(rng.random((p, 1)) < 0.25, rng.integers(0, 8, (p, 1)), -1),
+            jnp.int32,
+        ),
+        spread_max=jnp.full((p, 1), 2, jnp.int32),
+        # pinning: a couple of pods pinned, one to an absent node
+        target_node=jnp.asarray(
+            np.where(
+                rng.random(p) < 0.2, rng.integers(0, n + 4, p), -1
+            ),
+            jnp.int32,
+        ),
+        # preferred inter-pod terms on ~30%
+        pref_affinity_sel=jnp.asarray(
+            np.where(rng.random((p, 1)) < 0.3, rng.integers(0, 8, (p, 1)), -1),
+            jnp.int32,
+        ),
+        pref_affinity_weight=jnp.full((p, 1), 7, jnp.int32),
+        pref_anti_sel=jnp.asarray(
+            np.where(rng.random((p, 1)) < 0.3, rng.integers(0, 8, (p, 1)), -1),
+            jnp.int32,
+        ),
+        pref_anti_weight=jnp.full((p, 1), 5, jnp.int32),
+    )
+    # existing pods' preferred terms (symmetric scoring half)
+    snapshot = snapshot._replace(
+        pref_attract=jnp.asarray(
+            (rng.random((n, 8)) < 0.1) * rng.integers(1, 5, (n, 8)), jnp.float32
+        ),
+        pref_avoid=jnp.asarray(
+            (rng.random((n, 8)) < 0.1) * rng.integers(1, 5, (n, 8)), jnp.float32
+        ),
+    )
+    single = schedule_batch(
+        snapshot, pods, assigner="greedy", affinity_aware=True, soft=True
+    )
+    sharded = make_sharded_schedule_fn(make_mesh(8), soft=True)(snapshot, pods)
+    assert (
+        np.asarray(sharded.node_idx).tolist()
+        == np.asarray(single.node_idx).tolist()
+    ), seed
+    np.testing.assert_allclose(
+        np.asarray(sharded.scores), np.asarray(single.scores),
+        rtol=1e-4, atol=2e-3,
+    )
+    np.testing.assert_allclose(
+        np.asarray(sharded.free_after), np.asarray(single.free_after), atol=1e-3
+    )

@@ -76,7 +76,10 @@ class EngineService:
                     "normalizer": request.normalizer,
                 }
                 for key, want in asked.items():
-                    have = self._sharded_opts.get(key)
+                    # make_sharded_schedule_fn is greedy-only, so an opts
+                    # dict that doesn't say otherwise still pins greedy
+                    default = "greedy" if key == "assigner" else None
+                    have = self._sharded_opts.get(key, default)
                     if want and have and want != have:
                         context.abort(
                             grpc.StatusCode.INVALID_ARGUMENT,
@@ -217,7 +220,14 @@ def main(argv=None):
         sharded_fn_soft = make_sharded_schedule_fn(
             mesh, policy=args.policy, node_axes=node_axes, soft=True
         )
-        sharded_opts = {"policy": args.policy, "normalizer": "min_max"}
+        # assigner is pinned too: the sharded engine is greedy-only, and a
+        # host that asked for the auction must get an error, not silently
+        # different placement semantics
+        sharded_opts = {
+            "policy": args.policy,
+            "assigner": "greedy",
+            "normalizer": "min_max",
+        }
     else:
         sharded_fn_soft = None
         sharded_opts = None

@@ -164,6 +164,7 @@ class Scheduler:
             self._dispatch = AdaptiveDispatch(config.min_device_work)
         else:
             self._dispatch = None
+        self._scalar_cycler = None
         # bounded: a long-lived process keeps the last window of cycle
         # metrics (latency quantiles), while monotonic run totals live in
         # self.totals — Prometheus counters must never decrease, and the
@@ -271,6 +272,17 @@ class Scheduler:
                 )
                 m.used_fallback = True
                 self._run_scalar(window, nodes, utils, m)
+                # a failed device cycle is a device observation priced at
+                # its FULL cost: the failed attempt (timeout or fast
+                # connect error) plus the scalar fallback that had to
+                # run. Pricing only the time-to-exception would teach the
+                # model that a fast-failing path is cheap and keep
+                # routing to it; pricing nothing would never re-model a
+                # degraded path at all.
+                if self._dispatch is not None and scalar_eligible:
+                    self._dispatch.observe(
+                        True, cells, time.perf_counter() - t_path
+                    )
         else:
             m.used_fallback = True
             self._run_scalar(window, nodes, utils, m)
@@ -475,7 +487,20 @@ class Scheduler:
         disk_io = np.array([u.disk_io for u in util], np.float32)
         cpu_pct = np.array([u.cpu_pct for u in util], np.float32)
 
-        idx, _, _ = native.scalar_cycle(req, r_io, free, disk_io, cpu_pct)
+        # prebound cycler, reused while the cycle shape is stable (steady
+        # state for a fixed window size on a fixed cluster): one foreign
+        # call per cycle instead of per-call pointer marshaling
+        cyc = self._scalar_cycler
+        if cyc is None or cyc.shape != (len(window), len(nodes), len(names)):
+            cyc = native.ScalarCycler(req, r_io, free, disk_io, cpu_pct)
+            self._scalar_cycler = cyc
+        else:
+            cyc.update(
+                pod_req=req, r_io=r_io, free=free, disk_io=disk_io,
+                cpu_pct=cpu_pct,
+            )
+        cyc.run()
+        idx = cyc.node_idx
         for i, pod in enumerate(window):
             j = int(idx[i])
             if j >= 0:

@@ -27,7 +27,7 @@ _REPO_ROOT = os.path.dirname(
 )
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libyoda_host.so")
-ABI_VERSION = 1
+ABI_VERSION = 2
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
@@ -81,11 +81,20 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.yoda_queue_len.restype = i64
     lib.yoda_queue_len.argtypes = [ctypes.c_void_p]
 
+    # Tensor pointers are declared c_void_p so callers can pass the raw
+    # integer address (ndarray.ctypes.data): extracting the address is
+    # ~2.5x cheaper than building a typed POINTER per call, and on tiny
+    # cycles marshaling — not the C++ — is the entire cost.
+    vp = ctypes.c_void_p
     lib.yoda_scalar_cycle.restype = i64
     lib.yoda_scalar_cycle.argtypes = [
-        i64, i64, i64, f32p, f32p, f32p, f32p, f32p, ctypes.c_int, i32p,
+        i64, i64, i64, vp, vp, vp, vp, vp, ctypes.c_int, vp,
     ]
-    lib.yoda_aggregate_requested.argtypes = [i64, i64, i64, i32p, f32p, f32p]
+    lib.yoda_scalar_cycle_buf.restype = i64
+    lib.yoda_scalar_cycle_buf.argtypes = [
+        i64, i64, i64, vp, vp, vp, vp, vp, vp, ctypes.c_int, vp,
+    ]
+    lib.yoda_aggregate_requested.argtypes = [i64, i64, i64, vp, vp, vp]
     return lib
 
 
@@ -129,6 +138,11 @@ def _f32(a) -> np.ndarray:
 
 def _ptr(a: np.ndarray, ctype):
     return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def _addr(a: np.ndarray) -> int:
+    """Raw buffer address for c_void_p parameters (cheap marshaling)."""
+    return a.ctypes.data
 
 
 class NativeQueue:
@@ -202,12 +216,97 @@ def scalar_cycle(
     out = np.empty(p, dtype=np.int32)
     bound = lib.yoda_scalar_cycle(
         p, n, r,
-        _ptr(pod_req, ctypes.c_float), _ptr(r_io, ctypes.c_float),
-        _ptr(free, ctypes.c_float), _ptr(disk_io, ctypes.c_float),
-        _ptr(cpu_pct, ctypes.c_float), int(truncate),
-        _ptr(out, ctypes.c_int32),
+        _addr(pod_req), _addr(r_io), _addr(free), _addr(disk_io),
+        _addr(cpu_pct), int(truncate), _addr(out),
     )
     return out, free, int(bound)
+
+
+class ScalarCycler:
+    """Prebound scalar cycle for repeated same-shape cluster state.
+
+    Binds every buffer address once; each `run()` is a single foreign
+    call into yoda_scalar_cycle_buf with free capacity restored from the
+    bound `free` buffer (the input is never mutated). For tiny cycles —
+    the adaptive-dispatch scalar regime, e.g. the single-pod BASELINE.md
+    config — this removes the per-call marshaling that otherwise costs
+    ~10x the C++ cycle itself.
+
+    Change state between runs with `update(...)` (copies into the bound
+    buffers) or by writing the array attributes in place
+    (``cyc.free[:] = new_free``). The attributes are read-only
+    properties: the raw addresses are cached, so rebinding them must be
+    impossible — a dropped buffer would leave C++ reading freed memory.
+    A new shape means constructing a new cycler.
+    """
+
+    __slots__ = (
+        "_lib", "_pod_req", "_r_io", "_free", "_disk_io", "_cpu_pct",
+        "_free_after", "_node_idx", "_args",
+    )
+
+    def __init__(self, pod_req, r_io, free_cap, disk_io, cpu_pct, *,
+                 truncate: bool = True):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        # always copy: the cached addresses must point at buffers this
+        # object owns, never at caller arrays whose lifetime we can't see
+        self._pod_req = _f32(pod_req).copy()
+        self._r_io = _f32(r_io).copy()
+        self._free = _f32(free_cap).copy()
+        self._disk_io = _f32(disk_io).copy()
+        self._cpu_pct = _f32(cpu_pct).copy()
+        p, r = self._pod_req.shape
+        n = self._free.shape[0]
+        if self._free.shape != (n, r):
+            raise ValueError(
+                f"free_cap shape {self._free.shape} != ({n}, {r})"
+            )
+        if (
+            self._r_io.shape != (p,)
+            or self._disk_io.shape != (n,)
+            or self._cpu_pct.shape != (n,)
+        ):
+            raise ValueError("inconsistent ScalarCycler input shapes")
+        self._free_after = np.empty_like(self._free)
+        self._node_idx = np.empty(p, dtype=np.int32)
+        self._args = (
+            p, n, r, _addr(self._pod_req), _addr(self._r_io),
+            _addr(self._free), _addr(self._free_after),
+            _addr(self._disk_io), _addr(self._cpu_pct), int(truncate),
+            _addr(self._node_idx),
+        )
+
+    pod_req = property(lambda self: self._pod_req)
+    r_io = property(lambda self: self._r_io)
+    free = property(lambda self: self._free)
+    disk_io = property(lambda self: self._disk_io)
+    cpu_pct = property(lambda self: self._cpu_pct)
+    free_after = property(lambda self: self._free_after)
+    node_idx = property(lambda self: self._node_idx)
+
+    @property
+    def shape(self) -> tuple[int, int, int]:
+        """(pods, nodes, resources) this cycler is bound to."""
+        return tuple(self._args[:3])
+
+    def update(self, *, pod_req=None, r_io=None, free=None, disk_io=None,
+               cpu_pct=None) -> None:
+        """Copy new state into the bound buffers (shapes must match)."""
+        for buf, val in (
+            (self._pod_req, pod_req), (self._r_io, r_io),
+            (self._free, free), (self._disk_io, disk_io),
+            (self._cpu_pct, cpu_pct),
+        ):
+            if val is not None:
+                buf[...] = val
+
+    def run(self) -> int:
+        """One cycle; results land in .node_idx / .free_after. Returns
+        the number of pods bound."""
+        return int(self._lib.yoda_scalar_cycle_buf(*self._args))
 
 
 def aggregate_requested(pod_node, pod_req, n_nodes: int) -> np.ndarray:
@@ -222,8 +321,6 @@ def aggregate_requested(pod_node, pod_req, n_nodes: int) -> np.ndarray:
         raise ValueError("pod_node/pod_req length mismatch")
     out = np.zeros((n_nodes, r), dtype=np.float32)
     lib.yoda_aggregate_requested(
-        m, n_nodes, r,
-        _ptr(pod_node, ctypes.c_int32), _ptr(pod_req, ctypes.c_float),
-        _ptr(out, ctypes.c_float),
+        m, n_nodes, r, _addr(pod_node), _addr(pod_req), _addr(out)
     )
     return out

@@ -19,43 +19,69 @@ cycle keeps the underdog path's estimate fresh.
 
 from __future__ import annotations
 
-CELL_SCALE = 1.0e6  # cells normalized to millions: keeps RLS well-conditioned
+CELL_SCALE = 1.0e6  # cells normalized to millions: keeps the fit well-conditioned
+
+# Tikhonov floor for the normal-equation solve. Under CONSTANT cycle
+# shape — steady-state full windows on a fixed cluster, the common case —
+# the decayed Gram matrix is rank-1 and the affine fit is unidentifiable;
+# the ridge pins the unexcited direction to the zero prior while the
+# excited direction (the only one predictions at observed shapes use)
+# fits the data exactly. It also bounds the condition number, which is
+# what classic forgetting-RLS lacks: there the covariance grows 1/forget
+# per step along unexcited directions and overflows to inf after ~35k
+# constant-shape observations, turning the fit to NaN and wedging
+# dispatch permanently (found by review; pinned in test_adaptive).
+RIDGE = 1e-9
 
 
 class PathModel:
-    """RLS fit of t = overhead + rate * (cells / CELL_SCALE)."""
+    """Exponentially-weighted least-squares fit of
+    t = overhead + rate * (cells / CELL_SCALE).
+
+    Kept as decayed normal-equation sums (Gram matrix + moment vector),
+    solved with a ridge floor at prediction time. Same effective
+    ~1/(1-forget)-observation window as forgetting-RLS, but every state
+    component is a decayed sum of bounded inputs, so the estimator is
+    bounded by construction — no covariance windup, no divergence, full
+    adaptivity after arbitrarily long constant-excitation stretches.
+    """
 
     def __init__(self, forget: float = 0.98):
-        self.theta = [0.0, 0.0]
-        # generous prior covariance: first few observations dominate
-        self.p = [[1e6, 0.0], [0.0, 1e6]]
         self.forget = forget
+        # decayed sums: Gram [[1,x],[x,x2]] and moments [y, xy]
+        self.s11 = 0.0
+        self.s1x = 0.0
+        self.sxx = 0.0
+        self.sy = 0.0
+        self.sxy = 0.0
         self.n_obs = 0
 
     def observe(self, cells: int, seconds: float) -> None:
         if cells <= 0 or seconds <= 0:
             return
-        x = (1.0, cells / CELL_SCALE)
+        x = cells / CELL_SCALE
         lam = self.forget
-        p = self.p
-        # k = P x / (lam + x' P x)
-        px0 = p[0][0] * x[0] + p[0][1] * x[1]
-        px1 = p[1][0] * x[0] + p[1][1] * x[1]
-        denom = lam + x[0] * px0 + x[1] * px1
-        k0, k1 = px0 / denom, px1 / denom
-        err = seconds - (self.theta[0] * x[0] + self.theta[1] * x[1])
-        self.theta[0] += k0 * err
-        self.theta[1] += k1 * err
-        # P = (P - k x' P) / lam
-        self.p = [
-            [(p[0][0] - k0 * px0) / lam, (p[0][1] - k0 * px1) / lam],
-            [(p[1][0] - k1 * px0) / lam, (p[1][1] - k1 * px1) / lam],
-        ]
+        self.s11 = lam * self.s11 + 1.0
+        self.s1x = lam * self.s1x + x
+        self.sxx = lam * self.sxx + x * x
+        self.sy = lam * self.sy + seconds
+        self.sxy = lam * self.sxy + x * seconds
         self.n_obs += 1
 
+    def _theta(self) -> tuple[float, float]:
+        a, b, c = self.s11 + RIDGE, self.s1x, self.sxx + RIDGE
+        det = a * c - b * b
+        if det <= 0.0:
+            return 0.0, 0.0
+        t0 = (c * self.sy - b * self.sxy) / det
+        t1 = (a * self.sxy - b * self.sy) / det
+        return t0, t1
+
     def predict(self, cells: int) -> float:
-        t = self.theta[0] + self.theta[1] * (cells / CELL_SCALE)
-        # a partially-fitted model can dip negative; clamp to "free"
+        t0, t1 = self._theta()
+        t = t0 + t1 * (cells / CELL_SCALE)
+        # an extrapolating or partially-fitted model can dip negative;
+        # clamp to "free"
         return max(t, 0.0)
 
 

@@ -93,6 +93,27 @@ extern "C" int64_t yoda_scalar_cycle(int64_t P, int64_t N, int64_t R,
   return bound;
 }
 
+// Buffer-reusing variant: leaves free_in untouched and writes the
+// post-bind capacities to free_out (free_out == free_in is allowed and
+// degenerates to the in-place cycle above). With stable input/output
+// buffers a caller can prebind every pointer once and pay only the
+// foreign-call cost per cycle — the per-cycle floor for tiny clusters
+// (see native.ScalarCycler), where ctypes marshaling would otherwise
+// dominate the whole cycle.
+extern "C" int64_t yoda_scalar_cycle_buf(int64_t P, int64_t N, int64_t R,
+                                         const float* pod_req,
+                                         const float* r_io,
+                                         const float* free_in, float* free_out,
+                                         const float* disk_io,
+                                         const float* cpu_pct, int truncate,
+                                         int32_t* out_idx) {
+  if (free_out != free_in) {
+    for (int64_t k = 0; k < N * R; ++k) free_out[k] = free_in[k];
+  }
+  return yoda_scalar_cycle(P, N, R, pod_req, r_io, free_out, disk_io, cpu_pct,
+                           truncate, out_idx);
+}
+
 extern "C" void yoda_aggregate_requested(int64_t M, int64_t N, int64_t R,
                                          const int32_t* pod_node,
                                          const float* pod_req,

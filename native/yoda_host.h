@@ -62,6 +62,16 @@ int64_t yoda_scalar_cycle(int64_t P, int64_t N, int64_t R,
                           const float* cpu_pct, int truncate,
                           int32_t* out_idx);
 
+/* Buffer-reusing variant: free_in is const, post-bind capacities land in
+ * free_out (free_out == free_in degenerates to the in-place cycle). Lets
+ * a caller with stable buffers prebind every pointer once and pay only
+ * the foreign-call cost per cycle. */
+int64_t yoda_scalar_cycle_buf(int64_t P, int64_t N, int64_t R,
+                              const float* pod_req, const float* r_io,
+                              const float* free_in, float* free_out,
+                              const float* disk_io, const float* cpu_pct,
+                              int truncate, int32_t* out_idx);
+
 /* ---- snapshot aggregation --------------------------------------------
  * Sum running-pod requests into the per-node requested matrix
  * (the host-side analog of CalculateResourceAllocatableRequest's

@@ -1,6 +1,10 @@
 """Adaptive scalar-vs-device dispatch: the learned crossover."""
 
+import pytest
+
+from kubernetes_scheduler_tpu.host import NodeUtil
 from kubernetes_scheduler_tpu.utils.adaptive import AdaptiveDispatch, PathModel
+from tests.test_host import make_node, make_pod, make_sched
 
 
 def test_path_model_fits_affine_latency():
@@ -77,6 +81,49 @@ def test_cold_start_forced_scalar_bounded():
     # device fitted, scalar unobserved: force scalar only near threshold
     assert not d.decide(1 << 20)      # forced scalar sample (bounded size)
     assert d.decide(1 << 26)          # 64x threshold: stays on device
+
+
+def test_rls_no_covariance_windup_under_constant_excitation():
+    """Steady state means a CONSTANT cycle shape: with exponential
+    forgetting the covariance grows without bound in the unexcited
+    direction and (untreated) overflows to inf after ~35k observations,
+    wedging dispatch with NaN predictions. The trace ceiling must keep
+    theta finite and predictions sane through 100k identical cycles."""
+    import math
+
+    m = PathModel()
+    for _ in range(100_000):
+        m.observe(4096, 2e-3)
+    assert math.isfinite(m.predict(4096))
+    assert m.predict(4096) == pytest.approx(2e-3, rel=0.05)
+    # still adapts after the long constant stretch (exponential window:
+    # 100 fresh samples carry weight 1 - 0.98^100 ~ 0.87 of the fit)
+    for _ in range(100):
+        m.observe(4096, 8e-3)
+    assert m.predict(4096) == pytest.approx(8e-3, rel=0.15)
+
+
+def test_fast_failing_device_path_priced_at_full_cycle_cost():
+    """A sidecar that fails in ~1ms must not be learned as a ~1ms device
+    path: the scheduler prices a failed device cycle at failed attempt +
+    scalar fallback, so the model routes away from a broken path."""
+    nodes = [make_node(f"n{i}", cpu=8000) for i in range(3)]
+    utils = {f"n{i}": NodeUtil(cpu_pct=10, disk_io=5) for i in range(3)}
+    s = make_sched(nodes, [], utils, adaptive_dispatch=True)
+
+    def boom(*a, **k):
+        raise RuntimeError("connect refused")
+
+    s._run_batched = boom
+    s._dispatch.observe(True, 10, 0.5)  # burn warmup discard
+    for i in range(8):
+        s.submit(make_pod(f"p{i}", cpu=10, annotations={"diskIO": "1"}))
+        m = s.run_cycle()
+        assert m.pods_bound == 1 and m.used_fallback
+    cells = 3
+    # the device price includes the fallback work: it can never undercut
+    # the scalar path it had to invoke
+    assert s._dispatch.device.predict(cells) >= s._dispatch.scalar.predict(cells)
 
 
 def test_retrace_compile_spike_filtered_but_regime_shift_believed():

@@ -128,6 +128,12 @@ def test_sharded_sidecar_rejects_mismatched_options():
         assert ok.node_idx.shape == (2,)
         with pytest.raises(EngineUnavailable, match="INVALID_ARGUMENT"):
             client.schedule_batch(snap, pods, policy="balanced_cpu_diskio")
+        # the sharded engine is greedy-only: asking for the auction must
+        # fail loud even when the opts dict never mentions an assigner
+        with pytest.raises(EngineUnavailable, match="INVALID_ARGUMENT"):
+            client.schedule_batch(
+                snap, pods, policy="balanced_diskio", assigner="auction"
+            )
     finally:
         client.close()
         server.stop(grace=None)

@@ -302,6 +302,27 @@ def test_adaptive_dispatch_tiny_cycle_uses_scalar():
     assert m2.pods_bound == 1 and not m2.used_fallback  # device dispatch
 
 
+def test_failed_device_cycle_feeds_adaptive_model():
+    """A device-path failure must still produce a device observation
+    (including the failure's cost): otherwise the learned model never
+    sees the degraded path and keeps routing cycles into it forever."""
+    nodes = [make_node(f"n{i}", cpu=8000) for i in range(3)]
+    utils = {f"n{i}": NodeUtil(cpu_pct=10, disk_io=5) for i in range(3)}
+    s = make_sched(nodes, [], utils, adaptive_dispatch=True)
+
+    def boom(*a, **k):
+        raise RuntimeError("device path down")
+
+    s._run_batched = boom
+    # burn the one jit-compile warmup observation the model discards
+    s._dispatch.observe(True, 10, 0.5)
+    before = s._dispatch.device.n_obs
+    s.submit(make_pod("p0", cpu=100, annotations={"diskIO": "5"}))
+    m = s.run_cycle()
+    assert m.pods_bound == 1 and m.used_fallback
+    assert s._dispatch.device.n_obs == before + 1
+
+
 def test_running_avoider_forces_engine_path_and_blocks_domain():
     """Adaptive dispatch must consider RUNNING pods: a running pod with a
     required anti-affinity term (an avoider) forbids matching pending pods

@@ -169,6 +169,43 @@ def test_native_scalar_matches_python_plugin_path(seed):
             assert free_after[j, k] == pytest.approx(free_py[n.name][r], rel=1e-5)
 
 
+@pytest.mark.parametrize("seed", [0, 3])
+def test_scalar_cycler_matches_scalar_cycle(seed):
+    rng = np.random.default_rng(seed)
+    p, n, r = 9, 17, 5
+    req = rng.uniform(0.0, 3.0, (p, r)).astype(np.float32)
+    r_io = np.where(rng.random(p) > 0.3, rng.uniform(0, 40, p), 0).astype(
+        np.float32
+    )
+    free = rng.uniform(1.0, 8.0, (n, r)).astype(np.float32)
+    disk_io = rng.uniform(0, 60, n).astype(np.float32)
+    cpu_pct = rng.uniform(0, 100, n).astype(np.float32)
+
+    idx, free_after, bound = native.scalar_cycle(
+        req, r_io, free.copy(), disk_io, cpu_pct
+    )
+    cyc = native.ScalarCycler(req, r_io, free, disk_io, cpu_pct)
+    for _ in range(3):  # reruns are idempotent: free_in is never mutated
+        got = cyc.run()
+        assert got == bound
+        assert np.array_equal(cyc.node_idx, idx)
+        assert np.allclose(cyc.free_after, free_after)
+    assert np.allclose(cyc.free, free)
+
+    # state update between runs: drain the cluster and nothing binds
+    cyc.free[:] = 0.0
+    assert cyc.run() == 0
+    assert np.all(cyc.node_idx == -1)
+
+
+def test_scalar_cycler_shape_validation():
+    with pytest.raises(ValueError):
+        native.ScalarCycler(
+            np.ones((2, 3)), np.ones(2), np.ones((4, 3)), np.ones(4),
+            np.ones(3),
+        )
+
+
 def test_scalar_cycle_shape_validation():
     with pytest.raises(ValueError):
         native.scalar_cycle(

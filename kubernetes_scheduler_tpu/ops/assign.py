@@ -31,6 +31,13 @@ import jax.numpy as jnp
 
 NEG = -1.0e30
 
+# element budgets for trading dense compare-and-reduce formulations
+# against scatter forms (TPU scatters serialize per update, dense forms
+# vectorize but cost O(elements) work); overridable in tests to pin
+# dense/scatter parity without huge arrays
+DENSE_EVICT_BUDGET = 1 << 25   # [p, q, S] same-domain tensor in eviction
+DENSE_FOLD_BUDGET = 1 << 27    # [p, n, S] carry fold in the round body
+
 
 class AssignResult(NamedTuple):
     node_idx: jnp.ndarray      # [p] int32, assigned node or -1
@@ -322,6 +329,11 @@ def _affinity_round_mask(
     selectors and existing avoiders' reverse terms — holds on each node
     against live counts (base + in-window). Batched _affinity_row_ok.
 
+    `added`/`added_avoid` are per-node EXPANDED [n, S] tables (every
+    member of a domain holds the domain's in-window total, matching the
+    layout of snapshot.domain_counts itself), so live counts are a plain
+    add — no representative-row gather.
+
     MXU formulation: presence is binarized at the tiny [n, S] count table
     and each pod's required/forbidden selector SET becomes a one-hot row,
     so the per-round [p, n] masks are two [p, S] x [S, n] matmuls instead
@@ -330,8 +342,7 @@ def _affinity_round_mask(
     5k pods x 5k nodes). The one-hot operands are round-invariant; XLA's
     loop-invariant code motion hoists them out of the while_loop."""
     s = aff.domain_counts.shape[1]
-    cols = jnp.arange(s)[None, :]
-    cnt = aff.domain_counts + added[aff.domain_id, cols]          # [n, S]
+    cnt = aff.domain_counts + added                                # [n, S]
     present = (cnt > 0).astype(jnp.float32)                       # [n, S]
     # required selectors: ALL present <=> presence count reaches the
     # pod's distinct-required count (one-hot union handles -1 padding
@@ -345,7 +356,7 @@ def _affinity_round_mask(
     valid = ~(
         (aff.affinity_sel >= s).any(-1) | (aff.anti_affinity_sel >= s).any(-1)
     )                                                              # [p]
-    avoid_cnt = aff.avoid_counts + added_avoid[aff.domain_id, cols]
+    avoid_cnt = aff.avoid_counts + added_avoid
     rev_bad = anti_reverse_bad(aff.pod_matches, avoid_cnt)         # [p, n]
     spread = spread_ok_batched(cnt, aff.node_mask, aff.spread_sel, aff.spread_max)
     return aff_ok & anti_ok & valid[:, None] & ~rev_bad & spread
@@ -360,7 +371,8 @@ def _evict_round_conflicts(
 ) -> jnp.ndarray:
     """[p] bool: admitted pods whose hard anti-affinity is violated by
     OTHER same-round placements, minus one survivor per conflict group.
-    `added` [n, S] carries prior rounds' permanent placements; spread skew
+    `added` [n, S] carries prior rounds' permanent placements in the
+    per-node EXPANDED layout (see _affinity_round_mask); spread skew
     is a TOTAL-count constraint, so the check below must see base + added
     + this round's adds (anti-affinity needs only same-round adds — the
     pre-bid mask already rules out violations against base + added).
@@ -386,7 +398,7 @@ def _evict_round_conflicts(
     # backlog time. Per-(domain, selector) aggregates go through a dense
     # same-domain tensor when the window is small enough (a few MXU/VPU
     # passes), the scatter form otherwise.
-    use_dense = p * p * s <= (1 << 25)
+    use_dense = p * p * s <= DENSE_EVICT_BUDGET
     if use_dense:
         same = dom_p[:, None, :] == dom_p[None, :, :]              # [p, q, S]
         samef = same.astype(jnp.float32)
@@ -459,12 +471,11 @@ def _evict_round_conflicts(
     # re-bids next round against counts whose carry has absorbed the adds
     # — at most one extra round, never a missed violation. In exchange
     # the eviction path needs NO [n, S] scatter at all.)
-    live_cnt = aff.domain_counts + added[aff.domain_id, jnp.arange(s)[None, :]]
+    live_cnt = aff.domain_counts + added
     big = jnp.asarray(jnp.finfo(jnp.float32).max, jnp.float32)
     dmin = jnp.where(aff.node_mask[:, None], live_cnt, big).min(0)  # [S]
-    cnt_mine = (
-        aff.domain_counts[bid] + added[dom_p, cols] + cnt_incl
-    )                                                               # [p, S]
+    # expanded layout: added[bid] IS the prior-round total of bid's domain
+    cnt_mine = aff.domain_counts[bid] + added[bid] + cnt_incl       # [p, S]
     skew_t = (
         jnp.take_along_axis(cnt_mine, spc, axis=1)
         - dmin[spc]
@@ -560,8 +571,6 @@ def auction_assign(
         * (0.01 * price_frac)
     )
 
-    s_dim = 0 if affinity is None else affinity.domain_counts.shape[1]
-    cols_s = jnp.arange(s_dim)[None, :] if affinity is not None else None
     # priority order and its rank key are round-invariant; hoisted here so
     # each round pays ONE device sort (the node grouping in admission)
     # instead of three
@@ -603,21 +612,42 @@ def auction_assign(
             admitted = admitted & ~_evict_round_conflicts(
                 affinity, admitted, bid, prio_key, added
             )
-            dom_bid = affinity.domain_id[bid]
-            added = added.at[dom_bid, cols_s].add(
-                jnp.where(
-                    admitted[:, None],
-                    affinity.pod_matches.astype(added.dtype),
-                    0.0,
-                )
+            # Fold this round's placements into the per-node EXPANDED
+            # carry tables: node j gains pod i's contribution iff j is in
+            # the same (selector-s) domain as i's bid node. At window
+            # sizes this is one fused compare-and-reduce over [p, n, S] —
+            # NO [n, S] scatter: the two .at[dom, cols].add scatters here
+            # were ~100% of the auction's marginal round cost on TPU
+            # (scatters serialize per update; the reduction vectorizes).
+            # Past the dense budget (mirroring _evict_round_conflicts's
+            # use_dense guard) fall back to representative-row scatter +
+            # member gather, whose cost is O(p·S) not O(p·n·S).
+            dom_bid = affinity.domain_id[bid]                    # [p, S]
+            inc_m = jnp.where(
+                admitted[:, None],
+                affinity.pod_matches.astype(added.dtype), 0.0,
             )
-            added_avoid = added_avoid.at[dom_bid, cols_s].add(
-                jnp.where(
-                    admitted[:, None],
-                    affinity.pod_has_anti.astype(added.dtype),
-                    0.0,
-                )
+            inc_a = jnp.where(
+                admitted[:, None],
+                affinity.pod_has_anti.astype(added.dtype), 0.0,
             )
+            s_dim = affinity.domain_counts.shape[1]
+            if p * n * s_dim <= DENSE_FOLD_BUDGET:
+                same = (
+                    affinity.domain_id[None, :, :] == dom_bid[:, None, :]
+                )                                                # [p, n, S]
+                added = added + jnp.where(
+                    same, inc_m[:, None, :], 0.0
+                ).sum(0)
+                added_avoid = added_avoid + jnp.where(
+                    same, inc_a[:, None, :], 0.0
+                ).sum(0)
+            else:
+                cols_s = jnp.arange(s_dim)[None, :]
+                rep = jnp.zeros_like(added).at[dom_bid, cols_s].add(inc_m)
+                rep_a = jnp.zeros_like(added).at[dom_bid, cols_s].add(inc_a)
+                added = added + rep[affinity.domain_id, cols_s]
+                added_avoid = added_avoid + rep_a[affinity.domain_id, cols_s]
         new_assigned = jnp.where(admitted, bid, assigned)
         used = jnp.zeros_like(free).at[bid].add(
             jnp.where(admitted[:, None], pod_request, 0.0)

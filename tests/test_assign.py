@@ -201,6 +201,35 @@ def test_auction_affinity_exact_no_final_violations():
             seed, int(res.n_assigned), int(g.n_assigned))
 
 
+def test_auction_carry_fold_dense_and_scatter_paths_agree(monkeypatch):
+    """The round body folds placements into the expanded carry tables via
+    a dense [p, n, S] compare-and-reduce under DENSE_FOLD_BUDGET and a
+    representative-row scatter + gather above it; both layouts must yield
+    identical assignments (the budget is a cost knob, not semantics)."""
+    import numpy as np
+    from kubernetes_scheduler_tpu import ops
+    from kubernetes_scheduler_tpu.engine import schedule_batch
+    from kubernetes_scheduler_tpu.sim import gen_cluster, gen_pods
+
+    for seed in (1, 7):
+        snap = gen_cluster(48, seed=seed, constraints=True)
+        pods = gen_pods(40, seed=seed + 1, constraints=True)
+        dense = schedule_batch(snap, pods, assigner="auction", normalizer="none")
+        # the budget is read at trace time: clear the jit cache so the
+        # patched value actually selects the scatter path
+        monkeypatch.setattr(ops.assign, "DENSE_FOLD_BUDGET", 0)
+        schedule_batch.clear_cache()
+        scatter = schedule_batch(
+            snap, pods, assigner="auction", normalizer="none"
+        )
+        monkeypatch.undo()
+        schedule_batch.clear_cache()
+        assert np.array_equal(
+            np.asarray(dense.node_idx), np.asarray(scatter.node_idx)
+        ), seed
+        assert _final_affinity_violations(scatter.node_idx, snap, pods) == 0
+
+
 def test_auction_spread_pods_one_per_domain():
     """Self-anti-affinity (pod matches its own anti selector): at most one
     per topology domain, even when all arrive in one window."""

@@ -100,6 +100,7 @@ def cmd_scheduler_kube(args, cfg) -> int:
         KubeBinder,
         KubeClient,
         KubeClusterSource,
+        KubeEvictor,
         KubeLease,
     )
     from kubernetes_scheduler_tpu.kube.source import InformerCache, run_kube_loop
@@ -127,6 +128,7 @@ def cmd_scheduler_kube(args, cfg) -> int:
         cfg,
         advisor=PrometheusAdvisor(cfg.advisor.prometheus_host),
         binder=KubeBinder(client, cache=cache),
+        evictor=KubeEvictor(client),
         list_nodes=source.list_nodes,
         list_running_pods=source.list_running_pods,
         engine=engine,

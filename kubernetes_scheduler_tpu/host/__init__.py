@@ -20,4 +20,8 @@ from kubernetes_scheduler_tpu.host.snapshot import SnapshotBuilder
 from kubernetes_scheduler_tpu.host.advisor import NodeUtil, PrometheusAdvisor, StaticAdvisor
 from kubernetes_scheduler_tpu.host.cache import CycleCache
 from kubernetes_scheduler_tpu.host.queue import SchedulingQueue
-from kubernetes_scheduler_tpu.host.scheduler import Scheduler
+from kubernetes_scheduler_tpu.host.scheduler import (
+    RecordingBinder,
+    RecordingEvictor,
+    Scheduler,
+)

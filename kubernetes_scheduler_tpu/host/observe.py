@@ -57,6 +57,12 @@ def summarize(metrics, totals: dict | None = None) -> dict:
             "pods_bound": bound,
             "pods_unschedulable": sum(m.pods_unschedulable for m in cycles),
             "pods_dropped": sum(m.pods_dropped for m in cycles),
+            "pods_preempted": sum(
+                getattr(m, "pods_preempted", 0) for m in cycles
+            ),
+            "victims_evicted": sum(
+                getattr(m, "victims_evicted", 0) for m in cycles
+            ),
             "fallback_cycles": sum(1 for m in cycles if m.used_fallback),
             "fetch_failures": sum(
                 1 for m in cycles if getattr(m, "fetch_failed", False)
@@ -67,6 +73,8 @@ def summarize(metrics, totals: dict | None = None) -> dict:
         "pods_bound_total": totals["pods_bound"],
         "pods_unschedulable_total": totals["pods_unschedulable"],
         "pods_dropped_total": totals.get("pods_dropped", 0),
+        "pods_preempted_total": totals.get("pods_preempted", 0),
+        "victims_evicted_total": totals.get("victims_evicted", 0),
         "fallback_cycles_total": totals["fallback_cycles"],
         "fetch_failures_total": totals.get("fetch_failures", 0),
         "scheduling_pods_per_sec": bound / total_s if total_s > 0 else 0.0,
@@ -85,6 +93,8 @@ _HELP = {
     "pods_bound_total": "Pods bound to nodes",
     "pods_unschedulable_total": "Pod placements rejected (requeued with backoff)",
     "pods_dropped_total": "Pods forgotten after a bind-time lifecycle race (404/409)",
+    "pods_preempted_total": "Unschedulable pods that triggered a preemption (PostFilter)",
+    "victims_evicted_total": "Running pods evicted to make room for preemptors",
     "fallback_cycles_total": "Cycles served by the scalar fallback path",
     "fetch_failures_total": "Cycles aborted by a cluster-source/advisor fetch failure (window requeued)",
     "scheduling_pods_per_sec": "Bound pods per second of cycle time",

@@ -35,6 +35,12 @@ from kubernetes_scheduler_tpu.utils.config import SchedulerConfig
 log = logging.getLogger("yoda_tpu.scheduler")
 
 
+def _pod_key(pod: Pod) -> str:
+    """Identity that survives delete-and-recreate under the same name
+    (kube.source.pod_key semantics)."""
+    return pod.uid or f"{pod.namespace}/{pod.name}"
+
+
 @dataclass
 class Binding:
     pod: Pod
@@ -54,6 +60,25 @@ class RecordingBinder:
 
 
 @dataclass
+class Eviction:
+    victim: Pod
+    preemptor: Pod
+
+
+class RecordingEvictor:
+    """Evictor for simulation/tests; the live equivalent is
+    kube.KubeEvictor (DELETE the victim pod with a UID precondition).
+    Passing an evictor to Scheduler enables the preemption pass
+    (upstream PostFilter parity, ops/preempt.py)."""
+
+    def __init__(self):
+        self.evictions: list[Eviction] = []
+
+    def evict(self, victim: Pod, *, preemptor: Pod) -> None:
+        self.evictions.append(Eviction(victim, preemptor))
+
+
+@dataclass
 class CycleMetrics:
     """Per-cycle observability (SURVEY.md §5: the reference exports
     nothing; we track the north-star numbers)."""
@@ -65,6 +90,10 @@ class CycleMetrics:
     # bound by a racer -> 409) — routine churn, NOT scheduling failures,
     # so they get their own counter and never pollute pods_unschedulable
     pods_dropped: int = 0
+    # preemption pass (upstream PostFilter parity): preemptors that got a
+    # candidate this cycle, and the victims evicted for them
+    pods_preempted: int = 0
+    victims_evicted: int = 0
     cycle_seconds: float = 0.0
     engine_seconds: float = 0.0
     used_fallback: bool = False
@@ -81,6 +110,7 @@ class Scheduler:
         *,
         advisor,
         binder=None,
+        evictor=None,
         list_nodes: Callable[[], list[Node]],
         list_running_pods: Callable[[], list[Pod]],
         engine=None,
@@ -137,6 +167,20 @@ class Scheduler:
         except (TypeError, ValueError):
             self._engine_takes_auction_kw = False
         self.binder = binder or RecordingBinder()
+        self.evictor = evictor
+        self._cycle_unsched: list[Pod] = []
+        self._cycle_bound: list[Pod] = []
+        # victims whose DELETE was issued but that still appear in
+        # list_running_pods (termination grace): never re-evicted, and
+        # their nodes are off-limits to further preemption until the
+        # capacity actually frees (poor-man's nominatedNodeName)
+        self._pending_evictions: dict[str, str] = {}  # pod key -> node name
+        # preemptor key -> (nominated node, preemptor pod, expiry):
+        # a pod that already triggered evictions waits for that node's
+        # capacity — reserved via a virtual running pod — instead of
+        # evicting more victims elsewhere every retry cycle (upstream
+        # nominatedNodeName semantics)
+        self._nominations: dict[str, tuple[str, Pod, float]] = {}
         self.list_nodes = list_nodes
         self.list_running_pods = list_running_pods
         if config.feature_gates.native_host:
@@ -177,6 +221,8 @@ class Scheduler:
             "pods_bound": 0,
             "pods_unschedulable": 0,
             "pods_dropped": 0,
+            "pods_preempted": 0,
+            "victims_evicted": 0,
             "fallback_cycles": 0,
             "fetch_failures": 0,
         }
@@ -191,6 +237,8 @@ class Scheduler:
             self.totals["pods_bound"] += m.pods_bound
             self.totals["pods_unschedulable"] += m.pods_unschedulable
             self.totals["pods_dropped"] += m.pods_dropped
+            self.totals["pods_preempted"] += m.pods_preempted
+            self.totals["victims_evicted"] += m.victims_evicted
             self.totals["fallback_cycles"] += int(m.used_fallback)
             self.totals["fetch_failures"] += int(m.fetch_failed)
 
@@ -208,6 +256,8 @@ class Scheduler:
     def run_cycle(self) -> CycleMetrics:
         m = CycleMetrics()
         t0 = time.perf_counter()
+        self._cycle_unsched = []
+        self._cycle_bound = []
         window = self.queue.pop_window(self.config.batch_window)
         m.pods_in = len(window)
         if not window:
@@ -235,6 +285,16 @@ class Scheduler:
             m.cycle_seconds = time.perf_counter() - t0
             self._record(m)
             return m
+
+        # nominated-capacity reservations (upstream nominatedNodeName):
+        # a preemptor whose victims were evicted holds its nominated
+        # node's capacity as a virtual running pod, so the freed space
+        # cannot be consumed by lower-priority arrivals during the
+        # preemptor's retry backoff — which would otherwise re-trigger
+        # eviction loops under a steady low-priority trickle. The
+        # reservation is skipped while the preemptor itself is in the
+        # window (it is about to consume the capacity for real).
+        running = running + self._nomination_reservations(window)
 
         # adaptive dispatch: tiny cycles are device-latency-bound; the
         # scalar host path (C++ when native) wins below the crossover.
@@ -271,7 +331,7 @@ class Scheduler:
                     self.config.policy,
                 )
                 m.used_fallback = True
-                self._run_scalar(window, nodes, utils, m)
+                self._run_scalar(window, nodes, running, utils, m)
                 # a failed device cycle is a device observation priced at
                 # its FULL cost: the failed attempt (timeout or fast
                 # connect error) plus the scalar fallback that had to
@@ -285,15 +345,186 @@ class Scheduler:
                     )
         else:
             m.used_fallback = True
-            self._run_scalar(window, nodes, utils, m)
+            self._run_scalar(window, nodes, running, utils, m)
             if self._dispatch is not None and scalar_eligible:
                 self._dispatch.observe(
                     False, cells, time.perf_counter() - t_path
                 )
 
+        # PostFilter parity: unschedulable pods may preempt strictly-
+        # lower-priority running pods (ops/preempt.py). A failure here
+        # must never lose the cycle's bindings — preemptors are already
+        # requeued and simply retry without preemption next cycle.
+        if (
+            self._cycle_unsched
+            and self.evictor is not None
+            and self.config.preemption
+        ):
+            try:
+                self._run_preemption(
+                    self._cycle_unsched, nodes, running, utils, m
+                )
+            except Exception:
+                log.exception("preemption pass failed; retrying next cycle")
+
         m.cycle_seconds = time.perf_counter() - t0
         self._record(m)
         return m
+
+    def _run_preemption(self, pods, nodes, running, utils, m: CycleMetrics):
+        """Select and evict victims for this cycle's unschedulable pods.
+
+        Device pass (ops/preempt.py) proposes (node, victims) per
+        preemptor; the host applies proposals in priority order, one
+        preemptor per node per cycle (two proposals for one node were
+        each computed assuming the other's victims still hold capacity).
+        Victims are evicted through self.evictor; the preemptor is
+        already requeued and binds on a later cycle once the victims'
+        capacity is actually released — upstream's nominated-node flow
+        has the same asynchrony (preemption never binds in-cycle).
+        """
+        import jax.numpy as jnp
+
+        from kubernetes_scheduler_tpu.engine import compute_free_capacity
+        from kubernetes_scheduler_tpu.ops.preempt import (
+            build_victim_tables,
+            preempt_candidates,
+        )
+
+        k_cap = self.config.preemption_max_victims
+        if k_cap <= 0 or not nodes:
+            return
+        # THIS cycle's bindings must be part of the capacity model: the
+        # `running` list was read before they happened, and a preemption
+        # computed against pre-bind free capacity can kill victims for a
+        # preemptor that still won't fit (upstream simulates PostFilter
+        # against the assume-cache for the same reason)
+        running = running + self._cycle_bound
+        if not running:
+            return
+        # drop eviction records whose victim has actually terminated;
+        # a still-terminating victim keeps occupying snapshot capacity
+        # (it is in `running`) and is excluded from the victim tables
+        # below, so its node is naturally unattractive — no explicit
+        # node blocking needed
+        live_keys = {_pod_key(pd) for pd in running}
+        self._pending_evictions = {
+            k: v for k, v in self._pending_evictions.items() if k in live_keys
+        }
+        # snapshot with requests zeroed: compute_feasibility's resource
+        # term then checks against FULL allocatable — "could this pod
+        # ever fit here after evictions" — while every other constraint
+        # family applies unchanged (see ops/preempt.py for the
+        # documented affinity-recheck deviation)
+        snapshot = self.builder.build_snapshot(
+            nodes, utils, running, pending_pods=pods
+        )
+        pend = self.builder.build_pod_batch(pods)
+        vics = self.builder.build_pod_batch(running)
+        static_ok = self.engine_feasibility(
+            snapshot._replace(requested=jnp.zeros_like(snapshot.requested)),
+            pend,
+        )
+        node_index = {nd.name: j for j, nd in enumerate(nodes)}
+        vnode = np.full(np.asarray(vics.request).shape[0], -1, np.int32)
+        for i, pd in enumerate(running):
+            key = _pod_key(pd)
+            # terminating victims and nomination reservations occupy
+            # capacity but are not evictable (a reservation is not a
+            # real pod; a terminating victim is already dying)
+            if key in self._pending_evictions or key in self._nominations:
+                continue
+            vnode[i] = node_index.get(pd.node_name, -1)
+        res = preempt_candidates(
+            pend.request,
+            pend.priority,
+            pend.pod_mask,
+            static_ok,
+            compute_free_capacity(snapshot),
+            build_victim_tables(
+                jnp.asarray(vnode),
+                vics.priority,
+                vics.request,
+                vics.pod_mask,
+                n_nodes=np.asarray(snapshot.allocatable).shape[0],
+                k_cap=k_cap,
+            ),
+        )
+        chosen_node = np.asarray(res.node)
+        victim_ids = np.asarray(res.victims)
+        prio = np.asarray(pend.priority)
+        order = sorted(range(len(pods)), key=lambda i: (-int(prio[i]), i))
+        claimed_nodes: set[int] = set()
+        ttl = self.config.preemption_nomination_ttl_seconds
+        for i in order:
+            j = int(chosen_node[i])
+            if (
+                j < 0
+                or j >= len(nodes)
+                or j in claimed_nodes
+                or _pod_key(pods[i]) in self._nominations
+            ):
+                continue
+            claimed_nodes.add(j)
+            n_evicted = 0
+            for v in victim_ids[i]:
+                v = int(v)
+                if not (0 <= v < len(running)):
+                    continue
+                try:
+                    self.evictor.evict(running[v], preemptor=pods[i])
+                except Exception:
+                    # partial proposal: victims already deleted are
+                    # tracked below either way; stop killing more for a
+                    # proposal that may no longer complete
+                    log.exception(
+                        "evicting %s for %s failed; abandoning the rest "
+                        "of this proposal",
+                        running[v].name, pods[i].name,
+                    )
+                    break
+                self._pending_evictions[_pod_key(running[v])] = nodes[j].name
+                n_evicted += 1
+            if n_evicted:
+                # the nomination must be recorded even for a PARTIAL
+                # eviction round: capacity was destroyed on this node
+                # for this preemptor, and an un-nominated preemptor
+                # would evict again elsewhere next cycle
+                self._nominations[_pod_key(pods[i])] = (
+                    nodes[j].name, pods[i], time.monotonic() + ttl,
+                )
+                m.pods_preempted += 1
+                m.victims_evicted += n_evicted
+                log.info(
+                    "preempting %d pod(s) on %s for %s",
+                    n_evicted, nodes[j].name, pods[i].name,
+                )
+
+    def _nomination_reservations(self, window) -> list[Pod]:
+        """Virtual running pods holding nominated capacity (see
+        run_cycle). Prunes expired nominations; a nomination is also
+        dropped when its preemptor binds (Scheduler._bind)."""
+        import dataclasses
+
+        now = time.monotonic()
+        self._nominations = {
+            k: v for k, v in self._nominations.items() if v[2] > now
+        }
+        if not self._nominations:
+            return []
+        in_window = {_pod_key(pd) for pd in window}
+        return [
+            dataclasses.replace(pod, node_name=node)
+            for key, (node, pod, _) in self._nominations.items()
+            if key not in in_window
+        ]
+
+    def engine_feasibility(self, snapshot, pend):
+        """Static feasibility for the preemption pass; separated so tests
+        and alternative engines can override it."""
+        from kubernetes_scheduler_tpu.engine import compute_feasibility
+
+        return compute_feasibility(snapshot, pend, include_pod_affinity=True)
 
     @staticmethod
     def _scalar_sufficient(window, nodes, running) -> bool:
@@ -346,6 +577,16 @@ class Scheduler:
             return
         self.queue.mark_scheduled(pod)
         m.pods_bound += 1
+        self._cycle_bound.append(pod)
+        self._nominations.pop(_pod_key(pod), None)
+
+    def _requeue_unschedulable(self, pod: Pod, m: CycleMetrics) -> None:
+        """Nothing fit this pod this cycle: requeue with backoff and
+        remember it as a preemption candidate for this cycle's PostFilter
+        pass (upstream: unschedulable pods enter PostFilter)."""
+        self.queue.requeue_unschedulable(pod)
+        m.pods_unschedulable += 1
+        self._cycle_unsched.append(pod)
 
     def _run_batched(self, window, nodes, running, utils, m: CycleMetrics):
         # snapshot FIRST: build_snapshot registers every selector the cycle
@@ -432,12 +673,11 @@ class Scheduler:
             if j >= 0:
                 self._bind(pod, nodes[j].name, m)
             else:
-                self.queue.requeue_unschedulable(pod)
-                m.pods_unschedulable += 1
+                self._requeue_unschedulable(pod, m)
 
-    def _run_scalar(self, window, nodes, utils, m: CycleMetrics):
+    def _run_scalar(self, window, nodes, running, utils, m: CycleMetrics):
         if nodes and self._native_ok:
-            self._run_scalar_native(window, nodes, utils, m)
+            self._run_scalar_native(window, nodes, running, utils, m)
             return
         plugin = ScalarYodaPlugin(utils)
         free = {
@@ -446,7 +686,7 @@ class Scheduler:
             }
             for n in nodes
         }
-        for pod in self.list_running_pods():
+        for pod in running:
             if pod.node_name in free:
                 for res in free[pod.node_name]:
                     free[pod.node_name][res] -= pod_resource_request(pod, res)
@@ -456,10 +696,9 @@ class Scheduler:
             if best is not None:
                 self._bind(pod, best, m)
             else:
-                self.queue.requeue_unschedulable(pod)
-                m.pods_unschedulable += 1
+                self._requeue_unschedulable(pod, m)
 
-    def _run_scalar_native(self, window, nodes, utils, m: CycleMetrics):
+    def _run_scalar_native(self, window, nodes, running, utils, m: CycleMetrics):
         """The scalar fallback in C++ (native/scalar.cc): same decisions
         as the Python plugin path, one library call per window."""
         from kubernetes_scheduler_tpu import native
@@ -479,7 +718,7 @@ class Scheduler:
             np.float32,
         )
         node_index = {n.name: j for j, n in enumerate(nodes)}
-        for pod in self.list_running_pods():
+        for pod in running:
             j = node_index.get(pod.node_name)
             if j is not None:
                 free[j] -= [pod_resource_request(pod, r) for r in names]
@@ -506,8 +745,7 @@ class Scheduler:
             if j >= 0:
                 self._bind(pod, nodes[j].name, m)
             else:
-                self.queue.requeue_unschedulable(pod)
-                m.pods_unschedulable += 1
+                self._requeue_unschedulable(pod, m)
 
     # ---- loop ----------------------------------------------------------
 

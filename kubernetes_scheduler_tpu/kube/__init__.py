@@ -13,12 +13,13 @@ backend for host.leader.LeaderElector.
 
 from kubernetes_scheduler_tpu.kube.client import KubeApiError, KubeClient, KubeConfig
 from kubernetes_scheduler_tpu.kube.convert import node_from_api, pod_from_api
-from kubernetes_scheduler_tpu.kube.source import KubeBinder, KubeClusterSource
+from kubernetes_scheduler_tpu.kube.source import KubeBinder, KubeClusterSource, KubeEvictor
 from kubernetes_scheduler_tpu.kube.lease import KubeLease
 
 __all__ = [
     "KubeApiError",
     "KubeBinder",
+    "KubeEvictor",
     "KubeClient",
     "KubeClusterSource",
     "KubeConfig",

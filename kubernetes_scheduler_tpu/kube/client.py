@@ -242,8 +242,8 @@ class KubeClient:
     def put(self, path: str, body: dict):
         return self._request("PUT", path, body=body)
 
-    def delete(self, path: str):
-        return self._request("DELETE", path)
+    def delete(self, path: str, body: dict | None = None):
+        return self._request("DELETE", path, body=body)
 
     def list_all(self, path: str, params: dict | None = None) -> list[dict]:
         """GET a List object, following `continue` pagination."""

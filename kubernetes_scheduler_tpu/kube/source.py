@@ -326,6 +326,35 @@ class KubeBinder:
         self.bound.append((pod_key(pod), node_name))
 
 
+class KubeEvictor:
+    """DELETE the victim pod — the eviction step of the preemption pass
+    (upstream PostFilter; host/scheduler._run_preemption). A UID
+    precondition makes the delete a no-op (409) when the name has been
+    recreated since the snapshot, so a stale proposal can never kill an
+    unrelated pod; 404/409 are swallowed (the victim is already gone or
+    already replaced — either way capacity resolves by the next cycle).
+    """
+
+    def __init__(self, client: KubeClient):
+        self.client = client
+        self.evicted: list[str] = []
+
+    def evict(self, victim: Pod, *, preemptor: Pod) -> None:
+        body: dict = {"apiVersion": "v1", "kind": "DeleteOptions"}
+        if victim.uid:
+            body["preconditions"] = {"uid": victim.uid}
+        try:
+            self.client.delete(
+                f"/api/v1/namespaces/{victim.namespace}/pods/{victim.name}",
+                body,
+            )
+        except KubeApiError as e:
+            if e.status not in (404, 409):
+                raise
+            return
+        self.evicted.append(pod_key(victim))
+
+
 class _Feeder(threading.Thread):
     """Background pending-pod watcher feeding the scheduling queue.
 

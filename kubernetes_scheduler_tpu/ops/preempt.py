@@ -1,0 +1,188 @@
+"""Batched preemption (upstream PostFilter parity): victim selection on
+device.
+
+The reference rides the upstream kube-scheduler, whose scheduling
+framework runs a PostFilter phase when a pod fits nowhere: find a node
+where evicting a minimal set of strictly-lower-priority pods makes the
+pod feasible, preferring candidates whose victims matter least
+(upstream's ordering: lowest highest-victim-priority first, then fewest
+victims). The reference plugin itself never customizes this phase
+(SURVEY.md L6 — the implicit upstream layer), so parity means
+reproducing the framework behavior, batched.
+
+TPU-first formulation: instead of upstream's per-node goroutine
+simulation (clone snapshot, remove pods one by one, re-run filters),
+victims are laid out ONCE into per-node prefix tables sorted by
+priority — freed[n, k, r] = capacity released by evicting the k
+lowest-priority victims of node n — and every (pending pod, node,
+victim count) combination is evaluated as one [p, n, K] tensor op.
+Priority eligibility ("only strictly lower priority may be evicted")
+falls out of the sort: the k-th prefix is eligible iff its LAST
+(= highest-priority) member is below the preemptor's priority.
+
+Deviations from upstream, documented:
+- PodDisruptionBudgets are not consulted (the reference deploys no PDBs
+  and carries no PDB client; upstream prefers zero-violation candidates
+  but may still preempt past a PDB).
+- Constraint families (taints, node/pod affinity, spread) are checked
+  against the CURRENT cluster state via the caller-supplied
+  `static_ok` mask; the marginal effect of removing the victims
+  themselves on (anti)affinity domain counts is not re-simulated.
+  Upstream's RemovePod/AddPod accounting does simulate it; for count-
+  based families this can only make a chosen node conservatively wrong
+  in the pod's favor (victims leaving a domain free anti-affinity slots,
+  never consume them), and the next cycle re-checks everything against
+  real state before binding.
+- Victim start-time tie-breaking (upstream's final ordering criterion)
+  is replaced by deterministic node-index order: start times are not
+  part of the snapshot.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+PRIO_PAD = jnp.iinfo(jnp.int32).max  # padding sentinel: never evictable
+
+
+class VictimTables(NamedTuple):
+    """Per-node victim prefix tables, victims sorted by priority asc.
+
+    prio:  [n, K] int32 — k-th lowest victim priority on node n
+           (PRIO_PAD past the node's victim count)
+    freed: [n, K, r] f32 — capacity released by evicting victims 0..k
+           (inclusive prefix sums)
+    vid:   [n, K] int32 — index into the caller's victim arrays, -1 pad
+    """
+
+    prio: jnp.ndarray
+    freed: jnp.ndarray
+    vid: jnp.ndarray
+
+
+class PreemptResult(NamedTuple):
+    """node:    [p] int32 — chosen node, -1 when no candidate exists
+    victims: [p, K] int32 — victim indices to evict (-1 padded)
+    n_victims: [p] int32
+    """
+
+    node: jnp.ndarray
+    victims: jnp.ndarray
+    n_victims: jnp.ndarray
+
+
+def build_victim_tables(
+    victim_node: jnp.ndarray,
+    victim_prio: jnp.ndarray,
+    victim_req: jnp.ndarray,
+    victim_mask: jnp.ndarray,
+    *,
+    n_nodes: int,
+    k_cap: int,
+) -> VictimTables:
+    """Lay running pods out into per-node priority-ascending prefix
+    tables. victim_node [m] int32 (entries outside [0, n) ignored),
+    victim_prio [m] int32, victim_req [m, r] f32, victim_mask [m] bool.
+
+    One sort + one scatter over the m running pods, paid once per
+    preemption pass (not per candidate)."""
+    m, r = victim_req.shape
+    ok = victim_mask & (victim_node >= 0) & (victim_node < n_nodes)
+    # lexicographic (node asc, prio asc) via two stable argsorts
+    ord1 = jnp.argsort(victim_prio, stable=True)
+    ord2 = jnp.argsort(
+        jnp.where(ok, victim_node, n_nodes)[ord1], stable=True
+    )
+    order = ord1[ord2]                                           # [m]
+    node_s = jnp.where(ok[order], victim_node[order], n_nodes)
+    prio_s = victim_prio[order]
+    req_s = victim_req[order]
+    # position within the node's segment
+    idx = jnp.arange(m)
+    boundary = jnp.concatenate(
+        [jnp.ones((1,), bool), node_s[1:] != node_s[:-1]]
+    )
+    start = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(boundary, idx, 0)
+    )
+    pos = idx - start                                            # [m]
+    keep = (node_s < n_nodes) & (pos < k_cap)
+    row = jnp.where(keep, node_s, n_nodes)                       # pad row
+    prio = (
+        jnp.full((n_nodes + 1, k_cap), PRIO_PAD, jnp.int32)
+        .at[row, pos].set(jnp.where(keep, prio_s, PRIO_PAD))[:n_nodes]
+    )
+    steps = (
+        jnp.zeros((n_nodes + 1, k_cap, r), req_s.dtype)
+        .at[row, pos].set(jnp.where(keep[:, None], req_s, 0.0))[:n_nodes]
+    )
+    vid = (
+        jnp.full((n_nodes + 1, k_cap), -1, jnp.int32)
+        .at[row, pos].set(jnp.where(keep, order.astype(jnp.int32), -1))[
+            :n_nodes
+        ]
+    )
+    return VictimTables(prio=prio, freed=jnp.cumsum(steps, axis=1), vid=vid)
+
+
+def preempt_candidates(
+    pend_req: jnp.ndarray,
+    pend_prio: jnp.ndarray,
+    pend_mask: jnp.ndarray,
+    static_ok: jnp.ndarray,
+    free: jnp.ndarray,
+    tables: VictimTables,
+) -> PreemptResult:
+    """Choose a preemption candidate per pending pod.
+
+    pend_req [p, r], pend_prio [p] int32, pend_mask [p] bool,
+    static_ok [p, n] bool (non-resource constraint families hold),
+    free [n, r] current free capacity.
+
+    Candidate (pod p, node n, count k) is valid iff all k victims have
+    priority strictly below p's and p's request fits free + freed[k-1].
+    Per pod the minimal k per node is kept, then nodes compete
+    lexicographically on (highest victim priority, victim count, node
+    index) — upstream's dominant two criteria with a deterministic tie
+    break."""
+    p, r = pend_req.shape
+    n, k_cap = tables.prio.shape
+    cap = free[None, :, None, :] + tables.freed[None, :, :, :]  # [1,n,K,r]
+    fits = (
+        (pend_req[:, None, None, :] <= cap)
+        | (pend_req[:, None, None, :] == 0)
+    ).all(-1)                                                   # [p,n,K]
+    # victims sorted ascending: prefix k eligible iff its last member is
+    # below the preemptor (PRIO_PAD padding fails automatically)
+    elig = tables.prio[None, :, :] < pend_prio[:, None, None]   # [p,n,K]
+    ok = fits & elig & static_ok[:, :, None] & pend_mask[:, None, None]
+    has_k = ok.any(-1)                                          # [p,n]
+    kstar = jnp.argmax(ok, axis=-1)                             # first True
+    maxprio = jnp.take_along_axis(
+        tables.prio[None], jnp.broadcast_to(kstar[:, :, None], (p, n, 1)),
+        axis=2,
+    )[..., 0]                                                   # [p,n]
+    # lexicographic argmin over nodes: (maxprio, kstar, node index)
+    big = jnp.iinfo(jnp.int32).max
+    mp = jnp.where(has_k, maxprio, big)
+    best_mp = mp.min(axis=1, keepdims=True)
+    tier1 = has_k & (mp == best_mp)
+    ks = jnp.where(tier1, kstar, big)
+    best_k = ks.min(axis=1, keepdims=True)
+    tier2 = tier1 & (ks == best_k)
+    node = jnp.where(
+        tier2.any(-1), jnp.argmax(tier2, axis=-1), -1
+    ).astype(jnp.int32)                                         # [p]
+    safe = jnp.maximum(node, 0)
+    nv = jnp.where(node >= 0, kstar[jnp.arange(p), safe] + 1, 0)
+    vics = tables.vid[safe]                                     # [p, K]
+    vics = jnp.where(
+        (jnp.arange(k_cap)[None, :] < nv[:, None]) & (node >= 0)[:, None],
+        vics, -1,
+    )
+    return PreemptResult(
+        node=node, victims=vics, n_victims=nv.astype(jnp.int32)
+    )

@@ -84,6 +84,16 @@ class SchedulerConfig:
     # sidecar ~1ms — a 20x shift in the break-even point).
     min_device_work: int = 1 << 20
     adaptive_dispatch: bool = True
+    # preemption (upstream PostFilter parity, ops/preempt.py): when a pod
+    # fits nowhere, evict <= preemption_max_victims strictly-lower-
+    # priority pods from the least-disruptive node. Requires an evictor
+    # wired into the Scheduler (RecordingEvictor for sims, kube.
+    # KubeEvictor live); without one the pass is inert.
+    preemption: bool = True
+    preemption_max_victims: int = 8
+    # how long a preemptor's nominated-node capacity reservation survives
+    # if the preemptor never comes back to bind (deleted while pending)
+    preemption_nomination_ttl_seconds: float = 120.0
     feature_gates: FeatureGates = field(default_factory=FeatureGates)
     advisor: AdvisorConfig = field(default_factory=AdvisorConfig)
 

@@ -16,6 +16,7 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 _BIND_RE = re.compile(r"^/api/v1/namespaces/([^/]+)/pods/([^/]+)/binding$")
+_POD_RE = re.compile(r"^/api/v1/namespaces/([^/]+)/pods/([^/]+)$")
 _LEASE_RE = re.compile(
     r"^/apis/coordination\.k8s\.io/v1/namespaces/([^/]+)/leases(?:/([^/]+))?$"
 )
@@ -26,6 +27,7 @@ class FakeKube:
         self.lock = threading.Lock()
         self.nodes: list[dict] = []
         self.pods: dict[str, dict] = {}     # "ns/name" -> pod object
+        self.deleted: list[str] = []        # "ns/name" DELETE log
         self.leases: dict[str, dict] = {}   # "ns/name" -> lease object
         self.bindings: list[tuple[str, str]] = []
         # node -> {cpu_pct, mem_pct, disk_io, net_up, net_down}: served
@@ -272,6 +274,24 @@ class FakeKube:
                     with fake.lock:
                         if fake.leases.pop(key, None) is None:
                             return self._send(404, {"message": "not found"})
+                    return self._send(200, {"status": "Success"})
+                m = _POD_RE.match(path)
+                if m:
+                    ns, name = m.group(1), m.group(2)
+                    body = self._read_body()
+                    want_uid = (body.get("preconditions") or {}).get("uid")
+                    with fake.lock:
+                        pod = fake.pods.get(f"{ns}/{name}")
+                        if pod is None:
+                            return self._send(404, {"message": "not found"})
+                        have_uid = (pod.get("metadata") or {}).get("uid")
+                        if want_uid and have_uid and want_uid != have_uid:
+                            return self._send(
+                                409,
+                                {"message": "uid precondition failed"},
+                            )
+                        fake.pods.pop(f"{ns}/{name}")
+                        fake.deleted.append(f"{ns}/{name}")
                     return self._send(200, {"status": "Success"})
                 return self._send(404, {"message": f"no route {path}"})
 

@@ -250,6 +250,30 @@ def test_binder_posts_binding_and_conflicts(fake):
     assert ei.value.status == 409
 
 
+def test_evictor_deletes_with_uid_precondition(fake):
+    from kubernetes_scheduler_tpu.kube import KubeEvictor
+
+    fake.add_pod(make_pod_obj("victim", uid="uid-1"))
+    client = client_for(fake)
+    ev = KubeEvictor(client)
+    victim = pod_from_api(fake.pods["default/victim"])
+    preemptor = pod_from_api(make_pod_obj("urgent"))
+
+    # stale UID: the name was recreated since the snapshot -> no delete
+    stale = pod_from_api(make_pod_obj("victim", uid="uid-OLD"))
+    ev.evict(stale, preemptor=preemptor)
+    assert "default/victim" in fake.pods and not fake.deleted
+
+    ev.evict(victim, preemptor=preemptor)
+    assert fake.deleted == ["default/victim"]
+    assert "default/victim" not in fake.pods
+    assert ev.evicted == ["uid-1"]
+
+    # already gone: 404 swallowed
+    ev.evict(victim, preemptor=preemptor)
+    assert fake.deleted == ["default/victim"]
+
+
 def test_kube_loop_watch_cycle_bind_e2e(fake):
     """The VERDICT-prescribed e2e: fake API server driving
     watch -> cycle -> bind. Nodes and pending pods live only on the

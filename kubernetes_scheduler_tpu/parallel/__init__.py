@@ -10,4 +10,7 @@ without any O(N^2) attention term (SURVEY.md §2, §5).
 """
 
 from kubernetes_scheduler_tpu.parallel.mesh import NODE_AXIS, make_mesh
-from kubernetes_scheduler_tpu.parallel.engine import make_sharded_schedule_fn
+from kubernetes_scheduler_tpu.parallel.engine import (
+    make_sharded_schedule_fn,
+    make_sharded_windows_fn,
+)

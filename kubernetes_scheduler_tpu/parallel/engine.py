@@ -99,6 +99,7 @@ def _sharded_greedy(
     free0: jnp.ndarray,
     snapshot: SnapshotArrays,
     axes,
+    added2_0: jnp.ndarray | None = None,
 ):
     """Exact greedy over the sharded node axis.
 
@@ -108,6 +109,12 @@ def _sharded_greedy(
     its capacity slice, and the chosen node's topology-domain ids are
     psum-broadcast so every shard updates the (replicated) in-window
     inter-pod-affinity counts identically.
+
+    added2_0: optional [2, n_global, S] in-window domain-count carry
+    (matches + avoiders) from PREVIOUS windows of the same backlog, so a
+    multi-window caller (make_sharded_windows_fn) keeps exact cross-window
+    (anti)affinity; it is threaded through and returned for the next
+    window.
     """
     n_local = norm.shape[1]
     n_devices = jax.lax.psum(1, axes)
@@ -123,8 +130,12 @@ def _sharded_greedy(
     has_anti = pod_has_anti_onehot(pods.anti_affinity_sel, s)
     # the scan body mixes per-shard (varying) values into the update chain,
     # so the carry must start out marked varying for the vma checker
-    added0 = jax.lax.pcast(
-        jnp.zeros((2, n_global, s), jnp.float32), axes, to="varying"
+    added0 = (
+        added2_0
+        if added2_0 is not None
+        else jax.lax.pcast(
+            jnp.zeros((2, n_global, s), jnp.float32), axes, to="varying"
+        )
     )
 
     def step(carry, i):
@@ -186,13 +197,78 @@ def _sharded_greedy(
         )
         return (free, added2), jnp.where(found, chosen, jnp.int32(-1))
 
-    (free_after, _), picks = jax.lax.scan(step, (free0, added0), order)
+    (free_after, added2_f), picks = jax.lax.scan(step, (free0, added0), order)
     node_idx = jnp.full((p,), -1, jnp.int32).at[order].set(picks)
     # picks are computed identically on every shard, but the replication
     # checker cannot see that through all_gather/argmax; a pmax over equal
     # values is the identity and makes replication provable.
     node_idx = jax.lax.pmax(node_idx, axes)
-    return node_idx, free_after
+    return node_idx, free_after, added2_f
+
+
+def _mesh_specs(mesh: Mesh, node_axes):
+    """Validated mesh axes + the standard sharding specs: per-node arrays
+    shard on their leading node axis, per-pod arrays replicate. Shared by
+    both sharded factories so the layouts cannot drift."""
+    axes = node_axes if isinstance(node_axes, tuple) else (node_axes,)
+    missing = [a for a in axes if a not in mesh.axis_names]
+    if missing:
+        raise ValueError(f"mesh {mesh.axis_names} lacks axes {missing}")
+    node = P(axes)
+    rep = P()
+    snap_specs = SnapshotArrays(**{f: node for f in SnapshotArrays._fields})
+    pod_specs = PodBatch(**{f: rep for f in PodBatch._fields})
+    return axes, node, rep, snap_specs, pod_specs
+
+
+def _window_pipeline(snapshot, pods, policy, normalizer, soft, axes):
+    """Scores + static feasibility + normalization for one window on one
+    shard — the shared front half of the sharded single-window and
+    multi-window programs (they must not diverge)."""
+    raw = _sharded_scores(snapshot, pods, policy, axes)
+    # purely local/elementwise on the node axis — reuse the
+    # single-device implementation so the two paths cannot diverge.
+    # Inter-pod affinity is excluded from the static mask: the greedy
+    # scan evaluates it dynamically (base + in-window counts).
+    # spec.nodeName pinning is GLOBAL (target_node indexes the full
+    # node axis) but feasibility columns are shard-LOCAL: translate by
+    # this shard's offset, mapping out-of-shard targets to the
+    # matches-nothing encoding (n_local) — NOT to a negative value,
+    # which node_name_fit reads as "unpinned".
+    n_local = snapshot.allocatable.shape[0]
+    offset = jax.lax.axis_index(axes).astype(jnp.int32) * n_local
+    local = pods.target_node - offset
+    local = jnp.where((local < 0) | (local >= n_local), n_local, local)
+    pods_local = pods._replace(
+        target_node=jnp.where(pods.target_node < 0, pods.target_node, local)
+    )
+    feasible = compute_feasibility(
+        snapshot, pods_local, include_pod_affinity=False
+    )
+
+    if normalizer == "min_max":
+        hi, lo = score_bounds(raw, snapshot.node_mask)
+        hi = jax.lax.pmax(hi, axes)
+        lo = jax.lax.pmin(lo, axes)
+        norm = min_max_normalize(raw, snapshot.node_mask, bounds=(hi, lo))
+    elif normalizer == "softmax":
+        # masked softmax with a global denominator
+        neg = jnp.asarray(-1e30, raw.dtype)
+        logits = jnp.where(snapshot.node_mask[None, :], raw, neg)
+        z = jax.lax.pmax(logits.max(axis=1, keepdims=True), axes)
+        e = jnp.exp(logits - z)
+        denom = jax.lax.psum(e.sum(axis=1, keepdims=True), axes)
+        norm = e / denom
+    elif normalizer == "none":
+        norm = raw
+    else:
+        raise ValueError(f"unknown normalizer {normalizer!r}")
+
+    if soft:
+        from kubernetes_scheduler_tpu.engine import compute_soft_scores
+
+        norm = norm + compute_soft_scores(snapshot, pods)
+    return raw, norm, feasible
 
 
 def make_sharded_schedule_fn(
@@ -233,22 +309,11 @@ def make_sharded_schedule_fn(
       exactly where greedy's one-candidate-election-per-pod collective
       pattern is cheaper; an auction variant would need a distributed
       sort per round and is deliberately out of scope.
-    - one window per call (no schedule_windows fusion): the capacity and
-      affinity carries between windows are local state here (free /
-      added2 in _sharded_greedy's scan) — callers loop over windows and
-      keep the returned free_after, paying one dispatch per window.
+    - for a whole backlog in one dispatch use make_sharded_windows_fn,
+      which threads the capacity AND (anti)affinity carries across
+      windows exactly like engine.schedule_windows does on one device.
     """
-    axes = node_axes if isinstance(node_axes, tuple) else (node_axes,)
-    missing = [a for a in axes if a not in mesh.axis_names]
-    if missing:
-        raise ValueError(f"mesh {mesh.axis_names} lacks axes {missing}")
-
-    node = P(axes)
-    rep = P()
-    # every per-node array shards on its leading node axis; per-pod arrays
-    # are replicated
-    snap_specs = SnapshotArrays(**{f: node for f in SnapshotArrays._fields})
-    pod_specs = PodBatch(**{f: rep for f in PodBatch._fields})
+    axes, node, rep, snap_specs, pod_specs = _mesh_specs(mesh, node_axes)
     out_specs = ScheduleResult(
         node_idx=rep,
         scores=P(None, axes),
@@ -259,52 +324,13 @@ def make_sharded_schedule_fn(
     )
 
     def body(snapshot: SnapshotArrays, pods: PodBatch) -> ScheduleResult:
-        raw = _sharded_scores(snapshot, pods, policy, axes)
-        # purely local/elementwise on the node axis — reuse the
-        # single-device implementation so the two paths cannot diverge.
-        # Inter-pod affinity is excluded from the static mask: the greedy
-        # scan evaluates it dynamically (base + in-window counts).
-        # spec.nodeName pinning is GLOBAL (target_node indexes the full
-        # node axis) but feasibility columns are shard-LOCAL: translate by
-        # this shard's offset, mapping out-of-shard targets to the
-        # matches-nothing encoding (n_local) — NOT to a negative value,
-        # which node_name_fit reads as "unpinned".
-        n_local = snapshot.allocatable.shape[0]
-        offset = jax.lax.axis_index(axes).astype(jnp.int32) * n_local
-        local = pods.target_node - offset
-        local = jnp.where((local < 0) | (local >= n_local), n_local, local)
-        pods_local = pods._replace(
-            target_node=jnp.where(pods.target_node < 0, pods.target_node, local)
+        raw, norm, feasible = _window_pipeline(
+            snapshot, pods, policy, normalizer, soft, axes
         )
-        feasible = compute_feasibility(
-            snapshot, pods_local, include_pod_affinity=False
-        )
-
-        if normalizer == "min_max":
-            hi, lo = score_bounds(raw, snapshot.node_mask)
-            hi = jax.lax.pmax(hi, axes)
-            lo = jax.lax.pmin(lo, axes)
-            norm = min_max_normalize(raw, snapshot.node_mask, bounds=(hi, lo))
-        elif normalizer == "softmax":
-            # masked softmax with a global denominator
-            neg = jnp.asarray(-1e30, raw.dtype)
-            logits = jnp.where(snapshot.node_mask[None, :], raw, neg)
-            z = jax.lax.pmax(logits.max(axis=1, keepdims=True), axes)
-            e = jnp.exp(logits - z)
-            denom = jax.lax.psum(e.sum(axis=1, keepdims=True), axes)
-            norm = e / denom
-        elif normalizer == "none":
-            norm = raw
-        else:
-            raise ValueError(f"unknown normalizer {normalizer!r}")
-
-        if soft:
-            from kubernetes_scheduler_tpu.engine import compute_soft_scores
-
-            norm = norm + compute_soft_scores(snapshot, pods)
-
         free0 = compute_free_capacity(snapshot)
-        node_idx, free_after = _sharded_greedy(norm, feasible, pods, free0, snapshot, axes)
+        node_idx, free_after, _ = _sharded_greedy(
+            norm, feasible, pods, free0, snapshot, axes
+        )
         return ScheduleResult(
             node_idx=node_idx,
             scores=norm,
@@ -312,6 +338,84 @@ def make_sharded_schedule_fn(
             feasible=feasible,
             free_after=free_after,
             n_assigned=(node_idx >= 0).sum().astype(jnp.int32),
+        )
+
+    fn = shard_map(
+        body, mesh=mesh, in_specs=(snap_specs, pod_specs), out_specs=out_specs
+    )
+    return jax.jit(fn)
+
+
+def make_sharded_windows_fn(
+    mesh: Mesh,
+    *,
+    policy: str = "balanced_cpu_diskio",
+    normalizer: str = "min_max",
+    node_axes: str | tuple[str, ...] = NODE_AXIS,
+    soft: bool = False,
+):
+    """Multi-window sharded scheduling: engine.schedule_windows with the
+    node axis sharded over `mesh`.
+
+    Takes (snapshot, pods_windows) where pods_windows carries a leading
+    [w, p, ...] window axis (engine.stack_windows) and returns
+    engine.WindowsResult. One device dispatch schedules the whole
+    backlog: a lax.scan over windows threads free capacity AND the
+    in-window (anti)affinity domain-count carry (the [2, n_global, S]
+    table _sharded_greedy maintains) between windows, so window k+1 sees
+    window k's placements exactly as the dense schedule_windows scan
+    does. Greedy assigner only, like make_sharded_schedule_fn.
+    """
+    from kubernetes_scheduler_tpu.engine import WindowsResult
+
+    axes, node, rep, snap_specs, pod_specs = _mesh_specs(mesh, node_axes)
+    out_specs = WindowsResult(node_idx=rep, free_after=node, n_assigned=rep)
+
+    def body(snapshot: SnapshotArrays, pods_w: PodBatch) -> WindowsResult:
+        s = snapshot.domain_counts.shape[1]
+        n_local = snapshot.allocatable.shape[0]
+        n_global = n_local * jax.lax.psum(1, axes)
+        free0 = compute_free_capacity(snapshot)
+        added0 = jax.lax.pcast(
+            jnp.zeros((2, n_global, s), jnp.float32), axes, to="varying"
+        )
+
+        cols = jnp.arange(s)[None, :]
+
+        def wstep(carry, w):
+            free, added2 = carry
+            # feasibility must see the capacity consumed by previous
+            # windows, and the SOFT terms (preferred inter-pod affinity)
+            # must see their placements' domain counts — the dense scan
+            # folds both into its carried snapshot. Scores read
+            # utilization series, which are static across the backlog.
+            snap_pipe = snapshot._replace(
+                requested=snapshot.allocatable - free,
+                domain_counts=snapshot.domain_counts
+                + added2[0][snapshot.domain_id, cols],
+                avoid_counts=snapshot.avoid_counts
+                + added2[1][snapshot.domain_id, cols],
+            )
+            _, norm, feasible = _window_pipeline(
+                snap_pipe, w, policy, normalizer, soft, axes
+            )
+            # greedy takes the ORIGINAL counts plus the added2 carry (it
+            # layers the carry itself — snap_pipe's folded counts would
+            # double-count)
+            node_idx, free_after, added2 = _sharded_greedy(
+                norm, feasible, w, free, snapshot, axes, added2
+            )
+            return (free_after, added2), (
+                node_idx, (node_idx >= 0).sum().astype(jnp.int32)
+            )
+
+        (free_f, _), (idx, counts) = jax.lax.scan(
+            wstep, (free0, added0), pods_w
+        )
+        return WindowsResult(
+            node_idx=idx,
+            free_after=free_f,
+            n_assigned=counts.sum().astype(jnp.int32),
         )
 
     fn = shard_map(

@@ -170,6 +170,111 @@ def test_sharded_engine_matches_single_device(policy):
     )
 
 
+def test_sharded_windows_matches_dense_schedule_windows():
+    """Whole-backlog scheduling on the 8-device mesh: the sharded
+    multi-window scan (capacity + affinity carries threaded across
+    windows) must make exactly the dense schedule_windows decisions,
+    constraint families included."""
+    from kubernetes_scheduler_tpu.parallel.engine import make_sharded_windows_fn
+    from kubernetes_scheduler_tpu.sim import gen_cluster, gen_pods
+
+    snapshot = gen_cluster(64, seed=5, constraints=True)
+    pods = gen_pods(24, seed=6, constraints=True)
+    windows = stack_windows(pods, 8)
+    dense = schedule_windows(
+        snapshot, windows, assigner="greedy", affinity_aware=True,
+        normalizer="none",
+    )
+    mesh = make_mesh(8)
+    fn = make_sharded_windows_fn(mesh, normalizer="min_max")
+    sharded = fn(snapshot, windows)
+    np.testing.assert_array_equal(
+        np.asarray(sharded.node_idx), np.asarray(dense.node_idx)
+    )
+    assert int(sharded.n_assigned) == int(dense.n_assigned)
+    np.testing.assert_allclose(
+        np.asarray(sharded.free_after)[np.asarray(snapshot.node_mask)],
+        np.asarray(dense.free_after)[np.asarray(snapshot.node_mask)],
+        atol=1e-2,
+    )
+
+
+def test_sharded_windows_soft_sees_earlier_window_placements():
+    """soft=True across windows: preferred inter-pod affinity toward a
+    pod PLACED IN AN EARLIER WINDOW must boost that pod's domain, exactly
+    as the dense scan does (which folds placements into its carried
+    domain counts before scoring) — the carry must reach the soft terms,
+    not only the hard masks."""
+    from kubernetes_scheduler_tpu.parallel.engine import make_sharded_windows_fn
+
+    n, s = 8, 1
+    # two topology domains: nodes 0-3 (rep 0) and 4-7 (rep 4); base
+    # scores strictly favor domain B (higher disk_io balances the
+    # r_io-less pods' alpha-heavy score toward low-CPU nodes — simpler:
+    # make domain A's CPU% higher so its base score is lower)
+    snapshot = make_snapshot(
+        allocatable=np.full((n, 3), 1e6, np.float32),
+        requested=np.zeros((n, 3), np.float32),
+        disk_io=np.zeros(n),
+        cpu_pct=np.asarray([50.0] * 4 + [0.0] * 4),
+        mem_pct=np.zeros(n),
+        domain_counts=np.zeros((n, s), np.float32),
+        domain_id=np.repeat([0, 4], 4)[:, None].astype(np.int32),
+    )
+    # window 0: pod A matches selector 0 and is PINNED to node 1
+    # (domain A, the low-score domain). window 1: pod B prefers
+    # selector 0 with a weight that dwarfs the base-score gap.
+    pods = make_pod_batch(
+        request=np.ones((2, 3), np.float32),
+        pod_matches=np.asarray([[True], [False]]),
+        target_node=np.asarray([1, -1], np.int32),
+        pref_affinity_sel=np.asarray([[-1], [0]], np.int32),
+        pref_affinity_weight=np.asarray([[0.0], [1000.0]], np.float32),
+    )
+    windows = stack_windows(pods, 1)
+    dense = schedule_windows(
+        snapshot, windows, assigner="greedy", affinity_aware=True,
+        normalizer="min_max", soft=True,
+    )
+    didx = np.asarray(dense.node_idx).ravel()
+    assert didx[0] == 1
+    assert 0 <= didx[1] < 4, "dense soft carry should pull B into domain A"
+
+    fn = make_sharded_windows_fn(make_mesh(8), soft=True)
+    sharded = fn(snapshot, windows)
+    np.testing.assert_array_equal(np.asarray(sharded.node_idx), didx.reshape(2, 1))
+
+
+def test_sharded_windows_carries_anti_affinity_across_windows():
+    """Sharded mirror of the dense cross-window anti-affinity test: a
+    window-1 avoider must see window-0's placement through the carried
+    [2, n_global, S] table, across shard boundaries."""
+    from kubernetes_scheduler_tpu.parallel.engine import make_sharded_windows_fn
+
+    n, s = 8, 1
+    snapshot = make_snapshot(
+        allocatable=np.full((n, 3), 1e6, np.float32),
+        requested=np.zeros((n, 3), np.float32),
+        disk_io=np.zeros(n),
+        cpu_pct=np.zeros(n),
+        mem_pct=np.zeros(n),
+        domain_counts=np.zeros((n, s), np.float32),
+        domain_id=np.zeros((n, s), np.int32),  # one global domain
+    )
+    pods = make_pod_batch(
+        request=np.ones((2, 3), np.float32),
+        pod_matches=np.asarray([[True], [False]]),
+        anti_affinity_sel=np.asarray([[-1], [0]], np.int32),
+    )
+    mesh = make_mesh(8)
+    fn = make_sharded_windows_fn(mesh)
+    res = fn(snapshot, stack_windows(pods, 1))
+    idx = np.asarray(res.node_idx).ravel()
+    assert idx[0] >= 0
+    assert idx[1] == -1, "anti-affinity ignored window 0's placement"
+    assert int(res.n_assigned) == 1
+
+
 @pytest.mark.parametrize("normalizer", ["softmax", "none"])
 def test_sharded_normalizers_match_single_device(normalizer):
     snapshot, pods = random_state(64, 6)

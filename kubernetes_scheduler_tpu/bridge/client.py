@@ -62,6 +62,11 @@ class RemoteEngine:
             request_serializer=pb.ScheduleRequest.SerializeToString,
             response_deserializer=pb.ScheduleReply.FromString,
         )
+        self._schedule_windows = self._channel.unary_unary(
+            f"/{SERVICE}/ScheduleWindows",
+            request_serializer=pb.ScheduleRequest.SerializeToString,
+            response_deserializer=pb.ScheduleReply.FromString,
+        )
         self._health = self._channel.unary_unary(
             f"/{SERVICE}/Health",
             request_serializer=pb.HealthRequest.SerializeToString,
@@ -80,6 +85,8 @@ class RemoteEngine:
         fused: bool = False,
         affinity_aware: bool = True,
         soft: bool = False,
+        auction_price_frac: float = 0.0,
+        auction_rounds: int = 0,
     ) -> engine.ScheduleResult:
         request = pb.ScheduleRequest(
             policy=policy,
@@ -89,16 +96,57 @@ class RemoteEngine:
             fused=fused,
             affinity_aware=affinity_aware,
             soft=soft,
+            # 0 = sidecar default; nonzero rides the wire so remote
+            # engines honor the host's auction config instead of
+            # silently degrading to defaults
+            auction_price_frac=auction_price_frac,
+            auction_rounds=auction_rounds,
         )
         codec.pack_fields(snapshot, request.snapshot)
         codec.pack_fields(pods, request.pods)
+        reply = self._call_with_retry(self._schedule, request)
+        return self._unpack_result(reply, snapshot, pods)
 
+    def schedule_windows(
+        self,
+        snapshot,
+        pods_windows,
+        *,
+        policy: str = "balanced_cpu_diskio",
+        assigner: str = "auction",
+        normalizer: str = "none",
+        fused: bool = False,
+        affinity_aware: bool = True,
+        soft: bool = False,
+        auction_price_frac: float = 0.0,
+        auction_rounds: int = 0,
+    ) -> "engine.WindowsResult":
+        """Whole-backlog RPC: pods_windows carries a leading [w, p, ...]
+        window axis (engine.stack_windows); one sidecar dispatch
+        schedules every window with capacity and (anti)affinity carries
+        threaded between them, and the reply is engine.WindowsResult."""
+        request = pb.ScheduleRequest(
+            policy=policy,
+            assigner=assigner,
+            normalizer=normalizer,
+            fused=fused,
+            affinity_aware=affinity_aware,
+            soft=soft,
+            auction_price_frac=auction_price_frac,
+            auction_rounds=auction_rounds,
+        )
+        codec.pack_fields(snapshot, request.snapshot)
+        codec.pack_fields(pods_windows, request.pods)
+        reply = self._call_with_retry(self._schedule_windows, request)
+        return codec.unpack_fields(engine.WindowsResult, reply.result)
+
+    def _call_with_retry(self, method, request):
         last_err = None
         for attempt in range(self.retries + 1):
             try:
-                reply = self._schedule(request, timeout=self.deadline_seconds)
+                reply = method(request, timeout=self.deadline_seconds)
                 self.last_engine_seconds = reply.engine_seconds
-                return self._unpack_result(reply, snapshot, pods)
+                return reply
             except grpc.RpcError as e:
                 last_err = e
                 if e.code() not in _RETRYABLE:

@@ -31,6 +31,16 @@ log = logging.getLogger("yoda_tpu.bridge.server")
 SERVICE = "yodatpu.Engine"
 _DECISION_FIELDS = ("node_idx", "free_after", "n_assigned")
 
+
+def _auction_kw(request: pb.ScheduleRequest) -> dict:
+    """Auction knobs from the wire; proto3 zero means "engine default"."""
+    kw = {}
+    if request.auction_price_frac > 0:
+        kw["auction_price_frac"] = request.auction_price_frac
+    if request.auction_rounds > 0:
+        kw["auction_rounds"] = int(request.auction_rounds)
+    return kw
+
 # Matrices are ~P*N*4 bytes; 10k nodes x 4k pods of f32 scores is ~160 MB.
 MAX_MESSAGE_BYTES = 512 * 1024 * 1024
 
@@ -46,8 +56,12 @@ class EngineService:
         sharded_fn=None,
         sharded_opts: dict | None = None,
         sharded_fn_soft=None,
+        sharded_windows_fn=None,
+        sharded_windows_fn_soft=None,
     ):
         self._sharded_fn = sharded_fn
+        self._sharded_windows_fn = sharded_windows_fn
+        self._sharded_windows_fn_soft = sharded_windows_fn_soft
         # soft (preferred-constraint) variant: request.soft selects it, so
         # a host that detects preferred terms is served them rather than
         # getting silently-unscored placements
@@ -57,6 +71,37 @@ class EngineService:
         self._sharded_opts = sharded_opts or {}
         self.cycles_served = 0
         self._lock = threading.Lock()
+
+    def _pick_sharded_fn(self, request, context, fn, fn_soft, what):
+        """Validate the request against the options baked into the
+        sharded engine at startup (fail loud, never silently override)
+        and select the plain or soft variant."""
+        asked = {
+            "policy": request.policy,
+            "assigner": request.assigner,
+            "normalizer": request.normalizer,
+        }
+        for key, want in asked.items():
+            # make_sharded_*_fn factories are greedy-only, so an opts
+            # dict that doesn't say otherwise still pins greedy
+            default = "greedy" if key == "assigner" else None
+            have = self._sharded_opts.get(key, default)
+            if want and have and want != have:
+                context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    f"sidecar's sharded engine is fixed to "
+                    f"{key}={have!r}; request asked for {want!r}",
+                )
+        if request.soft:
+            if fn_soft is None:
+                context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    f"request asked for soft (preferred-constraint) "
+                    f"scoring but this sidecar's {what} was built "
+                    f"without a soft variant",
+                )
+            return fn_soft
+        return fn
 
     def schedule_batch(self, request: pb.ScheduleRequest, context) -> pb.ScheduleReply:
         try:
@@ -70,32 +115,10 @@ class EngineService:
                 # `fused` is a decision-identical optimization hint; the
                 # sharded engine has no fused path, so serve unfused rather
                 # than degrade the deployment to the host's scalar fallback
-                asked = {
-                    "policy": request.policy,
-                    "assigner": request.assigner,
-                    "normalizer": request.normalizer,
-                }
-                for key, want in asked.items():
-                    # make_sharded_schedule_fn is greedy-only, so an opts
-                    # dict that doesn't say otherwise still pins greedy
-                    default = "greedy" if key == "assigner" else None
-                    have = self._sharded_opts.get(key, default)
-                    if want and have and want != have:
-                        context.abort(
-                            grpc.StatusCode.INVALID_ARGUMENT,
-                            f"sidecar's sharded engine is fixed to "
-                            f"{key}={have!r}; request asked for {want!r}",
-                        )
-                fn = self._sharded_fn
-                if request.soft:
-                    if self._sharded_fn_soft is None:
-                        context.abort(
-                            grpc.StatusCode.INVALID_ARGUMENT,
-                            "request asked for soft (preferred-constraint) "
-                            "scoring but this sidecar's sharded engine was "
-                            "built without a soft variant",
-                        )
-                    fn = self._sharded_fn_soft
+                fn = self._pick_sharded_fn(
+                    request, context, self._sharded_fn,
+                    self._sharded_fn_soft, "sharded engine",
+                )
                 res = fn(snapshot, pods)
             else:
                 res = engine.schedule_batch(
@@ -107,6 +130,7 @@ class EngineService:
                     fused=request.fused,
                     affinity_aware=request.affinity_aware,
                     soft=request.soft,
+                    **_auction_kw(request),
                 )
         except ValueError as e:  # unknown policy/assigner/normalizer
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
@@ -117,6 +141,48 @@ class EngineService:
         reply = pb.ScheduleReply(engine_seconds=dt)
         only = set(_DECISION_FIELDS) if request.decisions_only else None
         codec.pack_fields(res, reply.result, only=only)
+        return reply
+
+    def schedule_windows(
+        self, request: pb.ScheduleRequest, context
+    ) -> pb.ScheduleReply:
+        """Whole-backlog RPC: pods carry a leading [w, p, ...] window
+        axis; the reply holds engine.WindowsResult fields. One device
+        dispatch schedules every window with capacity + (anti)affinity
+        carries threaded between them."""
+        try:
+            snapshot = codec.unpack_fields(engine.SnapshotArrays, request.snapshot)
+            pods_w = codec.unpack_fields(engine.PodBatch, request.pods)
+        except (ValueError, TypeError) as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        t0 = time.perf_counter()
+        try:
+            if self._sharded_windows_fn is not None:
+                fn = self._pick_sharded_fn(
+                    request, context, self._sharded_windows_fn,
+                    self._sharded_windows_fn_soft, "sharded windows engine",
+                )
+                res = fn(snapshot, pods_w)
+            else:
+                res = engine.schedule_windows(
+                    snapshot,
+                    pods_w,
+                    policy=request.policy or "balanced_cpu_diskio",
+                    assigner=request.assigner or "auction",
+                    normalizer=request.normalizer or "none",
+                    fused=request.fused,
+                    affinity_aware=request.affinity_aware,
+                    soft=request.soft,
+                    **_auction_kw(request),
+                )
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        res = jax.tree_util.tree_map(np.asarray, res)
+        dt = time.perf_counter() - t0
+        with self._lock:
+            self.cycles_served += 1
+        reply = pb.ScheduleReply(engine_seconds=dt)
+        codec.pack_fields(res, reply.result)
         return reply
 
     def health(self, request: pb.HealthRequest, context) -> pb.HealthReply:
@@ -135,6 +201,8 @@ def make_server(
     sharded_fn=None,
     sharded_opts: dict | None = None,
     sharded_fn_soft=None,
+    sharded_windows_fn=None,
+    sharded_windows_fn_soft=None,
     max_workers: int = 1,
 ) -> tuple[grpc.Server, int, EngineService]:
     """Build (server, bound_port, service). max_workers=1 keeps device
@@ -143,12 +211,19 @@ def make_server(
         sharded_fn=sharded_fn,
         sharded_opts=sharded_opts,
         sharded_fn_soft=sharded_fn_soft,
+        sharded_windows_fn=sharded_windows_fn,
+        sharded_windows_fn_soft=sharded_windows_fn_soft,
     )
     handlers = grpc.method_handlers_generic_handler(
         SERVICE,
         {
             "ScheduleBatch": grpc.unary_unary_rpc_method_handler(
                 service.schedule_batch,
+                request_deserializer=pb.ScheduleRequest.FromString,
+                response_serializer=pb.ScheduleReply.SerializeToString,
+            ),
+            "ScheduleWindows": grpc.unary_unary_rpc_method_handler(
+                service.schedule_windows,
                 request_deserializer=pb.ScheduleRequest.FromString,
                 response_serializer=pb.ScheduleReply.SerializeToString,
             ),
@@ -197,7 +272,10 @@ def main(argv=None):
     sharded_fn = None
     if args.mesh_devices > 1:
         from jax.sharding import Mesh
-        from kubernetes_scheduler_tpu.parallel.engine import make_sharded_schedule_fn
+        from kubernetes_scheduler_tpu.parallel.engine import (
+            make_sharded_schedule_fn,
+            make_sharded_windows_fn,
+        )
         from kubernetes_scheduler_tpu.parallel.mesh import (
             DCN_AXIS, NODE_AXIS, make_mesh_multihost,
         )
@@ -220,6 +298,12 @@ def main(argv=None):
         sharded_fn_soft = make_sharded_schedule_fn(
             mesh, policy=args.policy, node_axes=node_axes, soft=True
         )
+        sharded_windows_fn = make_sharded_windows_fn(
+            mesh, policy=args.policy, node_axes=node_axes
+        )
+        sharded_windows_fn_soft = make_sharded_windows_fn(
+            mesh, policy=args.policy, node_axes=node_axes, soft=True
+        )
         # assigner is pinned too: the sharded engine is greedy-only, and a
         # host that asked for the auction must get an error, not silently
         # different placement semantics
@@ -230,6 +314,8 @@ def main(argv=None):
         }
     else:
         sharded_fn_soft = None
+        sharded_windows_fn = None
+        sharded_windows_fn_soft = None
         sharded_opts = None
 
     server, port, _ = make_server(
@@ -237,6 +323,8 @@ def main(argv=None):
         sharded_fn=sharded_fn,
         sharded_opts=sharded_opts,
         sharded_fn_soft=sharded_fn_soft,
+        sharded_windows_fn=sharded_windows_fn,
+        sharded_windows_fn_soft=sharded_windows_fn_soft,
     )
     server.start()
     log.info(

@@ -362,6 +362,9 @@ class LocalEngine:
     def schedule_batch(self, snapshot, pods, **kw) -> "ScheduleResult":
         return schedule_batch(snapshot, pods, **kw)
 
+    def schedule_windows(self, snapshot, pods_windows, **kw) -> "WindowsResult":
+        return schedule_windows(snapshot, pods_windows, **kw)
+
     def healthy(self) -> bool:
         return True
 

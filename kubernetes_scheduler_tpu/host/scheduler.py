@@ -152,10 +152,11 @@ class Scheduler:
                 engine = LearnedEngine(state.params, model=model)
         self.engine = engine or LocalEngine()
         # auction knobs ride only engines whose call surface takes them
-        # (LocalEngine's **kw does; the gRPC bridge's wire protocol does
-        # not) — gating on the SIGNATURE, not on config values, so a
-        # non-default knob against a remote engine degrades to defaults
-        # instead of TypeError-ing every cycle into the scalar fallback
+        # (LocalEngine's **kw and RemoteEngine's explicit params both do;
+        # the knobs ride the ScheduleRequest wire fields) — gating on the
+        # SIGNATURE so an engine predating the wire fields degrades to
+        # defaults instead of TypeError-ing every cycle into the scalar
+        # fallback
         import inspect
 
         try:

@@ -385,16 +385,17 @@ def make_sharded_windows_fn(
         def wstep(carry, w):
             free, added2 = carry
             # feasibility must see the capacity consumed by previous
-            # windows, and the SOFT terms (preferred inter-pod affinity)
-            # must see their placements' domain counts — the dense scan
-            # folds both into its carried snapshot. Scores read
-            # utilization series, which are static across the backlog.
+            # windows, and the SOFT terms (preferred inter-pod affinity,
+            # the one domain_counts reader in the pipeline) must see
+            # their placements' domain counts, like the dense scan's
+            # fold. avoid_counts is NOT folded here: its only reader
+            # (the reverse anti-affinity check) runs inside
+            # _sharded_greedy from the added2 carry directly. Scores
+            # read utilization series, static across the backlog.
             snap_pipe = snapshot._replace(
                 requested=snapshot.allocatable - free,
                 domain_counts=snapshot.domain_counts
                 + added2[0][snapshot.domain_id, cols],
-                avoid_counts=snapshot.avoid_counts
-                + added2[1][snapshot.domain_id, cols],
             )
             _, norm, feasible = _window_pipeline(
                 snap_pipe, w, policy, normalizer, soft, axes

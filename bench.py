@@ -245,31 +245,37 @@ def loop_rate() -> dict:
 
     n_nodes = int(os.environ.get("BENCH_LOOP_NODES", 4000))
     n_pods = int(os.environ.get("BENCH_LOOP_PODS", 8192))
-    nodes, advisor = gen_host_cluster(n_nodes, seed=0)
-    pods = gen_host_pods(n_pods, seed=1)
-    running: list = []
-    sched = Scheduler(
-        SchedulerConfig(batch_window=1024, normalizer="none"),
-        advisor=advisor,
-        list_nodes=lambda: nodes,
-        list_running_pods=lambda: running,
-    )
-    for pod in pods:
-        sched.submit(pod)
-    t0 = time.perf_counter()
-    cycles = []
-    seen = 0
-    for _ in range(64):
-        if len(sched.queue) == 0:
-            break
-        cycles.append(sched.run_cycle())
-        # feed this cycle's binds back as running pods, so later cycles
-        # pay the real steady-state snapshot cost (NonZeroRequested
-        # re-sum over every bound pod) and capacity accrues
-        for b in sched.binder.bindings[seen:]:
-            running.append(b.pod)
-        seen = len(sched.binder.bindings)
-    dt = time.perf_counter() - t0
+    # two identical passes over fresh clusters: the first compiles the
+    # device program(s) (tens of seconds on a cold chip, paid once per
+    # process in a real deployment), the second measures the steady
+    # state the latency metric is about
+    for _phase in ("warmup", "measured"):
+        nodes, advisor = gen_host_cluster(n_nodes, seed=0)
+        pods = gen_host_pods(n_pods, seed=1)
+        running: list = []
+        sched = Scheduler(
+            SchedulerConfig(batch_window=1024, normalizer="none"),
+            advisor=advisor,
+            list_nodes=lambda: nodes,
+            list_running_pods=lambda: running,
+        )
+        for pod in pods:
+            sched.submit(pod)
+        t0 = time.perf_counter()
+        cycles = []
+        seen = 0
+        for _ in range(64):
+            if len(sched.queue) == 0:
+                break
+            cycles.append(sched.run_cycle())
+            # feed this cycle's binds back as running pods, so later
+            # cycles pay the real steady-state snapshot cost
+            # (NonZeroRequested re-sum over every bound pod) and
+            # capacity accrues
+            for b in sched.binder.bindings[seen:]:
+                running.append(b.pod)
+            seen = len(sched.binder.bindings)
+        dt = time.perf_counter() - t0
     bound = sum(c.pods_bound for c in cycles)
     lat = [c.cycle_seconds for c in cycles]
     eng = [c.engine_seconds for c in cycles]

@@ -149,6 +149,13 @@ class RemoteEngine:
                 return reply
             except grpc.RpcError as e:
                 last_err = e
+                if e.code() == grpc.StatusCode.UNIMPLEMENTED:
+                    # version-skewed sidecar without this RPC: callers
+                    # (host backlog mode) degrade to the per-window
+                    # surface rather than treating it as an outage
+                    raise NotImplementedError(
+                        f"sidecar {self.target} does not serve this RPC"
+                    ) from e
                 if e.code() not in _RETRYABLE:
                     raise EngineUnavailable(
                         f"sidecar rejected cycle: {e.code().name}: {e.details()}"

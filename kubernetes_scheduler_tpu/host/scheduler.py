@@ -167,6 +167,9 @@ class Scheduler:
             )
         except (TypeError, ValueError):
             self._engine_takes_auction_kw = False
+        # deep-queue batching needs the windows surface; flips False at
+        # runtime if a version-skewed sidecar answers UNIMPLEMENTED
+        self._engine_windows_ok = hasattr(self.engine, "schedule_windows")
         self.binder = binder or RecordingBinder()
         self.evictor = evictor
         self._cycle_unsched: list[Pod] = []
@@ -259,7 +262,14 @@ class Scheduler:
         t0 = time.perf_counter()
         self._cycle_unsched = []
         self._cycle_bound = []
-        window = self.queue.pop_window(self.config.batch_window)
+        window = self.queue.pop_window(
+            self.config.batch_window
+            * (
+                max(1, self.config.max_windows_per_cycle)
+                if self._engine_windows_ok
+                else 1
+            )
+        )
         m.pods_in = len(window)
         if not window:
             # empty cycles (backoff waits, idle polls) are not recorded:
@@ -317,10 +327,38 @@ class Scheduler:
         else:
             use_device = cells >= self.config.min_device_work
         t_path = time.perf_counter()
+        backlog = (
+            len(window) > self.config.batch_window and self._engine_windows_ok
+        )
         if self.config.feature_gates.tpu_batch_score and nodes and use_device:
             try:
-                self._run_batched(window, nodes, running, utils, m)
-                if self._dispatch is not None and scalar_eligible:
+                # deep backlog: schedule all popped windows in ONE engine
+                # dispatch when the engine serves the windows surface
+                if backlog:
+                    try:
+                        self._run_backlog(window, nodes, running, utils, m)
+                    except NotImplementedError:
+                        # version-skewed sidecar without the windows RPC:
+                        # degrade to per-window dispatches (same
+                        # decisions, one RPC each), never to the scalar
+                        # fallback, and stop popping deep windows
+                        log.warning(
+                            "engine lacks the windows surface; falling "
+                            "back to per-window dispatch"
+                        )
+                        self._engine_windows_ok = False
+                        bw = self.config.batch_window
+                        for i in range(0, len(window), bw):
+                            self._run_batched(
+                                window[i : i + bw], nodes, running, utils, m
+                            )
+                else:
+                    self._run_batched(window, nodes, running, utils, m)
+                # backlog cycles amortize dispatch over many windows — a
+                # different cost curve than the single-dispatch cycles
+                # the scalar/device crossover model is about, so only
+                # single-window cycles feed it
+                if self._dispatch is not None and scalar_eligible and not backlog:
                     self._dispatch.observe(
                         True, cells, time.perf_counter() - t_path
                     )
@@ -340,7 +378,7 @@ class Scheduler:
                 # model that a fast-failing path is cheap and keep
                 # routing to it; pricing nothing would never re-model a
                 # degraded path at all.
-                if self._dispatch is not None and scalar_eligible:
+                if self._dispatch is not None and scalar_eligible and not backlog:
                     self._dispatch.observe(
                         True, cells, time.perf_counter() - t_path
                     )
@@ -589,27 +627,21 @@ class Scheduler:
         m.pods_unschedulable += 1
         self._cycle_unsched.append(pod)
 
-    def _run_batched(self, window, nodes, running, utils, m: CycleMetrics):
-        # snapshot FIRST: build_snapshot registers every selector the cycle
-        # needs — the window's terms AND running pods' anti terms (reverse
-        # anti-affinity) — so build_pod_batch computes pod_matches against
-        # the complete table. Reversed, a selector first introduced by a
-        # running avoider would be missing from pod_matches and the reverse
-        # check would silently pass.
-        snapshot = self.builder.build_snapshot(
-            nodes, utils, running, pending_pods=window
-        )
-        pods_batch = self.builder.build_pod_batch(window)
-        # both assigners enforce window-internal (anti)affinity exactly
-        # (greedy: live counts in the scan; auction: per-round dynamic
-        # masks + same-round conflict eviction — ops/assign.py). The
-        # dynamic machinery is only needed when placements inside this
-        # window can interact: some pod matches a selector AND some pod
-        # constrains on one; otherwise static pre-window counts are exact
-        # and ~2x cheaper.
-        assigner = self.config.assigner
-        # preferred (soft) constraints become score terms only when present
-        # (window preferences, running pods' preferred terms, soft taints)
+    def _engine_options(self, window, nodes, running, pods_batch) -> dict:
+        """Per-cycle engine options, shared by the single-window and
+        backlog device paths so their semantics cannot diverge.
+
+        Both assigners enforce window-internal (anti)affinity exactly
+        (greedy: live counts in the scan; auction: per-round dynamic
+        masks + same-round conflict eviction — ops/assign.py). The
+        dynamic machinery is only needed when placements inside this
+        cycle can interact: some pod matches a selector AND some pod
+        constrains on one; otherwise static pre-window counts are exact
+        and ~2x cheaper. Preferred (soft) constraints become score terms
+        only when present (window preferences, running pods' preferred
+        terms, soft taints). The fused Pallas path is an optimization
+        with identical decisions; silently unavailable outside its
+        (policy, normalizer) domain."""
         soft = (
             any(
                 pd.preferred_node_affinity
@@ -629,31 +661,84 @@ class Scheduler:
                 or (np.asarray(pods_batch.spread_sel) >= 0).any()
             )
         )
-        # the fused Pallas path is an optimization with identical decisions;
-        # silently unavailable outside its (policy, normalizer) domain
         fused = (
             self.config.feature_gates.fused_kernel
             and self.config.policy == "balanced_cpu_diskio"
             and self.config.normalizer == "none"
         )
-        kw = {}
-        if self._engine_takes_auction_kw:
-            kw = dict(
-                auction_rounds=self.config.auction_rounds,
-                auction_price_frac=self.config.auction_price_frac,
-            )
-        t0 = time.perf_counter()
-        res = self.engine.schedule_batch(
-            snapshot,
-            pods_batch,
+        kw = dict(
             policy=self.config.policy,
-            assigner=assigner,
+            assigner=self.config.assigner,
             normalizer=self.config.normalizer,
             fused=fused,
             affinity_aware=affinity_aware,
             soft=soft,
-            **kw,
         )
+        if self._engine_takes_auction_kw:
+            kw.update(
+                auction_rounds=self.config.auction_rounds,
+                auction_price_frac=self.config.auction_price_frac,
+            )
+        return kw
+
+    def _run_backlog(self, window, nodes, running, utils, m: CycleMetrics):
+        """Deep-queue cycle: schedule the whole backlog as stacked
+        windows in ONE engine dispatch (engine.schedule_windows /
+        the ScheduleWindows RPC), capacity and (anti)affinity carried
+        between windows on device instead of one dispatch per window."""
+        from kubernetes_scheduler_tpu.engine import stack_windows
+        from kubernetes_scheduler_tpu.utils.padding import pad_pod_batch
+
+        bw = self.config.batch_window
+        snapshot = self.builder.build_snapshot(
+            nodes, utils, running, pending_pods=window
+        )
+        pods_batch = self.builder.build_pod_batch(window)
+        n_padded = -(-len(window) // bw) * bw
+        p_have = int(np.asarray(pods_batch.request).shape[0])
+        if p_have < n_padded:
+            pods_batch = pad_pod_batch(pods_batch, n_padded)
+        elif p_have > n_padded:
+            # bucket padding overshot the window multiple: drop only
+            # pod_mask=False padding rows
+            pods_batch = type(pods_batch)(
+                *[np.asarray(a)[:n_padded] for a in pods_batch]
+            )
+        windows = stack_windows(pods_batch, bw)
+        kw = self._engine_options(window, nodes, running, pods_batch)
+        t0 = time.perf_counter()
+        res = self.engine.schedule_windows(snapshot, windows, **kw)
+        idx = np.asarray(res.node_idx).reshape(-1)
+        m.engine_seconds = time.perf_counter() - t0
+        if (
+            idx.shape[0] < len(window)
+            or (idx[: len(window)] >= len(nodes)).any()
+        ):
+            raise RuntimeError(
+                f"engine returned node_idx shape {np.asarray(res.node_idx).shape} "
+                f"for a {len(window)}-pod backlog over {len(nodes)} nodes"
+            )
+        for i, pod in enumerate(window):
+            j = int(idx[i])
+            if j >= 0:
+                self._bind(pod, nodes[j].name, m)
+            else:
+                self._requeue_unschedulable(pod, m)
+
+    def _run_batched(self, window, nodes, running, utils, m: CycleMetrics):
+        # snapshot FIRST: build_snapshot registers every selector the cycle
+        # needs — the window's terms AND running pods' anti terms (reverse
+        # anti-affinity) — so build_pod_batch computes pod_matches against
+        # the complete table. Reversed, a selector first introduced by a
+        # running avoider would be missing from pod_matches and the reverse
+        # check would silently pass.
+        snapshot = self.builder.build_snapshot(
+            nodes, utils, running, pending_pods=window
+        )
+        pods_batch = self.builder.build_pod_batch(window)
+        kw = self._engine_options(window, nodes, running, pods_batch)
+        t0 = time.perf_counter()
+        res = self.engine.schedule_batch(snapshot, pods_batch, **kw)
         idx = np.asarray(res.node_idx)
         m.engine_seconds = time.perf_counter() - t0
         p_padded = int(np.asarray(pods_batch.request).shape[0])

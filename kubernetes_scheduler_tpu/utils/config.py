@@ -52,9 +52,9 @@ class SchedulerConfig:
     # auction assigner knobs (ops/assign.auction_assign). price_frac is the
     # quality/throughput dial: rounds-to-converge scales ~1/price_frac
     # while mean placement score degrades ~2% from 1/16 to 1.0 (measured,
-    # PARITY.md); 1/16 keeps host scheduling quality-first. Non-default
-    # values apply to the in-process engine only — the gRPC bridge serves
-    # the defaults (knobs are not in the wire protocol).
+    # PARITY.md); 1/16 keeps host scheduling quality-first. The knobs ride
+    # the gRPC wire too (ScheduleRequest.auction_*), so remote engines
+    # honor them.
     auction_rounds: int = 1024
     auction_price_frac: float = 1.0 / 16.0
     # resource -> weight, all 1 like the reference (scheduler.go:75-77)
@@ -84,6 +84,11 @@ class SchedulerConfig:
     # sidecar ~1ms — a 20x shift in the break-even point).
     min_device_work: int = 1 << 20
     adaptive_dispatch: bool = True
+    # deep-queue batching: a cycle may pop up to this many windows and
+    # schedule them in ONE engine dispatch (engine.schedule_windows /
+    # the ScheduleWindows RPC) with capacity + affinity carried between
+    # windows on device. 1 = one window per cycle (the upstream shape).
+    max_windows_per_cycle: int = 8
     # preemption (upstream PostFilter parity, ops/preempt.py): when a pod
     # fits nowhere, evict <= preemption_max_victims strictly-lower-
     # priority pods from the least-disruptive node. Requires an evictor

@@ -43,16 +43,34 @@ def pad_to_bucket(arr: np.ndarray, axis: int = 0, *, floor: int = 8, fill=0):
     return pad_axis(arr, size, axis, fill), mask
 
 
+# Fields whose "absent" encoding is -1, not 0 (make_pod_batch defaults):
+# selector ids, the NodeName pin, and the card wants. Zero-filled padding
+# would read as "selector 0" / "pinned to node 0" / "wants 0-memory
+# card"; decisions stay correct (pod_mask gates the engine) but any
+# consumer inspecting the raw fields — e.g. the host's affinity_aware
+# heuristic — would see phantom constraints.
+_NEG_SENTINEL_FIELDS = frozenset({
+    "affinity_sel", "anti_affinity_sel", "spread_sel", "target_node",
+    "pref_affinity_sel", "pref_anti_sel", "want_memory", "want_clock",
+})
+
+
 def pad_pod_batch(pods, size: int):
     """Pad every array of a PodBatch along the pod axis to `size`, with
-    pod_mask False on the padding (all other fields zero-filled — the
-    engine masks padded pods out of feasibility and assignment)."""
+    pod_mask False on the padding and each field's own absent sentinel
+    (-1 for selector/pin/card-want fields, 0 elsewhere)."""
     p = pods.request.shape[0]
     if p > size:
         raise ValueError(f"pod count {p} > target {size}")
     if p == size:
         return pods
     return type(pods)(
-        *[pad_axis(np.asarray(f), size, 0) for f in pods]
+        *[
+            pad_axis(
+                np.asarray(f), size, 0,
+                fill=-1 if name in _NEG_SENTINEL_FIELDS else 0,
+            )
+            for name, f in zip(pods._fields, pods)
+        ]
     )._replace(pod_mask=np.concatenate([np.asarray(pods.pod_mask),
                                         np.zeros(size - p, bool)]))

@@ -194,16 +194,18 @@ def test_domain_counts_topology_aggregation():
     assert counts[:3, host_sid].tolist() == [1.0, 0.0, 0.0]
 
 
-def make_sched(nodes, running, utils, **cfg):
+def make_sched(nodes, running, utils, *, engine_override=None, **cfg):
     # min_device_work=0: tests drive the batched path on tiny clusters that
     # adaptive dispatch would otherwise (correctly) route to the scalar path
     cfg.setdefault("min_device_work", 0)
-    config = SchedulerConfig(batch_window=64, **cfg)
+    cfg.setdefault("batch_window", 64)
+    config = SchedulerConfig(**cfg)
     return Scheduler(
         config,
         advisor=StaticAdvisor(utils),
         list_nodes=lambda: nodes,
         list_running_pods=lambda: running,
+        engine=engine_override,
     )
 
 
@@ -300,6 +302,95 @@ def test_adaptive_dispatch_tiny_cycle_uses_scalar():
     s2.submit(pod)
     m2 = s2.run_cycle()
     assert m2.pods_bound == 1 and not m2.used_fallback  # device dispatch
+
+
+def test_backlog_cycle_schedules_all_windows_in_one_dispatch():
+    """A deep queue pops max_windows_per_cycle windows and schedules them
+    through ONE engine.schedule_windows dispatch; placements must be
+    feasible and capacity-consistent, and every pod handled."""
+    nodes = [make_node(f"n{i}", cpu=8000) for i in range(6)]
+    utils = {
+        f"n{i}": NodeUtil(cpu_pct=10 * i, disk_io=5) for i in range(6)
+    }
+    calls = []
+
+    class CountingEngine:
+        def __init__(self):
+            from kubernetes_scheduler_tpu.engine import LocalEngine
+
+            self._inner = LocalEngine()
+
+        def schedule_batch(self, *a, **kw):
+            calls.append("batch")
+            return self._inner.schedule_batch(*a, **kw)
+
+        def schedule_windows(self, *a, **kw):
+            calls.append("windows")
+            return self._inner.schedule_windows(*a, **kw)
+
+        def healthy(self):
+            return True
+
+    s = make_sched(nodes, [], utils, batch_window=8, engine_override=CountingEngine())
+    for i in range(30):
+        s.submit(make_pod(f"p{i}", cpu=500, annotations={"diskIO": "5"}))
+    m = s.run_cycle()
+    assert m.pods_in == 30 and m.pods_bound == 30
+    assert calls == ["windows"]  # one dispatch for the whole backlog
+    # capacity consistent: per-node sum of bound requests <= allocatable
+    used = {}
+    for b in s.binder.bindings:
+        used[b.node_name] = used.get(b.node_name, 0) + 500
+    assert all(v <= 8000 for v in used.values())
+
+    # max_windows_per_cycle=1 restores the one-window-per-cycle shape
+    s2 = make_sched(nodes, [], utils, batch_window=8, max_windows_per_cycle=1)
+    for i in range(30):
+        s2.submit(make_pod(f"q{i}", cpu=500, annotations={"diskIO": "5"}))
+    ms = s2.run_until_empty()
+    assert len(ms) == 4  # 8+8+8+6
+    assert sum(c.pods_bound for c in ms) == 30
+
+
+def test_backlog_degrades_to_per_window_on_unimplemented():
+    """A version-skewed engine whose windows surface answers
+    NotImplementedError must degrade to per-window schedule_batch
+    dispatches (same decisions), NEVER to the scalar fallback, and stop
+    popping deep windows afterwards."""
+    nodes = [make_node(f"n{i}", cpu=8000) for i in range(4)]
+    utils = {f"n{i}": NodeUtil(cpu_pct=10, disk_io=5) for i in range(4)}
+    calls = []
+
+    class SkewedEngine:
+        def __init__(self):
+            from kubernetes_scheduler_tpu.engine import LocalEngine
+
+            self._inner = LocalEngine()
+
+        def schedule_batch(self, *a, **kw):
+            calls.append("batch")
+            return self._inner.schedule_batch(*a, **kw)
+
+        def schedule_windows(self, *a, **kw):
+            calls.append("windows")
+            raise NotImplementedError("old sidecar")
+
+        def healthy(self):
+            return True
+
+    s = make_sched(nodes, [], utils, batch_window=8,
+                   engine_override=SkewedEngine())
+    for i in range(20):
+        s.submit(make_pod(f"p{i}", cpu=100, annotations={"diskIO": "5"}))
+    m = s.run_cycle()
+    assert m.pods_bound == 20 and not m.used_fallback
+    assert calls == ["windows", "batch", "batch", "batch"]  # 8+8+4 chunks
+    assert not s._engine_windows_ok
+    # subsequent cycles pop only one window
+    for i in range(20):
+        s.submit(make_pod(f"q{i}", cpu=100, annotations={"diskIO": "5"}))
+    m2 = s.run_cycle()
+    assert m2.pods_in == 8
 
 
 def test_failed_device_cycle_feeds_adaptive_model():

@@ -131,6 +131,7 @@ def cmd_scheduler_kube(args, cfg) -> int:
         evictor=KubeEvictor(client),
         list_nodes=source.list_nodes,
         list_running_pods=source.list_running_pods,
+        list_pdbs=source.list_pdbs,
         engine=engine,
     )
     # exporter FIRST: a standby replica blocks in acquire_blocking below,

@@ -113,6 +113,7 @@ class Scheduler:
         evictor=None,
         list_nodes: Callable[[], list[Node]],
         list_running_pods: Callable[[], list[Pod]],
+        list_pdbs: Callable[[], list] | None = None,
         engine=None,
     ):
         self.config = config
@@ -187,6 +188,9 @@ class Scheduler:
         self._nominations: dict[str, tuple[str, Pod, float]] = {}
         self.list_nodes = list_nodes
         self.list_running_pods = list_running_pods
+        # PodDisruptionBudgets for the preemption pass (None = no budgets
+        # consulted, e.g. simulated clusters without PDBs)
+        self.list_pdbs = list_pdbs
         if config.feature_gates.native_host:
             from kubernetes_scheduler_tpu import native
 
@@ -349,9 +353,28 @@ class Scheduler:
                         self._engine_windows_ok = False
                         bw = self.config.batch_window
                         for i in range(0, len(window), bw):
-                            self._run_batched(
-                                window[i : i + bw], nodes, running, utils, m
-                            )
+                            chunk = window[i : i + bw]
+                            # each chunk must see the capacity consumed
+                            # by earlier chunks' binds (the one-dispatch
+                            # path carries it on device; the one-window-
+                            # per-cycle shape re-lists between cycles)
+                            run_now = running + self._cycle_bound
+                            try:
+                                self._run_batched(
+                                    chunk, nodes, run_now, utils, m
+                                )
+                            except Exception:
+                                # chunk-local fallback: earlier chunks'
+                                # binds are final and must NOT be
+                                # re-scheduled by a whole-window fallback
+                                log.exception(
+                                    "chunk failed; scalar fallback for "
+                                    "this chunk only"
+                                )
+                                m.used_fallback = True
+                                self._run_scalar(
+                                    chunk, nodes, run_now, utils, m
+                                )
                 else:
                     self._run_batched(window, nodes, running, utils, m)
                 # backlog cycles amortize dispatch over many windows — a
@@ -385,7 +408,7 @@ class Scheduler:
         else:
             m.used_fallback = True
             self._run_scalar(window, nodes, running, utils, m)
-            if self._dispatch is not None and scalar_eligible:
+            if self._dispatch is not None and scalar_eligible and not backlog:
                 self._dispatch.observe(
                     False, cells, time.perf_counter() - t_path
                 )
@@ -464,6 +487,29 @@ class Scheduler:
             snapshot._replace(requested=jnp.zeros_like(snapshot.requested)),
             pend,
         )
+        # PodDisruptionBudgets: preemption NEVER violates one (stricter
+        # than upstream's last-resort violation ordering — documented in
+        # ops/preempt.py). Victims under an exhausted budget are excluded
+        # from the tables; remaining budgets cap the apply loop below.
+        pdbs = list(self.list_pdbs()) if self.list_pdbs is not None else []
+        budgets: list[int] = []
+        victim_budgets: dict[int, list[int]] = {}
+        if pdbs:
+            real = [
+                pd for pd in running
+                # neither nomination reservations (not real pods) nor
+                # terminating victims (already being disrupted) count as
+                # healthy — otherwise consecutive cycles each see the
+                # full count and re-spend the same disruption budget
+                if _pod_key(pd) not in self._nominations
+                and _pod_key(pd) not in self._pending_evictions
+            ]
+            for pdb in pdbs:
+                budgets.append(pdb.allowed(sum(1 for pd in real if pdb.selects(pd))))
+            for i, pd in enumerate(running):
+                sel = [b for b, pdb in enumerate(pdbs) if pdb.selects(pd)]
+                if sel:
+                    victim_budgets[i] = sel
         node_index = {nd.name: j for j, nd in enumerate(nodes)}
         vnode = np.full(np.asarray(vics.request).shape[0], -1, np.int32)
         for i, pd in enumerate(running):
@@ -473,6 +519,8 @@ class Scheduler:
             # real pod; a terminating victim is already dying)
             if key in self._pending_evictions or key in self._nominations:
                 continue
+            if any(budgets[b] <= 0 for b in victim_budgets.get(i, ())):
+                continue  # an exhausted budget protects this victim
             vnode[i] = node_index.get(pd.node_name, -1)
         res = preempt_candidates(
             pend.request,
@@ -505,11 +553,19 @@ class Scheduler:
             ):
                 continue
             claimed_nodes.add(j)
-            n_evicted = 0
-            for v in victim_ids[i]:
-                v = int(v)
-                if not (0 <= v < len(running)):
+            vset = [int(v) for v in victim_ids[i] if 0 <= int(v) < len(running)]
+            # a proposal that would overdraw any disruption budget is
+            # skipped whole (never partially violate): the preemptor
+            # retries next cycle against recomputed budgets
+            if victim_budgets:
+                need: dict[int, int] = {}
+                for v in vset:
+                    for b in victim_budgets.get(v, ()):
+                        need[b] = need.get(b, 0) + 1
+                if any(budgets[b] < k for b, k in need.items()):
                     continue
+            n_evicted = 0
+            for v in vset:
                 try:
                     self.evictor.evict(running[v], preemptor=pods[i])
                 except Exception:
@@ -523,6 +579,8 @@ class Scheduler:
                     )
                     break
                 self._pending_evictions[_pod_key(running[v])] = nodes[j].name
+                for b in victim_budgets.get(v, ()):
+                    budgets[b] -= 1
                 n_evicted += 1
             if n_evicted:
                 # the nomination must be recorded even for a PARTIAL
@@ -709,7 +767,7 @@ class Scheduler:
         t0 = time.perf_counter()
         res = self.engine.schedule_windows(snapshot, windows, **kw)
         idx = np.asarray(res.node_idx).reshape(-1)
-        m.engine_seconds = time.perf_counter() - t0
+        m.engine_seconds += time.perf_counter() - t0
         if (
             idx.shape[0] < len(window)
             or (idx[: len(window)] >= len(nodes)).any()
@@ -740,7 +798,7 @@ class Scheduler:
         t0 = time.perf_counter()
         res = self.engine.schedule_batch(snapshot, pods_batch, **kw)
         idx = np.asarray(res.node_idx)
-        m.engine_seconds = time.perf_counter() - t0
+        m.engine_seconds += time.perf_counter() - t0
         p_padded = int(np.asarray(pods_batch.request).shape[0])
         if (
             idx.shape != (p_padded,)

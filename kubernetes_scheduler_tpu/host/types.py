@@ -83,6 +83,73 @@ class SpreadConstraint:
 
 
 @dataclass
+class PodDisruptionBudget:
+    """policy/v1 PodDisruptionBudget, the slice preemption consults
+    (upstream PostFilter orders candidates by PDB violations; this
+    framework's preemption never violates a budget — documented stricter
+    deviation in ops/preempt.py's module docstring).
+
+    min_available / max_unavailable accept ints or "N%" strings exactly
+    like the API; disruptions_allowed, when set, is the server-computed
+    status.disruptionsAllowed and takes precedence over the spec math.
+    """
+
+    name: str
+    namespace: str = "default"
+    match_labels: dict[str, str] = field(default_factory=dict)
+    match_expressions: list["MatchExpression"] = field(default_factory=list)
+    min_available: int | str | None = None
+    max_unavailable: int | str | None = None
+    disruptions_allowed: int | None = None
+
+    def selects(self, pod: "Pod") -> bool:
+        if pod.namespace != self.namespace:
+            return False
+        if not all(
+            pod.labels.get(k) == v for k, v in self.match_labels.items()
+        ):
+            return False
+        for e in self.match_expressions:
+            has = e.key in pod.labels
+            val = pod.labels.get(e.key)
+            if e.operator == "In":
+                if not has or val not in e.values:
+                    return False
+            elif e.operator == "NotIn":
+                # k8s label-selector semantics: a missing key satisfies
+                # NotIn
+                if has and val in e.values:
+                    return False
+            elif e.operator == "Exists":
+                if not has:
+                    return False
+            elif e.operator == "DoesNotExist":
+                if has:
+                    return False
+            else:  # unknown operator: fail closed (select nothing)
+                return False
+        return True
+
+    def allowed(self, matching_count: int) -> int:
+        """Evictions this budget permits given the current healthy count."""
+        if self.disruptions_allowed is not None:
+            return max(0, int(self.disruptions_allowed))
+
+        def resolve(v) -> int:
+            if isinstance(v, str) and v.endswith("%"):
+                import math
+
+                return math.ceil(matching_count * float(v[:-1]) / 100.0)
+            return int(v)
+
+        if self.max_unavailable is not None:
+            return max(0, resolve(self.max_unavailable))
+        if self.min_available is not None:
+            return max(0, matching_count - resolve(self.min_available))
+        return matching_count  # no constraint given
+
+
+@dataclass
 class WeightedExpression:
     """One preferred node-affinity term: a weighted matchExpression
     (preferredDuringScheduling...; the upstream term's expression list is

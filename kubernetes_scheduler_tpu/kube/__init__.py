@@ -12,7 +12,7 @@ backend for host.leader.LeaderElector.
 """
 
 from kubernetes_scheduler_tpu.kube.client import KubeApiError, KubeClient, KubeConfig
-from kubernetes_scheduler_tpu.kube.convert import node_from_api, pod_from_api
+from kubernetes_scheduler_tpu.kube.convert import node_from_api, pdb_from_api, pod_from_api
 from kubernetes_scheduler_tpu.kube.source import KubeBinder, KubeClusterSource, KubeEvictor
 from kubernetes_scheduler_tpu.kube.lease import KubeLease
 
@@ -25,5 +25,6 @@ __all__ = [
     "KubeConfig",
     "KubeLease",
     "node_from_api",
+    "pdb_from_api",
     "pod_from_api",
 ]

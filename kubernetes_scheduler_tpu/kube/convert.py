@@ -172,6 +172,28 @@ def pod_from_api(obj: dict) -> Pod:
     )
 
 
+def pdb_from_api(obj: dict) -> "PodDisruptionBudget":
+    """policy/v1 PodDisruptionBudget JSON -> host type (matchLabels AND
+    matchExpressions, with k8s label-selector operator semantics)."""
+    from kubernetes_scheduler_tpu.host.types import PodDisruptionBudget
+
+    meta = obj.get("metadata") or {}
+    spec = obj.get("spec") or {}
+    status = obj.get("status") or {}
+    selector = spec.get("selector") or {}
+    return PodDisruptionBudget(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default"),
+        match_labels=dict(selector.get("matchLabels") or {}),
+        match_expressions=[
+            _match_expr(e) for e in selector.get("matchExpressions") or []
+        ],
+        min_available=spec.get("minAvailable"),
+        max_unavailable=spec.get("maxUnavailable"),
+        disruptions_allowed=status.get("disruptionsAllowed"),
+    )
+
+
 def node_from_api(obj: dict) -> Node:
     meta = obj.get("metadata") or {}
     spec = obj.get("spec") or {}

@@ -222,11 +222,15 @@ class KubeClusterSource:
         scheduler_name: str = "yoda-tpu",
         namespace: str | None = None,   # None = all namespaces
         cache: InformerCache | None = None,
+        pdb_ttl: float = 15.0,
     ):
         self.client = client
         self.scheduler_name = scheduler_name
         self.namespace = namespace
         self.cache = cache
+        self.pdb_ttl = pdb_ttl
+        self._pdb_cache: list | None = None
+        self._pdb_expiry = 0.0
 
     def _pods_path(self) -> str:
         if self.namespace:
@@ -237,6 +241,26 @@ class KubeClusterSource:
         if self.cache is not None:
             return self.cache.nodes()
         return [node_from_api(o) for o in self.client.list_all("/api/v1/nodes")]
+
+    def list_pdbs(self) -> list:
+        """policy/v1 PodDisruptionBudgets, cluster-wide — consulted by
+        the preemption pass so evictions never overdraw a budget. The
+        list is TTL-cached (budgets change rarely; a full cluster-wide
+        LIST on every preemption pass would sit on the cycle's critical
+        path), refreshed at most every pdb_ttl seconds."""
+        from kubernetes_scheduler_tpu.kube.convert import pdb_from_api
+
+        now = time.monotonic()
+        if self._pdb_cache is not None and now < self._pdb_expiry:
+            return self._pdb_cache
+        self._pdb_cache = [
+            pdb_from_api(o)
+            for o in self.client.list_all(
+                "/apis/policy/v1/poddisruptionbudgets"
+            )
+        ]
+        self._pdb_expiry = now + self.pdb_ttl
+        return self._pdb_cache
 
     def list_running_pods(self) -> list[Pod]:
         """Assigned, unfinished pods — the capacity + affinity base state

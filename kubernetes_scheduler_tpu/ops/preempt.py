@@ -21,9 +21,11 @@ falls out of the sort: the k-th prefix is eligible iff its LAST
 (= highest-priority) member is below the preemptor's priority.
 
 Deviations from upstream, documented:
-- PodDisruptionBudgets are not consulted (the reference deploys no PDBs
-  and carries no PDB client; upstream prefers zero-violation candidates
-  but may still preempt past a PDB).
+- PodDisruptionBudgets are consulted HOST-SIDE (host/scheduler:
+  victims under an exhausted budget are excluded from the tables, and
+  the apply loop never overdraws a budget), but strictly: upstream
+  orders candidates by fewest PDB violations and may still preempt
+  past a budget as a last resort; this framework never violates one.
 - Constraint families (taints, node/pod affinity, spread) are checked
   against the CURRENT cluster state via the caller-supplied
   `static_ok` mask; the marginal effect of removing the victims

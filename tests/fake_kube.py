@@ -29,6 +29,7 @@ class FakeKube:
         self.pods: dict[str, dict] = {}     # "ns/name" -> pod object
         self.deleted: list[str] = []        # "ns/name" DELETE log
         self.leases: dict[str, dict] = {}   # "ns/name" -> lease object
+        self.pdbs: list[dict] = []          # policy/v1 PDB objects
         self.bindings: list[tuple[str, str]] = []
         # node -> {cpu_pct, mem_pct, disk_io, net_up, net_down}: served
         # Prometheus-style from POST /api/v1/query so one fixture covers
@@ -135,6 +136,9 @@ class FakeKube:
                 if path == "/api/v1/nodes":
                     with fake.lock:
                         return self._send(200, {"items": list(fake.nodes)})
+                if path == "/apis/policy/v1/poddisruptionbudgets":
+                    with fake.lock:
+                        return self._send(200, {"items": list(fake.pdbs)})
                 m = _LEASE_RE.match(path)
                 if m and m.group(2):
                     with fake.lock:

@@ -393,6 +393,42 @@ def test_backlog_degrades_to_per_window_on_unimplemented():
     assert m2.pods_in == 8
 
 
+def test_backlog_degradation_carries_capacity_between_chunks():
+    """The per-window degradation loop must see earlier chunks' binds:
+    scheduling each chunk against the cycle-start running list would
+    over-commit full nodes up to max_windows_per_cycle-fold."""
+    nodes = [make_node(f"n{i}", cpu=1000) for i in range(2)]
+    utils = {f"n{i}": NodeUtil(cpu_pct=10, disk_io=5) for i in range(2)}
+
+    class SkewedEngine:
+        def __init__(self):
+            from kubernetes_scheduler_tpu.engine import LocalEngine
+
+            self._inner = LocalEngine()
+
+        def schedule_batch(self, *a, **kw):
+            return self._inner.schedule_batch(*a, **kw)
+
+        def schedule_windows(self, *a, **kw):
+            raise NotImplementedError("old sidecar")
+
+        def healthy(self):
+            return True
+
+    s = make_sched(nodes, [], utils, batch_window=2,
+                   engine_override=SkewedEngine())
+    for i in range(6):
+        s.submit(make_pod(f"p{i}", cpu=900, annotations={"diskIO": "5"}))
+    m = s.run_cycle()
+    # two nodes of 1000 fit exactly one 900-cpu pod each — ever
+    assert m.pods_bound == 2, m
+    assert m.pods_unschedulable == 4
+    used = {}
+    for b in s.binder.bindings:
+        used[b.node_name] = used.get(b.node_name, 0) + 900
+    assert all(v <= 1000 for v in used.values())
+
+
 def test_failed_device_cycle_feeds_adaptive_model():
     """A device-path failure must still produce a device observation
     (including the failure's cost): otherwise the learned model never

@@ -250,6 +250,29 @@ def test_binder_posts_binding_and_conflicts(fake):
     assert ei.value.status == 409
 
 
+def test_pdb_conversion_and_listing(fake):
+    from kubernetes_scheduler_tpu.kube import KubeClusterSource, pdb_from_api
+
+    obj = {
+        "metadata": {"name": "db-pdb", "namespace": "prod"},
+        "spec": {
+            "minAvailable": "50%",
+            "selector": {"matchLabels": {"app": "db"}},
+        },
+        "status": {"disruptionsAllowed": 1},
+    }
+    pdb = pdb_from_api(obj)
+    assert pdb.name == "db-pdb" and pdb.namespace == "prod"
+    assert pdb.match_labels == {"app": "db"}
+    assert pdb.allowed(4) == 1  # status wins over the 50% spec math
+
+    fake.pdbs.append(obj)
+    client = client_for(fake)
+    source = KubeClusterSource(client, scheduler_name="yoda-tpu")
+    pdbs = source.list_pdbs()
+    assert len(pdbs) == 1 and pdbs[0].name == "db-pdb"
+
+
 def test_evictor_deletes_with_uid_precondition(fake):
     from kubernetes_scheduler_tpu.kube import KubeEvictor
 

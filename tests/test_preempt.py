@@ -401,6 +401,115 @@ def test_host_nominated_capacity_not_stolen_by_lower_priority_arrival():
     assert not s._nominations  # nomination cleared on bind
 
 
+def test_pdb_allowed_math():
+    from kubernetes_scheduler_tpu.host.types import PodDisruptionBudget
+
+    assert PodDisruptionBudget("a", min_available=2).allowed(5) == 3
+    assert PodDisruptionBudget("a", min_available="50%").allowed(5) == 2
+    assert PodDisruptionBudget("a", max_unavailable=1).allowed(5) == 1
+    assert PodDisruptionBudget("a", max_unavailable="20%").allowed(5) == 1
+    # server-computed status wins over spec math
+    assert PodDisruptionBudget(
+        "a", min_available=0, disruptions_allowed=0
+    ).allowed(5) == 0
+    assert PodDisruptionBudget("a").allowed(5) == 5  # unconstrained
+
+
+def test_pdb_match_expressions_semantics():
+    """k8s label-selector operators: In/NotIn/Exists/DoesNotExist, with
+    a missing key satisfying NotIn; unknown operators fail closed."""
+    from kubernetes_scheduler_tpu.host.types import (
+        MatchExpression,
+        PodDisruptionBudget,
+    )
+    from tests.test_host import make_pod
+
+    db = make_pod("db", labels={"app": "db", "tier": "prod"})
+    web = make_pod("web", labels={"app": "web"})
+    bare = make_pod("bare")
+
+    def pdb(*exprs):
+        return PodDisruptionBudget("x", match_expressions=list(exprs))
+
+    e_in = MatchExpression("app", "In", ["db", "cache"])
+    assert pdb(e_in).selects(db) and not pdb(e_in).selects(web)
+    e_notin = MatchExpression("app", "NotIn", ["web"])
+    assert pdb(e_notin).selects(db) and not pdb(e_notin).selects(web)
+    assert pdb(e_notin).selects(bare)  # missing key satisfies NotIn
+    e_ex = MatchExpression("tier", "Exists")
+    assert pdb(e_ex).selects(db) and not pdb(e_ex).selects(web)
+    e_dne = MatchExpression("tier", "DoesNotExist")
+    assert not pdb(e_dne).selects(db) and pdb(e_dne).selects(web)
+    assert not pdb(MatchExpression("app", "Garbage")).selects(db)
+
+
+def test_host_pdb_protects_victims():
+    """A victim under an exhausted PodDisruptionBudget must never be
+    evicted; an unprotected victim on another node is chosen instead,
+    and when no candidate remains, no eviction happens at all."""
+    from kubernetes_scheduler_tpu.host import NodeUtil, RecordingEvictor
+    from kubernetes_scheduler_tpu.host.types import PodDisruptionBudget
+    from tests.test_host import make_node, make_pod
+
+    nodes = [make_node("n0", cpu=1000), make_node("n1", cpu=1000)]
+    utils = {n.name: NodeUtil(cpu_pct=10, disk_io=5) for n in nodes}
+    guarded = make_pod("guarded", cpu=900,
+                       labels={"scv/priority": "1", "app": "db"})
+    guarded.node_name = "n0"
+    plain = make_pod("plain", cpu=900, labels={"scv/priority": "2"})
+    plain.node_name = "n1"
+    running = [guarded, plain]
+    pdbs = [PodDisruptionBudget("db-pdb", match_labels={"app": "db"},
+                                min_available=1)]
+    ev = RecordingEvictor()
+    s = _sched(nodes, utils, running, evictor=ev)
+    s.list_pdbs = lambda: pdbs
+    s.submit(make_pod("urgent", cpu=800, labels={"scv/priority": "9"}))
+    m = s.run_cycle()
+    # guarded (prio 1) would be the lexicographic-best victim, but its
+    # budget allows 0 disruptions (1 pod, minAvailable 1) -> plain goes
+    assert m.pods_preempted == 1
+    assert ev.evictions[0].victim.name == "plain"
+
+    # same cluster, BOTH victims budget-protected: nothing is evicted
+    running2 = [guarded, plain]
+    pdbs2 = pdbs + [PodDisruptionBudget("all-pdb", match_labels={},
+                                        max_unavailable=0)]
+    ev2 = RecordingEvictor()
+    s2 = _sched(nodes, utils, running2, evictor=ev2)
+    s2.list_pdbs = lambda: pdbs2
+    s2.submit(make_pod("urgent2", cpu=800, labels={"scv/priority": "9"}))
+    m2 = s2.run_cycle()
+    assert m2.pods_preempted == 0 and not ev2.evictions
+
+
+def test_host_pdb_budget_caps_evictions_across_proposals():
+    """One remaining disruption in a shared budget: only one of two
+    preemptors' proposals may evict this cycle; the proposal that would
+    overdraw is skipped whole."""
+    from kubernetes_scheduler_tpu.host import NodeUtil, RecordingEvictor
+    from kubernetes_scheduler_tpu.host.types import PodDisruptionBudget
+    from tests.test_host import make_node, make_pod
+
+    nodes = [make_node("n0", cpu=1000), make_node("n1", cpu=1000)]
+    utils = {n.name: NodeUtil(cpu_pct=10, disk_io=5) for n in nodes}
+    v0 = make_pod("v0", cpu=900, labels={"scv/priority": "1", "app": "web"})
+    v0.node_name = "n0"
+    v1 = make_pod("v1", cpu=900, labels={"scv/priority": "1", "app": "web"})
+    v1.node_name = "n1"
+    running = [v0, v1]
+    pdbs = [PodDisruptionBudget("web-pdb", match_labels={"app": "web"},
+                                min_available=1)]  # 2 pods -> 1 allowed
+    ev = RecordingEvictor()
+    s = _sched(nodes, utils, running, evictor=ev)
+    s.list_pdbs = lambda: pdbs
+    s.submit(make_pod("u1", cpu=800, labels={"scv/priority": "9"}))
+    s.submit(make_pod("u2", cpu=800, labels={"scv/priority": "8"}))
+    m = s.run_cycle()
+    assert m.pods_preempted == 1 and m.victims_evicted == 1
+    assert len(ev.evictions) == 1
+
+
 def test_host_taints_exclude_preemption_candidates():
     from kubernetes_scheduler_tpu.host import RecordingEvictor
     from kubernetes_scheduler_tpu.host.types import Taint

@@ -721,6 +721,32 @@ def stack_windows(pods: PodBatch, window: int) -> PodBatch:
     )
 
 
+def fold_window_counts(snapshot, pods, node_idx, domain_counts, avoid_counts):
+    """Fold one window's placements into the per-node replicated domain
+    match AND avoider count tables so the NEXT window's (anti)affinity
+    sees them (the sequential host loop gets this from re-snapshotting
+    between cycles). Counts[n, s] are per-node replicated totals of node
+    n's domain: increments scatter onto the representative row
+    (domain_id) and gather back to every member node. Shared by the
+    dense schedule_windows scan and LearnedEngine's windows scan."""
+    found = node_idx >= 0
+    s = domain_counts.shape[1]
+    cols = jnp.arange(s)
+    dom = snapshot.domain_id[
+        jnp.clip(node_idx, 0, snapshot.domain_id.shape[0] - 1)
+    ]  # [p, S]
+
+    def fold(counts, per_pod):
+        inc = jnp.where(found[:, None], per_pod.astype(counts.dtype), 0.0)
+        added = jnp.zeros_like(counts).at[dom, cols[None, :]].add(inc)
+        return counts + added[snapshot.domain_id, cols[None, :]]
+
+    return (
+        fold(domain_counts, match_matrix(pods, s)),
+        fold(avoid_counts, pod_has_anti_onehot(pods.anti_affinity_sel, s)),
+    )
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -775,26 +801,9 @@ def schedule_windows(
             auction_rounds=auction_rounds,
             auction_price_frac=auction_price_frac,
         )
-        # fold this window's placements into the domain match AND avoider
-        # counts so the next window's (anti)affinity sees them (the
-        # sequential host loop gets this from re-snapshotting between
-        # cycles). Counts[n, s] are per-node replicated totals of node n's
-        # domain, so increments are scattered onto the representative row
-        # (domain_id) and then gathered back to every member node.
-        found = res.node_idx >= 0
-        s = domain_counts.shape[1]
-        cols = jnp.arange(s)
-        dom = snapshot.domain_id[
-            jnp.clip(res.node_idx, 0, snapshot.domain_id.shape[0] - 1)
-        ]  # [p, S]
-
-        def fold(counts, per_pod):
-            inc = jnp.where(found[:, None], per_pod.astype(counts.dtype), 0.0)
-            added = jnp.zeros_like(counts).at[dom, cols[None, :]].add(inc)
-            return counts + added[snapshot.domain_id, cols[None, :]]
-
-        new_counts = fold(domain_counts, match_matrix(w, s))
-        new_avoid = fold(avoid_counts, pod_has_anti_onehot(w.anti_affinity_sel, s))
+        new_counts, new_avoid = fold_window_counts(
+            snapshot, w, res.node_idx, domain_counts, avoid_counts
+        )
         return (
             (snapshot.allocatable - res.free_after, new_counts, new_avoid),
             (res.node_idx, res.n_assigned),

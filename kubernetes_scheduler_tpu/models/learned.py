@@ -232,12 +232,11 @@ class LearnedEngine:
         self.model = model or NodeScorer()
         self.params = params
 
-        @functools.partial(
-            jax.jit,
-            static_argnames=("assigner", "normalizer", "affinity_aware", "soft"),
-        )
-        def _run(params, snapshot, pods, *, assigner, normalizer,
-                 affinity_aware, soft):
+        def _one_cycle(params, snapshot, pods, *, assigner, normalizer,
+                       affinity_aware, soft):
+            """Score with the two-tower model, then the exact engine
+            tail — the ONE scoring pipeline both the single-batch and
+            windows paths run (they must not diverge)."""
             pod_x, node_x = make_features(snapshot, pods)
             raw = self.model.apply(params, pod_x, node_x)
             feasible = compute_feasibility(
@@ -249,7 +248,62 @@ class LearnedEngine:
                 assigner=assigner, affinity_aware=affinity_aware, soft=soft,
             )
 
+        @functools.partial(
+            jax.jit,
+            static_argnames=("assigner", "normalizer", "affinity_aware", "soft"),
+        )
+        def _run(params, snapshot, pods, *, assigner, normalizer,
+                 affinity_aware, soft):
+            return _one_cycle(
+                params, snapshot, pods, assigner=assigner,
+                normalizer=normalizer, affinity_aware=affinity_aware,
+                soft=soft,
+            )
+
         self._run = _run
+
+        @functools.partial(
+            jax.jit,
+            static_argnames=("assigner", "normalizer", "affinity_aware", "soft"),
+        )
+        def _run_windows(params, snapshot, pods_w, *, assigner, normalizer,
+                         affinity_aware, soft):
+            from kubernetes_scheduler_tpu.engine import (
+                WindowsResult,
+                fold_window_counts,
+            )
+
+            def step(carry, w):
+                requested, dc, ac = carry
+                snap = snapshot._replace(
+                    requested=requested, domain_counts=dc, avoid_counts=ac
+                )
+                res = _one_cycle(
+                    params, snap, w, assigner=assigner,
+                    normalizer=normalizer, affinity_aware=affinity_aware,
+                    soft=soft,
+                )
+                dc2, ac2 = fold_window_counts(
+                    snapshot, w, res.node_idx, dc, ac
+                )
+                return (
+                    (snapshot.allocatable - res.free_after, dc2, ac2),
+                    (res.node_idx, res.n_assigned),
+                )
+
+            (req_f, _, _), (idx, counts) = jax.lax.scan(
+                step,
+                (snapshot.requested, snapshot.domain_counts,
+                 snapshot.avoid_counts),
+                pods_w,
+            )
+            return WindowsResult(
+                node_idx=idx,
+                free_after=snapshot.allocatable - req_f,
+                n_assigned=counts.sum().astype(jnp.int32),
+            )
+
+        self._run_windows = _run_windows
 
     def schedule_batch(
         self,
@@ -265,6 +319,31 @@ class LearnedEngine:
     ):
         return self._run(
             self.params, snapshot, pods, assigner=assigner,
+            normalizer=normalizer, affinity_aware=affinity_aware, soft=soft,
+        )
+
+    def schedule_windows(
+        self,
+        snapshot,
+        pods_windows,
+        *,
+        policy: str = "learned",
+        assigner: str = "greedy",
+        normalizer: str = "min_max",
+        fused: bool = False,
+        affinity_aware: bool = True,
+        soft: bool = False,
+        auction_rounds: int = 0,      # accepted for surface parity;
+        auction_price_frac: float = 0.0,  # the engine defaults apply
+    ):
+        """Whole-backlog scheduling with the learned scorer: the same
+        capacity- and affinity-carrying window scan as
+        engine.schedule_windows (sharing its fold), scored per window by
+        the two-tower model against the CARRIED snapshot state — so the
+        host's deep-queue backlog cycles work under policy='learned'
+        too."""
+        return self._run_windows(
+            self.params, snapshot, pods_windows, assigner=assigner,
             normalizer=normalizer, affinity_aware=affinity_aware, soft=soft,
         )
 

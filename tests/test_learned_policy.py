@@ -104,6 +104,75 @@ def test_host_loop_policy_learned():
     assert m.pods_bound == 6 and not m.used_fallback
 
 
+def test_learned_windows_matches_sequential_batches():
+    """LearnedEngine.schedule_windows (the backlog surface) makes the
+    same decisions as per-window schedule_batch with capacity and
+    affinity carried on the host — mirroring the dense engine's
+    windows-vs-sequential parity."""
+    import jax.numpy as jnp
+    from kubernetes_scheduler_tpu.engine import stack_windows
+
+    state, model, _, _ = _train(steps=3)
+    engine = LearnedEngine(state.params, model=model)
+
+    snap = gen_cluster(32, seed=9, constraints=True)
+    pods = gen_pods(16, seed=10, constraints=True)
+    windows = stack_windows(pods, 4)
+    fused = engine.schedule_windows(snap, windows, assigner="greedy",
+                                    normalizer="none")
+
+    from kubernetes_scheduler_tpu.engine import fold_window_counts
+
+    requested = snap.requested
+    dc, ac = snap.domain_counts, snap.avoid_counts
+    seq_idx, total = [], 0
+    for w in range(4):
+        one = type(pods)(*[jnp.asarray(f)[w] for f in windows])
+        res = engine.schedule_batch(
+            snap._replace(requested=requested, domain_counts=dc,
+                          avoid_counts=ac),
+            one, assigner="greedy", normalizer="none",
+        )
+        requested = snap.allocatable - res.free_after
+        dc, ac = fold_window_counts(snap, one, res.node_idx, dc, ac)
+        seq_idx.append(np.asarray(res.node_idx))
+        total += int(res.n_assigned)
+
+    np.testing.assert_array_equal(np.asarray(fused.node_idx), np.stack(seq_idx))
+    assert int(fused.n_assigned) == total
+
+
+def test_host_backlog_policy_learned():
+    """Deep queues under policy='learned' use the windows surface the
+    engine now serves (one dispatch), not the 8x single-batch path."""
+    from kubernetes_scheduler_tpu.host.advisor import NodeUtil
+    from kubernetes_scheduler_tpu.host.scheduler import Scheduler
+    from kubernetes_scheduler_tpu.host.types import Container, Node, Pod
+    from kubernetes_scheduler_tpu.utils.config import SchedulerConfig
+
+    nodes = [
+        Node(name=f"n{i}", allocatable={"cpu": 8000.0, "memory": 32 * 2**30,
+                                        "pods": 110})
+        for i in range(5)
+    ]
+
+    class A:
+        def fetch(self):
+            return {nd.name: NodeUtil(cpu_pct=10.0 * i, disk_io=2.0 * i)
+                    for i, nd in enumerate(nodes)}
+
+    cfg = SchedulerConfig(policy="learned", min_device_work=0,
+                          batch_window=8, adaptive_dispatch=False)
+    cfg.feature_gates.native_host = False
+    s = Scheduler(cfg, advisor=A(), list_nodes=lambda: nodes,
+                  list_running_pods=lambda: [])
+    assert s._engine_windows_ok
+    for i in range(20):
+        s.submit(Pod(name=f"p{i}", containers=[Container(requests={"cpu": 400.0})]))
+    m = s.run_cycle()
+    assert m.pods_in == 20 and m.pods_bound == 20 and not m.used_fallback
+
+
 def test_unknown_policy_still_rejected():
     with pytest.raises(ValueError, match="unknown policy"):
         schedule_batch(gen_cluster(8, seed=0), gen_pods(2, seed=1),

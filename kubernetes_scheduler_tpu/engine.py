@@ -747,6 +747,40 @@ def fold_window_counts(snapshot, pods, node_idx, domain_counts, avoid_counts):
     )
 
 
+def run_windows_scan(snapshot, pods_windows, cycle_fn) -> "WindowsResult":
+    """The capacity- and (anti)affinity-carrying scan over stacked
+    windows, parameterized by the per-window cycle (cycle_fn(snap, w) ->
+    ScheduleResult). schedule_windows passes schedule_batch; the learned
+    engine passes its two-tower cycle — ONE scan, so the carried state
+    cannot drift between engines."""
+
+    def step(carry, w):
+        requested, domain_counts, avoid_counts = carry
+        snap = snapshot._replace(
+            requested=requested, domain_counts=domain_counts,
+            avoid_counts=avoid_counts,
+        )
+        res = cycle_fn(snap, w)
+        new_counts, new_avoid = fold_window_counts(
+            snapshot, w, res.node_idx, domain_counts, avoid_counts
+        )
+        return (
+            (snapshot.allocatable - res.free_after, new_counts, new_avoid),
+            (res.node_idx, res.n_assigned),
+        )
+
+    (req_final, _, _), (node_idx, counts) = jax.lax.scan(
+        step,
+        (snapshot.requested, snapshot.domain_counts, snapshot.avoid_counts),
+        pods_windows,
+    )
+    return WindowsResult(
+        node_idx=node_idx,
+        free_after=snapshot.allocatable - req_final,
+        n_assigned=counts.sum().astype(jnp.int32),
+    )
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -789,33 +823,12 @@ def schedule_windows(
     exactly.
     """
 
-    def step(carry, w):
-        requested, domain_counts, avoid_counts = carry
-        snap = snapshot._replace(
-            requested=requested, domain_counts=domain_counts,
-            avoid_counts=avoid_counts,
-        )
-        res = schedule_batch(
+    def cycle(snap, w):
+        return schedule_batch(
             snap, w, policy=policy, assigner=assigner, normalizer=normalizer,
             fused=fused, affinity_aware=affinity_aware, soft=soft,
             auction_rounds=auction_rounds,
             auction_price_frac=auction_price_frac,
         )
-        new_counts, new_avoid = fold_window_counts(
-            snapshot, w, res.node_idx, domain_counts, avoid_counts
-        )
-        return (
-            (snapshot.allocatable - res.free_after, new_counts, new_avoid),
-            (res.node_idx, res.n_assigned),
-        )
 
-    (req_final, _, _), (node_idx, counts) = jax.lax.scan(
-        step,
-        (snapshot.requested, snapshot.domain_counts, snapshot.avoid_counts),
-        pods_windows,
-    )
-    return WindowsResult(
-        node_idx=node_idx,
-        free_after=snapshot.allocatable - req_final,
-        n_assigned=counts.sum().astype(jnp.int32),
-    )
+    return run_windows_scan(snapshot, pods_windows, cycle)

@@ -233,7 +233,8 @@ class LearnedEngine:
         self.params = params
 
         def _one_cycle(params, snapshot, pods, *, assigner, normalizer,
-                       affinity_aware, soft):
+                       affinity_aware, soft, auction_rounds,
+                       auction_price_frac):
             """Score with the two-tower model, then the exact engine
             tail — the ONE scoring pipeline both the single-batch and
             windows paths run (they must not diverge)."""
@@ -246,62 +247,49 @@ class LearnedEngine:
             return finish_cycle(
                 snapshot, pods, raw, norm, feasible,
                 assigner=assigner, affinity_aware=affinity_aware, soft=soft,
+                auction_rounds=auction_rounds,
+                auction_price_frac=auction_price_frac,
             )
 
         @functools.partial(
             jax.jit,
-            static_argnames=("assigner", "normalizer", "affinity_aware", "soft"),
+            static_argnames=(
+                "assigner", "normalizer", "affinity_aware", "soft",
+                "auction_rounds", "auction_price_frac",
+            ),
         )
         def _run(params, snapshot, pods, *, assigner, normalizer,
-                 affinity_aware, soft):
+                 affinity_aware, soft, auction_rounds, auction_price_frac):
             return _one_cycle(
                 params, snapshot, pods, assigner=assigner,
                 normalizer=normalizer, affinity_aware=affinity_aware,
-                soft=soft,
+                soft=soft, auction_rounds=auction_rounds,
+                auction_price_frac=auction_price_frac,
             )
 
         self._run = _run
 
         @functools.partial(
             jax.jit,
-            static_argnames=("assigner", "normalizer", "affinity_aware", "soft"),
+            static_argnames=(
+                "assigner", "normalizer", "affinity_aware", "soft",
+                "auction_rounds", "auction_price_frac",
+            ),
         )
         def _run_windows(params, snapshot, pods_w, *, assigner, normalizer,
-                         affinity_aware, soft):
-            from kubernetes_scheduler_tpu.engine import (
-                WindowsResult,
-                fold_window_counts,
-            )
+                         affinity_aware, soft, auction_rounds,
+                         auction_price_frac):
+            from kubernetes_scheduler_tpu.engine import run_windows_scan
 
-            def step(carry, w):
-                requested, dc, ac = carry
-                snap = snapshot._replace(
-                    requested=requested, domain_counts=dc, avoid_counts=ac
-                )
-                res = _one_cycle(
+            def cycle(snap, w):
+                return _one_cycle(
                     params, snap, w, assigner=assigner,
                     normalizer=normalizer, affinity_aware=affinity_aware,
-                    soft=soft,
-                )
-                dc2, ac2 = fold_window_counts(
-                    snapshot, w, res.node_idx, dc, ac
-                )
-                return (
-                    (snapshot.allocatable - res.free_after, dc2, ac2),
-                    (res.node_idx, res.n_assigned),
+                    soft=soft, auction_rounds=auction_rounds,
+                    auction_price_frac=auction_price_frac,
                 )
 
-            (req_f, _, _), (idx, counts) = jax.lax.scan(
-                step,
-                (snapshot.requested, snapshot.domain_counts,
-                 snapshot.avoid_counts),
-                pods_w,
-            )
-            return WindowsResult(
-                node_idx=idx,
-                free_after=snapshot.allocatable - req_f,
-                n_assigned=counts.sum().astype(jnp.int32),
-            )
+            return run_windows_scan(snapshot, pods_w, cycle)
 
         self._run_windows = _run_windows
 
@@ -316,10 +304,14 @@ class LearnedEngine:
         fused: bool = False,  # no fused kernel for the learned scorer
         affinity_aware: bool = True,
         soft: bool = False,
+        auction_rounds: int = 1024,
+        auction_price_frac: float = 1.0 / 16.0,
     ):
         return self._run(
             self.params, snapshot, pods, assigner=assigner,
             normalizer=normalizer, affinity_aware=affinity_aware, soft=soft,
+            auction_rounds=auction_rounds,
+            auction_price_frac=auction_price_frac,
         )
 
     def schedule_windows(
@@ -333,8 +325,8 @@ class LearnedEngine:
         fused: bool = False,
         affinity_aware: bool = True,
         soft: bool = False,
-        auction_rounds: int = 0,      # accepted for surface parity;
-        auction_price_frac: float = 0.0,  # the engine defaults apply
+        auction_rounds: int = 1024,
+        auction_price_frac: float = 1.0 / 16.0,
     ):
         """Whole-backlog scheduling with the learned scorer: the same
         capacity- and affinity-carrying window scan as
@@ -345,6 +337,8 @@ class LearnedEngine:
         return self._run_windows(
             self.params, snapshot, pods_windows, assigner=assigner,
             normalizer=normalizer, affinity_aware=affinity_aware, soft=soft,
+            auction_rounds=auction_rounds,
+            auction_price_frac=auction_price_frac,
         )
 
     def healthy(self) -> bool:
@@ -362,3 +356,33 @@ def load_learned_engine(
     like, _, _ = init_train_state(jax.random.key(0), model=model)
     state = restore_checkpoint(checkpoint_path, like)
     return LearnedEngine(state.params, model=model)
+
+
+def make_sharded_learned_fn(params, mesh, *, model: NodeScorer | None = None,
+                            windows: bool = False, **kw):
+    """The learned two-tower policy on a device mesh.
+
+    The scorer is embarrassingly shardable along the node axis: the node
+    tower reads only per-node features (node-local on each shard), the
+    pod tower is replicated, and the [p, n_local] contraction is
+    per-shard MXU work — so it plugs into the sharded engine's
+    `score_fn` hook with NO extra collectives of its own (normalization
+    bounds are already global pmax/pmin inside the sharded pipeline).
+
+    Returns a jitted shard_map'd function with the same surface as
+    make_sharded_schedule_fn (or make_sharded_windows_fn when
+    windows=True). `params` are closed over; pass replicated.
+    """
+    from kubernetes_scheduler_tpu.parallel.engine import (
+        make_sharded_schedule_fn,
+        make_sharded_windows_fn,
+    )
+
+    scorer = model or NodeScorer()
+
+    def score_fn(snapshot, pods):
+        pod_x, node_x = make_features(snapshot, pods)
+        return scorer.apply(params, pod_x, node_x)
+
+    factory = make_sharded_windows_fn if windows else make_sharded_schedule_fn
+    return factory(mesh, score_fn=score_fn, **kw)

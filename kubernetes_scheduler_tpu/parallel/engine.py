@@ -221,11 +221,23 @@ def _mesh_specs(mesh: Mesh, node_axes):
     return axes, node, rep, snap_specs, pod_specs
 
 
-def _window_pipeline(snapshot, pods, policy, normalizer, soft, axes):
+def _window_pipeline(snapshot, pods, policy, normalizer, soft, axes,
+                     score_fn=None):
     """Scores + static feasibility + normalization for one window on one
     shard — the shared front half of the sharded single-window and
-    multi-window programs (they must not diverge)."""
-    raw = _sharded_scores(snapshot, pods, policy, axes)
+    multi-window programs (they must not diverge).
+
+    score_fn: optional custom scorer called with the SHARD-LOCAL
+    (snapshot, pods), returning a [p, n_local] raw score matrix — the
+    hook that puts e.g. the learned two-tower policy on the mesh (its
+    node tower is node-local, so the scorer shards for free); the
+    global normalization (pmax/pmin/psum bounds) still applies on top.
+    When given, `policy` is ignored."""
+    raw = (
+        score_fn(snapshot, pods)
+        if score_fn is not None
+        else _sharded_scores(snapshot, pods, policy, axes)
+    )
     # purely local/elementwise on the node axis — reuse the
     # single-device implementation so the two paths cannot diverge.
     # Inter-pod affinity is excluded from the static mask: the greedy
@@ -278,6 +290,7 @@ def make_sharded_schedule_fn(
     normalizer: str = "min_max",
     node_axes: str | tuple[str, ...] = NODE_AXIS,
     soft: bool = False,
+    score_fn=None,
 ):
     """Build a jitted shard_map'd schedule function for `mesh`.
 
@@ -325,7 +338,7 @@ def make_sharded_schedule_fn(
 
     def body(snapshot: SnapshotArrays, pods: PodBatch) -> ScheduleResult:
         raw, norm, feasible = _window_pipeline(
-            snapshot, pods, policy, normalizer, soft, axes
+            snapshot, pods, policy, normalizer, soft, axes, score_fn
         )
         free0 = compute_free_capacity(snapshot)
         node_idx, free_after, _ = _sharded_greedy(
@@ -353,6 +366,7 @@ def make_sharded_windows_fn(
     normalizer: str = "min_max",
     node_axes: str | tuple[str, ...] = NODE_AXIS,
     soft: bool = False,
+    score_fn=None,
 ):
     """Multi-window sharded scheduling: engine.schedule_windows with the
     node axis sharded over `mesh`.
@@ -398,7 +412,7 @@ def make_sharded_windows_fn(
                 + added2[0][snapshot.domain_id, cols],
             )
             _, norm, feasible = _window_pipeline(
-                snap_pipe, w, policy, normalizer, soft, axes
+                snap_pipe, w, policy, normalizer, soft, axes, score_fn
             )
             # greedy takes the ORIGINAL counts plus the added2 carry (it
             # layers the carry itself — snap_pipe's folded counts would

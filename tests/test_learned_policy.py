@@ -173,6 +173,44 @@ def test_host_backlog_policy_learned():
     assert m.pods_in == 20 and m.pods_bound == 20 and not m.used_fallback
 
 
+def test_sharded_learned_matches_dense():
+    """The two-tower policy on the 8-device mesh: node tower is
+    node-local, so the scorer shards with no extra collectives — the
+    sharded engine must reproduce the dense LearnedEngine decisions,
+    single-window and whole-backlog."""
+    import jax
+    from kubernetes_scheduler_tpu.engine import stack_windows
+    from kubernetes_scheduler_tpu.models.learned import make_sharded_learned_fn
+    from kubernetes_scheduler_tpu.parallel.mesh import make_mesh
+
+    assert jax.device_count() == 8
+    state, model, _, _ = _train(steps=3)
+    engine = LearnedEngine(state.params, model=model)
+    mesh = make_mesh(8)
+
+    snap = gen_cluster(32, seed=11, constraints=True)
+    pods = gen_pods(12, seed=12, constraints=True)
+
+    dense = engine.schedule_batch(snap, pods, assigner="greedy",
+                                  normalizer="min_max")
+    fn = make_sharded_learned_fn(state.params, mesh, model=model)
+    sharded = fn(snap, pods)
+    np.testing.assert_array_equal(
+        np.asarray(sharded.node_idx), np.asarray(dense.node_idx)
+    )
+
+    windows = stack_windows(pods, 4)
+    dense_w = engine.schedule_windows(snap, windows, assigner="greedy",
+                                      normalizer="min_max")
+    wfn = make_sharded_learned_fn(state.params, mesh, model=model,
+                                  windows=True)
+    sharded_w = wfn(snap, windows)
+    np.testing.assert_array_equal(
+        np.asarray(sharded_w.node_idx), np.asarray(dense_w.node_idx)
+    )
+    assert int(sharded_w.n_assigned) == int(dense_w.n_assigned)
+
+
 def test_unknown_policy_still_rejected():
     with pytest.raises(ValueError, match="unknown policy"):
         schedule_batch(gen_cluster(8, seed=0), gen_pods(2, seed=1),

@@ -53,12 +53,18 @@ class EngineService:
     def __init__(
         self,
         *,
+        engine_override=None,
         sharded_fn=None,
         sharded_opts: dict | None = None,
         sharded_fn_soft=None,
         sharded_windows_fn=None,
         sharded_windows_fn_soft=None,
     ):
+        # serve a custom engine (e.g. models.learned.LearnedEngine) on
+        # the dense branch instead of the module-level heuristic engine;
+        # the sharded branches take precedence when configured. Resolved
+        # once: the choice is fixed for the server's lifetime.
+        self._engine = engine_override or engine
         self._sharded_fn = sharded_fn
         self._sharded_windows_fn = sharded_windows_fn
         self._sharded_windows_fn_soft = sharded_windows_fn_soft
@@ -121,7 +127,7 @@ class EngineService:
                 )
                 res = fn(snapshot, pods)
             else:
-                res = engine.schedule_batch(
+                res = self._engine.schedule_batch(
                     snapshot,
                     pods,
                     policy=request.policy or "balanced_cpu_diskio",
@@ -164,7 +170,7 @@ class EngineService:
                 )
                 res = fn(snapshot, pods_w)
             else:
-                res = engine.schedule_windows(
+                res = self._engine.schedule_windows(
                     snapshot,
                     pods_w,
                     policy=request.policy or "balanced_cpu_diskio",
@@ -198,6 +204,7 @@ class EngineService:
 def make_server(
     address: str = "127.0.0.1:0",
     *,
+    engine_override=None,
     sharded_fn=None,
     sharded_opts: dict | None = None,
     sharded_fn_soft=None,
@@ -208,6 +215,7 @@ def make_server(
     """Build (server, bound_port, service). max_workers=1 keeps device
     access single-writer; raise it only for a CPU-only sidecar."""
     service = EngineService(
+        engine_override=engine_override,
         sharded_fn=sharded_fn,
         sharded_opts=sharded_opts,
         sharded_fn_soft=sharded_fn_soft,
@@ -266,9 +274,33 @@ def main(argv=None):
         "(2-D dcn x node hierarchical collectives for multi-host slices)",
     )
     parser.add_argument("--policy", default="balanced_cpu_diskio")
+    parser.add_argument(
+        "--learned-checkpoint",
+        default=None,
+        help="serve the learned two-tower policy restored from this orbax "
+        "checkpoint (policy name becomes 'learned'; shards over the mesh "
+        "when --mesh-devices is set)",
+    )
     args = parser.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
+    engine_override = None
+    learned_params = None
+    learned_model = None
+    if args.learned_checkpoint:
+        if args.policy not in ("balanced_cpu_diskio", "learned"):
+            # fail loud, never silently override an explicit choice (the
+            # same convention the pinned-opts request checks follow)
+            raise SystemExit(
+                f"--policy {args.policy!r} conflicts with "
+                "--learned-checkpoint (which serves policy 'learned')"
+            )
+        from kubernetes_scheduler_tpu.models.learned import load_learned_engine
+
+        engine_override = load_learned_engine(args.learned_checkpoint)
+        learned_params = engine_override.params
+        learned_model = engine_override.model
+        args.policy = "learned"
     sharded_fn = None
     if args.mesh_devices > 1:
         from jax.sharding import Mesh
@@ -292,18 +324,34 @@ def main(argv=None):
                 np.asarray(jax.devices()[: args.mesh_devices]), (NODE_AXIS,)
             )
             node_axes = NODE_AXIS
-        sharded_fn = make_sharded_schedule_fn(
-            mesh, policy=args.policy, node_axes=node_axes
-        )
-        sharded_fn_soft = make_sharded_schedule_fn(
-            mesh, policy=args.policy, node_axes=node_axes, soft=True
-        )
-        sharded_windows_fn = make_sharded_windows_fn(
-            mesh, policy=args.policy, node_axes=node_axes
-        )
-        sharded_windows_fn_soft = make_sharded_windows_fn(
-            mesh, policy=args.policy, node_axes=node_axes, soft=True
-        )
+        if learned_params is not None:
+            from kubernetes_scheduler_tpu.models.learned import (
+                make_sharded_learned_fn,
+            )
+
+            def _learned(**kw):
+                return make_sharded_learned_fn(
+                    learned_params, mesh, model=learned_model,
+                    node_axes=node_axes, **kw,
+                )
+
+            sharded_fn = _learned()
+            sharded_fn_soft = _learned(soft=True)
+            sharded_windows_fn = _learned(windows=True)
+            sharded_windows_fn_soft = _learned(windows=True, soft=True)
+        else:
+            sharded_fn = make_sharded_schedule_fn(
+                mesh, policy=args.policy, node_axes=node_axes
+            )
+            sharded_fn_soft = make_sharded_schedule_fn(
+                mesh, policy=args.policy, node_axes=node_axes, soft=True
+            )
+            sharded_windows_fn = make_sharded_windows_fn(
+                mesh, policy=args.policy, node_axes=node_axes
+            )
+            sharded_windows_fn_soft = make_sharded_windows_fn(
+                mesh, policy=args.policy, node_axes=node_axes, soft=True
+            )
         # assigner is pinned too: the sharded engine is greedy-only, and a
         # host that asked for the auction must get an error, not silently
         # different placement semantics
@@ -320,6 +368,7 @@ def main(argv=None):
 
     server, port, _ = make_server(
         f"{args.host}:{args.port}",
+        engine_override=engine_override,
         sharded_fn=sharded_fn,
         sharded_opts=sharded_opts,
         sharded_fn_soft=sharded_fn_soft,

@@ -214,6 +214,56 @@ def test_sharded_sidecar_serves_windows():
         server.stop(grace=None)
 
 
+def test_unimplemented_rpc_maps_to_not_implemented(live_server):
+    """A version-skewed sidecar answering UNIMPLEMENTED must surface as
+    NotImplementedError (the host's windows degradation trigger), not as
+    an outage-style EngineUnavailable."""
+    client, _ = live_server
+    bogus = client._channel.unary_unary(
+        "/yodatpu.Engine/DoesNotExist",
+        request_serializer=pb.HealthRequest.SerializeToString,
+        response_deserializer=pb.HealthReply.FromString,
+    )
+    with pytest.raises(NotImplementedError):
+        client._call_with_retry(bogus, pb.HealthRequest())
+
+
+def test_sidecar_serves_learned_engine():
+    """engine_override: a sidecar built around a LearnedEngine serves
+    both RPC surfaces with the learned scorer's decisions."""
+    import jax
+    from kubernetes_scheduler_tpu.engine import stack_windows
+    from kubernetes_scheduler_tpu.models.learned import (
+        LearnedEngine,
+        init_train_state,
+    )
+
+    state, model, _ = init_train_state(jax.random.key(3))
+    learned = LearnedEngine(state.params, model=model)
+    server, port, _ = make_server("127.0.0.1:0", engine_override=learned)
+    server.start()
+    client = RemoteEngine(f"127.0.0.1:{port}", deadline_seconds=120.0)
+    try:
+        snap = gen_cluster(16, seed=40, constraints=True)
+        pods = gen_pods(8, seed=41, constraints=True)
+        local = learned.schedule_batch(snap, pods, assigner="greedy")
+        remote = client.schedule_batch(snap, pods, assigner="greedy")
+        np.testing.assert_array_equal(
+            np.asarray(remote.node_idx), np.asarray(local.node_idx)
+        )
+        pw = stack_windows(pods, 4)
+        local_w = learned.schedule_windows(snap, pw, assigner="greedy")
+        remote_w = client.schedule_windows(
+            snap, pw, assigner="greedy", normalizer="min_max"
+        )
+        np.testing.assert_array_equal(
+            np.asarray(remote_w.node_idx), np.asarray(local_w.node_idx)
+        )
+    finally:
+        client.close()
+        server.stop(grace=None)
+
+
 def test_health(live_server):
     client, service = live_server
     assert client.healthy()

@@ -18,6 +18,7 @@ from kubernetes_scheduler_tpu.kube import (
     KubeClient,
     KubeClusterSource,
     KubeConfig,
+    KubeEvictor,
     KubeLease,
     node_from_api,
     pod_from_api,
@@ -336,6 +337,64 @@ def test_kube_loop_watch_cycle_bind_e2e(fake):
         assert node in {f"n{i}" for i in range(4)}
     # server state reflects every placement; nothing is pending anymore
     assert [p.name for p in src.list_pending_pods()] == []
+
+
+def test_kube_preemption_e2e(fake):
+    """Live-path preemption: a high-priority pod that fits nowhere
+    evicts a lower-priority victim THROUGH the API server (KubeEvictor
+    DELETE), the eviction becomes visible via the cluster source, and
+    the preemptor binds on a later cycle — while a PDB-protected victim
+    is never touched."""
+    fake.add_node(make_node_obj("n0", cpu="1"))
+    fake.add_node(make_node_obj("n1", cpu="1"))
+    victim = make_pod_obj(
+        "victim", node_name="n0", cpu="900m", uid="v-1",
+        labels={"scv/priority": "1"},
+    )
+    guarded = make_pod_obj(
+        "guarded", node_name="n1", cpu="900m", uid="g-1",
+        labels={"scv/priority": "0", "app": "db"},
+    )
+    fake.add_pod(victim)
+    fake.add_pod(guarded)
+    fake.pdbs.append({
+        "metadata": {"name": "db-pdb"},
+        "spec": {"maxUnavailable": 0,
+                 "selector": {"matchLabels": {"app": "db"}}},
+    })
+    fake.add_pod(make_pod_obj(
+        "urgent", cpu="800m", labels={"scv/priority": "9"},
+        annotations={"diskIO": "3"},
+    ))
+    client = client_for(fake)
+    src = KubeClusterSource(client, scheduler_name="yoda-tpu")
+    utils = {"n0": NodeUtil(cpu_pct=10, disk_io=3),
+             "n1": NodeUtil(cpu_pct=20, disk_io=5)}
+    sched = Scheduler(
+        SchedulerConfig(batch_window=8, min_device_work=0,
+                        adaptive_dispatch=False),
+        advisor=StaticAdvisor(utils),
+        binder=KubeBinder(client),
+        evictor=KubeEvictor(client),
+        list_nodes=src.list_nodes,
+        list_running_pods=src.list_running_pods,
+        list_pdbs=src.list_pdbs,
+    )
+    for p in src.list_pending_pods():
+        sched.submit(p)
+    m1 = sched.run_cycle()
+    # urgent fits nowhere; the unprotected prio-1 victim is DELETEd on
+    # the server, the PDB-guarded prio-0 pod is not
+    assert m1.pods_unschedulable == 1 and m1.pods_preempted == 1
+    assert fake.deleted == ["default/victim"]
+    assert "default/guarded" in fake.pods
+
+    # the DELETE is immediately visible through the source (no grace
+    # period on the fake server): the requeued preemptor binds on n0
+    sched.queue._clock = lambda: 1e9
+    m2 = sched.run_cycle()
+    assert m2.pods_bound == 1
+    assert ("default/urgent", "n0") in fake.bindings
 
 
 # ---- Lease backend ------------------------------------------------------

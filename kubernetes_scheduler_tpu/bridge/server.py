@@ -380,7 +380,15 @@ def main(argv=None):
         "engine sidecar serving on %s:%d (devices=%s)",
         args.host, port, jax.devices(),
     )
-    server.wait_for_termination()
+    try:
+        server.wait_for_termination()
+    except (KeyboardInterrupt, SystemExit):
+        # drain in-flight RPCs before exiting (SIGTERM arrives via the
+        # CLI's handler as SystemExit); a cut-off cycle would flip the
+        # host to its scalar fallback for one window, which is fine but
+        # unnecessary when shutdown can just finish the RPC
+        log.info("shutting down; draining in-flight RPCs")
+        server.stop(grace=10).wait()
 
 
 if __name__ == "__main__":

@@ -156,8 +156,11 @@ def cmd_scheduler_kube(args, cfg) -> int:
             lease = FileLease(args.lease)
         elector = LeaderElector(lease, identity=args.lease_identity)
         log.info("waiting for leadership")
-        elector.acquire_blocking()
     try:
+        if elector is not None:
+            # inside the try: a SIGTERM landing right after the claim
+            # succeeds must still release through the finally below
+            elector.acquire_blocking()
         cycles = run_kube_loop(
             sched,
             source,
@@ -166,7 +169,7 @@ def cmd_scheduler_kube(args, cfg) -> int:
             exit_when_idle=not args.serve_forever,
             watch_timeout=args.watch_timeout,
         )
-    except KeyboardInterrupt:
+    except (KeyboardInterrupt, SystemExit):
         cycles = sched.totals["cycles"]
     finally:
         cache.stop()
@@ -222,7 +225,6 @@ def cmd_scheduler(args) -> int:
 
         elector = LeaderElector(FileLease(args.lease), identity=args.lease_identity)
         log.info("waiting for leadership on %s", args.lease)
-        elector.acquire_blocking()
 
     exporter = None
     if args.metrics_port:
@@ -234,7 +236,17 @@ def cmd_scheduler(args) -> int:
     for pod in pods:
         sched.submit(pod)
     t0 = time.perf_counter()
-    cycles = sched.run_until_empty(max_cycles=args.max_cycles)
+    try:
+        if elector is not None:
+            elector.acquire_blocking()
+        cycles = sched.run_until_empty(max_cycles=args.max_cycles)
+    finally:
+        # SIGTERM (SystemExit via _terminate) must still release the
+        # lease — an unreleased lease stalls standby failover — and
+        # close the exporter; on the normal path these are no-ops for
+        # the exporter in serve-forever mode, handled below
+        if elector is not None:
+            elector.release()
     dt = time.perf_counter() - t0
     for binding in sched.binder.bindings:
         running.append(binding.pod)
@@ -260,8 +272,6 @@ def cmd_scheduler(args) -> int:
             }
         )
     )
-    if elector is not None:
-        elector.release()
     if exporter is not None and not args.serve_forever:
         exporter.close()
     if args.serve_forever and exporter is not None:
@@ -269,7 +279,7 @@ def cmd_scheduler(args) -> int:
         try:
             while True:
                 time.sleep(3600)
-        except KeyboardInterrupt:
+        except (KeyboardInterrupt, SystemExit):
             exporter.close()
     return 0
 
@@ -375,6 +385,14 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _terminate(signum, frame):
+    """SIGTERM -> SystemExit so `finally` blocks run: Kubernetes stops
+    pods with SIGTERM, and the serve loops must release the leader Lease
+    on the way out (an unreleased lease stalls failover for the full
+    lease duration) and close exporters/caches cleanly."""
+    raise SystemExit(143)
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     logging.basicConfig(
@@ -382,6 +400,12 @@ def main(argv=None) -> int:
         logging.INFO if args.verbose == 1 else logging.WARNING,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
+    try:
+        import signal
+
+        signal.signal(signal.SIGTERM, _terminate)
+    except ValueError:
+        pass  # not the main thread (embedded use): skip
     return args.fn(args)
 
 

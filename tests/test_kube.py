@@ -339,6 +339,43 @@ def test_kube_loop_watch_cycle_bind_e2e(fake):
     assert [p.name for p in src.list_pending_pods()] == []
 
 
+def test_sigterm_releases_lease(fake, tmp_path, capsys, monkeypatch):
+    """Kubernetes stops pods with SIGTERM: the serve loop must release
+    the leader Lease on the way out (an unreleased lease stalls standby
+    failover for the whole lease duration). Simulated by raising the
+    CLI's SIGTERM translation (SystemExit) from inside the loop."""
+    import json as _json
+
+    import kubernetes_scheduler_tpu.cli as cli
+    import kubernetes_scheduler_tpu.kube.source as kube_source
+
+    fake.add_node(make_node_obj("n0"))
+    fake.prom["n0"] = {"cpu_pct": 10.0, "disk_io": 3.0}
+    host = fake.url.removeprefix("http://")
+    cfg_file = tmp_path / "cfg.json"
+    cfg_file.write_text(
+        _json.dumps({"batch_window": 8, "min_device_work": 0,
+                     "advisor": {"prometheus_host": host}})
+    )
+
+    def boom(*a, **kw):
+        raise SystemExit(0)  # what cli._terminate raises on SIGTERM
+
+    monkeypatch.setattr(kube_source, "run_kube_loop", boom)
+    rc = cli.main([
+        "scheduler", "--source", "kube", "--kube-server", fake.url,
+        "--config", str(cfg_file), "--watch-timeout", "5",
+        "--lease-kube",
+    ])
+    assert rc == 0  # clean exit: totals printed, no traceback
+    # the finally block released the lease: the fake server's Lease
+    # object exists and carries an EMPTY holderIdentity
+    lease = next(iter(fake.leases.values()), None)
+    assert lease is not None
+    holder = ((lease.get("spec") or {}).get("holderIdentity")) or ""
+    assert holder == ""
+
+
 def test_kube_preemption_e2e(fake):
     """Live-path preemption: a high-priority pod that fits nowhere
     evicts a lower-priority victim THROUGH the API server (KubeEvictor

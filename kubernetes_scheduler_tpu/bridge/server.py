@@ -88,11 +88,22 @@ class EngineService:
             "normalizer": request.normalizer,
         }
         for key, want in asked.items():
-            # make_sharded_*_fn factories are greedy-only, so an opts
+            # make_sharded_*_fn factories default to greedy, so an opts
             # dict that doesn't say otherwise still pins greedy
             default = "greedy" if key == "assigner" else None
             have = self._sharded_opts.get(key, default)
             if want and have and want != have:
+                context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    f"sidecar's sharded engine is fixed to "
+                    f"{key}={have!r}; request asked for {want!r}",
+                )
+        # auction knobs are baked into the sharded program too (the dense
+        # branch honors them per-request via _auction_kw); proto3 zero
+        # means "engine default" and is always accepted
+        for key, want in _auction_kw(request).items():
+            have = self._sharded_opts.get(key)
+            if have is not None and abs(want - have) > 1e-9:
                 context.abort(
                     grpc.StatusCode.INVALID_ARGUMENT,
                     f"sidecar's sharded engine is fixed to "
@@ -275,6 +286,23 @@ def main(argv=None):
     )
     parser.add_argument("--policy", default="balanced_cpu_diskio")
     parser.add_argument(
+        "--assigner",
+        default="greedy",
+        choices=["greedy", "auction"],
+        help="assignment algorithm baked into the sharded engine when "
+        "--mesh-devices is set (the dense engine honors the per-request "
+        "assigner field instead)",
+    )
+    parser.add_argument(
+        "--auction-rounds", type=int, default=1024,
+        help="max auction rounds for the sharded auction assigner",
+    )
+    parser.add_argument(
+        "--auction-price-frac", type=float, default=1.0 / 16.0,
+        help="price step (fraction of the unit row range) for the sharded "
+        "auction assigner",
+    )
+    parser.add_argument(
         "--learned-checkpoint",
         default=None,
         help="serve the learned two-tower policy restored from this orbax "
@@ -324,6 +352,12 @@ def main(argv=None):
                 np.asarray(jax.devices()[: args.mesh_devices]), (NODE_AXIS,)
             )
             node_axes = NODE_AXIS
+        assigner_kw = {"assigner": args.assigner}
+        if args.assigner == "auction":
+            assigner_kw.update(
+                auction_rounds=args.auction_rounds,
+                auction_price_frac=args.auction_price_frac,
+            )
         if learned_params is not None:
             from kubernetes_scheduler_tpu.models.learned import (
                 make_sharded_learned_fn,
@@ -332,7 +366,7 @@ def main(argv=None):
             def _learned(**kw):
                 return make_sharded_learned_fn(
                     learned_params, mesh, model=learned_model,
-                    node_axes=node_axes, **kw,
+                    node_axes=node_axes, **assigner_kw, **kw,
                 )
 
             sharded_fn = _learned()
@@ -341,25 +375,32 @@ def main(argv=None):
             sharded_windows_fn_soft = _learned(windows=True, soft=True)
         else:
             sharded_fn = make_sharded_schedule_fn(
-                mesh, policy=args.policy, node_axes=node_axes
+                mesh, policy=args.policy, node_axes=node_axes, **assigner_kw
             )
             sharded_fn_soft = make_sharded_schedule_fn(
-                mesh, policy=args.policy, node_axes=node_axes, soft=True
+                mesh, policy=args.policy, node_axes=node_axes, soft=True,
+                **assigner_kw,
             )
             sharded_windows_fn = make_sharded_windows_fn(
-                mesh, policy=args.policy, node_axes=node_axes
+                mesh, policy=args.policy, node_axes=node_axes, **assigner_kw
             )
             sharded_windows_fn_soft = make_sharded_windows_fn(
-                mesh, policy=args.policy, node_axes=node_axes, soft=True
+                mesh, policy=args.policy, node_axes=node_axes, soft=True,
+                **assigner_kw,
             )
-        # assigner is pinned too: the sharded engine is greedy-only, and a
-        # host that asked for the auction must get an error, not silently
-        # different placement semantics
+        # the assigner is baked into the sharded program at startup; a
+        # host that asked for the other one must get an error, not
+        # silently different placement semantics
         sharded_opts = {
             "policy": args.policy,
-            "assigner": "greedy",
+            "assigner": args.assigner,
             "normalizer": "min_max",
         }
+        if args.assigner == "auction":
+            sharded_opts.update(
+                auction_rounds=args.auction_rounds,
+                auction_price_frac=args.auction_price_frac,
+            )
     else:
         sharded_fn_soft = None
         sharded_windows_fn = None

@@ -86,6 +86,32 @@ class AffinityState(NamedTuple):
     node_mask: jnp.ndarray    # [n] bool (for the min-over-domains term)
 
 
+def tie_jitter(
+    p: int, n: int, scale, col_offset=0, dtype=jnp.float32
+) -> jnp.ndarray:
+    """[p, n] deterministic sub-step tie-break jitter in [0, scale).
+
+    A counter-based per-element hash of (row, GLOBAL column) rather than a
+    stateful PRNG draw, so a node-sharded caller can materialize just its
+    own columns (`col_offset` = shard offset) and get bit-identical values
+    to the dense [p, n_global] matrix — the property the sharded auction's
+    decision parity with the dense auction rests on. Magnitude << the
+    price step keeps it decision-neutral except between genuine near-ties.
+    """
+    r = jnp.arange(p, dtype=jnp.uint32)[:, None]
+    c = (jnp.arange(n, dtype=jnp.uint32) + jnp.uint32(col_offset))[None, :]
+    x = r * jnp.uint32(0x9E3779B9) + c * jnp.uint32(0x85EBCA6B) + jnp.uint32(1)
+    # final avalanche of a murmur3-style mixer: every (row, col) bit
+    # diffuses into the mantissa bits we keep
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    u = (x >> 8).astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))
+    return u.astype(dtype) * scale
+
+
 def pod_has_anti_onehot(anti_affinity_sel: jnp.ndarray, s: int) -> jnp.ndarray:
     """[p, S] bool one-hot union of each pod's anti selectors."""
     p = anti_affinity_sel.shape[0]
@@ -136,11 +162,17 @@ def spread_ok_batched(
     node_mask: jnp.ndarray,
     spread_sel: jnp.ndarray,
     spread_max: jnp.ndarray,
+    dmin: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
-    """[p, n] bool batched spread_ok_from_counts (spread_sel/max [p, K])."""
+    """[p, n] bool batched spread_ok_from_counts (spread_sel/max [p, K]).
+
+    dmin: optional precomputed [S] per-selector minimum domain count over
+    schedulable nodes — a node-sharded caller passes the GLOBAL (pmin'd)
+    minimum; default computes it from the local cnt."""
     s = cnt.shape[1]
     big = jnp.asarray(jnp.finfo(jnp.float32).max, jnp.float32)
-    dmin = jnp.where(node_mask[:, None], cnt, big).min(0)         # [S]
+    if dmin is None:
+        dmin = jnp.where(node_mask[:, None], cnt, big).min(0)     # [S]
     sel = jnp.clip(spread_sel, 0, max(s - 1, 0))                  # [p, K]
     skew = cnt[:, sel] + 1.0 - dmin[sel][None, :, :]              # [n, p, K]
     ok = (skew <= spread_max[None, :, :]) | (spread_sel < 0)[None, :, :]
@@ -323,7 +355,10 @@ def _segmented_admission(
 
 
 def _affinity_round_mask(
-    aff: AffinityState, added: jnp.ndarray, added_avoid: jnp.ndarray
+    aff: AffinityState,
+    added: jnp.ndarray,
+    added_avoid: jnp.ndarray,
+    dmin: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """[p, n] bool: every (anti)affinity constraint of each pod — own
     selectors and existing avoiders' reverse terms — holds on each node
@@ -358,24 +393,41 @@ def _affinity_round_mask(
     )                                                              # [p]
     avoid_cnt = aff.avoid_counts + added_avoid
     rev_bad = anti_reverse_bad(aff.pod_matches, avoid_cnt)         # [p, n]
-    spread = spread_ok_batched(cnt, aff.node_mask, aff.spread_sel, aff.spread_max)
+    spread = spread_ok_batched(
+        cnt, aff.node_mask, aff.spread_sel, aff.spread_max, dmin=dmin
+    )
     return aff_ok & anti_ok & valid[:, None] & ~rev_bad & spread
 
 
-def _evict_round_conflicts(
-    aff: AffinityState,
+def _evict_conflicts_core(
+    pod_matches: jnp.ndarray,
+    anti_affinity_sel: jnp.ndarray,
+    pod_has_anti: jnp.ndarray,
+    spread_sel: jnp.ndarray,
+    spread_max: jnp.ndarray,
     admitted: jnp.ndarray,
-    bid: jnp.ndarray,
+    dom_p: jnp.ndarray,
     prio_key: jnp.ndarray,
-    added: jnp.ndarray,
+    base_at_bid: jnp.ndarray,
+    added_at_bid: jnp.ndarray,
+    dmin: jnp.ndarray,
+    table_rows: int,
 ) -> jnp.ndarray:
-    """[p] bool: admitted pods whose hard anti-affinity is violated by
-    OTHER same-round placements, minus one survivor per conflict group.
-    `added` [n, S] carries prior rounds' permanent placements in the
-    per-node EXPANDED layout (see _affinity_round_mask); spread skew
-    is a TOTAL-count constraint, so the check below must see base + added
-    + this round's adds (anti-affinity needs only same-round adds — the
-    pre-bid mask already rules out violations against base + added).
+    """[p] bool: admitted pods whose hard anti-affinity or spread skew is
+    violated by OTHER same-round placements, minus one survivor per
+    conflict group. Pure per-pod/replicated inputs — shared by the dense
+    wrapper (_evict_round_conflicts) and the node-sharded auction, whose
+    node-side state lives on other shards: a sharded caller psum-broadcasts
+    the bid-node lookups (dom_p, base_at_bid, added_at_bid) and the global
+    dmin, then runs this identically on every shard.
+
+    dom_p:        [p, S] domain rep ids of each pod's bid node, in
+                  [0, table_rows)
+    base_at_bid:  [p, S] base (pre-window) domain counts at the bid node
+    added_at_bid: [p, S] prior-round in-window totals of the bid domain
+    dmin:         [S] min live (base + prior-round) count over schedulable
+                  nodes — GLOBAL under sharding
+    table_rows:   row count of the scatter-form aggregation tables
 
     The pre-bid mask guarantees no violation against base + previous
     rounds; only pods admitted in the SAME round can conflict. A pod p
@@ -387,11 +439,10 @@ def _evict_round_conflicts(
     avoider out. Evicted pods re-bid next round against counts that now
     include the survivors, so their masks strictly shrink — no livelock.
     """
-    p, s = aff.pod_matches.shape
+    p, s = pod_matches.shape
     cols = jnp.arange(s)[None, :]
-    dom_p = aff.domain_id[bid]                                     # [p, S]
     contrib = jnp.where(
-        admitted[:, None], aff.pod_matches.astype(jnp.float32), 0.0
+        admitted[:, None], pod_matches.astype(jnp.float32), 0.0
     )
     # No [n, S] scatters in here: TPU scatters serialize per update, and
     # four of them per auction round were ~45% of the constraint-config
@@ -405,26 +456,28 @@ def _evict_round_conflicts(
         cnt_incl = jnp.einsum("pqs,qs->ps", samef, contrib)        # [p, S]
     else:
         adds = (
-            jnp.zeros_like(aff.domain_counts).at[dom_p, cols].add(contrib)
-        )                                                          # [n, S]
+            jnp.zeros((table_rows, s), jnp.float32)
+            .at[dom_p, cols]
+            .add(contrib)
+        )
         cnt_incl = adds[dom_p, cols]
     cnt_other = cnt_incl - contrib                                 # [p, S]
 
-    t_sel = aff.anti_affinity_sel                                  # [p, K]
+    t_sel = anti_affinity_sel                                      # [p, K]
     tc = jnp.clip(t_sel, 0, max(s - 1, 0))
-    has_anti = aff.pod_has_anti                                    # [p, S]
+    has_anti = pod_has_anti                                        # [p, S]
     viol_t = (t_sel >= 0) & (
         jnp.take_along_axis(cnt_other, tc, axis=1) > 0
     ) & admitted[:, None]                                          # [p, K]
 
     # non-avoider matchers: permanent this round; their presence hard-blocks
     contrib_nv = jnp.where(
-        (admitted[:, None] & aff.pod_matches & ~has_anti), 1.0, 0.0
+        (admitted[:, None] & pod_matches & ~has_anti), 1.0, 0.0
     )
     if use_dense:
         blocked_full = jnp.einsum("pqs,qs->ps", samef, contrib_nv) > 0
     else:
-        adds_nv = jnp.zeros_like(aff.domain_counts).at[dom_p, cols].add(
+        adds_nv = jnp.zeros((table_rows, s), jnp.float32).at[dom_p, cols].add(
             contrib_nv
         )
         blocked_full = adds_nv[dom_p, cols] > 0
@@ -437,14 +490,14 @@ def _evict_round_conflicts(
     # non-positive for negative priority labels). Computed ONCE outside
     # the auction loop — the rank argsort is round-invariant and device
     # sorts inside a while_loop were the auction's dominant round cost.
-    key = prio_key                                                 # [1, p]
-    member = admitted[:, None] & has_anti & aff.pod_matches        # [p, S]
+    key = prio_key                                                 # [p]
+    member = admitted[:, None] & has_anti & pod_matches            # [p, S]
     keyf = jnp.where(member, key[:, None], 0)
     if use_dense:
         gmax_at = jnp.max(jnp.where(same, keyf[None, :, :], 0), axis=1)
     else:
         gmax = (
-            jnp.zeros(aff.domain_counts.shape, jnp.int32)
+            jnp.zeros((table_rows, s), jnp.int32)
             .at[dom_p, cols]
             .max(keyf)
         )
@@ -463,7 +516,7 @@ def _evict_round_conflicts(
     # against counts that include the survivors — masks shrink, no
     # livelock. Violated non-contributors always re-bid (keeping them
     # blocks nothing).
-    sp_sel = aff.spread_sel                                        # [p, Kс]
+    sp_sel = spread_sel                                            # [p, Kc]
     spc = jnp.clip(sp_sel, 0, max(s - 1, 0))
     # dmin from base + prior-round carry only (this round's adds can only
     # RAISE counts, so omitting them under-estimates dmin and the skew
@@ -471,29 +524,25 @@ def _evict_round_conflicts(
     # re-bids next round against counts whose carry has absorbed the adds
     # — at most one extra round, never a missed violation. In exchange
     # the eviction path needs NO [n, S] scatter at all.)
-    live_cnt = aff.domain_counts + added
-    big = jnp.asarray(jnp.finfo(jnp.float32).max, jnp.float32)
-    dmin = jnp.where(aff.node_mask[:, None], live_cnt, big).min(0)  # [S]
-    # expanded layout: added[bid] IS the prior-round total of bid's domain
-    cnt_mine = aff.domain_counts[bid] + added[bid] + cnt_incl       # [p, S]
+    cnt_mine = base_at_bid + added_at_bid + cnt_incl                # [p, S]
     skew_t = (
         jnp.take_along_axis(cnt_mine, spc, axis=1)
         - dmin[spc]
     )                                                               # [p, Kc]
     viol_sp = admitted[:, None] & (sp_sel >= 0) & (
-        skew_t > aff.spread_max.astype(jnp.float32)
+        skew_t > spread_max.astype(jnp.float32)
     )
     rows_sp = jnp.arange(p)[:, None]
     has_spread = (
         jnp.zeros((p, s), bool).at[rows_sp, spc].max(sp_sel >= 0)
     )                                                               # [p, S]
-    member_sp = admitted[:, None] & has_spread & aff.pod_matches    # [p, S]
+    member_sp = admitted[:, None] & has_spread & pod_matches        # [p, S]
     keyf_sp = jnp.where(member_sp, key[:, None], 0)
     if use_dense:
         gmax_sp_at = jnp.max(jnp.where(same, keyf_sp[None, :, :], 0), axis=1)
     else:
         gmax_sp = (
-            jnp.zeros(aff.domain_counts.shape, jnp.int32)
+            jnp.zeros((table_rows, s), jnp.int32)
             .at[dom_p, cols]
             .max(keyf_sp)
         )
@@ -501,6 +550,33 @@ def _evict_round_conflicts(
     keep_sp_s = member_sp & (keyf_sp == gmax_sp_at)                 # [p, S]
     survive_sp = jnp.take_along_axis(keep_sp_s, spc, axis=1)        # [p, Kc]
     return evict | (viol_sp & ~survive_sp).any(-1)
+
+
+def _evict_round_conflicts(
+    aff: AffinityState,
+    admitted: jnp.ndarray,
+    bid: jnp.ndarray,
+    prio_key: jnp.ndarray,
+    added: jnp.ndarray,
+) -> jnp.ndarray:
+    """Dense wrapper over _evict_conflicts_core: `added` [n, S] carries
+    prior rounds' permanent placements in the per-node EXPANDED layout
+    (see _affinity_round_mask), so the bid-node lookups are plain gathers.
+    Spread skew is a TOTAL-count constraint, so the core must see base +
+    added + this round's adds (anti-affinity needs only same-round adds —
+    the pre-bid mask already rules out violations against base + added).
+    """
+    dom_p = aff.domain_id[bid]                                     # [p, S]
+    live_cnt = aff.domain_counts + added
+    big = jnp.asarray(jnp.finfo(jnp.float32).max, jnp.float32)
+    dmin = jnp.where(aff.node_mask[:, None], live_cnt, big).min(0)  # [S]
+    # expanded layout: added[bid] IS the prior-round total of bid's domain
+    return _evict_conflicts_core(
+        aff.pod_matches, aff.anti_affinity_sel, aff.pod_has_anti,
+        aff.spread_sel, aff.spread_max, admitted, dom_p, prio_key,
+        aff.domain_counts[bid], added[bid], dmin,
+        aff.domain_counts.shape[0],
+    )
 
 
 def auction_assign(
@@ -564,12 +640,7 @@ def auction_assign(
     # Deterministic sub-step tie-break jitter: without it, pods with
     # identical score rows (homogeneous clusters) bid in lockstep — one
     # admission per round — and a round budget strands schedulable pods.
-    # Magnitude << step keeps it decision-neutral except between genuine
-    # near-ties.
-    jitter = (
-        jax.random.uniform(jax.random.key(0), (p, n), scores.dtype)
-        * (0.01 * price_frac)
-    )
+    jitter = tie_jitter(p, n, 0.01 * price_frac, dtype=scores.dtype)
 
     # priority order and its rank key are round-invariant; hoisted here so
     # each round pays ONE device sort (the node grouping in admission)

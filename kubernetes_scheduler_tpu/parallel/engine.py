@@ -36,10 +36,15 @@ from kubernetes_scheduler_tpu.engine import (
 from kubernetes_scheduler_tpu.ops import card_fit, card_score, free_capacity
 from kubernetes_scheduler_tpu.ops.assign import (
     NEG,
+    AffinityState,
+    _affinity_round_mask,
+    _evict_conflicts_core,
     _priority_order,
+    _segmented_admission,
     affinity_ok_from_counts,
     anti_reverse_ok,
     pod_has_anti_onehot,
+    tie_jitter,
 )
 from kubernetes_scheduler_tpu.ops.collect import local_max_card_values
 from kubernetes_scheduler_tpu.ops.normalize import min_max_normalize, score_bounds, softmax_normalize
@@ -206,6 +211,227 @@ def _sharded_greedy(
     return node_idx, free_after, added2_f
 
 
+def _sharded_auction(
+    norm: jnp.ndarray,
+    feasible: jnp.ndarray,
+    pods: PodBatch,
+    free0: jnp.ndarray,
+    snapshot: SnapshotArrays,
+    axes,
+    rounds: int,
+    price_frac: float,
+    added2_0: jnp.ndarray | None = None,
+):
+    """Distributed price-guided auction over the sharded node axis.
+
+    The dense auction_assign round structure — bid → admit → evict →
+    reprice — with per-ROUND collectives instead of greedy's per-POD
+    candidate election (rounds are few; this is the regime where the
+    auction's parallel rounds beat greedy's O(P) latency-bound collective
+    chain on a mesh). Per round:
+
+      1. local bid: each shard computes every pod's best (score + jitter −
+         price) over ITS node columns — the [p, n_local] mask includes
+         dynamic (anti)affinity/spread against live counts, as in the
+         dense affinity-aware auction;
+      2. election: ONE stacked all_gather of the per-shard (best value,
+         global index) pairs; every shard then picks the identical global
+         argmax per pod (first-max tie-break matches the dense argmax);
+      3. admission: each shard runs the segmented prefix-sum admission for
+         bids that landed on ITS nodes (a node's bidder group never spans
+         shards), then one psum ORs the per-shard verdicts;
+      4. eviction: same-round conflict resolution runs REPLICATED on every
+         shard via _evict_conflicts_core — the only node-side lookups it
+         needs (bid node's domain ids and base counts) are psum-broadcast
+         from the owning shard, and the spread dmin is a pmin;
+      5. fold + reprice: domain-count carries live in the REPRESENTATIVE-
+         ROW layout ([n_global, S], indexed by global domain rep id — the
+         same table _sharded_greedy threads), so the fold is a replicated
+         O(p·S) scatter; free capacity and prices update shard-locally.
+
+    Collectives per round: one all_gather ([2, p] candidate pairs) + three
+    psums ([p] admission, [p, S] domain ids, [p, S] base counts) + one
+    pmin ([S] spread dmin) — all O(p·S), none O(n).
+
+    Decision parity with the dense auction is exact (bit-identical
+    node_idx): the tie-break jitter is a counter-based hash of (row,
+    GLOBAL column) (ops/assign.tie_jitter) so shards materialize the same
+    values the dense path sees, and the row normalization bounds are
+    pmax/pmin'd to global.
+
+    added2_0: optional [2, n_global, S] in-window carry from previous
+    windows (representative-row layout); threaded through and returned,
+    so make_sharded_windows_fn mixes windows across assigners with exact
+    cross-window (anti)affinity.
+    """
+    from kubernetes_scheduler_tpu.engine import match_matrix
+
+    p, n_local = norm.shape
+    n_devices = jax.lax.psum(1, axes)
+    n_global = n_local * n_devices
+    offset = jax.lax.axis_index(axes).astype(jnp.int32) * n_local
+    s = snapshot.domain_counts.shape[1]
+    cols = jnp.arange(s)[None, :]
+    matches = match_matrix(pods, s)
+    has_anti = pod_has_anti_onehot(pods.anti_affinity_sel, s)
+
+    # global per-row min-max to [0, 1] over feasible entries (the dense
+    # auction's pricing-scale normalization, with global bounds)
+    row_hi = jax.lax.pmax(
+        jnp.where(feasible, norm, -jnp.inf).max(axis=1), axes
+    )                                                              # [p]
+    row_lo = jax.lax.pmin(
+        jnp.where(feasible, norm, jnp.inf).min(axis=1), axes
+    )
+    row_ok = jnp.isfinite(row_hi) & jnp.isfinite(row_lo)
+    denom = jnp.where(row_ok, jnp.maximum(row_hi - row_lo, 1e-6), 1.0)
+    scores = jnp.where(
+        row_ok[:, None],
+        (norm - jnp.where(row_ok, row_lo, 0.0)[:, None]) / denom[:, None],
+        0.0,
+    )
+    step = jnp.asarray(price_frac, scores.dtype)
+    jitter = tie_jitter(
+        p, n_local, 0.01 * price_frac, col_offset=offset, dtype=scores.dtype
+    )
+
+    by_prio = _priority_order(pods.priority, pods.pod_mask)
+    rank = jnp.zeros((p,), jnp.int32).at[by_prio].set(
+        jnp.arange(p, dtype=jnp.int32)
+    )
+    prio_key = p - rank
+
+    aff_local = AffinityState(
+        domain_counts=snapshot.domain_counts,
+        domain_id=snapshot.domain_id,   # global representative ids
+        pod_matches=matches,
+        affinity_sel=pods.affinity_sel,
+        anti_affinity_sel=pods.anti_affinity_sel,
+        avoid_counts=snapshot.avoid_counts,
+        pod_has_anti=has_anti,
+        spread_sel=pods.spread_sel,
+        spread_max=pods.spread_max,
+        node_mask=snapshot.node_mask,
+    )
+    big = jnp.asarray(jnp.finfo(jnp.float32).max, jnp.float32)
+
+    def varying(x):
+        return jax.lax.pcast(x, axes, to="varying")
+
+    added2_init = (
+        added2_0
+        if added2_0 is not None
+        else varying(jnp.zeros((2, n_global, s), jnp.float32))
+    )
+
+    def round_body(state):
+        assigned, free, price, added2, _, r = state
+        added, added_avoid = added2[0], added2[1]
+        active = pods.pod_mask & (assigned < 0)
+        cap_ok = (
+            (pods.request[:, None, :] <= free[None, :, :])
+            | (pods.request[:, None, :] == 0)
+        ).all(-1)                                                  # [p, n_local]
+        # live counts: rep-layout carry gathered to this shard's nodes
+        added_exp = added[snapshot.domain_id, cols]                # [n_local, S]
+        avoid_exp = added_avoid[snapshot.domain_id, cols]
+        live_local = snapshot.domain_counts + added_exp
+        dmin = jax.lax.pmin(
+            jnp.where(snapshot.node_mask[:, None], live_local, big).min(0),
+            axes,
+        )                                                          # [S]
+        aff_ok = _affinity_round_mask(aff_local, added_exp, avoid_exp, dmin=dmin)
+        mask = feasible & cap_ok & active[:, None] & aff_ok
+        row = jnp.where(mask, scores + jitter - price[None, :], NEG)
+        local_best = row.max(axis=1)                               # [p]
+        local_arg = jnp.argmax(row, axis=1).astype(jnp.int32) + offset
+        # ONE stacked gather per election: the index rides as bitcast f32
+        # payload (never arithmetically touched), halving the per-round
+        # collective launches on the latency-bound election
+        cand = jax.lax.all_gather(
+            jnp.stack(
+                [local_best, jax.lax.bitcast_convert_type(local_arg, jnp.float32)]
+            ),
+            axes,
+        )                                                          # [D, 2, p]
+        cand_s = cand[:, 0, :]                                     # [D, p]
+        cand_i = jax.lax.bitcast_convert_type(cand[:, 1, :], jnp.int32)
+        gbest = cand_s.max(axis=0)
+        shard = jnp.argmax(cand_s, axis=0)                         # first max
+        bid = jnp.take_along_axis(cand_i, shard[None, :], axis=0)[0]  # [p]
+        has_bid = gbest > NEG * 0.5
+        blocal = bid - offset
+        mine = has_bid & (blocal >= 0) & (blocal < n_local)
+        adm_local = _segmented_admission(
+            blocal, mine, pods.request, free, by_prio
+        )
+        admitted = jax.lax.psum(adm_local.astype(jnp.int32), axes) > 0  # [p]
+        # same-round conflict eviction, replicated: broadcast the owning
+        # shard's bid-node lookups, then every shard runs the identical
+        # per-pod resolution
+        bl_c = jnp.clip(blocal, 0, n_local - 1)
+        dom_local = snapshot.domain_id[bl_c]                       # [p, S]
+        dom_p = (
+            jax.lax.psum(jnp.where(mine[:, None], dom_local + 1, 0), axes) - 1
+        )
+        dom_c = jnp.clip(dom_p, 0, n_global - 1)
+        base_at_bid = jax.lax.psum(
+            jnp.where(mine[:, None], snapshot.domain_counts[bl_c], 0.0), axes
+        )
+        added_at_bid = added[dom_c, cols]
+        evict = _evict_conflicts_core(
+            matches, pods.anti_affinity_sel, has_anti,
+            pods.spread_sel, pods.spread_max, admitted, dom_c, prio_key,
+            base_at_bid, added_at_bid, dmin, n_global,
+        )
+        admitted = admitted & ~evict
+        # fold permanent placements into the rep-layout carries (replicated)
+        inc_m = jnp.where(admitted[:, None], matches.astype(jnp.float32), 0.0)
+        inc_a = jnp.where(admitted[:, None], has_anti.astype(jnp.float32), 0.0)
+        added2 = jnp.stack(
+            [
+                added.at[dom_c, cols].add(inc_m),
+                added_avoid.at[dom_c, cols].add(inc_a),
+            ]
+        )
+        new_assigned = jnp.where(admitted, bid, assigned)
+        used = jnp.zeros_like(free).at[bl_c].add(
+            jnp.where((admitted & mine)[:, None], pods.request, 0.0)
+        )
+        rejected = (
+            jnp.zeros((n_local,), bool).at[bl_c].max(mine & ~admitted)
+        )
+        return (
+            new_assigned,
+            free - used,
+            price + jnp.where(rejected, step, 0.0),
+            added2,
+            has_bid.any(),
+            r + 1,
+        )
+
+    def cond(state):
+        can_bid, r = state[-2], state[-1]
+        return (r < rounds) & can_bid
+
+    assigned, free_after, _, added2_f, _, _ = jax.lax.while_loop(
+        cond,
+        round_body,
+        (
+            varying(jnp.full((p,), -1, jnp.int32)),
+            free0,
+            varying(jnp.zeros((n_local,), jnp.float32)),
+            added2_init,
+            varying(jnp.asarray(True)),
+            jnp.int32(0),
+        ),
+    )
+    # identical on every shard; pmax makes replication provable (see
+    # _sharded_greedy)
+    assigned = jax.lax.pmax(assigned, axes)
+    return assigned, free_after, added2_f
+
+
 def _mesh_specs(mesh: Mesh, node_axes):
     """Validated mesh axes + the standard sharding specs: per-node arrays
     shard on their leading node axis, per-pod arrays replicate. Shared by
@@ -291,6 +517,9 @@ def make_sharded_schedule_fn(
     node_axes: str | tuple[str, ...] = NODE_AXIS,
     soft: bool = False,
     score_fn=None,
+    assigner: str = "greedy",
+    auction_rounds: int = 1024,
+    auction_price_frac: float = 1.0 / 16.0,
 ):
     """Build a jitted shard_map'd schedule function for `mesh`.
 
@@ -314,18 +543,20 @@ def make_sharded_schedule_fn(
     collective; normalization bounds are already global (pmax/pmin), so
     weight-vs-range semantics match the dense path bit-for-bit.
 
-    Capability stance (documented deviations from the dense engine):
-    - assigner is GREEDY only. The auction's per-round segmented
-      admission sorts pods by destination NODE — a global sort across
-      the sharded axis every round. Sharding the node axis is the
-      regime where per-shard work is large and rounds are few, which is
-      exactly where greedy's one-candidate-election-per-pod collective
-      pattern is cheaper; an auction variant would need a distributed
-      sort per round and is deliberately out of scope.
-    - for a whole backlog in one dispatch use make_sharded_windows_fn,
-      which threads the capacity AND (anti)affinity carries across
-      windows exactly like engine.schedule_windows does on one device.
+    assigner selects between the exact sequential greedy (_sharded_greedy:
+    one candidate-election collective per POD — the right trade at small
+    windows) and the distributed price-guided auction (_sharded_auction:
+    a handful of O(p·S) collectives per ROUND, bit-identical decisions to
+    the dense auction_assign — the performance assigner for large
+    windows, now first-class on the mesh). Both paths evaluate inter-pod
+    (anti)affinity and spread dynamically against live counts.
+
+    For a whole backlog in one dispatch use make_sharded_windows_fn,
+    which threads the capacity AND (anti)affinity carries across
+    windows exactly like engine.schedule_windows does on one device.
     """
+    if assigner not in ("greedy", "auction"):
+        raise ValueError(f"unknown assigner {assigner!r}")
     axes, node, rep, snap_specs, pod_specs = _mesh_specs(mesh, node_axes)
     out_specs = ScheduleResult(
         node_idx=rep,
@@ -341,9 +572,15 @@ def make_sharded_schedule_fn(
             snapshot, pods, policy, normalizer, soft, axes, score_fn
         )
         free0 = compute_free_capacity(snapshot)
-        node_idx, free_after, _ = _sharded_greedy(
-            norm, feasible, pods, free0, snapshot, axes
-        )
+        if assigner == "greedy":
+            node_idx, free_after, _ = _sharded_greedy(
+                norm, feasible, pods, free0, snapshot, axes
+            )
+        else:
+            node_idx, free_after, _ = _sharded_auction(
+                norm, feasible, pods, free0, snapshot, axes,
+                auction_rounds, auction_price_frac,
+            )
         return ScheduleResult(
             node_idx=node_idx,
             scores=norm,
@@ -367,6 +604,9 @@ def make_sharded_windows_fn(
     node_axes: str | tuple[str, ...] = NODE_AXIS,
     soft: bool = False,
     score_fn=None,
+    assigner: str = "greedy",
+    auction_rounds: int = 1024,
+    auction_price_frac: float = 1.0 / 16.0,
 ):
     """Multi-window sharded scheduling: engine.schedule_windows with the
     node axis sharded over `mesh`.
@@ -376,12 +616,15 @@ def make_sharded_windows_fn(
     engine.WindowsResult. One device dispatch schedules the whole
     backlog: a lax.scan over windows threads free capacity AND the
     in-window (anti)affinity domain-count carry (the [2, n_global, S]
-    table _sharded_greedy maintains) between windows, so window k+1 sees
-    window k's placements exactly as the dense schedule_windows scan
-    does. Greedy assigner only, like make_sharded_schedule_fn.
+    representative-row table both sharded assigners maintain) between
+    windows, so window k+1 sees window k's placements exactly as the
+    dense schedule_windows scan does. assigner selects greedy or the
+    distributed auction per window (see make_sharded_schedule_fn).
     """
     from kubernetes_scheduler_tpu.engine import WindowsResult
 
+    if assigner not in ("greedy", "auction"):
+        raise ValueError(f"unknown assigner {assigner!r}")
     axes, node, rep, snap_specs, pod_specs = _mesh_specs(mesh, node_axes)
     out_specs = WindowsResult(node_idx=rep, free_after=node, n_assigned=rep)
 
@@ -414,12 +657,18 @@ def make_sharded_windows_fn(
             _, norm, feasible = _window_pipeline(
                 snap_pipe, w, policy, normalizer, soft, axes, score_fn
             )
-            # greedy takes the ORIGINAL counts plus the added2 carry (it
-            # layers the carry itself — snap_pipe's folded counts would
-            # double-count)
-            node_idx, free_after, added2 = _sharded_greedy(
-                norm, feasible, w, free, snapshot, axes, added2
-            )
+            # the assigner takes the ORIGINAL counts plus the added2 carry
+            # (it layers the carry itself — snap_pipe's folded counts
+            # would double-count)
+            if assigner == "greedy":
+                node_idx, free_after, added2 = _sharded_greedy(
+                    norm, feasible, w, free, snapshot, axes, added2
+                )
+            else:
+                node_idx, free_after, added2 = _sharded_auction(
+                    norm, feasible, w, free, snapshot, axes,
+                    auction_rounds, auction_price_frac, added2,
+                )
             return (free_after, added2), (
                 node_idx, (node_idx >= 0).sum().astype(jnp.int32)
             )

@@ -139,6 +139,66 @@ def test_sharded_sidecar_rejects_mismatched_options():
         server.stop(grace=None)
 
 
+def test_sharded_auction_sidecar_serves_and_pins_knobs():
+    """A mesh sidecar baked to the AUCTION assigner serves it with dense
+    parity, and rejects requests asking for different auction knobs (the
+    dense branch honors per-request knobs; the sharded program bakes them
+    at startup, so mismatches must fail loud — review finding r4)."""
+    import jax
+    from kubernetes_scheduler_tpu.engine import schedule_batch
+    from kubernetes_scheduler_tpu.parallel.engine import make_sharded_schedule_fn
+    from kubernetes_scheduler_tpu.parallel.mesh import make_mesh
+
+    assert jax.device_count() == 8
+    mesh = make_mesh(8)
+    server, port, _ = make_server(
+        "127.0.0.1:0",
+        sharded_fn=make_sharded_schedule_fn(mesh, assigner="auction"),
+        sharded_opts={
+            "policy": "balanced_cpu_diskio",
+            "assigner": "auction",
+            "normalizer": "min_max",
+            "auction_rounds": 1024,
+            "auction_price_frac": 1.0 / 16.0,
+        },
+    )
+    server.start()
+    client = RemoteEngine(f"127.0.0.1:{port}", deadline_seconds=120.0)
+    try:
+        snap = gen_cluster(32, seed=30, constraints=True)
+        pods = gen_pods(10, seed=31, constraints=True)
+        remote = client.schedule_batch(snap, pods, assigner="auction")
+        dense = schedule_batch(
+            snap, pods, assigner="auction", affinity_aware=True
+        )
+        assert (
+            np.asarray(remote.node_idx).tolist()
+            == np.asarray(dense.node_idx).tolist()
+        )
+        with pytest.raises(EngineUnavailable, match="INVALID_ARGUMENT"):
+            client.schedule_batch(snap, pods, assigner="greedy")
+        with pytest.raises(EngineUnavailable, match="INVALID_ARGUMENT"):
+            client.schedule_batch(
+                snap, pods, assigner="auction", auction_price_frac=1.0
+            )
+        with pytest.raises(EngineUnavailable, match="INVALID_ARGUMENT"):
+            client.schedule_batch(
+                snap, pods, assigner="auction", auction_rounds=64
+            )
+        # baked values offered explicitly are accepted
+        ok = client.schedule_batch(
+            snap, pods, assigner="auction",
+            auction_rounds=1024, auction_price_frac=1.0 / 16.0,
+        )
+        assert (
+            np.asarray(ok.node_idx).tolist()
+            == np.asarray(dense.node_idx).tolist()
+        )
+    finally:
+        client.close()
+        server.stop(grace=None)
+
+
 def test_schedule_windows_rpc_matches_local(live_server):
     """Whole-backlog RPC: one ScheduleWindows call reproduces the local
     schedule_windows decisions, auction knobs riding the wire."""

@@ -275,6 +275,120 @@ def test_sharded_windows_carries_anti_affinity_across_windows():
     assert int(res.n_assigned) == 1
 
 
+def test_sharded_auction_matches_dense_auction():
+    """The distributed auction must make bit-identical decisions to the
+    dense auction_assign path (the tie-break jitter is a counter-based
+    hash of global coordinates, so shards see the dense path's values)."""
+    from kubernetes_scheduler_tpu.sim import gen_cluster, gen_pods
+
+    assert jax.device_count() == 8
+    snapshot = gen_cluster(64, seed=5, constraints=True)
+    pods = gen_pods(12, seed=6, constraints=True)
+    dense = schedule_batch(snapshot, pods, assigner="auction", affinity_aware=True)
+    sharded = make_sharded_schedule_fn(make_mesh(8), assigner="auction")(
+        snapshot, pods
+    )
+    assert (
+        np.asarray(sharded.node_idx).tolist()
+        == np.asarray(dense.node_idx).tolist()
+    )
+    np.testing.assert_allclose(
+        np.asarray(sharded.free_after), np.asarray(dense.free_after), atol=1e-3
+    )
+
+
+def test_sharded_auction_contention_spreads_across_shards():
+    """Hot-node contention: many identical pods all preferring one node
+    must spread via prices to nodes on OTHER shards, and the result must
+    match the dense auction exactly (admission + repricing cross the
+    shard boundary correctly)."""
+    n, p, r = 16, 12, 2
+    # node 3 (shard 0) scores highest for everyone; capacity fits 2 pods
+    # per node, so most pods must overflow to other shards' nodes
+    score = np.zeros((p, n), np.float32)
+    score[:, 3] = 10.0
+    score[:, :] += np.linspace(0, 1, n)[None, :]
+    snapshot = make_snapshot(
+        allocatable=np.full((n, r), 2000.0, np.float32),
+        requested=np.zeros((n, r), np.float32),
+        disk_io=np.zeros(n),
+        cpu_pct=np.linspace(0, 50, n),
+        mem_pct=np.zeros(n),
+    )
+    pods = make_pod_batch(request=np.full((p, r), 1000.0, np.float32))
+    dense = schedule_batch(
+        snapshot, pods, assigner="auction", policy="free_capacity"
+    )
+    sharded = make_sharded_schedule_fn(
+        make_mesh(8), assigner="auction", policy="free_capacity"
+    )(snapshot, pods)
+    didx = np.asarray(dense.node_idx)
+    sidx = np.asarray(sharded.node_idx)
+    assert sidx.tolist() == didx.tolist()
+    assert (sidx >= 0).all(), "capacity exists for every pod"
+    # capacity respected: at most 2 pods per node
+    counts = np.bincount(sidx, minlength=n)
+    assert counts.max() <= 2
+    # contention actually crossed shards (nodes 0-7 are shards 0-3)
+    assert len({i // 2 for i in sidx}) >= 3
+
+
+def test_sharded_windows_auction_matches_dense():
+    """Whole-backlog scheduling with the AUCTION assigner on the mesh:
+    cross-window capacity + (anti)affinity carries must thread through
+    the distributed auction exactly as dense schedule_windows does."""
+    from kubernetes_scheduler_tpu.parallel.engine import make_sharded_windows_fn
+    from kubernetes_scheduler_tpu.sim import gen_cluster, gen_pods
+
+    snapshot = gen_cluster(64, seed=5, constraints=True)
+    pods = gen_pods(24, seed=6, constraints=True)
+    windows = stack_windows(pods, 8)
+    dense = schedule_windows(
+        snapshot, windows, assigner="auction", affinity_aware=True,
+        normalizer="none",
+    )
+    sharded = make_sharded_windows_fn(make_mesh(8), assigner="auction")(
+        snapshot, windows
+    )
+    np.testing.assert_array_equal(
+        np.asarray(sharded.node_idx), np.asarray(dense.node_idx)
+    )
+    assert int(sharded.n_assigned) == int(dense.n_assigned)
+    np.testing.assert_allclose(
+        np.asarray(sharded.free_after)[np.asarray(snapshot.node_mask)],
+        np.asarray(dense.free_after)[np.asarray(snapshot.node_mask)],
+        atol=1e-2,
+    )
+
+
+def test_sharded_windows_auction_carries_anti_affinity():
+    """A window-1 avoider must see window-0's placement through the
+    auction's carried [2, n_global, S] table, across shard boundaries."""
+    from kubernetes_scheduler_tpu.parallel.engine import make_sharded_windows_fn
+
+    n, s = 8, 1
+    snapshot = make_snapshot(
+        allocatable=np.full((n, 3), 1e6, np.float32),
+        requested=np.zeros((n, 3), np.float32),
+        disk_io=np.zeros(n),
+        cpu_pct=np.zeros(n),
+        mem_pct=np.zeros(n),
+        domain_counts=np.zeros((n, s), np.float32),
+        domain_id=np.zeros((n, s), np.int32),  # one global domain
+    )
+    pods = make_pod_batch(
+        request=np.ones((2, 3), np.float32),
+        pod_matches=np.asarray([[True], [False]]),
+        anti_affinity_sel=np.asarray([[-1], [0]], np.int32),
+    )
+    fn = make_sharded_windows_fn(make_mesh(8), assigner="auction")
+    res = fn(snapshot, stack_windows(pods, 1))
+    idx = np.asarray(res.node_idx).ravel()
+    assert idx[0] >= 0
+    assert idx[1] == -1, "anti-affinity ignored window 0's placement"
+    assert int(res.n_assigned) == 1
+
+
 @pytest.mark.parametrize("normalizer", ["softmax", "none"])
 def test_sharded_normalizers_match_single_device(normalizer):
     snapshot, pods = random_state(64, 6)
@@ -309,9 +423,10 @@ def test_sharded_engine_padded_nodes():
 
 
 @pytest.mark.parametrize("shape", [(2, 4), (4, 2)])
-def test_multihost_mesh_matches_single_device(shape):
+@pytest.mark.parametrize("assigner", ["greedy", "auction"])
+def test_multihost_mesh_matches_single_device(shape, assigner):
     """2-D (dcn, node) hierarchical mesh — the multi-host layout — must
-    produce the same decisions as single-device."""
+    produce the same decisions as single-device, under BOTH assigners."""
     from kubernetes_scheduler_tpu.parallel.mesh import (
         DCN_AXIS, NODE_AXIS, make_mesh_multihost,
     )
@@ -320,10 +435,12 @@ def test_multihost_mesh_matches_single_device(shape):
 
     snapshot = gen_cluster(64, seed=21, constraints=True)
     pods = gen_pods(6, seed=22, constraints=True)
-    single = schedule_batch(snapshot, pods)
+    single = schedule_batch(snapshot, pods, assigner=assigner)
     mesh = make_mesh_multihost(*shape)
     assert mesh.axis_names == (DCN_AXIS, NODE_AXIS)
-    fn = make_sharded_schedule_fn(mesh, node_axes=(DCN_AXIS, NODE_AXIS))
+    fn = make_sharded_schedule_fn(
+        mesh, node_axes=(DCN_AXIS, NODE_AXIS), assigner=assigner
+    )
     sharded = fn(snapshot, pods)
     np.testing.assert_array_equal(
         np.asarray(sharded.feasible), np.asarray(single.feasible)
@@ -400,12 +517,14 @@ def test_sharded_node_name_matches_single_device():
 
 
 @pytest.mark.parametrize("seed", [0, 1, 2, 3])
-def test_sharded_full_constraint_parity_sweep(seed):
+@pytest.mark.parametrize("assigner", ["greedy", "auction"])
+def test_sharded_full_constraint_parity_sweep(seed, assigner):
     """Randomized dense-vs-sharded parity across EVERY constraint family
     at once: taints/tolerations, node affinity, inter-pod (anti)affinity
     with in-window interaction, topology spread, spec.nodeName pinning,
-    and soft (preferred) terms — on the 8-device mesh. The sharded engine
-    must make byte-identical decisions to the dense greedy path."""
+    and soft (preferred) terms — on the 8-device mesh, under BOTH
+    assigners. The sharded engine must make byte-identical decisions to
+    the dense path."""
     from kubernetes_scheduler_tpu.sim import gen_cluster, gen_pods
 
     assert jax.device_count() == 8
@@ -449,9 +568,11 @@ def test_sharded_full_constraint_parity_sweep(seed):
         ),
     )
     single = schedule_batch(
-        snapshot, pods, assigner="greedy", affinity_aware=True, soft=True
+        snapshot, pods, assigner=assigner, affinity_aware=True, soft=True
     )
-    sharded = make_sharded_schedule_fn(make_mesh(8), soft=True)(snapshot, pods)
+    sharded = make_sharded_schedule_fn(
+        make_mesh(8), soft=True, assigner=assigner
+    )(snapshot, pods)
     assert (
         np.asarray(sharded.node_idx).tolist()
         == np.asarray(single.node_idx).tolist()

@@ -67,6 +67,11 @@ class RemoteEngine:
             request_serializer=pb.ScheduleRequest.SerializeToString,
             response_deserializer=pb.ScheduleReply.FromString,
         )
+        self._preempt = self._channel.unary_unary(
+            f"/{SERVICE}/Preempt",
+            request_serializer=pb.ScheduleRequest.SerializeToString,
+            response_deserializer=pb.ScheduleReply.FromString,
+        )
         self._health = self._channel.unary_unary(
             f"/{SERVICE}/Health",
             request_serializer=pb.HealthRequest.SerializeToString,
@@ -139,6 +144,21 @@ class RemoteEngine:
         codec.pack_fields(pods_windows, request.pods)
         reply = self._call_with_retry(self._schedule_windows, request)
         return codec.unpack_fields(engine.WindowsResult, reply.result)
+
+    def preempt(self, snapshot, pods, victims, *, k_cap: int):
+        """Preemption pass on the sidecar (engine.preempt_batch): `pods`
+        = this cycle's unschedulable preemptors, `victims` an
+        ops.preempt.VictimArrays. Raises NotImplementedError against a
+        version-skewed sidecar without the RPC — the host then runs the
+        pass in-process (host/scheduler._run_preemption)."""
+        from kubernetes_scheduler_tpu.ops.preempt import PreemptResult
+
+        request = pb.ScheduleRequest(preempt_k_cap=k_cap)
+        codec.pack_fields(snapshot, request.snapshot)
+        codec.pack_fields(pods, request.pods)
+        codec.pack_fields(victims, request.victims)
+        reply = self._call_with_retry(self._preempt, request)
+        return codec.unpack_fields(PreemptResult, reply.result)
 
     def _call_with_retry(self, method, request):
         last_err = None

@@ -202,6 +202,32 @@ class EngineService:
         codec.pack_fields(res, reply.result)
         return reply
 
+    def preempt(self, request: pb.ScheduleRequest, context) -> pb.ScheduleReply:
+        """Preemption pass (upstream PostFilter) on the device: pending
+        preemptors + victim arrays in, (node, victims, n_victims) out —
+        engine.preempt_batch. Served dense even by mesh-sharded sidecars
+        (the victim tables are [n, K] — small next to a score matrix)."""
+        from kubernetes_scheduler_tpu.ops.preempt import VictimArrays
+
+        try:
+            snapshot = codec.unpack_fields(engine.SnapshotArrays, request.snapshot)
+            pods = codec.unpack_fields(engine.PodBatch, request.pods)
+            victims = codec.unpack_fields(VictimArrays, request.victims)
+            k_cap = int(request.preempt_k_cap)
+            if k_cap <= 0:
+                raise ValueError("preempt_k_cap must be positive")
+        except (ValueError, TypeError) as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        t0 = time.perf_counter()
+        res = engine.preempt_batch(snapshot, pods, victims, k_cap=k_cap)
+        res = jax.tree_util.tree_map(np.asarray, res)
+        dt = time.perf_counter() - t0
+        with self._lock:
+            self.cycles_served += 1
+        reply = pb.ScheduleReply(engine_seconds=dt)
+        codec.pack_fields(res, reply.result)
+        return reply
+
     def health(self, request: pb.HealthRequest, context) -> pb.HealthReply:
         devs = jax.devices()
         return pb.HealthReply(
@@ -243,6 +269,11 @@ def make_server(
             ),
             "ScheduleWindows": grpc.unary_unary_rpc_method_handler(
                 service.schedule_windows,
+                request_deserializer=pb.ScheduleRequest.FromString,
+                response_serializer=pb.ScheduleReply.SerializeToString,
+            ),
+            "Preempt": grpc.unary_unary_rpc_method_handler(
+                service.preempt,
                 request_deserializer=pb.ScheduleRequest.FromString,
                 response_serializer=pb.ScheduleReply.SerializeToString,
             ),

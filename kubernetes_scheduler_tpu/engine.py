@@ -123,6 +123,10 @@ class PodBatch(NamedTuple):
     na_vals: jnp.ndarray           # [p, E, V] int32 value-id sets
     na_val_mask: jnp.ndarray       # [p, E, V] bool
     na_mask: jnp.ndarray           # [p, E] bool
+    na_term: jnp.ndarray           # [p, E] int32 OR-group ids (upstream
+    #                                nodeSelectorTerms: AND within a group,
+    #                                OR across groups; all-zeros = one AND
+    #                                list)
     affinity_sel: jnp.ndarray      # [p, K] int32 selector ids, -1 pad
     anti_affinity_sel: jnp.ndarray  # [p, K] int32 selector ids, -1 pad
     pod_matches: jnp.ndarray       # [p, S] bool — pod's labels match selector s
@@ -144,6 +148,9 @@ class PodBatch(NamedTuple):
     target_node: jnp.ndarray         # [p] int32: -1 unpinned, else node idx
     spread_sel: jnp.ndarray          # [p, Ks] int32 selector ids, -1 pad
     spread_max: jnp.ndarray          # [p, Ks] int32 maxSkew per constraint
+    # ScheduleAnyway spread constraints: a score term, never a filter
+    # (upstream PodTopologySpread scoring; compute_soft_scores)
+    soft_spread_sel: jnp.ndarray     # [p, Kss] int32 selector ids, -1 pad
 
 
 def make_snapshot(
@@ -250,6 +257,7 @@ def make_pod_batch(
     na_vals=None,
     na_val_mask=None,
     na_mask=None,
+    na_term=None,
     affinity_sel=None,
     anti_affinity_sel=None,
     pod_matches=None,
@@ -266,6 +274,7 @@ def make_pod_batch(
     target_node=None,
     spread_sel=None,
     spread_max=None,
+    soft_spread_sel=None,
 ) -> PodBatch:
     """PodBatch with no-op defaults (no GPU demand, no tolerations, no
     affinity requirements, no preferences)."""
@@ -299,6 +308,11 @@ def make_pod_batch(
             (zb(p, 1) if na_key is None
              else jnp.ones(jnp.asarray(na_key).shape, bool))
             if na_mask is None else jnp.asarray(na_mask, bool)
+        ),
+        na_term=(
+            (zi(p, 1) if na_key is None
+             else jnp.zeros(jnp.asarray(na_key).shape, jnp.int32))
+            if na_term is None else jnp.asarray(na_term, jnp.int32)
         ),
         affinity_sel=jnp.full((p, 1), -1, jnp.int32) if affinity_sel is None else jnp.asarray(affinity_sel, jnp.int32),
         anti_affinity_sel=jnp.full((p, 1), -1, jnp.int32) if anti_affinity_sel is None else jnp.asarray(anti_affinity_sel, jnp.int32),
@@ -342,6 +356,11 @@ def make_pod_batch(
              else jnp.ones(jnp.asarray(spread_sel).shape, jnp.int32))
             if spread_max is None else jnp.asarray(spread_max, jnp.int32)
         ),
+        soft_spread_sel=(
+            jnp.full((p, 1), -1, jnp.int32)
+            if soft_spread_sel is None
+            else jnp.asarray(soft_spread_sel, jnp.int32)
+        ),
     )
 
 
@@ -364,6 +383,9 @@ class LocalEngine:
 
     def schedule_windows(self, snapshot, pods_windows, **kw) -> "WindowsResult":
         return schedule_windows(snapshot, pods_windows, **kw)
+
+    def preempt(self, snapshot, pods, victims, *, k_cap: int):
+        return preempt_batch(snapshot, pods, victims, k_cap=k_cap)
 
     def healthy(self) -> bool:
         return True
@@ -426,6 +448,7 @@ def compute_feasibility(
     na_ok = node_affinity_fit(
         snapshot.node_labels, snapshot.node_label_mask,
         pods.na_key, pods.na_op, pods.na_vals, pods.na_val_mask, pods.na_mask,
+        pods.na_term,
     )
     out = fits & gpu_fits & taint_ok & na_ok & pods.pod_mask[:, None]
     out = out & node_name_fit(pods.target_node, snapshot.allocatable.shape[0])
@@ -490,6 +513,9 @@ def compute_soft_scores(
       with a topology-domain match (InterPodAffinity scoring)
     - PreferNoSchedule taints: −taint_penalty_weight per untolerated soft
       taint (TaintToleration scoring)
+    - ScheduleAnyway topology spread: −(count − min count) marginal-skew
+      penalty per soft constraint, steering toward the least-loaded
+      domain without ever filtering (PodTopologySpread scoring)
 
     Added onto the normalized policy score when schedule_batch runs with
     soft=True; weights are interpreted relative to the active score range
@@ -514,7 +540,19 @@ def compute_soft_scores(
     # attracting/avoiding preferred terms whose selector it matches
     matches = match_matrix(pods, snapshot.pref_attract.shape[1]).astype(jnp.float32)
     sym = matches @ (snapshot.pref_attract - snapshot.pref_avoid).T  # [p, n]
-    return na + pa + sym - taint_penalty_weight * pen
+    # ScheduleAnyway spread: marginal skew (count − min over schedulable
+    # domains) of each soft constraint's selector on this node
+    s = snapshot.domain_counts.shape[1]
+    ssel = pods.soft_spread_sel                                   # [p, K]
+    ok = (ssel >= 0) & (ssel < s)
+    idx = jnp.clip(ssel, 0, max(s - 1, 0))
+    big = jnp.asarray(jnp.finfo(jnp.float32).max, jnp.float32)
+    dmin = jnp.where(
+        snapshot.node_mask[:, None], snapshot.domain_counts, big
+    ).min(0)                                                      # [S]
+    skew = snapshot.domain_counts[:, idx] - dmin[idx][None, :, :]  # [n, p, K]
+    soft_spread = (jnp.where(ok[None, :, :], skew, 0.0)).sum(-1).T  # [p, n]
+    return na + pa + sym - taint_penalty_weight * pen - soft_spread
 
 
 def compute_free_capacity(snapshot: SnapshotArrays) -> jnp.ndarray:
@@ -550,6 +588,7 @@ def _fused_masked_scores(
     ) & node_affinity_fit(
         snapshot.node_labels, snapshot.node_label_mask,
         pods.na_key, pods.na_op, pods.na_vals, pods.na_val_mask, pods.na_mask,
+        pods.na_term,
     )
     other = other & node_name_fit(pods.target_node, snapshot.allocatable.shape[0])
     if include_pod_affinity:
@@ -785,7 +824,6 @@ def run_windows_scan(snapshot, pods_windows, cycle_fn) -> "WindowsResult":
     jax.jit,
     static_argnames=(
         "policy", "assigner", "normalizer", "fused", "affinity_aware", "soft",
-        "auction_rounds", "auction_price_frac",
     ),
 )
 def schedule_windows(
@@ -832,3 +870,55 @@ def schedule_windows(
         )
 
     return run_windows_scan(snapshot, pods_windows, cycle)
+
+
+@functools.partial(jax.jit, static_argnames=("k_cap",))
+def preempt_batch(
+    snapshot: SnapshotArrays,
+    pods: PodBatch,
+    victims,
+    *,
+    k_cap: int,
+):
+    """The preemption pass (upstream PostFilter parity) as ONE device
+    program: static feasibility against FULL allocatable (could this pod
+    ever fit here after evictions) → per-node victim prefix tables →
+    candidate selection with upstream's pickOneNodeForPreemption ordering
+    (ops/preempt.py). `victims` is an ops.preempt.VictimArrays; the host
+    pre-filters non-evictable pods (PDB-exhausted, terminating,
+    nomination reservations) to node=-1.
+
+    This is the engine surface the sidecar serves as the Preempt RPC —
+    the phase the reference runs inside its compute process (upstream
+    PostFilter via /root/reference/go.mod:13) now runs on the device
+    side of the bridge, keeping the "host thin, device computes" split
+    intact; host/scheduler._run_preemption falls back to in-host
+    evaluation when the sidecar predates the RPC.
+    """
+    from kubernetes_scheduler_tpu.ops.preempt import (
+        build_victim_tables,
+        preempt_candidates,
+    )
+
+    static_ok = compute_feasibility(
+        snapshot._replace(requested=jnp.zeros_like(snapshot.requested)),
+        pods,
+        include_pod_affinity=True,
+    )
+    tables = build_victim_tables(
+        victims.node,
+        victims.prio,
+        victims.req,
+        victims.mask,
+        n_nodes=snapshot.allocatable.shape[0],
+        k_cap=k_cap,
+        victim_start=victims.start,
+    )
+    return preempt_candidates(
+        pods.request,
+        pods.priority,
+        pods.pod_mask,
+        static_ok,
+        compute_free_capacity(snapshot),
+        tables,
+    )

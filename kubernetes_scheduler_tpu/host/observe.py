@@ -67,6 +67,9 @@ def summarize(metrics, totals: dict | None = None) -> dict:
             "fetch_failures": sum(
                 1 for m in cycles if getattr(m, "fetch_failed", False)
             ),
+            "fallback_policy_mismatch": sum(
+                1 for m in cycles if getattr(m, "policy_mismatch", False)
+            ),
         }
     return {
         "cycles_total": totals["cycles"],
@@ -77,6 +80,9 @@ def summarize(metrics, totals: dict | None = None) -> dict:
         "victims_evicted_total": totals.get("victims_evicted", 0),
         "fallback_cycles_total": totals["fallback_cycles"],
         "fetch_failures_total": totals.get("fetch_failures", 0),
+        "fallback_policy_mismatch_total": totals.get(
+            "fallback_policy_mismatch", 0
+        ),
         "scheduling_pods_per_sec": bound / total_s if total_s > 0 else 0.0,
         "bind_latency_p50_seconds": _quantile(lat, 0.50),
         "bind_latency_p99_seconds": _quantile(lat, 0.99),
@@ -97,6 +103,7 @@ _HELP = {
     "victims_evicted_total": "Running pods evicted to make room for preemptors",
     "fallback_cycles_total": "Cycles served by the scalar fallback path",
     "fetch_failures_total": "Cycles aborted by a cluster-source/advisor fetch failure (window requeued)",
+    "fallback_policy_mismatch_total": "Fallback cycles scored with the yoda formula because config.policy has no scalar mirror",
     "scheduling_pods_per_sec": "Bound pods per second of cycle time",
     "bind_latency_p50_seconds": "Median end-to-end cycle latency",
     "bind_latency_p99_seconds": "p99 end-to-end cycle latency",

@@ -101,6 +101,10 @@ class CycleMetrics:
     # Distinct from used_fallback so an advisor outage cannot masquerade
     # as scalar-fallback (TPU-path) degradation on dashboards
     fetch_failed: bool = False
+    # the scalar fallback could not score config.policy (e.g. "learned")
+    # and used the yoda formula instead — a POLICY change under
+    # degradation, distinct from benign same-policy fallback
+    policy_mismatch: bool = False
 
 
 class Scheduler:
@@ -233,6 +237,7 @@ class Scheduler:
             "victims_evicted": 0,
             "fallback_cycles": 0,
             "fetch_failures": 0,
+            "fallback_policy_mismatch": 0,
         }
         # appends/reads cross threads (scheduling loop vs /metrics scrape;
         # deque raises on mutation during iteration, unlike list)
@@ -249,6 +254,7 @@ class Scheduler:
             self.totals["victims_evicted"] += m.victims_evicted
             self.totals["fallback_cycles"] += int(m.used_fallback)
             self.totals["fetch_failures"] += int(m.fetch_failed)
+            self.totals["fallback_policy_mismatch"] += int(m.policy_mismatch)
 
     def metrics_snapshot(self) -> tuple[list[CycleMetrics], dict]:
         """Point-in-time copy for exporters (safe against the scheduling
@@ -321,7 +327,7 @@ class Scheduler:
         # min_device_work route scalar until both models are fitted.
         cells = len(window) * len(nodes)
         scalar_eligible = (
-            self.config.policy == "balanced_cpu_diskio"
+            self.config.policy in ("balanced_cpu_diskio", "free_capacity")
             and self._scalar_sufficient(window, nodes, running)
         )
         if not scalar_eligible:
@@ -388,8 +394,8 @@ class Scheduler:
             except Exception:
                 log.exception(
                     "engine cycle failed; falling back to scalar path "
-                    "(NOTE: the fallback scores with the yoda formula "
-                    "regardless of config.policy=%r)",
+                    "(policy=%r; unsupported policies degrade to the "
+                    "yoda formula and bump fallback_policy_mismatch)",
                     self.config.policy,
                 )
                 m.used_fallback = True
@@ -447,11 +453,7 @@ class Scheduler:
         """
         import jax.numpy as jnp
 
-        from kubernetes_scheduler_tpu.engine import compute_free_capacity
-        from kubernetes_scheduler_tpu.ops.preempt import (
-            build_victim_tables,
-            preempt_candidates,
-        )
+        from kubernetes_scheduler_tpu.ops.preempt import VictimArrays
 
         k_cap = self.config.preemption_max_victims
         if k_cap <= 0 or not nodes:
@@ -483,10 +485,6 @@ class Scheduler:
         )
         pend = self.builder.build_pod_batch(pods)
         vics = self.builder.build_pod_batch(running)
-        static_ok = self.engine_feasibility(
-            snapshot._replace(requested=jnp.zeros_like(snapshot.requested)),
-            pend,
-        )
         # PodDisruptionBudgets: preemption NEVER violates one (stricter
         # than upstream's last-resort violation ordering — documented in
         # ops/preempt.py). Victims under an exhausted budget are excluded
@@ -511,7 +509,18 @@ class Scheduler:
                 if sel:
                     victim_budgets[i] = sel
         node_index = {nd.name: j for j, nd in enumerate(nodes)}
-        vnode = np.full(np.asarray(vics.request).shape[0], -1, np.int32)
+        m_slots = np.asarray(vics.request).shape[0]
+        vnode = np.full(m_slots, -1, np.int32)
+        # relative start seconds (int32-safe): later = less important =
+        # evicted first among equal priority; a pod without
+        # status.startTime counts as just-started (upstream
+        # GetPodStartTime's nil-means-now)
+        starts = [pd.start_time for pd in running if pd.start_time is not None]
+        base = min(starts) if starts else 0.0
+        vstart = np.full(m_slots, 2**30, np.int32)
+        for i, pd in enumerate(running):
+            if pd.start_time is not None:
+                vstart[i] = int(min(pd.start_time - base, 2**30 - 1))
         for i, pd in enumerate(running):
             key = _pod_key(pd)
             # terminating victims and nomination reservations occupy
@@ -522,21 +531,35 @@ class Scheduler:
             if any(budgets[b] <= 0 for b in victim_budgets.get(i, ())):
                 continue  # an exhausted budget protects this victim
             vnode[i] = node_index.get(pd.node_name, -1)
-        res = preempt_candidates(
-            pend.request,
-            pend.priority,
-            pend.pod_mask,
-            static_ok,
-            compute_free_capacity(snapshot),
-            build_victim_tables(
-                jnp.asarray(vnode),
-                vics.priority,
-                vics.request,
-                vics.pod_mask,
-                n_nodes=np.asarray(snapshot.allocatable).shape[0],
-                k_cap=k_cap,
-            ),
+        victims = VictimArrays(
+            node=jnp.asarray(vnode),
+            prio=vics.priority,
+            req=vics.request,
+            mask=vics.pod_mask,
+            start=jnp.asarray(vstart),
         )
+        # the pass runs on the engine — on a bridged deployment that is
+        # the sidecar's Preempt RPC, keeping PostFilter on the compute
+        # side of the bridge like every other phase; a version-skewed or
+        # unreachable sidecar degrades to the in-host evaluation (same
+        # tensors, CPU jax), never to no-preemption
+        res = None
+        if hasattr(self.engine, "preempt"):
+            try:
+                res = self.engine.preempt(snapshot, pend, victims, k_cap=k_cap)
+            except NotImplementedError:
+                log.warning(
+                    "engine lacks the Preempt surface; running the "
+                    "preemption pass in-host"
+                )
+            except Exception:
+                log.exception(
+                    "engine preemption pass failed; running in-host"
+                )
+        if res is None:
+            from kubernetes_scheduler_tpu.engine import preempt_batch
+
+            res = preempt_batch(snapshot, pend, victims, k_cap=k_cap)
         chosen_node = np.asarray(res.node)
         victim_ids = np.asarray(res.victims)
         prio = np.asarray(pend.priority)
@@ -615,13 +638,6 @@ class Scheduler:
             for key, (node, pod, _) in self._nominations.items()
             if key not in in_window
         ]
-
-    def engine_feasibility(self, snapshot, pend):
-        """Static feasibility for the preemption pass; separated so tests
-        and alternative engines can override it."""
-        from kubernetes_scheduler_tpu.engine import compute_feasibility
-
-        return compute_feasibility(snapshot, pend, include_pod_affinity=True)
 
     @staticmethod
     def _scalar_sufficient(window, nodes, running) -> bool:
@@ -704,6 +720,7 @@ class Scheduler:
             any(
                 pd.preferred_node_affinity
                 or any(t.preferred for t in pd.pod_affinity)
+                or any(sc.soft for sc in pd.topology_spread)
                 for pd in window
             )
             or any(t.preferred for pd in running for t in pd.pod_affinity)
@@ -820,10 +837,25 @@ class Scheduler:
                 self._requeue_unschedulable(pod, m)
 
     def _run_scalar(self, window, nodes, running, utils, m: CycleMetrics):
-        if nodes and self._native_ok:
+        from kubernetes_scheduler_tpu.host.plugins import SCALAR_POLICIES
+
+        policy = self.config.policy
+        if policy == "balanced_cpu_diskio" and nodes and self._native_ok:
             self._run_scalar_native(window, nodes, running, utils, m)
             return
-        plugin = ScalarYodaPlugin(utils)
+        if policy not in SCALAR_POLICIES:
+            # e.g. "learned": the scalar path has no faithful mirror —
+            # degrade to the yoda formula and SAY SO, both in the log and
+            # in a dedicated counter (a policy change under degradation
+            # must be distinguishable from benign same-policy fallback)
+            log.warning(
+                "scalar fallback cannot score policy %r; scoring with "
+                "balanced_cpu_diskio (fallback_policy_mismatch)",
+                policy,
+            )
+            m.policy_mismatch = True
+            policy = "balanced_cpu_diskio"
+        plugin = ScalarYodaPlugin(utils, policy=policy)
         free = {
             n.name: {
                 res: n.allocatable.get(res, 0.0) for res in self.builder.resource_names

@@ -125,6 +125,10 @@ class SnapshotBuilder:
     _port_index: dict = field(default_factory=dict)  # port -> column offset
     # node-name -> index of the latest snapshot (for target_node encoding)
     _node_index: dict = field(default_factory=dict)
+    # selector key -> (match_labels dict, [MatchExpression]) parsed once
+    # at intern time (_selector_id); the matching loops are O(pods x
+    # selectors) per cycle
+    _selector_parsed: dict = field(default_factory=dict)
 
     @property
     def resource_names(self) -> list[str]:
@@ -249,10 +253,54 @@ class SnapshotBuilder:
         )
 
     def _selector_id(self, term) -> int:
-        key = (tuple(sorted(term.match_labels.items())), term.topology_key)
+        """Selector identity = (matchLabels, matchExpressions, topology
+        key); expressions are canonicalized so semantically identical
+        selectors share one id/domain-count column. The parsed form is
+        memoized per key: the matching loops probe O(pods x selectors)
+        per cycle and must not re-build dicts/dataclasses per probe."""
+        from kubernetes_scheduler_tpu.host.types import MatchExpression
+
+        exprs = tuple(
+            sorted(
+                (e.key, e.operator, tuple(sorted(e.values)))
+                for e in getattr(term, "match_expressions", None) or []
+            )
+        )
+        key = (tuple(sorted(term.match_labels.items())), exprs, term.topology_key)
         if key not in self.selectors:
             self.selectors[key] = len(self.selectors)
+            self._selector_parsed[key] = (
+                dict(key[0]),
+                [
+                    MatchExpression(key=k, operator=o, values=list(vs))
+                    for k, o, vs in exprs
+                ],
+            )
         return self.selectors[key]
+
+    def _key_matches(self, labels: dict, key) -> bool:
+        """Does a pod's label dict satisfy an interned selector key?
+        matchLabels-only selectors (the common case) stay a plain tuple
+        walk; expression selectors use the memoized parsed form."""
+        from kubernetes_scheduler_tpu.host.types import (
+            MatchExpression,
+            labels_match,
+        )
+
+        items, exprs, _topo = key
+        if not exprs:
+            return all(labels.get(k) == v for k, v in items)
+        parsed = self._selector_parsed.get(key)
+        if parsed is None:  # selectors persisted from an older builder
+            parsed = (
+                dict(items),
+                [
+                    MatchExpression(key=k, operator=o, values=list(vs))
+                    for k, o, vs in exprs
+                ],
+            )
+            self._selector_parsed[key] = parsed
+        return labels_match(labels, *parsed)
 
     def _selector_slots(self) -> int:
         return bucket_size(max(len(self.selectors), 1), floor=1, multiple=1)
@@ -307,8 +355,8 @@ class SnapshotBuilder:
             i = node_index.get(pod.node_name)
             if i is None:
                 continue
-            for (items, _topo), sid in self.selectors.items():
-                if all(pod.labels.get(k) == v for k, v in items):
+            for key, sid in self.selectors.items():
+                if self._key_matches(pod.labels, key):
                     raw[i, sid] += 1
             for term in pod.pod_affinity:
                 sid = self._selector_id(term)
@@ -317,7 +365,7 @@ class SnapshotBuilder:
                 elif term.anti:
                     raw_avoid[i, sid] += 1
         # aggregate over topology domains
-        for (_items, topo), sid in self.selectors.items():
+        for (_items, _exprs, topo), sid in self.selectors.items():
             sums: dict[str, list[float]] = {}
             first: dict[str, int] = {}
             for i, nd in enumerate(nodes):
@@ -364,6 +412,7 @@ class SnapshotBuilder:
         na_vals = np.zeros((p, e_max, v_max), np.int32)
         na_val_mask = np.zeros((p, e_max, v_max), bool)
         na_mask = np.zeros((p, e_max), bool)
+        na_term = np.zeros((p, e_max), np.int32)
         k_max = bucket_size(
             max((len(pd.pod_affinity) for pd in pods), default=0), floor=1, multiple=1
         )
@@ -374,11 +423,22 @@ class SnapshotBuilder:
         pref_anti = np.full((p, k_max), -1, np.int32)
         pref_anti_w = np.zeros((p, k_max), np.float32)
         ks_max = bucket_size(
-            max((len(pd.topology_spread) for pd in pods), default=0),
+            max(
+                (sum(1 for sc in pd.topology_spread if not sc.soft) for pd in pods),
+                default=0,
+            ),
             floor=1, multiple=1,
         )
         spread_sel = np.full((p, ks_max), -1, np.int32)
         spread_max = np.ones((p, ks_max), np.int32)
+        kss_max = bucket_size(
+            max(
+                (sum(1 for sc in pd.topology_spread if sc.soft) for pd in pods),
+                default=0,
+            ),
+            floor=1, multiple=1,
+        )
+        soft_spread_sel = np.full((p, kss_max), -1, np.int32)
         target_node = np.full(p, -1, np.int32)
         ep_max = bucket_size(
             max((len(pd.preferred_node_affinity) for pd in pods), default=0),
@@ -412,9 +472,15 @@ class SnapshotBuilder:
                 # unknown node name -> out-of-range index: infeasible
                 # everywhere (constraints.node_name_fit)
                 target_node[i] = self._node_index.get(pod.target_node, p + 2**20)
-            for j, sc in enumerate(pod.topology_spread):
-                spread_sel[i, j] = self._selector_id(sc)
-                spread_max[i, j] = sc.max_skew
+            j_hard = j_soft = 0
+            for sc in pod.topology_spread:
+                if sc.soft:
+                    soft_spread_sel[i, j_soft] = self._selector_id(sc)
+                    j_soft += 1
+                else:
+                    spread_sel[i, j_hard] = self._selector_id(sc)
+                    spread_max[i, j_hard] = sc.max_skew
+                    j_hard += 1
             # diskIO annotation (algorithm.go:103; unparsable -> 0)
             r_io[i] = parse_float_or_zero(pod.annotations.get("diskIO"))
             # scv/priority label (sort.go:12-18)
@@ -446,6 +512,9 @@ class SnapshotBuilder:
                 na_key[i, j] = self.label_keys.id(e.key)
                 na_op[i, j] = _NA_OPS[e.operator]
                 na_mask[i, j] = True
+                # OR-group id (upstream nodeSelectorTerms); the engine
+                # requires ids < E, and convert.py emits dense ids
+                na_term[i, j] = min(e.term, e_max - 1)
                 for q, v in enumerate(e.values):
                     na_vals[i, j, q] = self.label_values.id(v)
                     na_val_mask[i, j, q] = True
@@ -472,8 +541,8 @@ class SnapshotBuilder:
         s = self._selector_slots()
         pod_matches = np.zeros((p, s), bool)
         for i, pod in enumerate(pods):
-            for (items, _topo), sid in self.selectors.items():
-                if all(pod.labels.get(k) == v for k, v in items):
+            for key, sid in self.selectors.items():
+                if self._key_matches(pod.labels, key):
                     pod_matches[i, sid] = True
 
         return make_pod_batch(
@@ -481,7 +550,8 @@ class SnapshotBuilder:
             want_number=want_number, want_memory=want_memory,
             want_clock=want_clock, tolerations=tols, tol_mask=tol_mask,
             na_key=na_key, na_op=na_op, na_vals=na_vals,
-            na_val_mask=na_val_mask, na_mask=na_mask, affinity_sel=aff,
+            na_val_mask=na_val_mask, na_mask=na_mask, na_term=na_term,
+            affinity_sel=aff,
             anti_affinity_sel=anti, pod_matches=pod_matches,
             pna_key=pna_key, pna_op=pna_op, pna_vals=pna_vals,
             pna_val_mask=pna_val_mask, pna_mask=pna_mask,
@@ -489,4 +559,5 @@ class SnapshotBuilder:
             pref_affinity_weight=pref_aff_w, pref_anti_sel=pref_anti,
             pref_anti_weight=pref_anti_w, target_node=target_node,
             spread_sel=spread_sel, spread_max=spread_max,
+            soft_spread_sel=soft_spread_sel,
         )

@@ -55,6 +55,42 @@ class MatchExpression:
     key: str
     operator: str            # In | NotIn | Exists | DoesNotExist
     values: list[str] = field(default_factory=list)
+    # OR-group id for required node affinity: upstream nodeSelectorTerms
+    # are OR-of-AND-lists — a node passes if, for SOME term id, every
+    # expression carrying that id matches (kube/convert.pod_from_api
+    # assigns ids; the flat default 0 keeps a plain AND list)
+    term: int = 0
+
+
+def labels_match(
+    labels: dict[str, str],
+    match_labels: dict[str, str],
+    match_expressions: list["MatchExpression"] = (),
+) -> bool:
+    """k8s label-selector semantics: every matchLabels pair AND every
+    matchExpression must hold (missing key satisfies NotIn; an unknown
+    operator fails closed). Shared by PDB selection and the snapshot
+    builder's selector matching so the two cannot drift."""
+    if not all(labels.get(k) == v for k, v in match_labels.items()):
+        return False
+    for e in match_expressions:
+        has = e.key in labels
+        val = labels.get(e.key)
+        if e.operator == "In":
+            if not has or val not in e.values:
+                return False
+        elif e.operator == "NotIn":
+            if has and val in e.values:
+                return False
+        elif e.operator == "Exists":
+            if not has:
+                return False
+        elif e.operator == "DoesNotExist":
+            if has:
+                return False
+        else:
+            return False
+    return True
 
 
 @dataclass
@@ -66,20 +102,28 @@ class PodAffinityTerm:
     # this weight instead of a hard filter (engine.compute_soft_scores)
     preferred: bool = False
     weight: int = 1
+    # labelSelector.matchExpressions, ANDed with match_labels
+    match_expressions: list["MatchExpression"] = field(default_factory=list)
 
 
 @dataclass
 class SpreadConstraint:
-    """topologySpreadConstraints entry (hard DoNotSchedule semantics):
-    placements of pods matching `match_labels` may not skew across
+    """topologySpreadConstraints entry: placements of pods matching the
+    selector (match_labels AND match_expressions) may not skew across
     `topology_key` domains by more than `max_skew`. Skew here is measured
     against the minimum count over all schedulable nodes' domains (upstream
     additionally filters domains by the pod's node affinity — documented
-    simplification)."""
+    simplification).
+
+    soft=False is DoNotSchedule (a hard filter); soft=True is
+    ScheduleAnyway (a score term preferring less-skewed domains,
+    engine.compute_soft_scores)."""
 
     match_labels: dict[str, str]
     topology_key: str = "kubernetes.io/hostname"
     max_skew: int = 1
+    soft: bool = False
+    match_expressions: list["MatchExpression"] = field(default_factory=list)
 
 
 @dataclass
@@ -105,30 +149,7 @@ class PodDisruptionBudget:
     def selects(self, pod: "Pod") -> bool:
         if pod.namespace != self.namespace:
             return False
-        if not all(
-            pod.labels.get(k) == v for k, v in self.match_labels.items()
-        ):
-            return False
-        for e in self.match_expressions:
-            has = e.key in pod.labels
-            val = pod.labels.get(e.key)
-            if e.operator == "In":
-                if not has or val not in e.values:
-                    return False
-            elif e.operator == "NotIn":
-                # k8s label-selector semantics: a missing key satisfies
-                # NotIn
-                if has and val in e.values:
-                    return False
-            elif e.operator == "Exists":
-                if not has:
-                    return False
-            elif e.operator == "DoesNotExist":
-                if has:
-                    return False
-            else:  # unknown operator: fail closed (select nothing)
-                return False
-        return True
+        return labels_match(pod.labels, self.match_labels, self.match_expressions)
 
     def allowed(self, matching_count: int) -> int:
         """Evictions this budget permits given the current healthy count."""
@@ -183,6 +204,10 @@ class Pod:
     host_ports: list[int] = field(default_factory=list)
     node_name: str | None = None  # set once bound
     scheduler_name: str = "yoda-tpu"
+    # status.startTime as epoch seconds; None = not started (treated as
+    # newest, i.e. least important, in preemption victim ordering —
+    # upstream GetPodStartTime's nil-means-now stance)
+    start_time: float | None = None
 
 
 @dataclass

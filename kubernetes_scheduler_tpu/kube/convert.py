@@ -8,11 +8,12 @@ canonicalized the way the snapshot builder expects (cpu in millicores,
 memory/storage in bytes, counts as floats).
 
 Documented simplifications (each is a capability note, not an accident):
-- node-affinity `nodeSelectorTerms` are OR-of-ANDs upstream; the host
-  model is a single AND list, so the FIRST term's expressions are taken
-  (plus `nodeSelector`, which upstream also ANDs in).
-- pod-(anti)affinity label selectors support matchLabels (the form the
-  SCV-era workloads use); matchExpressions on pod selectors are skipped.
+- node-affinity `matchFields` (metadata.name selectors) are not
+  supported; `matchExpressions` carry full upstream OR-of-ANDs term
+  semantics (see pod_from_api).
+- pod-(anti)affinity and spread label selectors support matchLabels AND
+  matchExpressions (host/types.labels_match); spread carries both
+  whenUnsatisfiable modes (DoNotSchedule hard, ScheduleAnyway soft).
 - GPU cards come from the SCV CRD in the reference (filter.go:8); the
   core API carries no card inventory, so nodes converted here have no
   cards unless an SCV-style annotation ("scv/cards": JSON list) is set.
@@ -69,23 +70,32 @@ def _pod_affinity_terms(spec: dict, *, anti: bool) -> list[PodAffinityTerm]:
         "podAntiAffinity" if anti else "podAffinity"
     ) or {}
     out: list[PodAffinityTerm] = []
+
+    def selector(term):
+        sel = term.get("labelSelector") or {}
+        labels = dict(sel.get("matchLabels") or {})
+        exprs = [_match_expr(e) for e in sel.get("matchExpressions") or []]
+        return (labels, exprs) if labels or exprs else None
+
     for term in sect.get("requiredDuringSchedulingIgnoredDuringExecution") or []:
-        labels = (term.get("labelSelector") or {}).get("matchLabels") or {}
-        if labels:
+        got = selector(term)
+        if got:
             out.append(
                 PodAffinityTerm(
-                    match_labels=dict(labels),
+                    match_labels=got[0],
+                    match_expressions=got[1],
                     topology_key=term.get("topologyKey", "kubernetes.io/hostname"),
                     anti=anti,
                 )
             )
     for wt in sect.get("preferredDuringSchedulingIgnoredDuringExecution") or []:
         term = wt.get("podAffinityTerm") or {}
-        labels = (term.get("labelSelector") or {}).get("matchLabels") or {}
-        if labels:
+        got = selector(term)
+        if got:
             out.append(
                 PodAffinityTerm(
-                    match_labels=dict(labels),
+                    match_labels=got[0],
+                    match_expressions=got[1],
                     topology_key=term.get("topologyKey", "kubernetes.io/hostname"),
                     anti=anti,
                     preferred=True,
@@ -99,20 +109,37 @@ def pod_from_api(obj: dict) -> Pod:
     meta = obj.get("metadata") or {}
     spec = obj.get("spec") or {}
     node_aff = (spec.get("affinity") or {}).get("nodeAffinity") or {}
-    required: list[MatchExpression] = [
+    # upstream semantics: `nodeSelector` (a plain AND map) and
+    # `nodeSelectorTerms` (OR of AND-lists) must BOTH pass. The host model
+    # is a flat expression list with per-expression OR-group ids
+    # (MatchExpression.term: AND within a group, OR across groups), so
+    # the nodeSelector conjunct is replicated into every term — exactly
+    # "nodeSelector AND (term_0 OR term_1 OR ...)". A term with no
+    # matchExpressions matches NOTHING upstream ("a null or empty node
+    # selector term matches no objects"): encoded as In with an empty
+    # value set, which no node satisfies.
+    ns_exprs: list[MatchExpression] = [
         MatchExpression(key=k, operator="In", values=[v])
         for k, v in (spec.get("nodeSelector") or {}).items()
     ]
     terms = (
         node_aff.get("requiredDuringSchedulingIgnoredDuringExecution") or {}
     ).get("nodeSelectorTerms") or []
+    required: list[MatchExpression] = []
     if terms:
-        required.extend(_match_expr(e) for e in terms[0].get("matchExpressions") or [])
-        if len(terms) > 1:
-            log.debug(
-                "pod %s: %d nodeSelectorTerms; only the first is enforced",
-                meta.get("name"), len(terms),
-            )
+        for t_i, term in enumerate(terms):
+            t_exprs = [_match_expr(e) for e in term.get("matchExpressions") or []]
+            if not t_exprs:
+                t_exprs = [MatchExpression(key="", operator="In", values=[])]
+            t_exprs += [
+                MatchExpression(key=x.key, operator=x.operator, values=list(x.values))
+                for x in ns_exprs
+            ]
+            for e in t_exprs:
+                e.term = t_i
+                required.append(e)
+    else:
+        required = ns_exprs
     preferred = [
         WeightedExpression(expr=_match_expr(e), weight=int(wt.get("weight", 1)))
         for wt in node_aff.get("preferredDuringSchedulingIgnoredDuringExecution") or []
@@ -123,12 +150,20 @@ def pod_from_api(obj: dict) -> Pod:
             match_labels=dict(
                 (c.get("labelSelector") or {}).get("matchLabels") or {}
             ),
+            match_expressions=[
+                _match_expr(e)
+                for e in (c.get("labelSelector") or {}).get("matchExpressions")
+                or []
+            ],
             topology_key=c.get("topologyKey", "kubernetes.io/hostname"),
             max_skew=int(c.get("maxSkew", 1)),
+            # ScheduleAnyway = a soft score term (engine soft spread);
+            # DoNotSchedule = a hard filter
+            soft=c.get("whenUnsatisfiable", "DoNotSchedule") == "ScheduleAnyway",
         )
         for c in spec.get("topologySpreadConstraints") or []
-        if c.get("whenUnsatisfiable", "DoNotSchedule") == "DoNotSchedule"
-        and (c.get("labelSelector") or {}).get("matchLabels")
+        if (c.get("labelSelector") or {}).get("matchLabels")
+        or (c.get("labelSelector") or {}).get("matchExpressions")
     ]
     host_ports = [
         int(p["hostPort"])
@@ -137,7 +172,22 @@ def pod_from_api(obj: dict) -> Pod:
         if p.get("hostPort")
     ]
     node_name = spec.get("nodeName") or None
-    phase = (obj.get("status") or {}).get("phase", "")
+    status = obj.get("status") or {}
+    phase = status.get("phase", "")
+    start_time = None
+    raw_start = status.get("startTime")
+    if raw_start:
+        try:
+            import datetime
+
+            start_time = datetime.datetime.fromisoformat(
+                raw_start.replace("Z", "+00:00")
+            ).timestamp()
+        except ValueError:
+            log.warning(
+                "pod %s: unparsable status.startTime %r", meta.get("name"),
+                raw_start,
+            )
     return Pod(
         name=meta.get("name", ""),
         namespace=meta.get("namespace", "default"),
@@ -169,6 +219,7 @@ def pod_from_api(obj: dict) -> Pod:
         host_ports=host_ports,
         node_name=node_name,
         scheduler_name=spec.get("schedulerName", "default-scheduler"),
+        start_time=start_time,
     )
 
 

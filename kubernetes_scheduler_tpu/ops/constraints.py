@@ -118,23 +118,49 @@ def node_affinity_fit(
     expr_vals: jnp.ndarray,
     expr_val_mask: jnp.ndarray,
     expr_mask: jnp.ndarray,
+    expr_term: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
-    """F[p, n]: node satisfies every (ANDed) required matchExpression.
+    """F[p, n]: required node affinity with full upstream OR-of-ANDs
+    `nodeSelectorTerms` semantics — a node passes if it satisfies EVERY
+    expression of SOME term (a pod with no expressions passes everywhere).
 
     node_labels: [n, Ln, 2] (key_id, value_id); node_label_mask: [n, Ln]
     expr_key:  [p, E] int32; expr_op: [p, E]
     expr_vals: [p, E, V] int32 value-id sets; expr_val_mask: [p, E, V]
     expr_mask: [p, E] (False = padding: expression ignored)
+    expr_term: [p, E] int32 OR-group ids in [0, E) (None = all zeros, a
+               single AND list — the pre-term behavior)
 
-    Upstream semantics: In — label present with value in set; NotIn —
-    label absent OR value not in set; Exists — label present;
-    DoesNotExist — label absent.
+    Upstream per-expression semantics: In — label present with value in
+    set; NotIn — label absent OR value not in set; Exists — label
+    present; DoesNotExist — label absent. Terms are grouped by id, ANDed
+    within a group, OR'd across groups, via one [p,E,G]x[p,E,n] batched
+    contraction (G = E worst case; tiny next to the [p,E,n,Ln,V] match
+    tensor _expressions_satisfied already builds).
     """
     ok = _expressions_satisfied(
         node_labels, node_label_mask, expr_key, expr_op, expr_vals, expr_val_mask
     )
-    ok = ok | ~expr_mask[:, :, None]
-    return ok.all(1)  # [p, n]
+    if expr_term is None:
+        ok = ok | ~expr_mask[:, :, None]
+        return ok.all(1)  # [p, n]
+    e = expr_key.shape[1]
+    member = (
+        expr_term[:, :, None] == jnp.arange(e)[None, None, :]
+    ) & expr_mask[:, :, None]                                   # [p, E, G]
+    fail = expr_mask[:, :, None] & ~ok                          # [p, E, n]
+    group_fail = (
+        jnp.einsum(
+            "peg,pen->pgn",
+            member.astype(jnp.float32),
+            fail.astype(jnp.float32),
+        )
+        > 0
+    )                                                           # [p, G, n]
+    group_has = member.any(1)                                   # [p, G]
+    term_ok = group_has[:, :, None] & ~group_fail
+    no_terms = ~group_has.any(1)                                # [p]
+    return term_ok.any(1) | no_terms[:, None]                   # [p, n]
 
 
 def _expressions_satisfied(

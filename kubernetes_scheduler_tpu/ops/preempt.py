@@ -35,9 +35,15 @@ Deviations from upstream, documented:
   in the pod's favor (victims leaving a domain free anti-affinity slots,
   never consume them), and the next cycle re-checks everything against
   real state before binding.
-- Victim start-time tie-breaking (upstream's final ordering criterion)
-  is replaced by deterministic node-index order: start times are not
-  part of the snapshot.
+
+Candidate ordering reproduces upstream pickOneNodeForPreemption's
+criteria 2-6 in order: lowest highest-victim priority, lowest sum of
+victim priorities, fewest victims, LATEST start time of the
+highest-priority victim, then first node index (upstream criterion 1,
+fewest PDB violations, is superseded: budgets are enforced host-side and
+never violated at all). Within a node, victims of equal priority are
+evicted most-recently-started first (upstream util.MoreImportantPod:
+earlier start = more important).
 """
 
 from __future__ import annotations
@@ -51,18 +57,55 @@ PRIO_PAD = jnp.iinfo(jnp.int32).max  # padding sentinel: never evictable
 
 
 class VictimTables(NamedTuple):
-    """Per-node victim prefix tables, victims sorted by priority asc.
+    """Per-node victim prefix tables, victims sorted by (priority asc,
+    start time desc) — the k-th entry is the k-th least "important" pod
+    in upstream util.MoreImportantPod order, so prefix k is always the
+    upstream-minimal victim set of size k.
 
-    prio:  [n, K] int32 — k-th lowest victim priority on node n
+    prio:  [n, K] int32 — k-th victim priority on node n
            (PRIO_PAD past the node's victim count)
     freed: [n, K, r] f32 — capacity released by evicting victims 0..k
            (inclusive prefix sums)
     vid:   [n, K] int32 — index into the caller's victim arrays, -1 pad
+    psum_hi/psum_lo: [n, K] int32 — inclusive prefix sum of victim
+           priorities (padding contributes 0), upstream ordering
+           criterion 3, as a two-limb value hi*2^16 + lo with lo in
+           [0, 2^16): k8s priorities reach 2e9 and a K-victim prefix
+           overflows int32 (this image has no int64 — jnp silently
+           downgrades), so sums are compared lexicographically on the
+           normalized limb pair instead
+    start: [n, K] int32 — k-th victim's start time (relative seconds;
+           larger = started later; 0 past the victim count) — upstream
+           ordering criterion 5 reads it at the prefix end (the
+           highest-priority victim)
     """
 
     prio: jnp.ndarray
     freed: jnp.ndarray
     vid: jnp.ndarray
+    psum_hi: jnp.ndarray
+    psum_lo: jnp.ndarray
+    start: jnp.ndarray
+
+
+class VictimArrays(NamedTuple):
+    """Dense victim-side inputs to the preemption pass — the shape the
+    host ships to the sidecar (bridge Preempt RPC) or feeds the local
+    engine. Entries with node < 0 (PDB-protected, terminating, or
+    nomination reservations) never enter the tables.
+
+    node:  [m] int32 — victim's node index, -1 = not evictable
+    prio:  [m] int32
+    req:   [m, r] f32 — request vectors with non-zero defaults
+    mask:  [m] bool
+    start: [m] int32 — relative start seconds (larger = later)
+    """
+
+    node: jnp.ndarray
+    prio: jnp.ndarray
+    req: jnp.ndarray
+    mask: jnp.ndarray
+    start: jnp.ndarray
 
 
 class PreemptResult(NamedTuple):
@@ -84,23 +127,34 @@ def build_victim_tables(
     *,
     n_nodes: int,
     k_cap: int,
+    victim_start: jnp.ndarray | None = None,
 ) -> VictimTables:
-    """Lay running pods out into per-node priority-ascending prefix
-    tables. victim_node [m] int32 (entries outside [0, n) ignored),
-    victim_prio [m] int32, victim_req [m, r] f32, victim_mask [m] bool.
+    """Lay running pods out into per-node prefix tables sorted by
+    (priority asc, start time desc). victim_node [m] int32 (entries
+    outside [0, n) ignored), victim_prio [m] int32, victim_req [m, r]
+    f32, victim_mask [m] bool, victim_start [m] int32 relative seconds
+    (None = all equal, reducing the tie-break to input order).
 
     One sort + one scatter over the m running pods, paid once per
     preemption pass (not per candidate)."""
     m, r = victim_req.shape
     ok = victim_mask & (victim_node >= 0) & (victim_node < n_nodes)
-    # lexicographic (node asc, prio asc) via two stable argsorts
-    ord1 = jnp.argsort(victim_prio, stable=True)
+    if victim_start is None:
+        victim_start = jnp.zeros((m,), jnp.int32)
+    # lexicographic (node asc, prio asc, start desc) via stable argsorts,
+    # innermost key first: equal-priority victims evict most-recently-
+    # started first (upstream MoreImportantPod: earlier start = more
+    # important, evicted later)
+    ord0 = jnp.argsort(-victim_start, stable=True)
+    ord1 = jnp.argsort(victim_prio[ord0], stable=True)
+    ord01 = ord0[ord1]
     ord2 = jnp.argsort(
-        jnp.where(ok, victim_node, n_nodes)[ord1], stable=True
+        jnp.where(ok, victim_node, n_nodes)[ord01], stable=True
     )
-    order = ord1[ord2]                                           # [m]
+    order = ord01[ord2]                                          # [m]
     node_s = jnp.where(ok[order], victim_node[order], n_nodes)
     prio_s = victim_prio[order]
+    start_s = victim_start[order]
     req_s = victim_req[order]
     # position within the node's segment
     idx = jnp.arange(m)
@@ -127,7 +181,39 @@ def build_victim_tables(
             :n_nodes
         ]
     )
-    return VictimTables(prio=prio, freed=jnp.cumsum(steps, axis=1), vid=vid)
+    # priority prefix sums as two 16-bit limbs (see VictimTables.psum_hi):
+    # arithmetic >> handles negative priorities (hi = floor division by
+    # 2^16, lo in [0, 2^16)); the post-cumsum carry normalization restores
+    # lo's range so lexicographic (hi, lo) ordering equals numeric
+    # ordering of hi*2^16 + lo
+    kept_prio = jnp.where(keep, prio_s, 0)
+    hi_v = kept_prio >> 16
+    lo_v = kept_prio - (hi_v << 16)
+    hi_steps = (
+        jnp.zeros((n_nodes + 1, k_cap), jnp.int32)
+        .at[row, pos].set(hi_v)[:n_nodes]
+    )
+    lo_steps = (
+        jnp.zeros((n_nodes + 1, k_cap), jnp.int32)
+        .at[row, pos].set(lo_v)[:n_nodes]
+    )
+    psum_hi = jnp.cumsum(hi_steps, axis=1)
+    psum_lo = jnp.cumsum(lo_steps, axis=1)
+    carry = psum_lo >> 16
+    psum_hi = psum_hi + carry
+    psum_lo = psum_lo - (carry << 16)
+    start = (
+        jnp.zeros((n_nodes + 1, k_cap), jnp.int32)
+        .at[row, pos].set(jnp.where(keep, start_s, 0))[:n_nodes]
+    )
+    return VictimTables(
+        prio=prio,
+        freed=jnp.cumsum(steps, axis=1),
+        vid=vid,
+        psum_hi=psum_hi,
+        psum_lo=psum_lo,
+        start=start,
+    )
 
 
 def preempt_candidates(
@@ -146,10 +232,10 @@ def preempt_candidates(
 
     Candidate (pod p, node n, count k) is valid iff all k victims have
     priority strictly below p's and p's request fits free + freed[k-1].
-    Per pod the minimal k per node is kept, then nodes compete
-    lexicographically on (highest victim priority, victim count, node
-    index) — upstream's dominant two criteria with a deterministic tie
-    break."""
+    Per pod the minimal k per node is kept, then nodes compete on
+    upstream pickOneNodeForPreemption's ordering: lowest highest-victim
+    priority, lowest sum of victim priorities, fewest victims, latest
+    start time of the highest-priority victim, first node index."""
     p, r = pend_req.shape
     n, k_cap = tables.prio.shape
     cap = free[None, :, None, :] + tables.freed[None, :, :, :]  # [1,n,K,r]
@@ -163,20 +249,37 @@ def preempt_candidates(
     ok = fits & elig & static_ok[:, :, None] & pend_mask[:, None, None]
     has_k = ok.any(-1)                                          # [p,n]
     kstar = jnp.argmax(ok, axis=-1)                             # first True
-    maxprio = jnp.take_along_axis(
-        tables.prio[None], jnp.broadcast_to(kstar[:, :, None], (p, n, 1)),
-        axis=2,
-    )[..., 0]                                                   # [p,n]
-    # lexicographic argmin over nodes: (maxprio, kstar, node index)
+
+    def at_kstar(table):
+        return jnp.take_along_axis(
+            table[None], jnp.broadcast_to(kstar[:, :, None], (p, n, 1)),
+            axis=2,
+        )[..., 0]                                               # [p,n]
+
+    maxprio = at_kstar(tables.prio)
+    priosum_hi = at_kstar(tables.psum_hi)
+    priosum_lo = at_kstar(tables.psum_lo)
+    hp_start = at_kstar(tables.start)
+    # lexicographic argmin over nodes:
+    # (maxprio, priosum (hi then lo limb), kstar, -hp_start, node index)
     big = jnp.iinfo(jnp.int32).max
     mp = jnp.where(has_k, maxprio, big)
     best_mp = mp.min(axis=1, keepdims=True)
     tier1 = has_k & (mp == best_mp)
-    ks = jnp.where(tier1, kstar, big)
+    ps_hi = jnp.where(tier1, priosum_hi, big)
+    best_ps_hi = ps_hi.min(axis=1, keepdims=True)
+    tier1b = tier1 & (ps_hi == best_ps_hi)
+    ps_lo = jnp.where(tier1b, priosum_lo, big)
+    best_ps_lo = ps_lo.min(axis=1, keepdims=True)
+    tier2 = tier1b & (ps_lo == best_ps_lo)
+    ks = jnp.where(tier2, kstar, big)
     best_k = ks.min(axis=1, keepdims=True)
-    tier2 = tier1 & (ks == best_k)
+    tier3 = tier2 & (ks == best_k)
+    st = jnp.where(tier3, hp_start, -big)
+    best_st = st.max(axis=1, keepdims=True)
+    tier4 = tier3 & (st == best_st)
     node = jnp.where(
-        tier2.any(-1), jnp.argmax(tier2, axis=-1), -1
+        tier4.any(-1), jnp.argmax(tier4, axis=-1), -1
     ).astype(jnp.int32)                                         # [p]
     safe = jnp.maximum(node, 0)
     nv = jnp.where(node >= 0, kstar[jnp.arange(p), safe] + 1, 0)

@@ -158,6 +158,84 @@ def test_node_affinity_matches_oracle():
             assert got[p, n_] == oracle.node_affinity_fit_oracle(nl, exprs), (p, n_)
 
 
+def test_node_affinity_or_of_ands():
+    """Upstream nodeSelectorTerms semantics: AND within a term, OR across
+    terms — term1-fails-term2-passes must schedule; every-term-fails must
+    not; group ids need not be contiguous with expression order."""
+    node_labels = [
+        {1: 1},          # zone=a
+        {1: 2},          # zone=b
+        {1: 3, 2: 1},    # zone=c, disk=ssd
+        {},
+    ]
+    labels, l_mask = pack_node_labels(node_labels)
+    # pod 0: (zone in {a}) OR (zone in {b}) — two one-expression terms
+    # pod 1: (zone in {a} AND disk exists) OR (zone in {c} AND disk exists)
+    # pod 2: (zone in {9}) OR (zone in {8}) — both fail everywhere
+    # pod 3: no requirements
+    key, op, vals, val_mask, e_mask = pack_exprs([
+        [(1, OP_IN, [1]), (1, OP_IN, [2])],
+        [(1, OP_IN, [1]), (2, OP_EXISTS, []), (1, OP_IN, [3]), (2, OP_EXISTS, [])],
+        [(1, OP_IN, [9]), (1, OP_IN, [8])],
+        [],
+    ], e_max=4, v_max=2)
+    term = jnp.asarray(
+        [[0, 1, 0, 0], [0, 0, 1, 1], [0, 1, 0, 0], [0, 0, 0, 0]], jnp.int32
+    )
+    got = np.asarray(
+        node_affinity_fit(labels, l_mask, key, op, vals, val_mask, e_mask, term)
+    )
+    assert got.tolist() == [
+        [True, True, False, False],     # a or b
+        [False, False, True, False],    # (a & disk) or (c & disk) -> node 2
+        [False, False, False, False],   # all terms fail
+        [True, True, True, True],       # vacuous
+    ]
+
+
+def test_node_affinity_empty_term_matches_nothing():
+    """An upstream term with no expressions matches no objects: encoded
+    as In with an empty value set (the conversion's encoding), the term
+    contributes nothing to the OR — and a pod whose ONLY term is empty is
+    unschedulable."""
+    labels, l_mask = pack_node_labels([{1: 1}, {}])
+    # pod 0: empty term OR (zone in {1}); pod 1: only an empty term
+    key, op, vals, val_mask, e_mask = pack_exprs(
+        [[(0, OP_IN, []), (1, OP_IN, [1])], [(0, OP_IN, [])]],
+        e_max=2, v_max=1,
+    )
+    term = jnp.asarray([[0, 1], [0, 0]], jnp.int32)
+    got = np.asarray(
+        node_affinity_fit(labels, l_mask, key, op, vals, val_mask, e_mask, term)
+    )
+    assert got.tolist() == [[True, False], [False, False]]
+
+
+def test_node_affinity_default_term_matches_flat_and():
+    """na_term of all zeros (the make_pod_batch default) must reproduce
+    the single-AND-list behavior exactly."""
+    node_labels = [{1: 1, 2: 1}, {1: 2}, {2: 3}, {}, {1: 1, 2: 2, 3: 1}]
+    pod_exprs = [
+        [],
+        [(1, OP_IN, [1, 2])],
+        [(1, OP_NOT_IN, [2])],
+        [(2, OP_EXISTS, [])],
+        [(1, OP_IN, [1]), (2, OP_EXISTS, [])],
+    ]
+    labels, l_mask = pack_node_labels(node_labels)
+    key, op, vals, val_mask, e_mask = pack_exprs(pod_exprs)
+    flat = np.asarray(
+        node_affinity_fit(labels, l_mask, key, op, vals, val_mask, e_mask)
+    )
+    zeroed = np.asarray(
+        node_affinity_fit(
+            labels, l_mask, key, op, vals, val_mask, e_mask,
+            jnp.zeros_like(key),
+        )
+    )
+    np.testing.assert_array_equal(flat, zeroed)
+
+
 def test_pod_affinity_fit():
     # 4 nodes, 2 selectors: selector 0 matched in domains of nodes 0,1;
     # selector 1 matched only at node 2's domain.

@@ -136,6 +136,26 @@ def test_schedule_windows_carries_anti_affinity_across_windows():
     assert int(res.n_assigned) == 1
 
 
+def test_windows_auction_knobs_traced_not_static():
+    """schedule_windows must trace auction_rounds/auction_price_frac like
+    schedule_batch does (round-3 verdict: a runtime knob change recompiled
+    the whole backlog program on one surface and not the other)."""
+    snapshot, pods = random_state(16, 8)
+    windows = stack_windows(pods, 4)
+    schedule_windows.clear_cache()
+    r1 = schedule_windows(
+        snapshot, windows, auction_price_frac=1.0 / 16.0, auction_rounds=1024
+    )
+    n1 = schedule_windows._cache_size()
+    r2 = schedule_windows(
+        snapshot, windows, auction_price_frac=1.0, auction_rounds=64
+    )
+    assert schedule_windows._cache_size() == n1, (
+        "auction knob change recompiled schedule_windows"
+    )
+    assert int(r1.n_assigned) >= 0 and int(r2.n_assigned) >= 0
+
+
 def test_stack_windows_rejects_ragged():
     _, pods = random_state(4, 10)
     with pytest.raises(ValueError):

@@ -162,6 +162,148 @@ def test_snapshot_builder_gpu_and_scv_labels():
     assert float(batch.want_clock[2]) == 1500
 
 
+def test_node_affinity_or_terms_end_to_end():
+    """Upstream OR-of-ANDs through the full host pipeline: a pod whose
+    FIRST term fails everywhere but whose second term matches one node
+    must schedule there (the round-3 conversion truncated to terms[0],
+    over-constraining exactly this pod)."""
+    from kubernetes_scheduler_tpu.engine import schedule_batch
+    from kubernetes_scheduler_tpu.host.types import MatchExpression
+
+    b = SnapshotBuilder()
+    nodes = [
+        make_node("ssd", labels={"disk": "ssd"}),
+        make_node("hdd", labels={"disk": "hdd"}),
+    ]
+    two_terms = Pod(
+        name="or-pod",
+        containers=[Container()],
+        node_affinity=[
+            MatchExpression(key="disk", operator="In", values=["nvme"], term=0),
+            MatchExpression(key="disk", operator="In", values=["hdd"], term=1),
+        ],
+    )
+    one_term = Pod(
+        name="and-pod",
+        containers=[Container()],
+        node_affinity=[
+            MatchExpression(key="disk", operator="In", values=["nvme"], term=0),
+        ],
+    )
+    snap = b.build_snapshot(nodes, {}, [])
+    batch = b.build_pod_batch([two_terms, one_term])
+    res = schedule_batch(snap, batch)
+    feas = np.asarray(res.feasible)
+    assert feas[0, :2].tolist() == [False, True]
+    assert int(res.node_idx[0]) == 1
+    assert not feas[1, :2].any() and int(res.node_idx[1]) == -1
+
+
+def test_spread_selector_match_expressions():
+    """Spread selectors with matchExpressions count running pods via full
+    label-selector semantics (round-3 conversion silently dropped them)."""
+    from kubernetes_scheduler_tpu.host.types import MatchExpression, SpreadConstraint
+
+    b = SnapshotBuilder()
+    nodes = [make_node("n1"), make_node("n2")]
+    tiers = []
+    for name, node, tier in [("a", "n1", "web"), ("b", "n1", "db"), ("c", "n2", "web")]:
+        pd = make_pod(name, labels={"tier": tier})
+        pd.node_name = node
+        tiers.append(pd)
+    pending = [
+        Pod(
+            name="spread-expr",
+            containers=[Container()],
+            topology_spread=[
+                SpreadConstraint(
+                    match_labels={},
+                    match_expressions=[
+                        MatchExpression(key="tier", operator="In", values=["web"])
+                    ],
+                    max_skew=1,
+                )
+            ],
+        )
+    ]
+    snap = b.build_snapshot(nodes, {}, tiers, pending_pods=pending)
+    batch = b.build_pod_batch(pending)
+    sid = int(batch.spread_sel[0, 0])
+    assert sid >= 0
+    counts = np.asarray(snap.domain_counts)
+    # hostname domains: n1 has one web pod, n2 has one web pod (db ignored)
+    assert counts[0, sid] == 1.0 and counts[1, sid] == 1.0
+
+
+def test_soft_spread_schedule_anyway_steers_not_filters():
+    """ScheduleAnyway spread: the engine prefers the least-loaded domain
+    but never filters — even when every domain violates maxSkew."""
+    from kubernetes_scheduler_tpu.engine import schedule_batch
+    from kubernetes_scheduler_tpu.host.types import SpreadConstraint
+
+    b = SnapshotBuilder()
+    nodes = [make_node("busy"), make_node("idle")]
+    web_pods = []
+    for i in range(3):
+        pd = make_pod(f"web{i}", labels={"app": "web"})
+        pd.node_name = "busy"
+        web_pods.append(pd)
+    pending = [
+        Pod(
+            name="soft-spread",
+            containers=[Container()],
+            labels={"app": "web"},
+            topology_spread=[
+                SpreadConstraint(match_labels={"app": "web"}, soft=True)
+            ],
+        )
+    ]
+    snap = b.build_snapshot(nodes, {}, web_pods, pending_pods=pending)
+    batch = b.build_pod_batch(pending)
+    assert int(batch.soft_spread_sel[0, 0]) >= 0
+    assert int(batch.spread_sel[0, 0]) == -1  # not a hard constraint
+    res = schedule_batch(snap, batch, soft=True)
+    # both nodes stay feasible (soft, never filters); the empty domain wins
+    assert bool(np.asarray(res.feasible)[0, :2].all())
+    assert int(res.node_idx[0]) == 1
+
+    # with every node in one crowded domain, the pod still schedules
+    crowded = [make_pod(f"w{i}", labels={"app": "web"}) for i in range(2)]
+    for pd in crowded:
+        pd.node_name = "busy"
+    one = [make_node("busy")]
+    b2 = SnapshotBuilder()
+    snap2 = b2.build_snapshot(one, {}, crowded, pending_pods=pending)
+    batch2 = b2.build_pod_batch(pending)
+    res2 = schedule_batch(snap2, batch2, soft=True)
+    assert int(res2.node_idx[0]) == 0
+
+
+def test_soft_spread_through_scheduler_loop():
+    """The full host loop must turn a ScheduleAnyway constraint into a
+    soft score term (the cycle's soft gate has to see soft spread — a
+    window with ONLY a soft spread constraint still needs soft=True)."""
+    from kubernetes_scheduler_tpu.host.types import SpreadConstraint
+
+    nodes = [make_node("busy", cpu=8000), make_node("idle", cpu=8000)]
+    utils = {n.name: NodeUtil(cpu_pct=10, disk_io=5) for n in nodes}
+    crowd = make_pod("w0", cpu=100, labels={"app": "web"})
+    crowd.node_name = "busy"
+    spreader = Pod(
+        name="spreader",
+        containers=[Container(requests={"cpu": 100.0})],
+        labels={"app": "web"},
+        topology_spread=[
+            SpreadConstraint(match_labels={"app": "web"}, soft=True)
+        ],
+    )
+    s = make_sched(nodes, [crowd], utils)
+    s.submit(spreader)
+    m = s.run_cycle()
+    assert m.pods_bound == 1 and not m.used_fallback
+    assert s.binder.bindings[0].node_name == "idle"
+
+
 def test_domain_counts_topology_aggregation():
     b = SnapshotBuilder()
     nodes = [
@@ -448,6 +590,99 @@ def test_failed_device_cycle_feeds_adaptive_model():
     m = s.run_cycle()
     assert m.pods_bound == 1 and m.used_fallback
     assert s._dispatch.device.n_obs == before + 1
+
+
+def test_fallback_honors_free_capacity_policy():
+    """An engine failure under policy=free_capacity must degrade to the
+    SAME policy (round-3 verdict: the fallback always scored with the
+    yoda formula). free_capacity prefers the least-utilized node; the
+    yoda formula with these inputs prefers a balanced one — the binding
+    tells us which formula ran."""
+    nodes = [make_node(f"n{i}", cpu=8000) for i in range(3)]
+    # n2 is clearly least utilized -> free_capacity picks n2.
+    utils = {
+        "n0": NodeUtil(cpu_pct=20, mem_pct=80, disk_io=10),
+        "n1": NodeUtil(cpu_pct=50, mem_pct=50, disk_io=20),
+        "n2": NodeUtil(cpu_pct=5, mem_pct=5, disk_io=0),
+    }
+    ref = make_sched(nodes, [], utils, policy="free_capacity")
+    ref.submit(make_pod("probe", cpu=100, annotations={"diskIO": "10"}))
+    m0 = ref.run_cycle()
+    assert m0.pods_bound == 1 and not m0.used_fallback
+    want = ref.binder.bindings[0].node_name
+
+    s = make_sched(nodes, [], utils, policy="free_capacity")
+
+    def boom(*a, **k):
+        raise RuntimeError("device path down")
+
+    s._run_batched = boom
+    s.submit(make_pod("p0", cpu=100, annotations={"diskIO": "10"}))
+    m = s.run_cycle()
+    assert m.pods_bound == 1 and m.used_fallback
+    assert not m.policy_mismatch
+    assert s.totals["fallback_policy_mismatch"] == 0
+    bound = {b.pod.name: b.node_name for b in s.binder.bindings}
+    assert bound["p0"] == want == "n2", (bound, want)
+
+
+def test_fallback_honors_card_policy():
+    """policy=card fallback: GPU predicates filter and the card formula
+    scores — matching the engine path's decision."""
+    from kubernetes_scheduler_tpu.host.types import Card
+
+    weak = make_node("weak", cpu=8000)
+    weak.cards = [Card(clock=1000, free_memory=4000, core=100)]
+    strong = make_node("strong", cpu=8000)
+    strong.cards = [
+        Card(clock=1000, free_memory=16000, core=500),
+        Card(clock=1000, free_memory=16000, core=500),
+    ]
+    none = make_node("none", cpu=8000)
+    nodes = [weak, strong, none]
+    utils = {n.name: NodeUtil(cpu_pct=10, disk_io=5) for n in nodes}
+    gpu_pod = lambda name: make_pod(  # noqa: E731
+        name, cpu=100, labels={"scv/number": "2", "scv/memory": "8000"}
+    )
+    ref = make_sched(nodes, [], utils, policy="card")
+    ref.submit(gpu_pod("probe"))
+    m0 = ref.run_cycle()
+    assert m0.pods_bound == 1 and not m0.used_fallback
+    want = ref.binder.bindings[0].node_name
+    assert want == "strong"
+
+    s = make_sched(nodes, [], utils, policy="card")
+
+    def boom(*a, **k):
+        raise RuntimeError("device path down")
+
+    s._run_batched = boom
+    s.submit(gpu_pod("p0"))
+    m = s.run_cycle()
+    assert m.pods_bound == 1 and m.used_fallback and not m.policy_mismatch
+    bound = {b.pod.name: b.node_name for b in s.binder.bindings}
+    assert bound["p0"] == "strong", bound
+
+
+def test_fallback_policy_mismatch_counter():
+    """A policy with no scalar mirror (balanced_diskio) still binds under
+    fallback but flags the mismatch in metrics."""
+    from kubernetes_scheduler_tpu.host.observe import render_prometheus
+
+    nodes = [make_node(f"n{i}", cpu=8000) for i in range(2)]
+    utils = {f"n{i}": NodeUtil(cpu_pct=10, disk_io=5) for i in range(2)}
+    s = make_sched(nodes, [], utils, policy="balanced_diskio")
+
+    def boom(*a, **k):
+        raise RuntimeError("device path down")
+
+    s._run_batched = boom
+    s.submit(make_pod("p0", cpu=100, annotations={"diskIO": "5"}))
+    m = s.run_cycle()
+    assert m.pods_bound == 1 and m.used_fallback and m.policy_mismatch
+    assert s.totals["fallback_policy_mismatch"] == 1
+    text = render_prometheus(*s.metrics_snapshot())
+    assert "fallback_policy_mismatch_total 1" in text
 
 
 def test_running_avoider_forces_engine_path_and_blocks_domain():

@@ -151,11 +151,69 @@ def test_pod_from_api_full_spec():
     terms = {(t.topology_key, t.anti, t.preferred) for t in pod.pod_affinity}
     assert ("zone", True, False) in terms
     assert ("kubernetes.io/hostname", False, True) in terms
-    # only DoNotSchedule spread constraints become hard constraints
-    assert len(pod.topology_spread) == 1
-    assert pod.topology_spread[0].max_skew == 2
+    # both whenUnsatisfiable modes convert: DoNotSchedule hard,
+    # ScheduleAnyway soft
+    assert len(pod.topology_spread) == 2
+    hard = [sc for sc in pod.topology_spread if not sc.soft]
+    soft = [sc for sc in pod.topology_spread if sc.soft]
+    assert len(hard) == 1 and hard[0].max_skew == 2
+    assert len(soft) == 1 and soft[0].max_skew == 1
     assert pod.host_ports == [8080]
     assert pod.node_name is None and pod.target_node is None
+
+
+def test_pod_from_api_or_of_ands_node_affinity():
+    """ALL nodeSelectorTerms are kept as OR groups (upstream semantics),
+    nodeSelector is ANDed into every group, and an empty term becomes the
+    matches-nothing encoding."""
+    obj = {
+        "metadata": {"name": "multi-term"},
+        "spec": {
+            "nodeSelector": {"disk": "ssd"},
+            "containers": [{}],
+            "affinity": {
+                "nodeAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": {
+                        "nodeSelectorTerms": [
+                            {
+                                "matchExpressions": [
+                                    {"key": "zone", "operator": "In",
+                                     "values": ["a"]},
+                                    {"key": "arch", "operator": "Exists"},
+                                ]
+                            },
+                            {
+                                "matchExpressions": [
+                                    {"key": "zone", "operator": "In",
+                                     "values": ["b"]},
+                                ]
+                            },
+                            {},  # empty term: matches nothing
+                        ]
+                    }
+                }
+            },
+        },
+    }
+    pod = pod_from_api(obj)
+    by_term: dict[int, list] = {}
+    for e in pod.node_affinity:
+        by_term.setdefault(e.term, []).append(e)
+    assert sorted(by_term) == [0, 1, 2]
+    # every group carries the nodeSelector conjunct
+    for t, exprs in by_term.items():
+        assert any(
+            e.key == "disk" and e.operator == "In" and e.values == ["ssd"]
+            for e in exprs
+        ), t
+    assert {(e.key, e.operator) for e in by_term[0]} == {
+        ("zone", "In"), ("arch", "Exists"), ("disk", "In")
+    }
+    assert any(e.key == "zone" and e.values == ["b"] for e in by_term[1])
+    # the empty term's placeholder: In with no values, satisfiable nowhere
+    assert any(
+        e.operator == "In" and e.values == [] for e in by_term[2]
+    )
 
 
 def test_pod_from_api_pinned_and_running():

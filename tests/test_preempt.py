@@ -11,13 +11,19 @@ from kubernetes_scheduler_tpu.ops.preempt import (
 )
 
 
-def run(pend_req, pend_prio, static_ok, free, vnode, vprio, vreq, k_cap=4):
+def run(
+    pend_req, pend_prio, static_ok, free, vnode, vprio, vreq, k_cap=4,
+    vstart=None,
+):
     p = len(pend_prio)
     m = len(vprio)
     tables = build_victim_tables(
         jnp.asarray(vnode, jnp.int32), jnp.asarray(vprio, jnp.int32),
         jnp.asarray(vreq, jnp.float32), jnp.ones(m, bool),
         n_nodes=free.shape[0], k_cap=k_cap,
+        victim_start=(
+            None if vstart is None else jnp.asarray(vstart, jnp.int32)
+        ),
     )
     return preempt_candidates(
         jnp.asarray(pend_req, jnp.float32), jnp.asarray(pend_prio, jnp.int32),
@@ -26,25 +32,37 @@ def run(pend_req, pend_prio, static_ok, free, vnode, vprio, vreq, k_cap=4):
     )
 
 
-def oracle_one(req, prio, static_ok_row, free, vnode, vprio, vreq, k_cap):
-    """Reference semantics, brute force: per node, evict lowest-priority
-    victims (strictly below prio) one at a time until the pod fits (up to
-    k_cap); among feasible nodes pick lexicographic-min (highest victim
-    priority, count, node index)."""
+def oracle_one(
+    req, prio, static_ok_row, free, vnode, vprio, vreq, k_cap, vstart=None
+):
+    """Reference semantics, brute force: per node, evict least-important
+    victims (strictly below prio; importance = priority asc, start desc)
+    one at a time until the pod fits (up to k_cap); among feasible nodes
+    pick by upstream pickOneNodeForPreemption order: (highest victim
+    priority, sum of victim priorities, count, LATEST highest-victim
+    start, node index)."""
+    if vstart is None:
+        vstart = [0] * len(vprio)
     best = None
     for n in range(free.shape[0]):
         if not static_ok_row[n]:
             continue
         vics = sorted(
             [i for i in range(len(vprio)) if vnode[i] == n and vprio[i] < prio],
-            key=lambda i: (vprio[i],),
+            key=lambda i: (vprio[i], -vstart[i]),
         )
-        cap = free[n].copy()
         for k in range(1, min(k_cap, len(vics)) + 1):
             cap = free[n] + sum(vreq[i] for i in vics[:k])
             if all(req[j] <= cap[j] or req[j] == 0 for j in range(len(req))):
-                cand = (vprio[vics[k - 1]], k, n, [int(i) for i in vics[:k]])
-                if best is None or cand[:3] < best[:3]:
+                cand = (
+                    vprio[vics[k - 1]],
+                    sum(vprio[i] for i in vics[:k]),
+                    k,
+                    -vstart[vics[k - 1]],
+                    n,
+                    [int(i) for i in vics[:k]],
+                )
+                if best is None or cand[:5] < best[:5]:
                     best = cand
                 break
     return best
@@ -140,26 +158,91 @@ def test_matches_bruteforce_oracle(seed):
     vnode = rng.integers(0, n, m).astype(np.int32)
     vprio = rng.integers(0, 10, m).astype(np.int32)
     vreq = rng.uniform(0.2, 3.0, (m, r)).astype(np.float32)
+    # coarse start times so (priority, start) ties actually occur
+    vstart = rng.integers(0, 3, m).astype(np.int32)
 
     res = run(pend_req, pend_prio, static_ok, free, vnode, vprio, vreq,
-              k_cap=k_cap)
+              k_cap=k_cap, vstart=vstart)
     for i in range(p):
         want = oracle_one(
             pend_req[i], int(pend_prio[i]), static_ok[i], free,
-            vnode, vprio, vreq, k_cap,
+            vnode, vprio, vreq, k_cap, vstart=vstart,
         )
         got_node = int(res.node[i])
         if want is None:
             assert got_node == -1, (seed, i)
         else:
-            assert got_node == want[2], (seed, i, want, got_node)
-            assert int(res.n_victims[i]) == want[1]
+            assert got_node == want[4], (seed, i, want, got_node)
+            assert int(res.n_victims[i]) == want[2]
             got_v = sorted(int(v) for v in np.asarray(res.victims[i]) if v >= 0)
-            # same victim SET by priority; ties may reorder within equal
-            # priority — compare multisets of priorities and total freed
-            assert sorted(vprio[j] for j in got_v) == sorted(
-                vprio[j] for j in want[3]
+            # same victim SET by (priority, start); ties may reorder —
+            # compare multisets of sort keys
+            assert sorted((vprio[j], vstart[j]) for j in got_v) == sorted(
+                (vprio[j], vstart[j]) for j in want[5]
             )
+
+
+def test_equal_priority_victims_evict_latest_started_first():
+    """Upstream MoreImportantPod: among equal-priority victims the most
+    recently started is least important and evicted first."""
+    free = np.array([[0.0]])
+    # two prio-1 victims on node 0; victim 1 started LATER (t=100)
+    res = run(
+        pend_req=[[1.0]], pend_prio=[9], static_ok=[[True]],
+        free=free, vnode=[0, 0], vprio=[1, 1],
+        vreq=np.array([[1.0], [1.0]]), vstart=[10, 100],
+    )
+    assert int(res.node[0]) == 0 and int(res.n_victims[0]) == 1
+    vics = [int(v) for v in np.asarray(res.victims[0]) if v >= 0]
+    assert vics == [1], "the later-started equal-priority victim goes first"
+
+
+def test_node_tie_broken_by_latest_highest_victim_start():
+    """Upstream pickOneNodeForPreemption criterion 5: with equal highest
+    victim priority, priority sum and count, pick the node whose
+    highest-priority victim started LATEST."""
+    free = np.array([[0.0], [0.0]])
+    res = run(
+        pend_req=[[1.0]], pend_prio=[9], static_ok=[[True, True]],
+        free=free, vnode=[0, 1], vprio=[3, 3],
+        vreq=np.array([[1.0], [1.0]]), vstart=[50, 200],
+    )
+    assert int(res.node[0]) == 1
+
+
+def test_priority_sum_no_int32_overflow():
+    """k8s PriorityClass values reach 2e9; a 3-victim prefix sum
+    overflows int32. The two-limb psum must still order criterion 3
+    correctly (review finding r4: a wrapped-negative sum beat a valid
+    smaller one)."""
+    big_prio = 1_000_000_000
+    free = np.array([[0.0], [0.0]])
+    # pod needs 3 units. node 0: three victims at 1e9 (sum 3e9 — wraps
+    # int32). node 1: three victims at (1e9, 1e9, 0) — sum 2e9 (also
+    # past int32 max). maxprio ties at 1e9; node 1's TRUE sum is lower.
+    res = run(
+        pend_req=[[3.0]], pend_prio=[2_000_000_000],
+        static_ok=[[True, True]], free=free,
+        vnode=[0, 0, 0, 1, 1, 1],
+        vprio=[big_prio] * 3 + [big_prio, big_prio, 0],
+        vreq=np.ones((6, 1)),
+    )
+    assert int(res.node[0]) == 1
+
+
+def test_node_tie_broken_by_lower_priority_sum():
+    """Upstream criterion 3: equal highest victim priority, lower SUM of
+    victim priorities wins even with MORE victims."""
+    free = np.array([[0.0], [0.0]])
+    # pod needs 2 units. node 0: victims prio (4, 4) — sum 8, count 2.
+    # node 1: victims prio (0, 4) — sum 4, count 2. Equal maxprio 4 and
+    # count; node 1's sum is lower.
+    res = run(
+        pend_req=[[2.0]], pend_prio=[9], static_ok=[[True, True]],
+        free=free, vnode=[0, 0, 1, 1], vprio=[4, 4, 0, 4],
+        vreq=np.array([[1.0], [1.0], [1.0], [1.0]]),
+    )
+    assert int(res.node[0]) == 1
 
 
 # ---- host integration: the PostFilter pass in the scheduling loop ------
@@ -218,6 +301,45 @@ def test_host_preempts_lowest_priority_victim_then_binds():
     m2 = s.run_cycle()
     assert m2.pods_bound == 1
     assert s.binder.bindings[-1].node_name == "n0"
+
+
+def test_host_preemption_routes_through_engine_surface():
+    """The preemption pass runs on self.engine (the sidecar's Preempt RPC
+    in a bridged deployment); a version-skewed engine without the surface
+    degrades to the in-host evaluation with identical evictions."""
+    from kubernetes_scheduler_tpu.engine import LocalEngine
+    from kubernetes_scheduler_tpu.host import RecordingEvictor
+    from tests.test_host import make_pod
+
+    calls = []
+
+    class SpyEngine(LocalEngine):
+        def preempt(self, snapshot, pods, victims, *, k_cap):
+            calls.append(k_cap)
+            return super().preempt(snapshot, pods, victims, k_cap=k_cap)
+
+    nodes, utils, running = _cluster()
+    ev = RecordingEvictor()
+    s = _sched(nodes, utils, running, evictor=ev)
+    s.engine = SpyEngine()
+    s.submit(make_pod("urgent", cpu=800, labels={"scv/priority": "9"},
+                      annotations={"diskIO": "5"}))
+    m = s.run_cycle()
+    assert calls, "preemption did not route through the engine surface"
+    assert m.pods_preempted == 1 and ev.evictions[0].victim.name == "low0"
+
+    class SkewedEngine(LocalEngine):
+        def preempt(self, *a, **k):
+            raise NotImplementedError("old sidecar")
+
+    nodes2, utils2, running2 = _cluster()
+    ev2 = RecordingEvictor()
+    s2 = _sched(nodes2, utils2, running2, evictor=ev2)
+    s2.engine = SkewedEngine()
+    s2.submit(make_pod("urgent", cpu=800, labels={"scv/priority": "9"},
+                       annotations={"diskIO": "5"}))
+    m2 = s2.run_cycle()
+    assert m2.pods_preempted == 1 and ev2.evictions[0].victim.name == "low0"
 
 
 def test_host_no_preemption_without_higher_priority():

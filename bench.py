@@ -87,7 +87,9 @@ def _baseline_pass(req, r_io, alloc, requested, disk_io, cpu_pct):
             requested[j] += req[i]
 
 
-def tpu_rate(snapshot, pods) -> float:
+def tpu_rate(
+    snapshot, pods, *, price_frac: float = None, affinity_aware: bool = False
+) -> float:
     """Pods/sec of the batched engine: the whole backlog as ONE device
     program (schedule_windows: lax.scan over capacity-carrying windows).
     Throughput is measured pipelined — REPS backlogs enqueued back-to-back,
@@ -101,8 +103,8 @@ def tpu_rate(snapshot, pods) -> float:
     snapshot = jax.device_put(snapshot)
     pods_w = jax.device_put(stack_windows(pad_pod_batch(pods, n_padded), WINDOW))
 
-    kw = dict(assigner="auction", fused=FUSED, affinity_aware=False,
-              auction_price_frac=PRICE_FRAC)
+    kw = dict(assigner="auction", fused=FUSED, affinity_aware=affinity_aware,
+              auction_price_frac=PRICE_FRAC if price_frac is None else price_frac)
     out = schedule_windows(snapshot, pods_w, **kw)
     # int() readback forces completion — on a tunneled device
     # block_until_ready alone does not synchronize
@@ -170,6 +172,36 @@ def native_rate(name: str, cfg: dict) -> dict:
     }
 
 
+def _mean_chosen_score(snapshot, pods_flat, idx_flat, policy) -> float:
+    """Mean min-max-normalized policy score (0-100) of the assigned
+    pods' chosen nodes — the in-data quality measure beside raw assigned
+    counts. Not on the timed path; computed in pod CHUNKS because the
+    card policy's score intermediates are [p, n, c, 6] (full-batch at
+    10k x 10k exhausts HBM)."""
+    import jax.numpy as jnp
+    from kubernetes_scheduler_tpu.engine import compute_scores
+    from kubernetes_scheduler_tpu.ops.normalize import min_max_normalize
+
+    idx_all = np.asarray(idx_flat).reshape(-1)
+    mask_all = np.asarray(pods_flat.pod_mask)
+    p = mask_all.shape[0]
+    chunk = 256
+    total, count = 0.0, 0
+    for lo in range(0, p, chunk):
+        hi = min(lo + chunk, p)
+        sub = type(pods_flat)(*[np.asarray(a)[lo:hi] for a in pods_flat])
+        raw = compute_scores(snapshot, sub, policy)
+        norm = min_max_normalize(raw, snapshot.node_mask)
+        idx = jnp.asarray(idx_all[lo:hi])
+        ok = (idx >= 0) & jnp.asarray(mask_all[lo:hi])
+        take = jnp.take_along_axis(
+            norm, jnp.clip(idx, 0, norm.shape[1] - 1)[:, None], axis=1
+        )[:, 0]
+        total += float(jnp.where(ok, take, 0.0).sum())
+        count += int(ok.sum())
+    return total / max(count, 1)
+
+
 def suite_rate(name: str) -> dict:
     """One BASELINE.md config end-to-end: pods/s on the batch engine and
     the vs-baseline ratio, with the same windowed schedule_windows program
@@ -199,15 +231,17 @@ def suite_rate(name: str) -> dict:
     # masks + conflict eviction), so constraint configs use it too;
     # selector-free configs skip the dynamic machinery entirely
     assigner = "auction"
+    policy = "card" if cfg.get("gpu") else "balanced_cpu_diskio"
     affinity_aware = bool(cfg.get("constraints"))
     fused = FUSED and not cfg.get("gpu")  # card policy has no fused kernel
     snapshot = jax.device_put(snapshot)
-    pods_w = jax.device_put(stack_windows(pad_pod_batch(pods, n_padded), window))
+    pods_flat = pad_pod_batch(pods, n_padded)
+    pods_w = jax.device_put(stack_windows(pods_flat, window))
 
-    def run():
+    def run(which=assigner):
         return schedule_windows(
-            snapshot, pods_w, assigner=assigner, fused=fused,
-            policy="card" if cfg.get("gpu") else "balanced_cpu_diskio",
+            snapshot, pods_w, assigner=which, fused=fused,
+            policy=policy,
             affinity_aware=affinity_aware,
             auction_price_frac=PRICE_FRAC,
         )
@@ -223,6 +257,12 @@ def suite_rate(name: str) -> dict:
     dt = time.perf_counter() - t0
     rate = reps * n_pods / dt
     base = baseline_rate(snapshot, pods)
+    # quality oracle (untimed): greedy on the SAME matrices settles
+    # whether an assigned-count shortfall is genuine infeasibility
+    # (greedy strands them too) or auction quality loss, and the mean
+    # chosen score compares placement quality in-data
+    gout = run("greedy")
+    g_assigned = int(gout.n_assigned)
     return {
         "config": name,
         "pods": n_pods,
@@ -231,6 +271,14 @@ def suite_rate(name: str) -> dict:
         "assigned": assigned,
         "pods_per_sec": round(rate, 1),
         "vs_baseline": round(rate / base, 2),
+        "assigned_greedy": g_assigned,
+        "auction_vs_greedy_assigned": round(assigned / max(g_assigned, 1), 4),
+        "mean_score_auction": round(
+            _mean_chosen_score(snapshot, pods_flat, out.node_idx, policy), 2
+        ),
+        "mean_score_greedy": round(
+            _mean_chosen_score(snapshot, pods_flat, gout.node_idx, policy), 2
+        ),
     }
 
 
@@ -380,6 +428,25 @@ def main():
     pods = gen_pods(N_PODS, seed=1)
 
     base = baseline_rate(snapshot, pods)
+    # the deployed-default configuration (quality-first price step 1/16,
+    # dynamic affinity on) measured BESIDE the throughput-first headline
+    # — round-3 verdict: the shipped default's number belongs next to the
+    # headline, not only in PARITY.md. Emitted first; the driver records
+    # the LAST line as the headline metric.
+    dep = tpu_rate(
+        snapshot, pods, price_frac=1.0 / 16.0, affinity_aware=True
+    )
+    print(
+        json.dumps(
+            {
+                "metric": f"scheduling_throughput_{N_NODES}nodes_deployed_default",
+                "value": round(dep, 1),
+                "unit": "pods/s",
+                "vs_baseline": round(dep / base, 2),
+            }
+        ),
+        flush=True,
+    )
     tpu = tpu_rate(snapshot, pods)
     print(
         json.dumps(

@@ -290,6 +290,12 @@ def cmd_sidecar(args) -> int:
     argv = ["--port", str(args.port)]
     if args.mesh_devices:
         argv += ["--mesh-devices", str(args.mesh_devices)]
+        argv += ["--assigner", args.assigner]
+        if args.assigner == "auction":
+            argv += [
+                "--auction-rounds", str(args.auction_rounds),
+                "--auction-price-frac", str(args.auction_price_frac),
+            ]
     return server.main(argv)
 
 
@@ -371,6 +377,13 @@ def build_parser() -> argparse.ArgumentParser:
     pc = sub.add_parser("sidecar", help="run the gRPC engine server")
     pc.add_argument("--port", type=int, default=50051)
     pc.add_argument("--mesh-devices", type=int, default=0)
+    pc.add_argument(
+        "--assigner", default="greedy", choices=["greedy", "auction"],
+        help="assignment algorithm baked into the sharded engine "
+        "(mesh mode only; the dense engine honors per-request assigners)",
+    )
+    pc.add_argument("--auction-rounds", type=int, default=1024)
+    pc.add_argument("--auction-price-frac", type=float, default=1.0 / 16.0)
     pc.set_defaults(fn=cmd_sidecar)
 
     pb = sub.add_parser("bench", help="run the throughput benchmark")

@@ -503,7 +503,23 @@ class Scheduler:
                 and _pod_key(pd) not in self._pending_evictions
             ]
             for pdb in pdbs:
-                budgets.append(pdb.allowed(sum(1 for pd in real if pdb.selects(pd))))
+                allowed = pdb.allowed(sum(1 for pd in real if pdb.selects(pd)))
+                if pdb.disruptions_allowed is not None:
+                    # the server-computed status predates our in-flight
+                    # evictions (informer/TTL lag): a victim still
+                    # terminating must be charged against it, or two
+                    # consecutive cycles spend the same budget (ADVICE
+                    # r3). The spec-math path needs no correction — its
+                    # healthy count (`real`) already excludes
+                    # pending-eviction victims.
+                    pending_matching = sum(
+                        1
+                        for pd in running
+                        if _pod_key(pd) in self._pending_evictions
+                        and pdb.selects(pd)
+                    )
+                    allowed = max(0, allowed - pending_matching)
+                budgets.append(allowed)
             for i, pd in enumerate(running):
                 sel = [b for b, pdb in enumerate(pdbs) if pdb.selects(pd)]
                 if sel:

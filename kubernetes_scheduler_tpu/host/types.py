@@ -152,7 +152,16 @@ class PodDisruptionBudget:
         return labels_match(pod.labels, self.match_labels, self.match_expressions)
 
     def allowed(self, matching_count: int) -> int:
-        """Evictions this budget permits given the current healthy count."""
+        """Evictions this budget permits given the current healthy count.
+
+        Documented deviation (ADVICE r3): a percentage `minAvailable`
+        without a server-computed status resolves against the CURRENT
+        matching count, not the controller's expected replica count
+        (which would need a controller lookup this scheduler does not
+        do) — with replicas already down this over-allows evictions
+        (e.g. 50% of 10 replicas with 6 healthy: k8s allows 1, this
+        allows 3). Real clusters are unaffected: the PDB controller
+        maintains status.disruptionsAllowed, which takes precedence."""
         if self.disruptions_allowed is not None:
             return max(0, int(self.disruptions_allowed))
 
@@ -208,6 +217,33 @@ class Pod:
     # newest, i.e. least important, in preemption victim ordering —
     # upstream GetPodStartTime's nil-means-now stance)
     start_time: float | None = None
+    # PVC claim names referenced by spec.volumes (kube/volumes resolves
+    # bound claims' PV topology into node_affinity before scheduling)
+    volume_claims: list[str] = field(default_factory=list)
+
+
+@dataclass
+class PersistentVolume:
+    """The scheduling-relevant slice of a PV: its node-affinity terms
+    (spec.nodeAffinity.required — OR of AND-lists, local volumes) plus
+    zone/region topology labels (legacy VolumeZone semantics), already
+    folded into `terms` by kube/convert.pv_from_api. A pod bound to this
+    PV may only run on nodes satisfying some term."""
+
+    name: str
+    terms: list[list[MatchExpression]] = field(default_factory=list)
+
+
+@dataclass
+class PersistentVolumeClaim:
+    """PVC binding state: volume_name is set once the claim is Bound.
+    An unbound claim (WaitForFirstConsumer, or still pending binding)
+    contributes no scheduling constraint — the volume follows the pod
+    (constrain-at-bind), upstream VolumeBinding's WFFC stance."""
+
+    namespace: str
+    name: str
+    volume_name: str | None = None
 
 
 @dataclass

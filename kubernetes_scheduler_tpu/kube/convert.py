@@ -29,6 +29,8 @@ from kubernetes_scheduler_tpu.host.types import (
     Container,
     MatchExpression,
     Node,
+    PersistentVolume,
+    PersistentVolumeClaim,
     Pod,
     PodAffinityTerm,
     SpreadConstraint,
@@ -171,6 +173,11 @@ def pod_from_api(obj: dict) -> Pod:
         for p in c.get("ports") or []
         if p.get("hostPort")
     ]
+    volume_claims = [
+        v["persistentVolumeClaim"]["claimName"]
+        for v in spec.get("volumes") or []
+        if (v.get("persistentVolumeClaim") or {}).get("claimName")
+    ]
     node_name = spec.get("nodeName") or None
     status = obj.get("status") or {}
     phase = status.get("phase", "")
@@ -220,6 +227,53 @@ def pod_from_api(obj: dict) -> Pod:
         node_name=node_name,
         scheduler_name=spec.get("schedulerName", "default-scheduler"),
         start_time=start_time,
+        volume_claims=volume_claims,
+    )
+
+
+# topology labels the VolumeZone family matches between a PV and nodes
+_ZONE_LABELS = (
+    "topology.kubernetes.io/zone",
+    "topology.kubernetes.io/region",
+    "failure-domain.beta.kubernetes.io/zone",
+    "failure-domain.beta.kubernetes.io/region",
+)
+
+
+def pv_from_api(obj: dict) -> PersistentVolume:
+    """PV -> scheduling constraint: spec.nodeAffinity.required terms
+    (local volumes) with the PV's zone/region labels (VolumeZone) ANDed
+    into every term — exactly how the pod-side OR-of-ANDs conversion
+    treats nodeSelector. A PV with neither contributes no constraint."""
+    meta = obj.get("metadata") or {}
+    spec = obj.get("spec") or {}
+    raw_terms = (
+        (spec.get("nodeAffinity") or {}).get("required") or {}
+    ).get("nodeSelectorTerms") or []
+    terms: list[list[MatchExpression]] = []
+    for t in raw_terms:
+        exprs = [_match_expr(e) for e in t.get("matchExpressions") or []]
+        if not exprs:
+            exprs = [MatchExpression(key="", operator="In", values=[])]
+        terms.append(exprs)
+    zone_exprs = [
+        MatchExpression(key=k, operator="In", values=[v])
+        for k, v in (meta.get("labels") or {}).items()
+        if k in _ZONE_LABELS
+    ]
+    if zone_exprs:
+        terms = (
+            [t + zone_exprs for t in terms] if terms else [zone_exprs]
+        )
+    return PersistentVolume(name=meta.get("name", ""), terms=terms)
+
+
+def pvc_from_api(obj: dict) -> PersistentVolumeClaim:
+    meta = obj.get("metadata") or {}
+    return PersistentVolumeClaim(
+        namespace=meta.get("namespace", "default"),
+        name=meta.get("name", ""),
+        volume_name=(obj.get("spec") or {}).get("volumeName") or None,
     )
 
 

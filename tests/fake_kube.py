@@ -30,6 +30,8 @@ class FakeKube:
         self.deleted: list[str] = []        # "ns/name" DELETE log
         self.leases: dict[str, dict] = {}   # "ns/name" -> lease object
         self.pdbs: list[dict] = []          # policy/v1 PDB objects
+        self.pvcs: list[dict] = []          # v1 PersistentVolumeClaims
+        self.pvs: list[dict] = []           # v1 PersistentVolumes
         self.bindings: list[tuple[str, str]] = []
         # node -> {cpu_pct, mem_pct, disk_io, net_up, net_down}: served
         # Prometheus-style from POST /api/v1/query so one fixture covers
@@ -139,6 +141,12 @@ class FakeKube:
                 if path == "/apis/policy/v1/poddisruptionbudgets":
                     with fake.lock:
                         return self._send(200, {"items": list(fake.pdbs)})
+                if path == "/api/v1/persistentvolumeclaims":
+                    with fake.lock:
+                        return self._send(200, {"items": list(fake.pvcs)})
+                if path == "/api/v1/persistentvolumes":
+                    with fake.lock:
+                        return self._send(200, {"items": list(fake.pvs)})
                 m = _LEASE_RE.match(path)
                 if m and m.group(2):
                     with fake.lock:

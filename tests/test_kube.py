@@ -647,6 +647,182 @@ def test_informer_cache_sync_and_assume(fake):
         cache.stop()
 
 
+def test_volume_topology_zonal_pv_constrains_pod(fake):
+    """A pod whose PVC is Bound to a zonal PV may only land in the PV's
+    zone (upstream VolumeZone via the embedded scheduler,
+    /root/reference/go.mod:13): the source folds the PV's topology into
+    the pod's node affinity, and the engine binds only in-zone."""
+    from kubernetes_scheduler_tpu.host import Scheduler, StaticAdvisor
+    from kubernetes_scheduler_tpu.host.advisor import NodeUtil
+    from kubernetes_scheduler_tpu.utils.config import SchedulerConfig
+
+    fake.pvs.append({
+        "metadata": {
+            "name": "pv-za",
+            "labels": {"topology.kubernetes.io/zone": "za"},
+        },
+        "spec": {},
+    })
+    fake.pvcs.append({
+        "metadata": {"name": "data", "namespace": "default"},
+        "spec": {"volumeName": "pv-za"},
+    })
+    fake.add_pod({
+        "metadata": {"name": "zonal"},
+        "spec": {
+            "schedulerName": "yoda-tpu",
+            "containers": [{"resources": {"requests": {"cpu": "100m"}}}],
+            "volumes": [{"persistentVolumeClaim": {"claimName": "data"}}],
+        },
+        "status": {"phase": "Pending"},
+    })
+    src = KubeClusterSource(client_for(fake), scheduler_name="yoda-tpu")
+    pods = src.list_pending_pods()
+    assert len(pods) == 1
+    pod = pods[0]
+    assert pod.volume_claims == ["data"]
+    assert any(
+        e.key == "topology.kubernetes.io/zone" and e.values == ["za"]
+        for e in pod.node_affinity
+    ), pod.node_affinity
+
+    from kubernetes_scheduler_tpu.host.types import Node
+
+    nodes = [
+        Node(name="in-zone", labels={"topology.kubernetes.io/zone": "za"},
+             allocatable={"cpu": 8000.0, "memory": 2**33, "pods": 100}),
+        Node(name="out-zone", labels={"topology.kubernetes.io/zone": "zb"},
+             allocatable={"cpu": 8000.0, "memory": 2**33, "pods": 100}),
+    ]
+    utils = {n.name: NodeUtil(cpu_pct=10, disk_io=5) for n in nodes}
+    sched = Scheduler(
+        SchedulerConfig(batch_window=8, min_device_work=0,
+                        adaptive_dispatch=False),
+        advisor=StaticAdvisor(utils),
+        list_nodes=lambda: nodes,
+        list_running_pods=lambda: [],
+    )
+    sched.submit(pod)
+    m = sched.run_cycle()
+    assert m.pods_bound == 1
+    assert sched.binder.bindings[0].node_name == "in-zone"
+
+
+def test_volume_topology_unbound_wffc_and_cross_product(fake):
+    """An unbound claim (WaitForFirstConsumer) contributes no constraint;
+    a local PV's OR terms conjoin with the pod's own OR terms via the
+    cross product."""
+    from kubernetes_scheduler_tpu.host.types import MatchExpression, Pod
+    from kubernetes_scheduler_tpu.kube.convert import pv_from_api
+    from kubernetes_scheduler_tpu.kube.volumes import fold_volume_terms
+
+    # unbound claim through the live source: no constraint added
+    fake.pvcs.append({
+        "metadata": {"name": "wffc", "namespace": "default"},
+        "spec": {},
+    })
+    fake.add_pod({
+        "metadata": {"name": "waiter"},
+        "spec": {
+            "schedulerName": "yoda-tpu",
+            "containers": [{}],
+            "volumes": [{"persistentVolumeClaim": {"claimName": "wffc"}}],
+        },
+        "status": {"phase": "Pending"},
+    })
+    src = KubeClusterSource(client_for(fake), scheduler_name="yoda-tpu")
+    (pod,) = src.list_pending_pods()
+    assert pod.node_affinity == []
+
+    # cross product: pod (zone a OR zone b) AND pv (host h1 OR host h2)
+    pv = pv_from_api({
+        "metadata": {"name": "local-pv"},
+        "spec": {"nodeAffinity": {"required": {"nodeSelectorTerms": [
+            {"matchExpressions": [
+                {"key": "kubernetes.io/hostname", "operator": "In",
+                 "values": ["h1"]}]},
+            {"matchExpressions": [
+                {"key": "kubernetes.io/hostname", "operator": "In",
+                 "values": ["h2"]}]},
+        ]}}},
+    })
+    base = Pod(name="p", node_affinity=[
+        MatchExpression(key="zone", operator="In", values=["a"], term=0),
+        MatchExpression(key="zone", operator="In", values=["b"], term=1),
+    ])
+    folded = fold_volume_terms(base, [pv.terms])
+    groups: dict[int, set] = {}
+    for e in folded.node_affinity:
+        groups.setdefault(e.term, set()).add((e.key, tuple(e.values)))
+    assert len(groups) == 4  # 2 pod terms x 2 pv terms
+    assert {("zone", ("a",)), ("kubernetes.io/hostname", ("h1",))} in [
+        set(g) for g in groups.values()
+    ]
+    assert {("zone", ("b",)), ("kubernetes.io/hostname", ("h2",))} in [
+        set(g) for g in groups.values()
+    ]
+
+
+def test_informer_cache_serves_pdbs(fake):
+    """PDBs ride the informer like nodes/pods: list_pdbs with a cache
+    attached reads the watch-fed store — no per-preemption-pass LIST —
+    and new budgets appear without a TTL wait."""
+    from kubernetes_scheduler_tpu.kube.source import InformerCache
+
+    def pdb_obj(name):
+        return {
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {"selector": {"matchLabels": {"app": name}},
+                     "minAvailable": 1},
+            "status": {"disruptionsAllowed": 1},
+        }
+
+    fake.pdbs.append(pdb_obj("db"))
+    cache = InformerCache(client_for(fake), watch_timeout=2).start()
+    try:
+        assert cache.wait_synced(timeout=10)
+        assert [b.name for b in cache.pdbs()] == ["db"]
+        source = KubeClusterSource(client_for(fake), cache=cache)
+        got = source.list_pdbs()
+        assert [b.name for b in got] == ["db"]
+        assert got[0].disruptions_allowed == 1
+        # a budget created later reaches the cache via relist/watch
+        fake.pdbs.append(pdb_obj("web"))
+        deadline = time.time() + 10
+        while len(cache.pdbs()) < 2:
+            assert time.time() < deadline, "new PDB never reached the cache"
+            time.sleep(0.05)
+        assert {b.name for b in source.list_pdbs()} == {"db", "web"}
+    finally:
+        cache.stop()
+
+
+def test_informer_pdb_403_does_not_block_sync(fake, monkeypatch):
+    """An RBAC gap on the OPTIONAL PDB resource (403) must not hang
+    wait_synced or spam error backoff — the scheduler starts with an
+    empty budget set (review finding r4)."""
+    from kubernetes_scheduler_tpu.kube.client import KubeApiError
+    from kubernetes_scheduler_tpu.kube.source import InformerCache
+
+    fake.add_node(make_node_obj("n0"))
+    client = client_for(fake)
+    real = client.list_with_rv
+
+    def forbidden(path, params=None):
+        if "poddisruptionbudgets" in path:
+            raise KubeApiError(403, "GET", path, "forbidden")
+        return real(path, params)
+
+    monkeypatch.setattr(client, "list_with_rv", forbidden)
+    cache = InformerCache(client, watch_timeout=2).start()
+    try:
+        assert cache.wait_synced(timeout=10)
+        assert cache.pdbs() == []
+        assert [n.name for n in cache.nodes()] == ["n0"]
+    finally:
+        cache.stop()
+
+
 def test_cli_kube_uses_informer_cache(fake, capsys, tmp_path):
     """The CLI kube path schedules from the informer cache (running pod
     on the server consumes capacity seen by the cycle)."""

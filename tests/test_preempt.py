@@ -632,6 +632,45 @@ def test_host_pdb_budget_caps_evictions_across_proposals():
     assert len(ev.evictions) == 1
 
 
+def test_host_pdb_status_not_overdrawn_across_cycles():
+    """A server-computed status.disruptionsAllowed is stale while a
+    victim is still terminating: the NEXT cycle must charge the pending
+    eviction against it instead of spending the same budget twice
+    (ADVICE r3 medium — the never-overdraw guarantee held only on the
+    spec-math path)."""
+    from kubernetes_scheduler_tpu.host import NodeUtil, RecordingEvictor
+    from kubernetes_scheduler_tpu.host.types import PodDisruptionBudget
+    from tests.test_host import make_node, make_pod
+
+    nodes = [make_node("n0", cpu=1000), make_node("n1", cpu=1000)]
+    utils = {n.name: NodeUtil(cpu_pct=10, disk_io=5) for n in nodes}
+    v0 = make_pod("v0", cpu=900, labels={"scv/priority": "1", "app": "db"})
+    v0.node_name = "n0"
+    v1 = make_pod("v1", cpu=900, labels={"scv/priority": "1", "app": "db"})
+    v1.node_name = "n1"
+    running = [v0, v1]
+    # server-computed status: exactly one disruption allowed, and (being
+    # a snapshot) it stays 1 across our cycles
+    pdbs = [PodDisruptionBudget("db-pdb", match_labels={"app": "db"},
+                                disruptions_allowed=1)]
+    ev = RecordingEvictor()
+    s = _sched(nodes, utils, running, evictor=ev)
+    s.list_pdbs = lambda: pdbs
+    s.submit(make_pod("u1", cpu=800, labels={"scv/priority": "9"},
+                      annotations={"diskIO": "2"}))
+    m1 = s.run_cycle()
+    assert m1.victims_evicted == 1 and len(ev.evictions) == 1
+
+    # the victim is still terminating (stays in `running`); a second
+    # preemptor must NOT spend the same stale budget on the other victim
+    s.queue._clock = lambda: 1e9  # clear backoffs
+    s.submit(make_pod("u2", cpu=800, labels={"scv/priority": "8"},
+                      annotations={"diskIO": "2"}))
+    m2 = s.run_cycle()
+    assert m2.victims_evicted == 0, "stale status budget spent twice"
+    assert len(ev.evictions) == 1
+
+
 def test_host_taints_exclude_preemption_candidates():
     from kubernetes_scheduler_tpu.host import RecordingEvictor
     from kubernetes_scheduler_tpu.host.types import Taint

@@ -138,6 +138,10 @@ class PodBatch(NamedTuple):
     pna_val_mask: jnp.ndarray      # [p, Ep, V] bool
     pna_mask: jnp.ndarray          # [p, Ep] bool
     pna_weight: jnp.ndarray        # [p, Ep] float32 term weights
+    pna_term: jnp.ndarray          # [p, Ep] int32 preferred-term group ids
+    #                                (AND within a group, weight granted
+    #                                once per satisfied group; default =
+    #                                each expression its own term)
     pref_affinity_sel: jnp.ndarray   # [p, K] int32 selector ids, -1 pad
     pref_affinity_weight: jnp.ndarray  # [p, K] float32
     pref_anti_sel: jnp.ndarray       # [p, K] int32 selector ids, -1 pad
@@ -267,6 +271,7 @@ def make_pod_batch(
     pna_val_mask=None,
     pna_mask=None,
     pna_weight=None,
+    pna_term=None,
     pref_affinity_sel=None,
     pref_affinity_weight=None,
     pref_anti_sel=None,
@@ -334,6 +339,18 @@ def make_pod_batch(
             (z(p, 1) if pna_key is None
              else jnp.ones(jnp.asarray(pna_key).shape, jnp.float32))
             if pna_weight is None else jnp.asarray(pna_weight, jnp.float32)
+        ),
+        # default: each expression its own preferred term (per-expression
+        # weighting, the pre-grouping behavior)
+        pna_term=(
+            jnp.broadcast_to(
+                jnp.arange(
+                    1 if pna_key is None else jnp.asarray(pna_key).shape[1],
+                    dtype=jnp.int32,
+                )[None, :],
+                (p, 1 if pna_key is None else jnp.asarray(pna_key).shape[1]),
+            )
+            if pna_term is None else jnp.asarray(pna_term, jnp.int32)
         ),
         pref_affinity_sel=jnp.full((p, 1), -1, jnp.int32) if pref_affinity_sel is None else jnp.asarray(pref_affinity_sel, jnp.int32),
         pref_affinity_weight=(
@@ -524,7 +541,7 @@ def compute_soft_scores(
     na = node_affinity_preference(
         snapshot.node_labels, snapshot.node_label_mask,
         pods.pna_key, pods.pna_op, pods.pna_vals, pods.pna_val_mask,
-        pods.pna_mask, pods.pna_weight,
+        pods.pna_mask, pods.pna_weight, pods.pna_term,
     )
     pa = pod_affinity_preference(
         snapshot.domain_counts,

@@ -10,6 +10,7 @@ constraint matching runs as integer tensor compares on device.
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -25,6 +26,8 @@ from kubernetes_scheduler_tpu.ops.resources import (
     N_CANONICAL,
 )
 from kubernetes_scheduler_tpu.utils.padding import bucket_size
+
+log = logging.getLogger("yoda_tpu.host")
 
 _EFFECTS = {
     "NoSchedule": C.NO_SCHEDULE,
@@ -227,14 +230,34 @@ class SnapshotBuilder:
                 )
                 taint_mask[i, j] = True
 
-        # labels
-        l_max = bucket_size(max((len(nd.labels) for nd in nodes), default=0), floor=1, multiple=1)
+        # labels — plus one synthetic `metadata.name` entry per node, so
+        # node-affinity matchFields (upstream: metadata.name selectors)
+        # evaluate through the ordinary label-expression kernel
+        l_max = bucket_size(
+            max((len(nd.labels) for nd in nodes), default=0) + 1,
+            floor=1, multiple=1,
+        )
         labels = np.zeros((n, l_max, 2), np.int32)
         label_mask = np.zeros((n, l_max), bool)
+        name_key = self.label_keys.id("metadata.name")
         for i, nd in enumerate(nodes):
-            for j, (k, v) in enumerate(nd.labels.items()):
+            labels[i, 0] = (name_key, self.label_values.id(nd.name))
+            label_mask[i, 0] = True
+            j = 1
+            for k, v in nd.labels.items():
+                if k == "metadata.name":
+                    # reserved for the synthetic field entry: a USER label
+                    # under this (syntactically legal) key would satisfy
+                    # matchFields selectors upstream only reads from the
+                    # object field — skip it, loudly
+                    log.warning(
+                        "node %s: ignoring label 'metadata.name' "
+                        "(reserved for matchFields)", nd.name,
+                    )
+                    continue
                 labels[i, j] = (self.label_keys.id(k), self.label_values.id(v))
                 label_mask[i, j] = True
+                j += 1
 
         (domain_counts, domain_id, avoid_counts,
          pref_attract, pref_avoid) = self._domain_counts(
@@ -457,6 +480,8 @@ class SnapshotBuilder:
         pna_val_mask = np.zeros((p, ep_max, pv_max), bool)
         pna_mask = np.zeros((p, ep_max), bool)
         pna_weight = np.zeros((p, ep_max), np.float32)
+        # default: every expression its own preferred term
+        pna_term = np.tile(np.arange(ep_max, dtype=np.int32), (p, 1))
 
         names_t = tuple(names)
         pods_col = names.index("pods")
@@ -525,12 +550,26 @@ class SnapshotBuilder:
                     (pref_anti_w if term.anti else pref_aff_w)[i, j] = term.weight
                 else:
                     (anti if term.anti else aff)[i, j] = sid
+            # preferred-term group ids re-densified per pod: distinct
+            # caller ids map to distinct dense ids (each expression
+            # introduces at most one new group, so ids stay < ep_max —
+            # a clamp would silently MERGE independent terms)
+            pref_groups: dict[int, int] = {}
+            next_gid = 0
             for j, wexpr in enumerate(pod.preferred_node_affinity):
                 e = wexpr.expr
                 pna_key[i, j] = self.label_keys.id(e.key)
                 pna_op[i, j] = _NA_OPS[e.operator]
                 pna_mask[i, j] = True
                 pna_weight[i, j] = wexpr.weight
+                if wexpr.term is None:
+                    pna_term[i, j] = next_gid
+                    next_gid += 1
+                else:
+                    if wexpr.term not in pref_groups:
+                        pref_groups[wexpr.term] = next_gid
+                        next_gid += 1
+                    pna_term[i, j] = pref_groups[wexpr.term]
                 for q, v in enumerate(e.values):
                     pna_vals[i, j, q] = self.label_values.id(v)
                     pna_val_mask[i, j, q] = True
@@ -555,7 +594,8 @@ class SnapshotBuilder:
             anti_affinity_sel=anti, pod_matches=pod_matches,
             pna_key=pna_key, pna_op=pna_op, pna_vals=pna_vals,
             pna_val_mask=pna_val_mask, pna_mask=pna_mask,
-            pna_weight=pna_weight, pref_affinity_sel=pref_aff,
+            pna_weight=pna_weight, pna_term=pna_term,
+            pref_affinity_sel=pref_aff,
             pref_affinity_weight=pref_aff_w, pref_anti_sel=pref_anti,
             pref_anti_weight=pref_anti_w, target_node=target_node,
             spread_sel=spread_sel, spread_max=spread_max,

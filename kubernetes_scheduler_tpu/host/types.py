@@ -187,6 +187,10 @@ class WeightedExpression:
 
     expr: MatchExpression
     weight: int = 1
+    # preferred-term group id: upstream preferred terms are weighted
+    # AND-lists; expressions sharing a term id must ALL match for the
+    # weight to be granted once. None = this expression is its own term.
+    term: int | None = None
 
 
 @dataclass

@@ -8,9 +8,9 @@ canonicalized the way the snapshot builder expects (cpu in millicores,
 memory/storage in bytes, counts as floats).
 
 Documented simplifications (each is a capability note, not an accident):
-- node-affinity `matchFields` (metadata.name selectors) are not
-  supported; `matchExpressions` carry full upstream OR-of-ANDs term
-  semantics (see pod_from_api).
+- node-affinity `matchExpressions` AND `matchFields` (metadata.name
+  selectors — the snapshot synthesizes a `metadata.name` label per
+  node) carry full upstream OR-of-ANDs term semantics (pod_from_api).
 - pod-(anti)affinity and spread label selectors support matchLabels AND
   matchExpressions (host/types.labels_match); spread carries both
   whenUnsatisfiable modes (DoNotSchedule hard, ScheduleAnyway soft).
@@ -130,7 +130,14 @@ def pod_from_api(obj: dict) -> Pod:
     required: list[MatchExpression] = []
     if terms:
         for t_i, term in enumerate(terms):
-            t_exprs = [_match_expr(e) for e in term.get("matchExpressions") or []]
+            # matchFields (metadata.name selectors) evaluate through the
+            # same expression kernel: the snapshot synthesizes a
+            # `metadata.name` label per node
+            t_exprs = [
+                _match_expr(e)
+                for e in (term.get("matchExpressions") or [])
+                + (term.get("matchFields") or [])
+            ]
             if not t_exprs:
                 t_exprs = [MatchExpression(key="", operator="In", values=[])]
             t_exprs += [
@@ -142,11 +149,29 @@ def pod_from_api(obj: dict) -> Pod:
                 required.append(e)
     else:
         required = ns_exprs
-    preferred = [
-        WeightedExpression(expr=_match_expr(e), weight=int(wt.get("weight", 1)))
-        for wt in node_aff.get("preferredDuringSchedulingIgnoredDuringExecution") or []
-        for e in (wt.get("preference") or {}).get("matchExpressions") or []
-    ]
+    # preferred terms keep upstream weighted-AND-list semantics: every
+    # expression of a preference entry shares one group id, so the weight
+    # is granted once iff the whole entry matches. Group ids are DENSE
+    # over non-empty entries (an empty entry must not shift later ids
+    # past the builder's expression-count bound)
+    preferred: list[WeightedExpression] = []
+    t_dense = 0
+    for wt in node_aff.get("preferredDuringSchedulingIgnoredDuringExecution") or []:
+        pref = wt.get("preference") or {}
+        exprs = (pref.get("matchExpressions") or []) + (
+            pref.get("matchFields") or []
+        )
+        if not exprs:
+            continue
+        for e in exprs:
+            preferred.append(
+                WeightedExpression(
+                    expr=_match_expr(e),
+                    weight=int(wt.get("weight", 1)),
+                    term=t_dense,
+                )
+            )
+        t_dense += 1
     spread = [
         SpreadConstraint(
             match_labels=dict(

@@ -52,19 +52,26 @@ class InformerCache:
         self._nodes: dict[str, Node] = {}
         self._pods: dict[str, Pod] = {}
         self._pdbs: dict[str, object] = {}
+        self._pvcs: dict[str, object] = {}
+        self._pvs: dict[str, object] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._synced = {
             "nodes": threading.Event(),
             "pods": threading.Event(),
             "pdbs": threading.Event(),
+            "pvcs": threading.Event(),
+            "pvs": threading.Event(),
         }
         self._threads: list[threading.Thread] = []
 
     # -- lifecycle -------------------------------------------------------
 
     def start(self) -> "InformerCache":
-        for target in (self._node_loop, self._pod_loop, self._pdb_loop):
+        for target in (
+            self._node_loop, self._pod_loop, self._pdb_loop,
+            self._pvc_loop, self._pv_loop,
+        ):
             t = threading.Thread(target=target, daemon=True)
             t.start()
             self._threads.append(t)
@@ -89,6 +96,16 @@ class InformerCache:
     def pdbs(self) -> list:
         with self._lock:
             return list(self._pdbs.values())
+
+    def pvc_map(self) -> dict:
+        """'ns/name' -> PersistentVolumeClaim, watch-fed."""
+        with self._lock:
+            return dict(self._pvcs)
+
+    def pv_map(self) -> dict:
+        """PV name -> PersistentVolume, watch-fed."""
+        with self._lock:
+            return dict(self._pvs)
 
     def assume(self, pod: Pod) -> None:
         """Record a just-bound pod before the watch echoes it back —
@@ -202,6 +219,67 @@ class InformerCache:
             elif ev.get("type") in ("ADDED", "MODIFIED"):
                 self._pdbs[key] = pdb_from_api(obj)
 
+    # -- volume loops ----------------------------------------------------
+
+    def _pvc_loop(self) -> None:
+        self._resource_loop(
+            "pvcs",
+            "/api/v1/persistentvolumeclaims",
+            params=None,
+            replace=self._replace_pvcs,
+            apply=self._apply_pvc_event,
+            optional=True,
+        )
+
+    def _pv_loop(self) -> None:
+        self._resource_loop(
+            "pvs",
+            "/api/v1/persistentvolumes",
+            params=None,
+            replace=self._replace_pvs,
+            apply=self._apply_pv_event,
+            optional=True,
+        )
+
+    def _replace_pvcs(self, items: list[dict]) -> None:
+        from kubernetes_scheduler_tpu.kube.convert import pvc_from_api
+
+        fresh = {}
+        for o in items:
+            c = pvc_from_api(o)
+            fresh[f"{c.namespace}/{c.name}"] = c
+        with self._lock:
+            self._pvcs = fresh
+
+    def _apply_pvc_event(self, ev: dict) -> None:
+        from kubernetes_scheduler_tpu.kube.convert import pvc_from_api
+
+        obj = ev.get("object") or {}
+        c = pvc_from_api(obj)
+        key = f"{c.namespace}/{c.name}"
+        with self._lock:
+            if ev.get("type") == "DELETED":
+                self._pvcs.pop(key, None)
+            elif ev.get("type") in ("ADDED", "MODIFIED"):
+                self._pvcs[key] = c
+
+    def _replace_pvs(self, items: list[dict]) -> None:
+        from kubernetes_scheduler_tpu.kube.convert import pv_from_api
+
+        fresh = {(v := pv_from_api(o)).name: v for o in items}
+        with self._lock:
+            self._pvs = fresh
+
+    def _apply_pv_event(self, ev: dict) -> None:
+        from kubernetes_scheduler_tpu.kube.convert import pv_from_api
+
+        v = pv_from_api(ev.get("object") or {})
+        with self._lock:
+            if ev.get("type") == "DELETED":
+                self._pvs.pop(v.name, None)
+            elif ev.get("type") in ("ADDED", "MODIFIED"):
+                self._pvs[v.name] = v
+
     # -- shared loop -----------------------------------------------------
 
     def _resource_loop(
@@ -307,8 +385,12 @@ class KubeClusterSource:
         self._pdb_expiry = 0.0
         # bound PVs constrain placement (VolumeZone/VolumeBinding parity):
         # the pending stream hands the scheduler pods whose node-affinity
-        # already carries their volumes' topology (kube/volumes.py)
-        self.volumes = VolumeTopology(client) if volume_topology else None
+        # already carries their volumes' topology (kube/volumes.py). With
+        # an informer cache the resolver reads its watch-fed PVC/PV
+        # stores; otherwise a TTL LIST pair
+        self.volumes = (
+            VolumeTopology(client, cache=cache) if volume_topology else None
+        )
 
     def _fold_volumes(self, pod: Pod) -> Pod:
         if self.volumes is None or not pod.volume_claims:

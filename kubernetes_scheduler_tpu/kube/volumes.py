@@ -65,16 +65,23 @@ def fold_volume_terms(
 
 
 class VolumeTopology:
-    """PVC->PV resolution with a TTL-cached cluster view.
+    """PVC->PV resolution.
 
-    Claims/volumes change orders of magnitude less often than pods
-    schedule; the TTL keeps the two cluster-wide LISTs off the per-pod
-    path. A cluster without the PV API (or RBAC for it) degrades to
-    no volume constraints, logged once per TTL."""
+    With an InformerCache attached (the CLI kube path), claims/volumes
+    come from its watch-fed stores — always current within watch lag
+    (the same currency upstream's VolumeBinding plugin gets from ITS
+    informers), and no LIST ever lands on the pending-pod path. Without
+    one, a TTL-cached pair of cluster-wide LISTs serves the same facts
+    (errors keep stale data and retry after a short backoff, never a
+    full TTL of flying blind). A cluster without the PV API (or RBAC
+    for it) degrades to no volume constraints."""
 
-    def __init__(self, client: KubeClient, *, ttl: float = 30.0):
+    ERROR_RETRY_SECONDS = 5.0
+
+    def __init__(self, client: KubeClient, *, ttl: float = 30.0, cache=None):
         self.client = client
         self.ttl = ttl
+        self.cache = cache
         self._pvcs: dict[str, object] = {}
         self._pvs: dict[str, object] = {}
         self._expiry = 0.0
@@ -83,16 +90,20 @@ class VolumeTopology:
         now = time.monotonic()
         if now < self._expiry:
             return
-        self._expiry = now + self.ttl
         try:
             pvcs = self.client.list_all("/api/v1/persistentvolumeclaims")
             pvs = self.client.list_all("/api/v1/persistentvolumes")
         except KubeApiError as e:
+            # keep whatever view we have; re-probe soon (a full TTL of
+            # no-constraints after a transient blip risks out-of-zone
+            # binds the kubelet then rejects)
+            self._expiry = now + self.ERROR_RETRY_SECONDS
             log.warning(
-                "volume topology unavailable (%s); pods schedule without "
-                "PV constraints until the next probe", e,
+                "volume topology LIST failed (%s); keeping the previous "
+                "view and retrying in %.0fs", e, self.ERROR_RETRY_SECONDS,
             )
             return
+        self._expiry = now + self.ttl
         fresh_pvcs = {}
         for o in pvcs:
             c = pvc_from_api(o)
@@ -102,19 +113,25 @@ class VolumeTopology:
             (v := pv_from_api(o)).name: v for o in pvs
         }
 
+    def _maps(self) -> tuple[dict, dict]:
+        if self.cache is not None:
+            return self.cache.pvc_map(), self.cache.pv_map()
+        self._refresh()
+        return self._pvcs, self._pvs
+
     def fold(self, pod: Pod) -> Pod:
         """Pod with every bound claim's PV topology ANDed into its
         node-affinity requirement; claims that are unbound (WFFC) or
         reference unknown volumes contribute nothing."""
         if not pod.volume_claims:
             return pod
-        self._refresh()
+        pvcs, pvs = self._maps()
         term_sets = []
         for claim in pod.volume_claims:
-            pvc = self._pvcs.get(f"{pod.namespace}/{claim}")
+            pvc = pvcs.get(f"{pod.namespace}/{claim}")
             if pvc is None or not pvc.volume_name:
                 continue  # unbound: constrain-at-bind
-            pv = self._pvs.get(pvc.volume_name)
+            pv = pvs.get(pvc.volume_name)
             if pv is not None and pv.terms:
                 term_sets.append(pv.terms)
         return fold_volume_terms(pod, term_sets)

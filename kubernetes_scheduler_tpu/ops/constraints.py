@@ -209,17 +209,43 @@ def node_affinity_preference(
     expr_val_mask: jnp.ndarray,
     expr_mask: jnp.ndarray,
     expr_weight: jnp.ndarray,
+    expr_term: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
-    """[p, n] float32: sum of weights of satisfied PREFERRED node-affinity
-    expressions (preferredDuringSchedulingIgnoredDuringExecution — upstream
-    NodeAffinity scoring; one weighted expression per term, the common
-    single-expression case of the upstream weighted-term list).
+    """[p, n] float32: PREFERRED node-affinity scoring with upstream
+    weighted-term semantics (preferredDuringSchedulingIgnoredDuring-
+    Execution): each term is an AND-list of expressions sharing a group
+    id, and its weight is granted ONCE iff every expression matches.
+
+    expr_term: [p, E] int32 group ids in [0, E). None = each expression
+    its own term (the single-expression-per-term common case, where
+    per-expression and per-term weighting coincide).
     """
     ok = _expressions_satisfied(
         node_labels, node_label_mask, expr_key, expr_op, expr_vals, expr_val_mask
     )
-    w = jnp.where(expr_mask, expr_weight.astype(jnp.float32), 0.0)  # [p, E]
-    return (ok * w[:, :, None]).sum(1)  # [p, n]
+    if expr_term is None:
+        w = jnp.where(expr_mask, expr_weight.astype(jnp.float32), 0.0)  # [p, E]
+        return (ok * w[:, :, None]).sum(1)  # [p, n]
+    e = expr_key.shape[1]
+    member = (
+        expr_term[:, :, None] == jnp.arange(e)[None, None, :]
+    ) & expr_mask[:, :, None]                                   # [p, E, G]
+    fail = expr_mask[:, :, None] & ~ok                          # [p, E, n]
+    group_fail = (
+        jnp.einsum(
+            "peg,pen->pgn",
+            member.astype(jnp.float32),
+            fail.astype(jnp.float32),
+        )
+        > 0
+    )                                                           # [p, G, n]
+    group_has = member.any(1)                                   # [p, G]
+    # weights are per-term (identical across a group's expressions)
+    group_w = jnp.where(
+        member, expr_weight.astype(jnp.float32)[:, :, None], 0.0
+    ).max(1)                                                    # [p, G]
+    sat = group_has[:, :, None] & ~group_fail
+    return (sat * group_w[:, :, None]).sum(1)                   # [p, n]
 
 
 def pod_affinity_preference(

@@ -216,6 +216,64 @@ def test_pod_from_api_or_of_ands_node_affinity():
     )
 
 
+def test_pod_from_api_preferred_term_groups():
+    """Multi-expression preferred terms convert with shared group ids:
+    the weight is granted once per fully-matching entry."""
+    obj = {
+        "metadata": {"name": "pref"},
+        "spec": {
+            "containers": [{}],
+            "affinity": {"nodeAffinity": {
+                "preferredDuringSchedulingIgnoredDuringExecution": [
+                    {"weight": 7, "preference": {"matchExpressions": [
+                        {"key": "a", "operator": "Exists"},
+                        {"key": "b", "operator": "Exists"},
+                    ]}},
+                    {"weight": 3, "preference": {"matchExpressions": [
+                        {"key": "c", "operator": "Exists"},
+                    ]}},
+                ]
+            }},
+        },
+    }
+    pod = pod_from_api(obj)
+    by_term = {}
+    for w in pod.preferred_node_affinity:
+        by_term.setdefault(w.term, []).append((w.expr.key, w.weight))
+    assert by_term == {0: [("a", 7), ("b", 7)], 1: [("c", 3)]}
+
+
+def test_pod_from_api_match_fields():
+    """matchFields convert as ordinary expressions keyed metadata.name,
+    joining the term's matchExpressions conjunct."""
+    obj = {
+        "metadata": {"name": "fields"},
+        "spec": {
+            "containers": [{}],
+            "affinity": {"nodeAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": {
+                    "nodeSelectorTerms": [{
+                        "matchExpressions": [
+                            {"key": "zone", "operator": "In", "values": ["a"]}
+                        ],
+                        "matchFields": [
+                            {"key": "metadata.name", "operator": "NotIn",
+                             "values": ["cordoned-node"]}
+                        ],
+                    }]
+                }
+            }},
+        },
+    }
+    pod = pod_from_api(obj)
+    got = {(e.key, e.operator, tuple(e.values)) for e in pod.node_affinity}
+    assert got == {
+        ("zone", "In", ("a",)),
+        ("metadata.name", "NotIn", ("cordoned-node",)),
+    }
+    assert {e.term for e in pod.node_affinity} == {0}
+
+
 def test_pod_from_api_pinned_and_running():
     pending = pod_from_api(
         {
@@ -793,6 +851,45 @@ def test_informer_cache_serves_pdbs(fake):
             assert time.time() < deadline, "new PDB never reached the cache"
             time.sleep(0.05)
         assert {b.name for b in source.list_pdbs()} == {"db", "web"}
+    finally:
+        cache.stop()
+
+
+def test_informer_serves_volumes_and_fold_uses_them(fake):
+    """PVCs/PVs ride the informer: the volume fold reads the watch-fed
+    stores (no LIST on the pending-pod path), and a PVC that binds later
+    reaches the fold without a TTL wait."""
+    from kubernetes_scheduler_tpu.kube.source import InformerCache
+
+    fake.pvs.append({
+        "metadata": {"name": "pv-za",
+                     "labels": {"topology.kubernetes.io/zone": "za"}},
+        "spec": {},
+    })
+    fake.pvcs.append({
+        "metadata": {"name": "data", "namespace": "default"},
+        "spec": {"volumeName": "pv-za"},
+    })
+    fake.add_pod({
+        "metadata": {"name": "zonal"},
+        "spec": {"schedulerName": "yoda-tpu", "containers": [{}],
+                 "volumes": [{"persistentVolumeClaim": {"claimName": "data"}}]},
+        "status": {"phase": "Pending"},
+    })
+    cache = InformerCache(client_for(fake), watch_timeout=2).start()
+    try:
+        assert cache.wait_synced(timeout=10)
+        assert "default/data" in cache.pvc_map()
+        assert "pv-za" in cache.pv_map()
+        src = KubeClusterSource(
+            client_for(fake), scheduler_name="yoda-tpu", cache=cache
+        )
+        assert src.volumes.cache is cache
+        (pod,) = src.list_pending_pods()
+        assert any(
+            e.key == "topology.kubernetes.io/zone" and e.values == ["za"]
+            for e in pod.node_affinity
+        ), pod.node_affinity
     finally:
         cache.stop()
 

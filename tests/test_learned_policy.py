@@ -211,6 +211,31 @@ def test_sharded_learned_matches_dense():
     assert int(sharded_w.n_assigned) == int(dense_w.n_assigned)
 
 
+def test_sharded_learned_auction_matches_dense():
+    """The learned scorer composes with the distributed AUCTION assigner
+    (the factory kwargs flow through make_sharded_learned_fn) — dense
+    LearnedEngine auction decisions reproduced on the mesh."""
+    import jax
+    from kubernetes_scheduler_tpu.models.learned import make_sharded_learned_fn
+    from kubernetes_scheduler_tpu.parallel.mesh import make_mesh
+
+    assert jax.device_count() == 8
+    state, model, _, _ = _train(steps=3)
+    engine = LearnedEngine(state.params, model=model)
+    snap = gen_cluster(32, seed=13, constraints=True)
+    pods = gen_pods(10, seed=14, constraints=True)
+    dense = engine.schedule_batch(
+        snap, pods, assigner="auction", normalizer="min_max"
+    )
+    fn = make_sharded_learned_fn(
+        state.params, make_mesh(8), model=model, assigner="auction"
+    )
+    sharded = fn(snap, pods)
+    np.testing.assert_array_equal(
+        np.asarray(sharded.node_idx), np.asarray(dense.node_idx)
+    )
+
+
 def test_unknown_policy_still_rejected():
     with pytest.raises(ValueError, match="unknown policy"):
         schedule_batch(gen_cluster(8, seed=0), gen_pods(2, seed=1),

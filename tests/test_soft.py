@@ -15,6 +15,7 @@ from kubernetes_scheduler_tpu.engine import (
 )
 from kubernetes_scheduler_tpu.ops.constraints import (
     NO_SCHEDULE,
+    OP_EXISTS,
     OP_IN,
     PREFER_NO_SCHEDULE,
     TOL_EQUAL,
@@ -61,6 +62,42 @@ def test_node_affinity_preference_weights():
         jnp.full((1, 1), 10.0),
     ))
     np.testing.assert_array_equal(got, [[10.0, 0.0, 0.0]])
+
+
+def test_node_affinity_preference_term_grouping():
+    """Upstream weighted-term semantics: a preferred term's weight is
+    granted ONCE iff EVERY expression in the term matches — never per
+    matching expression."""
+    # nodes: 0 has (k=3,v=7) and (k=4,v=1); 1 has only (k=3,v=7); 2 none
+    labels = np.zeros((3, 2, 2), np.int32)
+    lmask = np.zeros((3, 2), bool)
+    labels[0, 0] = (3, 7); labels[0, 1] = (4, 1); lmask[0] = True
+    labels[1, 0] = (3, 7); lmask[1, 0] = True
+    # one preferred term, weight 10, two ANDed expressions:
+    # k3 in {7} AND k4 exists
+    key = np.asarray([[3, 4]], np.int32)
+    op = np.asarray([[OP_IN, OP_EXISTS]], np.int32)
+    vals = np.asarray([[[7], [0]]], np.int32)
+    vmask = np.asarray([[[True], [False]]])
+    term = np.zeros((1, 2), np.int32)  # both in group 0
+    got = np.asarray(node_affinity_preference(
+        jnp.asarray(labels), jnp.asarray(lmask),
+        jnp.asarray(key), jnp.asarray(op), jnp.asarray(vals),
+        jnp.asarray(vmask), jnp.ones((1, 2), bool),
+        jnp.full((1, 2), 10.0), jnp.asarray(term),
+    ))
+    # node 0 satisfies BOTH -> 10 once (not 20); node 1 only one -> 0
+    np.testing.assert_array_equal(got, [[10.0, 0.0, 0.0]])
+
+    # same expressions as separate terms: weights add per satisfied term
+    term2 = np.asarray([[0, 1]], np.int32)
+    got2 = np.asarray(node_affinity_preference(
+        jnp.asarray(labels), jnp.asarray(lmask),
+        jnp.asarray(key), jnp.asarray(op), jnp.asarray(vals),
+        jnp.asarray(vmask), jnp.ones((1, 2), bool),
+        jnp.full((1, 2), 10.0), jnp.asarray(term2),
+    ))
+    np.testing.assert_array_equal(got2, [[20.0, 10.0, 0.0]])
 
 
 def test_pod_affinity_preference_signs():

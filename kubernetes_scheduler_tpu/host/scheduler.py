@@ -307,6 +307,35 @@ class Scheduler:
             self._record(m)
             return m
 
+        # VolumeRestrictions (ReadWriteOncePod): at most one pod
+        # cluster-wide may use an exclusive claim. Enforced HERE, against
+        # this cycle's running set plus earlier window positions, because
+        # any admission-time check races (two pods pending together both
+        # look unconstrained before either binds).
+        if any(pod.exclusive_claims for pod in window):
+            held = {
+                f"{pd.namespace}/{c}"
+                for pd in running
+                for c in pd.volume_claims
+            }
+            kept = []
+            for pod in window:
+                exc = set(pod.exclusive_claims)
+                if exc & held:
+                    log.info(
+                        "pod %s/%s waits: exclusive claim in use",
+                        pod.namespace, pod.name,
+                    )
+                    self._requeue_unschedulable(pod, m)
+                else:
+                    held |= exc
+                    kept.append(pod)
+            window = kept
+            if not window:
+                m.cycle_seconds = time.perf_counter() - t0
+                self._record(m)
+                return m
+
         # nominated-capacity reservations (upstream nominatedNodeName):
         # a preemptor whose victims were evicted holds its nominated
         # node's capacity as a virtual running pod, so the freed space

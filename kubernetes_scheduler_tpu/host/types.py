@@ -224,6 +224,10 @@ class Pod:
     # PVC claim names referenced by spec.volumes (kube/volumes resolves
     # bound claims' PV topology into node_affinity before scheduling)
     volume_claims: list[str] = field(default_factory=list)
+    # 'ns/name' keys of this pod's ReadWriteOncePod claims (set by
+    # kube/volumes.fold): the scheduler serializes access per cycle —
+    # upstream VolumeRestrictions' at-most-one-pod exclusivity
+    exclusive_claims: list[str] = field(default_factory=list)
 
 
 @dataclass
@@ -243,11 +247,14 @@ class PersistentVolumeClaim:
     """PVC binding state: volume_name is set once the claim is Bound.
     An unbound claim (WaitForFirstConsumer, or still pending binding)
     contributes no scheduling constraint — the volume follows the pod
-    (constrain-at-bind), upstream VolumeBinding's WFFC stance."""
+    (constrain-at-bind), upstream VolumeBinding's WFFC stance.
+    access_modes feeds the VolumeRestrictions check: a ReadWriteOncePod
+    claim already in use keeps new pods pending."""
 
     namespace: str
     name: str
     volume_name: str | None = None
+    access_modes: list[str] = field(default_factory=list)
 
 
 @dataclass

@@ -295,10 +295,12 @@ def pv_from_api(obj: dict) -> PersistentVolume:
 
 def pvc_from_api(obj: dict) -> PersistentVolumeClaim:
     meta = obj.get("metadata") or {}
+    spec = obj.get("spec") or {}
     return PersistentVolumeClaim(
         namespace=meta.get("namespace", "default"),
         name=meta.get("name", ""),
-        volume_name=(obj.get("spec") or {}).get("volumeName") or None,
+        volume_name=spec.get("volumeName") or None,
+        access_modes=list(spec.get("accessModes") or []),
     )
 
 
@@ -340,17 +342,29 @@ def node_from_api(obj: dict) -> Node:
             cards = [Card(**c) for c in json.loads(raw)]
         except (json.JSONDecodeError, TypeError) as e:
             log.warning("node %s: bad scv/cards annotation: %s", meta.get("name"), e)
+    taints = [
+        Taint(
+            key=t["key"],
+            value=t.get("value", ""),
+            effect=t.get("effect", "NoSchedule"),
+        )
+        for t in spec.get("taints") or []
+    ]
+    # cordoned node (kubectl cordon sets spec.unschedulable): upstream's
+    # NodeUnschedulable plugin filters it, tolerable via the well-known
+    # taint key — expressed here as exactly that taint, so the existing
+    # toleration machinery carries the semantics (a pod tolerating
+    # node.kubernetes.io/unschedulable still lands, like upstream)
+    if spec.get("unschedulable") and not any(
+        t.key == "node.kubernetes.io/unschedulable" for t in taints
+    ):
+        taints.append(
+            Taint(key="node.kubernetes.io/unschedulable", effect="NoSchedule")
+        )
     return Node(
         name=meta.get("name", ""),
         labels=dict(meta.get("labels") or {}),
-        taints=[
-            Taint(
-                key=t["key"],
-                value=t.get("value", ""),
-                effect=t.get("effect", "NoSchedule"),
-            )
-            for t in spec.get("taints") or []
-        ],
+        taints=taints,
         allocatable=allocatable,
         cards=cards,
     )

@@ -41,9 +41,13 @@ class InformerCache:
         *,
         watch_timeout: float = 60.0,
         resync_interval: float = 300.0,
+        volumes: bool = True,
     ):
         self.client = client
         self.watch_timeout = watch_timeout
+        # volumes=False skips the PVC/PV loops (no list+watch streams, no
+        # resident stores) for deployments that disable volume topology
+        self.volumes = volumes
         # periodic full relist (client-go resyncPeriod): the correctness
         # backstop for missed deletes on servers that don't honor
         # resourceVersion-d watches; rv-tracked streams carry the load
@@ -68,10 +72,13 @@ class InformerCache:
     # -- lifecycle -------------------------------------------------------
 
     def start(self) -> "InformerCache":
-        for target in (
-            self._node_loop, self._pod_loop, self._pdb_loop,
-            self._pvc_loop, self._pv_loop,
-        ):
+        loops = [self._node_loop, self._pod_loop, self._pdb_loop]
+        if self.volumes:
+            loops += [self._pvc_loop, self._pv_loop]
+        else:
+            self._synced["pvcs"].set()
+            self._synced["pvs"].set()
+        for target in loops:
             t = threading.Thread(target=target, daemon=True)
             t.start()
             self._threads.append(t)
@@ -98,14 +105,26 @@ class InformerCache:
             return list(self._pdbs.values())
 
     def pvc_map(self) -> dict:
-        """'ns/name' -> PersistentVolumeClaim, watch-fed."""
+        """'ns/name' -> PersistentVolumeClaim, watch-fed (full copy —
+        prefer get_pvc on per-pod paths)."""
         with self._lock:
             return dict(self._pvcs)
 
     def pv_map(self) -> dict:
-        """PV name -> PersistentVolume, watch-fed."""
+        """PV name -> PersistentVolume, watch-fed (full copy — prefer
+        get_pv on per-pod paths)."""
         with self._lock:
             return dict(self._pvs)
+
+    def get_pvc(self, key: str):
+        """Point lookup, 'ns/name' — no map copy."""
+        with self._lock:
+            return self._pvcs.get(key)
+
+    def get_pv(self, name: str):
+        """Point lookup by PV name — no map copy."""
+        with self._lock:
+            return self._pvs.get(name)
 
     def assume(self, pod: Pod) -> None:
         """Record a just-bound pod before the watch echoes it back —

@@ -119,19 +119,45 @@ class VolumeTopology:
         self._refresh()
         return self._pvcs, self._pvs
 
+    def _lookup(self, pvc_key: str, pv_name: str | None):
+        """(pvc, pv) by key — point lookups against the informer stores
+        (no full-map copies on the per-pod path), TTL maps otherwise."""
+        if self.cache is not None:
+            pvc = self.cache.get_pvc(pvc_key)
+            pv = self.cache.get_pv(pv_name) if pv_name else None
+            return pvc, pv
+        self._refresh()
+        pvc = self._pvcs.get(pvc_key)
+        pv = self._pvs.get(pv_name) if pv_name else None
+        return pvc, pv
+
     def fold(self, pod: Pod) -> Pod:
         """Pod with every bound claim's PV topology ANDed into its
         node-affinity requirement; claims that are unbound (WFFC) or
-        reference unknown volumes contribute nothing."""
+        reference unknown volumes contribute nothing. ReadWriteOncePod
+        claims are recorded on Pod.exclusive_claims — the SCHEDULER
+        enforces their exclusivity per cycle (host/scheduler.run_cycle),
+        because a fold-time check races: two pods pending together would
+        both fold before either holds the claim."""
         if not pod.volume_claims:
             return pod
-        pvcs, pvs = self._maps()
         term_sets = []
+        exclusive: list[str] = []
         for claim in pod.volume_claims:
-            pvc = pvcs.get(f"{pod.namespace}/{claim}")
-            if pvc is None or not pvc.volume_name:
+            key = f"{pod.namespace}/{claim}"
+            pvc, _ = self._lookup(key, None)
+            if pvc is None:
+                continue
+            if "ReadWriteOncePod" in pvc.access_modes:
+                exclusive.append(key)
+            if not pvc.volume_name:
                 continue  # unbound: constrain-at-bind
-            pv = pvs.get(pvc.volume_name)
+            _, pv = self._lookup(key, pvc.volume_name)
             if pv is not None and pv.terms:
                 term_sets.append(pv.terms)
-        return fold_volume_terms(pod, term_sets)
+        out = fold_volume_terms(pod, term_sets)
+        if exclusive:
+            if out is pod:
+                out = dataclasses.replace(pod)
+            out.exclusive_claims = exclusive
+        return out

@@ -206,6 +206,15 @@ def node_affinity_fit_oracle(node_labels, exprs):
     return True
 
 
+def node_affinity_terms_oracle(node_labels, terms):
+    """Upstream OR-of-ANDs nodeSelectorTerms: terms is a list of
+    expression AND-lists (see node_affinity_fit_oracle); a node passes
+    iff SOME term's expressions all hold. No terms at all = pass."""
+    if not terms:
+        return True
+    return any(node_affinity_fit_oracle(node_labels, t) for t in terms)
+
+
 def greedy_assign_oracle(scores, feasible, pod_request, node_free, priority):
     """Reference-semantics sequential scheduling: pods in priority order
     (sort.go:8-18, stable on queue order), each binds to its best feasible

@@ -2,6 +2,7 @@
 
 import numpy as np
 import jax.numpy as jnp
+import pytest
 
 from kubernetes_scheduler_tpu.engine import make_pod_batch, make_snapshot, schedule_batch
 from kubernetes_scheduler_tpu.ops import (
@@ -191,6 +192,61 @@ def test_node_affinity_or_of_ands():
         [False, False, False, False],   # all terms fail
         [True, True, True, True],       # vacuous
     ]
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_node_affinity_or_terms_random_oracle(seed):
+    """Randomized OR-of-ANDs sweep vs the Python oracle: arbitrary term
+    counts, expression mixes, duplicate keys, absent labels."""
+    rng = np.random.default_rng(seed)
+    n, p = 12, 10
+    node_labels = [
+        {int(k): int(rng.integers(0, 4)) for k in rng.choice(5, rng.integers(0, 4), replace=False)}
+        for _ in range(n)
+    ]
+    pods_terms = []
+    for _ in range(p):
+        n_terms = int(rng.integers(0, 4))
+        terms = []
+        for _t in range(n_terms):
+            n_exprs = int(rng.integers(1, 3))
+            exprs = [
+                (
+                    int(rng.integers(0, 5)),
+                    int(rng.integers(0, 4)),
+                    [int(v) for v in rng.integers(0, 4, rng.integers(1, 3))],
+                )
+                for _ in range(n_exprs)
+            ]
+            terms.append(exprs)
+        pods_terms.append(terms)
+
+    e_max = max((sum(len(t) for t in ts) for ts in pods_terms), default=1) or 1
+    v_max = 2
+    key = np.zeros((p, e_max), np.int32)
+    op = np.zeros((p, e_max), np.int32)
+    vals = np.zeros((p, e_max, v_max), np.int32)
+    val_mask = np.zeros((p, e_max, v_max), bool)
+    e_mask = np.zeros((p, e_max), bool)
+    term = np.zeros((p, e_max), np.int32)
+    for i, ts in enumerate(pods_terms):
+        j = 0
+        for t_i, exprs in enumerate(ts):
+            for k, o, vs in exprs:
+                key[i, j], op[i, j], e_mask[i, j], term[i, j] = k, o, True, t_i
+                for q, v in enumerate(vs):
+                    vals[i, j, q] = v
+                    val_mask[i, j, q] = True
+                j += 1
+    labels, l_mask = pack_node_labels(node_labels)
+    got = np.asarray(node_affinity_fit(
+        labels, l_mask, jnp.asarray(key), jnp.asarray(op), jnp.asarray(vals),
+        jnp.asarray(val_mask), jnp.asarray(e_mask), jnp.asarray(term),
+    ))
+    for i, ts in enumerate(pods_terms):
+        for n_i, nl in enumerate(node_labels):
+            want = oracle.node_affinity_terms_oracle(nl, ts)
+            assert got[i, n_i] == want, (seed, i, n_i, ts, nl)
 
 
 def test_node_affinity_empty_term_matches_nothing():

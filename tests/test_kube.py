@@ -243,6 +243,41 @@ def test_pod_from_api_preferred_term_groups():
     assert by_term == {0: [("a", 7), ("b", 7)], 1: [("c", 3)]}
 
 
+def test_node_from_api_cordoned():
+    """spec.unschedulable (kubectl cordon) converts to the well-known
+    unschedulable taint, so cordoned nodes filter like upstream's
+    NodeUnschedulable plugin — and a toleration for it still admits."""
+    from kubernetes_scheduler_tpu.kube.convert import node_from_api
+
+    node = node_from_api({
+        "metadata": {"name": "cordoned"},
+        "spec": {"unschedulable": True},
+        "status": {"allocatable": {"cpu": "4"}},
+    })
+    assert any(
+        t.key == "node.kubernetes.io/unschedulable"
+        and t.effect == "NoSchedule"
+        for t in node.taints
+    )
+    # already-tainted node (the taint-nodes controller beat us): no dupe
+    node2 = node_from_api({
+        "metadata": {"name": "c2"},
+        "spec": {
+            "unschedulable": True,
+            "taints": [{"key": "node.kubernetes.io/unschedulable",
+                        "effect": "NoSchedule"}],
+        },
+        "status": {},
+    })
+    assert (
+        sum(t.key == "node.kubernetes.io/unschedulable" for t in node2.taints)
+        == 1
+    )
+    plain = node_from_api({"metadata": {"name": "open"}, "spec": {},
+                           "status": {}})
+    assert not plain.taints
+
+
 def test_pod_from_api_match_fields():
     """matchFields convert as ordinary expressions keyed metadata.name,
     joining the term's matchExpressions conjunct."""
@@ -890,6 +925,73 @@ def test_informer_serves_volumes_and_fold_uses_them(fake):
             e.key == "topology.kubernetes.io/zone" and e.values == ["za"]
             for e in pod.node_affinity
         ), pod.node_affinity
+    finally:
+        cache.stop()
+
+
+def test_volume_restrictions_rwop_exclusive(fake):
+    """VolumeRestrictions (ReadWriteOncePod): exclusivity enforced per
+    CYCLE in the scheduler — two pods pending together cannot both take
+    the claim (the race an admission-time check loses), a running holder
+    blocks it, and a released claim admits the waiter."""
+    from kubernetes_scheduler_tpu.host import Scheduler, StaticAdvisor
+    from kubernetes_scheduler_tpu.host.advisor import NodeUtil
+    from kubernetes_scheduler_tpu.host.types import Node
+    from kubernetes_scheduler_tpu.kube.source import InformerCache
+    from kubernetes_scheduler_tpu.utils.config import SchedulerConfig
+
+    fake.pvcs.append({
+        "metadata": {"name": "exclusive", "namespace": "default"},
+        "spec": {"volumeName": "pv-x", "accessModes": ["ReadWriteOncePod"]},
+    })
+    fake.pvs.append({"metadata": {"name": "pv-x"}, "spec": {}})
+    for name in ("rival-a", "rival-b"):
+        fake.add_pod({
+            "metadata": {"name": name},
+            "spec": {"schedulerName": "yoda-tpu",
+                     "containers": [{"resources": {"requests": {"cpu": "100m"}}}],
+                     "volumes": [{"persistentVolumeClaim": {"claimName": "exclusive"}}]},
+            "status": {"phase": "Pending"},
+        })
+    cache = InformerCache(client_for(fake), watch_timeout=2).start()
+    try:
+        assert cache.wait_synced(timeout=10)
+        src = KubeClusterSource(
+            client_for(fake), scheduler_name="yoda-tpu", cache=cache
+        )
+        pods = src.list_pending_pods()
+        assert all(p.exclusive_claims == ["default/exclusive"] for p in pods)
+
+        nodes = [Node(name=f"n{i}", allocatable={"cpu": 8000.0, "memory": 2**33,
+                                                 "pods": 100}) for i in range(2)]
+        utils = {n.name: NodeUtil(cpu_pct=10, disk_io=5) for n in nodes}
+        running: list = []
+        sched = Scheduler(
+            SchedulerConfig(batch_window=8, min_device_work=0,
+                            adaptive_dispatch=False),
+            advisor=StaticAdvisor(utils),
+            list_nodes=lambda: nodes,
+            list_running_pods=lambda: running,
+        )
+        for p in pods:
+            sched.submit(p)
+        m = sched.run_cycle()
+        # exactly ONE rival binds; the other waits
+        assert m.pods_bound == 1 and m.pods_unschedulable == 1
+
+        # the winner is now running and HOLDS the claim: the loser stays
+        # pending even with free nodes
+        winner = sched.binder.bindings[0].pod
+        running.append(winner)
+        sched.queue._clock = lambda: 1e9  # clear backoff
+        m2 = sched.run_cycle()
+        assert m2.pods_bound == 0 and m2.pods_unschedulable == 1
+
+        # holder released: the waiter binds
+        running.clear()
+        sched.queue._clock = lambda: 2e9
+        m3 = sched.run_cycle()
+        assert m3.pods_bound == 1
     finally:
         cache.stop()
 

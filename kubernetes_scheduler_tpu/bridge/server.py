@@ -129,9 +129,11 @@ class EngineService:
         t0 = time.perf_counter()
         try:
             if self._sharded_fn is not None:
-                # `fused` is a decision-identical optimization hint; the
-                # sharded engine has no fused path, so serve unfused rather
-                # than degrade the deployment to the host's scalar fallback
+                # `fused` is a decision-identical optimization hint; this
+                # sidecar's sharded program is built once at startup
+                # (make_sharded_*_fn(fused=...) exists, but the choice is
+                # baked), so serve the built variant rather than degrade
+                # the deployment to the host's scalar fallback
                 fn = self._pick_sharded_fn(
                     request, context, self._sharded_fn,
                     self._sharded_fn_soft, "sharded engine",
@@ -334,6 +336,18 @@ def main(argv=None):
         "auction assigner",
     )
     parser.add_argument(
+        "--normalizer", default="min_max",
+        choices=["min_max", "softmax", "none"],
+        help="score normalizer baked into the sharded engine when "
+        "--mesh-devices is set",
+    )
+    parser.add_argument(
+        "--fused", action="store_true",
+        help="route score + resource fit through the fused Pallas kernel "
+        "on the sharded engine (requires --normalizer none and the "
+        "balanced_cpu_diskio policy)",
+    )
+    parser.add_argument(
         "--learned-checkpoint",
         default=None,
         help="serve the learned two-tower policy restored from this orbax "
@@ -383,7 +397,11 @@ def main(argv=None):
                 np.asarray(jax.devices()[: args.mesh_devices]), (NODE_AXIS,)
             )
             node_axes = NODE_AXIS
-        assigner_kw = {"assigner": args.assigner}
+        assigner_kw = {
+            "assigner": args.assigner,
+            "normalizer": args.normalizer,
+            "fused": args.fused,
+        }
         if args.assigner == "auction":
             assigner_kw.update(
                 auction_rounds=args.auction_rounds,
@@ -425,7 +443,7 @@ def main(argv=None):
         sharded_opts = {
             "policy": args.policy,
             "assigner": args.assigner,
-            "normalizer": "min_max",
+            "normalizer": args.normalizer,
         }
         if args.assigner == "auction":
             sharded_opts.update(

@@ -291,6 +291,9 @@ def cmd_sidecar(args) -> int:
     if args.mesh_devices:
         argv += ["--mesh-devices", str(args.mesh_devices)]
         argv += ["--assigner", args.assigner]
+        argv += ["--normalizer", args.normalizer]
+        if args.fused:
+            argv += ["--fused"]
         if args.assigner == "auction":
             argv += [
                 "--auction-rounds", str(args.auction_rounds),
@@ -384,6 +387,15 @@ def build_parser() -> argparse.ArgumentParser:
     )
     pc.add_argument("--auction-rounds", type=int, default=1024)
     pc.add_argument("--auction-price-frac", type=float, default=1.0 / 16.0)
+    pc.add_argument(
+        "--normalizer", default="min_max",
+        choices=["min_max", "softmax", "none"],
+    )
+    pc.add_argument(
+        "--fused", action="store_true",
+        help="fused Pallas score+fit on the sharded engine "
+        "(requires --normalizer none)",
+    )
     pc.set_defaults(fn=cmd_sidecar)
 
     pb = sub.add_parser("bench", help="run the throughput benchmark")

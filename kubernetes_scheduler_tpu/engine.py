@@ -520,6 +520,7 @@ def compute_soft_scores(
     pods: PodBatch,
     *,
     taint_penalty_weight: float = 1.0,
+    spread_dmin: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """[p, n] float32 soft-constraint score term: upstream's preferred
     (scoring, never filtering) constraint families —
@@ -558,18 +559,44 @@ def compute_soft_scores(
     matches = match_matrix(pods, snapshot.pref_attract.shape[1]).astype(jnp.float32)
     sym = matches @ (snapshot.pref_attract - snapshot.pref_avoid).T  # [p, n]
     # ScheduleAnyway spread: marginal skew (count − min over schedulable
-    # domains) of each soft constraint's selector on this node
+    # domains) of each soft constraint's selector on this node.
+    # spread_dmin: optional precomputed [S] minimum — a node-sharded
+    # caller passes the GLOBAL (pmin'd) minimum so the term cannot
+    # diverge from the dense path when domains span shards
     s = snapshot.domain_counts.shape[1]
     ssel = pods.soft_spread_sel                                   # [p, K]
     ok = (ssel >= 0) & (ssel < s)
     idx = jnp.clip(ssel, 0, max(s - 1, 0))
-    big = jnp.asarray(jnp.finfo(jnp.float32).max, jnp.float32)
-    dmin = jnp.where(
-        snapshot.node_mask[:, None], snapshot.domain_counts, big
-    ).min(0)                                                      # [S]
+    dmin = local_spread_dmin(snapshot) if spread_dmin is None else spread_dmin
     skew = snapshot.domain_counts[:, idx] - dmin[idx][None, :, :]  # [n, p, K]
     soft_spread = (jnp.where(ok[None, :, :], skew, 0.0)).sum(-1).T  # [p, n]
     return na + pa + sym - taint_penalty_weight * pen - soft_spread
+
+
+def local_spread_dmin(snapshot: SnapshotArrays) -> jnp.ndarray:
+    """[S] per-selector minimum domain count over schedulable nodes —
+    the spread families' reference point. ONE definition: the sharded
+    path pmins this local value to the global minimum, so the two
+    paths cannot drift on sentinel/masking details."""
+    big = jnp.asarray(jnp.finfo(jnp.float32).max, jnp.float32)
+    return jnp.where(
+        snapshot.node_mask[:, None], snapshot.domain_counts, big
+    ).min(0)
+
+
+def check_fused_contract(policy: str, normalizer: str) -> None:
+    """The fused Pallas path's (policy, normalizer) domain — shared by
+    schedule_batch and the sharded factories so the two surfaces cannot
+    enforce different contracts."""
+    if policy != "balanced_cpu_diskio":
+        raise ValueError(
+            f"fused kernel only implements balanced_cpu_diskio, not {policy!r}"
+        )
+    if normalizer != "none":
+        raise ValueError(
+            "fused=True requires normalizer='none' (masked NEG sentinels "
+            "would skew min_max/softmax statistics)"
+        )
 
 
 def compute_free_capacity(snapshot: SnapshotArrays) -> jnp.ndarray:
@@ -669,15 +696,7 @@ def schedule_batch(
     matrices) must use fused=False.
     """
     if fused:
-        if policy != "balanced_cpu_diskio":
-            raise ValueError(
-                f"fused kernel only implements balanced_cpu_diskio, not {policy!r}"
-            )
-        if normalizer != "none":
-            raise ValueError(
-                "fused=True requires normalizer='none' (masked NEG sentinels "
-                "would skew min_max/softmax statistics)"
-            )
+        check_fused_contract(policy, normalizer)
         raw = _fused_masked_scores(
             snapshot, pods, include_pod_affinity=not affinity_aware
         )

@@ -432,6 +432,18 @@ def _sharded_auction(
     return assigned, free_after, added2_f
 
 
+def _check_fused(fused, policy, normalizer, score_fn) -> None:
+    """The fused kernel's contract (engine.check_fused_contract — ONE
+    definition for both surfaces) plus the sharded-only score_fn clash."""
+    if not fused:
+        return
+    if score_fn is not None:
+        raise ValueError("fused=True cannot combine with a custom score_fn")
+    from kubernetes_scheduler_tpu.engine import check_fused_contract
+
+    check_fused_contract(policy, normalizer)
+
+
 def _mesh_specs(mesh: Mesh, node_axes):
     """Validated mesh axes + the standard sharding specs: per-node arrays
     shard on their leading node axis, per-pod arrays replicate. Shared by
@@ -448,7 +460,7 @@ def _mesh_specs(mesh: Mesh, node_axes):
 
 
 def _window_pipeline(snapshot, pods, policy, normalizer, soft, axes,
-                     score_fn=None):
+                     score_fn=None, fused=False):
     """Scores + static feasibility + normalization for one window on one
     shard — the shared front half of the sharded single-window and
     multi-window programs (they must not diverge).
@@ -458,16 +470,14 @@ def _window_pipeline(snapshot, pods, policy, normalizer, soft, axes,
     hook that puts e.g. the learned two-tower policy on the mesh (its
     node tower is node-local, so the scorer shards for free); the
     global normalization (pmax/pmin/psum bounds) still applies on top.
-    When given, `policy` is ignored."""
-    raw = (
-        score_fn(snapshot, pods)
-        if score_fn is not None
-        else _sharded_scores(snapshot, pods, policy, axes)
-    )
-    # purely local/elementwise on the node axis — reuse the
-    # single-device implementation so the two paths cannot diverge.
-    # Inter-pod affinity is excluded from the static mask: the greedy
-    # scan evaluates it dynamically (base + in-window counts).
+    When given, `policy` is ignored.
+
+    fused=True routes score + resource fit through the Pallas kernel on
+    this shard's node columns — the balanced_cpu_diskio formula is
+    purely node-local (u, v per node; no cross-node statistic), so the
+    kernel shards with zero extra collectives. Requires
+    normalizer="none", like the dense fused path; `scores`/`feasible`
+    carry the NEG-masked contract of engine._fused_masked_scores."""
     # spec.nodeName pinning is GLOBAL (target_node indexes the full
     # node axis) but feasibility columns are shard-LOCAL: translate by
     # this shard's offset, mapping out-of-shard targets to the
@@ -480,6 +490,28 @@ def _window_pipeline(snapshot, pods, policy, normalizer, soft, axes,
     pods_local = pods._replace(
         target_node=jnp.where(pods.target_node < 0, pods.target_node, local)
     )
+
+    if fused:
+        from kubernetes_scheduler_tpu.engine import _fused_masked_scores
+
+        raw = _fused_masked_scores(
+            snapshot, pods_local, include_pod_affinity=False
+        )
+        feasible = raw > NEG * 0.5
+        norm = raw
+        if soft:
+            norm = norm + _sharded_soft_scores(snapshot, pods, axes)
+        return raw, norm, feasible
+
+    raw = (
+        score_fn(snapshot, pods)
+        if score_fn is not None
+        else _sharded_scores(snapshot, pods, policy, axes)
+    )
+    # purely local/elementwise on the node axis — reuse the
+    # single-device implementation so the two paths cannot diverge.
+    # Inter-pod affinity is excluded from the static mask: the greedy
+    # scan evaluates it dynamically (base + in-window counts).
     feasible = compute_feasibility(
         snapshot, pods_local, include_pod_affinity=False
     )
@@ -503,10 +535,22 @@ def _window_pipeline(snapshot, pods, policy, normalizer, soft, axes,
         raise ValueError(f"unknown normalizer {normalizer!r}")
 
     if soft:
-        from kubernetes_scheduler_tpu.engine import compute_soft_scores
-
-        norm = norm + compute_soft_scores(snapshot, pods)
+        norm = norm + _sharded_soft_scores(snapshot, pods, axes)
     return raw, norm, feasible
+
+
+def _sharded_soft_scores(snapshot, pods, axes) -> jnp.ndarray:
+    """compute_soft_scores on this shard's node columns. Every soft
+    family reads node-LOCAL state except the ScheduleAnyway spread term's
+    min-over-domains, which must be the GLOBAL minimum (domains span
+    shards) — the dense definition's local value, pmin'd."""
+    from kubernetes_scheduler_tpu.engine import (
+        compute_soft_scores,
+        local_spread_dmin,
+    )
+
+    dmin = jax.lax.pmin(local_spread_dmin(snapshot), axes)
+    return compute_soft_scores(snapshot, pods, spread_dmin=dmin)
 
 
 def make_sharded_schedule_fn(
@@ -520,6 +564,7 @@ def make_sharded_schedule_fn(
     assigner: str = "greedy",
     auction_rounds: int = 1024,
     auction_price_frac: float = 1.0 / 16.0,
+    fused: bool = False,
 ):
     """Build a jitted shard_map'd schedule function for `mesh`.
 
@@ -557,6 +602,7 @@ def make_sharded_schedule_fn(
     """
     if assigner not in ("greedy", "auction"):
         raise ValueError(f"unknown assigner {assigner!r}")
+    _check_fused(fused, policy, normalizer, score_fn)
     axes, node, rep, snap_specs, pod_specs = _mesh_specs(mesh, node_axes)
     out_specs = ScheduleResult(
         node_idx=rep,
@@ -569,7 +615,7 @@ def make_sharded_schedule_fn(
 
     def body(snapshot: SnapshotArrays, pods: PodBatch) -> ScheduleResult:
         raw, norm, feasible = _window_pipeline(
-            snapshot, pods, policy, normalizer, soft, axes, score_fn
+            snapshot, pods, policy, normalizer, soft, axes, score_fn, fused
         )
         free0 = compute_free_capacity(snapshot)
         if assigner == "greedy":
@@ -590,8 +636,12 @@ def make_sharded_schedule_fn(
             n_assigned=(node_idx >= 0).sum().astype(jnp.int32),
         )
 
+    # the Pallas kernel's out_shape carries no vma annotation, so the
+    # fused variant runs with the varying-manual-axes checker off (the
+    # non-fused paths keep it: pcast/pmax provability is its value)
     fn = shard_map(
-        body, mesh=mesh, in_specs=(snap_specs, pod_specs), out_specs=out_specs
+        body, mesh=mesh, in_specs=(snap_specs, pod_specs),
+        out_specs=out_specs, check_vma=not fused,
     )
     return jax.jit(fn)
 
@@ -607,6 +657,7 @@ def make_sharded_windows_fn(
     assigner: str = "greedy",
     auction_rounds: int = 1024,
     auction_price_frac: float = 1.0 / 16.0,
+    fused: bool = False,
 ):
     """Multi-window sharded scheduling: engine.schedule_windows with the
     node axis sharded over `mesh`.
@@ -625,6 +676,7 @@ def make_sharded_windows_fn(
 
     if assigner not in ("greedy", "auction"):
         raise ValueError(f"unknown assigner {assigner!r}")
+    _check_fused(fused, policy, normalizer, score_fn)
     axes, node, rep, snap_specs, pod_specs = _mesh_specs(mesh, node_axes)
     out_specs = WindowsResult(node_idx=rep, free_after=node, n_assigned=rep)
 
@@ -655,7 +707,7 @@ def make_sharded_windows_fn(
                 + added2[0][snapshot.domain_id, cols],
             )
             _, norm, feasible = _window_pipeline(
-                snap_pipe, w, policy, normalizer, soft, axes, score_fn
+                snap_pipe, w, policy, normalizer, soft, axes, score_fn, fused
             )
             # the assigner takes the ORIGINAL counts plus the added2 carry
             # (it layers the carry itself — snap_pipe's folded counts
@@ -683,6 +735,7 @@ def make_sharded_windows_fn(
         )
 
     fn = shard_map(
-        body, mesh=mesh, in_specs=(snap_specs, pod_specs), out_specs=out_specs
+        body, mesh=mesh, in_specs=(snap_specs, pod_specs),
+        out_specs=out_specs, check_vma=not fused,
     )
     return jax.jit(fn)

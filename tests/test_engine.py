@@ -409,6 +409,116 @@ def test_sharded_windows_auction_carries_anti_affinity():
     assert int(res.n_assigned) == 1
 
 
+@pytest.mark.parametrize("assigner", ["greedy", "auction"])
+def test_sharded_fused_matches_dense_fused(assigner):
+    """The fused Pallas score+fit kernel on the mesh: the formula is
+    node-local, so the kernel shards with zero extra collectives and must
+    reproduce the dense fused decisions under both assigners."""
+    from kubernetes_scheduler_tpu.sim import gen_cluster, gen_pods
+
+    assert jax.device_count() == 8
+    snap = gen_cluster(64, seed=5, constraints=True)
+    pods = gen_pods(12, seed=6, constraints=True)
+    dense = schedule_batch(
+        snap, pods, assigner=assigner, normalizer="none", fused=True,
+        affinity_aware=True,
+    )
+    sharded = make_sharded_schedule_fn(
+        make_mesh(8), assigner=assigner, normalizer="none", fused=True
+    )(snap, pods)
+    assert (
+        np.asarray(sharded.node_idx).tolist()
+        == np.asarray(dense.node_idx).tolist()
+    )
+    np.testing.assert_allclose(
+        np.asarray(sharded.free_after), np.asarray(dense.free_after), atol=1e-3
+    )
+
+
+def test_sharded_fused_windows_and_validation():
+    from kubernetes_scheduler_tpu.parallel.engine import make_sharded_windows_fn
+    from kubernetes_scheduler_tpu.sim import gen_cluster, gen_pods
+
+    snap = gen_cluster(64, seed=5, constraints=True)
+    w = stack_windows(gen_pods(24, seed=7, constraints=True), 8)
+    dw = schedule_windows(snap, w, assigner="auction", normalizer="none",
+                          fused=True)
+    sw = make_sharded_windows_fn(
+        make_mesh(8), assigner="auction", normalizer="none", fused=True
+    )(snap, w)
+    np.testing.assert_array_equal(
+        np.asarray(sw.node_idx), np.asarray(dw.node_idx)
+    )
+    # the dense fused contract applies on the mesh too
+    with pytest.raises(ValueError, match="normalizer"):
+        make_sharded_schedule_fn(make_mesh(8), fused=True)
+    with pytest.raises(ValueError, match="balanced_cpu_diskio"):
+        make_sharded_schedule_fn(
+            make_mesh(8), fused=True, policy="card", normalizer="none"
+        )
+
+
+def test_sharded_fused_soft_matches_dense():
+    """fused + soft on the mesh: the soft terms (incl. the pmin'd spread
+    dmin) layer onto the NEG-masked fused matrix exactly as the dense
+    fused path does."""
+    from kubernetes_scheduler_tpu.sim import gen_cluster, gen_pods
+
+    snap = gen_cluster(64, seed=31, constraints=True)
+    pods = gen_pods(10, seed=32, constraints=True)
+    pods = pods._replace(
+        soft_spread_sel=jnp.zeros((10, 1), jnp.int32),
+        pref_affinity_sel=jnp.asarray(
+            np.where(np.arange(10)[:, None] % 3 == 0, 1, -1), jnp.int32
+        ),
+        pref_affinity_weight=jnp.full((10, 1), 9.0, jnp.float32),
+    )
+    dense = schedule_batch(
+        snap, pods, assigner="auction", normalizer="none", fused=True,
+        affinity_aware=True, soft=True,
+    )
+    sharded = make_sharded_schedule_fn(
+        make_mesh(8), assigner="auction", normalizer="none", fused=True,
+        soft=True,
+    )(snap, pods)
+    assert (
+        np.asarray(sharded.node_idx).tolist()
+        == np.asarray(dense.node_idx).tolist()
+    )
+
+
+def test_sharded_soft_spread_global_dmin():
+    """ScheduleAnyway spread on the mesh: the marginal-skew term's
+    min-over-domains must be GLOBAL (domains span shards) — a pod must
+    prefer the emptier domain even when that domain's nodes live
+    entirely on other shards."""
+    n, s = 16, 1
+    # domain A = nodes 0-7 (shards 0-3), domain B = nodes 8-15; A holds
+    # 3 matching pods, B none. A shard seeing only A-nodes would compute
+    # dmin=3 locally and zero skew — the global dmin is 0.
+    snapshot = make_snapshot(
+        allocatable=np.full((n, 3), 1e6, np.float32),
+        requested=np.zeros((n, 3), np.float32),
+        disk_io=np.zeros(n),
+        cpu_pct=np.zeros(n),
+        mem_pct=np.zeros(n),
+        domain_counts=np.asarray([[3.0]] * 8 + [[0.0]] * 8, np.float32),
+        domain_id=np.asarray([0] * 8 + [8] * 8, np.int32)[:, None],
+    )
+    pods = make_pod_batch(
+        request=np.ones((1, 3), np.float32),
+        soft_spread_sel=np.zeros((1, 1), np.int32),
+    )
+    dense = schedule_batch(snapshot, pods, soft=True)
+    assert int(dense.node_idx[0]) >= 8, "dense soft spread must pick B"
+    sharded = make_sharded_schedule_fn(make_mesh(8), soft=True)(snapshot, pods)
+    assert int(sharded.node_idx[0]) == int(dense.node_idx[0])
+    np.testing.assert_allclose(
+        np.asarray(sharded.scores), np.asarray(dense.scores),
+        rtol=1e-4, atol=2e-3,
+    )
+
+
 @pytest.mark.parametrize("normalizer", ["softmax", "none"])
 def test_sharded_normalizers_match_single_device(normalizer):
     snapshot, pods = random_state(64, 6)

@@ -342,6 +342,36 @@ def test_host_preemption_routes_through_engine_surface():
     assert m2.pods_preempted == 1 and ev2.evictions[0].victim.name == "low0"
 
 
+def test_host_preemption_over_live_bridge():
+    """Full integration of the Preempt RPC: a host Scheduler wired to a
+    RemoteEngine runs its preemption pass on the sidecar (no in-host
+    fallback), and the evictions match the local-engine decisions."""
+    from kubernetes_scheduler_tpu.bridge.client import RemoteEngine
+    from kubernetes_scheduler_tpu.bridge.server import make_server
+    from kubernetes_scheduler_tpu.host import RecordingEvictor
+    from tests.test_host import make_pod
+
+    server, port, service = make_server("127.0.0.1:0")
+    server.start()
+    client = RemoteEngine(f"127.0.0.1:{port}", deadline_seconds=120.0)
+    try:
+        nodes, utils, running = _cluster()
+        ev = RecordingEvictor()
+        s = _sched(nodes, utils, running, evictor=ev)
+        s.engine = client
+        s.submit(make_pod("urgent", cpu=800, labels={"scv/priority": "9"},
+                          annotations={"diskIO": "5"}))
+        before = service.cycles_served
+        m = s.run_cycle()
+        assert m.pods_preempted == 1
+        assert ev.evictions[0].victim.name == "low0"
+        # the sidecar served BOTH the schedule cycle and the preempt pass
+        assert service.cycles_served >= before + 2
+    finally:
+        client.close()
+        server.stop(grace=None)
+
+
 def test_host_no_preemption_without_higher_priority():
     from kubernetes_scheduler_tpu.host import RecordingEvictor
     from tests.test_host import make_pod

@@ -277,10 +277,11 @@ class SnapshotBuilder:
 
     def _selector_id(self, term) -> int:
         """Selector identity = (matchLabels, matchExpressions, topology
-        key); expressions are canonicalized so semantically identical
-        selectors share one id/domain-count column. The parsed form is
-        memoized per key: the matching loops probe O(pods x selectors)
-        per cycle and must not re-build dicts/dataclasses per probe."""
+        key, namespace scope); expressions are canonicalized so
+        semantically identical selectors share one id/domain-count
+        column. The parsed form is memoized per key: the matching loops
+        probe O(pods x selectors) per cycle and must not re-build
+        dicts/dataclasses per probe."""
         from kubernetes_scheduler_tpu.host.types import MatchExpression
 
         exprs = tuple(
@@ -289,7 +290,14 @@ class SnapshotBuilder:
                 for e in getattr(term, "match_expressions", None) or []
             )
         )
-        key = (tuple(sorted(term.match_labels.items())), exprs, term.topology_key)
+        namespaces = getattr(term, "namespaces", None)
+        ns_key = None if namespaces is None else tuple(sorted(set(namespaces)))
+        key = (
+            tuple(sorted(term.match_labels.items())),
+            exprs,
+            term.topology_key,
+            ns_key,
+        )
         if key not in self.selectors:
             self.selectors[key] = len(self.selectors)
             self._selector_parsed[key] = (
@@ -301,18 +309,22 @@ class SnapshotBuilder:
             )
         return self.selectors[key]
 
-    def _key_matches(self, labels: dict, key) -> bool:
-        """Does a pod's label dict satisfy an interned selector key?
-        matchLabels-only selectors (the common case) stay a plain tuple
-        walk; expression selectors use the memoized parsed form."""
+    def _key_matches(self, pod: Pod, key) -> bool:
+        """Does a pod satisfy an interned selector key — labels AND
+        namespace scope (upstream inter-pod selectors match only the
+        listed namespaces; None = all)? matchLabels-only selectors (the
+        common case) stay a plain tuple walk; expression selectors use
+        the memoized parsed form."""
         from kubernetes_scheduler_tpu.host.types import (
             MatchExpression,
             labels_match,
         )
 
-        items, exprs, _topo = key
+        items, exprs, _topo, ns_key = key
+        if ns_key is not None and pod.namespace not in ns_key:
+            return False
         if not exprs:
-            return all(labels.get(k) == v for k, v in items)
+            return all(pod.labels.get(k) == v for k, v in items)
         parsed = self._selector_parsed.get(key)
         if parsed is None:  # selectors persisted from an older builder
             parsed = (
@@ -323,7 +335,7 @@ class SnapshotBuilder:
                 ],
             )
             self._selector_parsed[key] = parsed
-        return labels_match(labels, *parsed)
+        return labels_match(pod.labels, parsed[0], parsed[1])
 
     def _selector_slots(self) -> int:
         return bucket_size(max(len(self.selectors), 1), floor=1, multiple=1)
@@ -379,16 +391,20 @@ class SnapshotBuilder:
             if i is None:
                 continue
             for key, sid in self.selectors.items():
-                if self._key_matches(pod.labels, key):
+                if self._key_matches(pod, key):
                     raw[i, sid] += 1
             for term in pod.pod_affinity:
-                sid = self._selector_id(term)
+                # intern ONLY the term kinds the pre-intern loop above
+                # registered (preferred/anti): a required attract term of
+                # a running pod would otherwise mint a fresh selector id
+                # AFTER the arrays were sized to s — an index crash
                 if term.preferred:
+                    sid = self._selector_id(term)
                     (raw_avoid_w if term.anti else raw_attract_w)[i, sid] += term.weight
                 elif term.anti:
-                    raw_avoid[i, sid] += 1
+                    raw_avoid[i, self._selector_id(term)] += 1
         # aggregate over topology domains
-        for (_items, _exprs, topo), sid in self.selectors.items():
+        for (_items, _exprs, topo, _ns), sid in self.selectors.items():
             sums: dict[str, list[float]] = {}
             first: dict[str, int] = {}
             for i, nd in enumerate(nodes):
@@ -581,7 +597,7 @@ class SnapshotBuilder:
         pod_matches = np.zeros((p, s), bool)
         for i, pod in enumerate(pods):
             for key, sid in self.selectors.items():
-                if self._key_matches(pod.labels, key):
+                if self._key_matches(pod, key):
                     pod_matches[i, sid] = True
 
         return make_pod_batch(

@@ -104,6 +104,12 @@ class PodAffinityTerm:
     weight: int = 1
     # labelSelector.matchExpressions, ANDed with match_labels
     match_expressions: list["MatchExpression"] = field(default_factory=list)
+    # namespaces whose pods the selector may match. None = ALL
+    # namespaces (host-API convenience and the namespaceSelector:{}
+    # case); upstream's default — the owning pod's own namespace — is
+    # what kube/convert fills in ([pod.namespace]) when the term
+    # carries no explicit list
+    namespaces: list[str] | None = None
 
 
 @dataclass
@@ -124,6 +130,10 @@ class SpreadConstraint:
     max_skew: int = 1
     soft: bool = False
     match_expressions: list["MatchExpression"] = field(default_factory=list)
+    # upstream spread selectors match only the pod's OWN namespace;
+    # kube/convert fills [pod.namespace]. None = all namespaces
+    # (host-API convenience, the pre-namespace behavior)
+    namespaces: list[str] | None = None
 
 
 @dataclass

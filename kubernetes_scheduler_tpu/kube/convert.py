@@ -12,8 +12,11 @@ Documented simplifications (each is a capability note, not an accident):
   selectors — the snapshot synthesizes a `metadata.name` label per
   node) carry full upstream OR-of-ANDs term semantics (pod_from_api).
 - pod-(anti)affinity and spread label selectors support matchLabels AND
-  matchExpressions (host/types.labels_match); spread carries both
-  whenUnsatisfiable modes (DoNotSchedule hard, ScheduleAnyway soft).
+  matchExpressions (host/types.labels_match) with upstream namespace
+  scoping (own namespace by default, explicit `namespaces` honored;
+  a namespaceSelector is approximated as ALL namespaces, logged);
+  spread carries both whenUnsatisfiable modes (DoNotSchedule hard,
+  ScheduleAnyway soft).
 - GPU cards come from the SCV CRD in the reference (filter.go:8); the
   core API carries no card inventory, so nodes converted here have no
   cards unless an SCV-style annotation ("scv/cards": JSON list) is set.
@@ -67,7 +70,29 @@ def _match_expr(e: dict) -> MatchExpression:
     )
 
 
-def _pod_affinity_terms(spec: dict, *, anti: bool) -> list[PodAffinityTerm]:
+def _term_namespaces(term: dict, own_namespace: str, pod_name) -> list[str] | None:
+    """Upstream PodAffinityTerm namespace scope. A namespaceSelector
+    means label-selected namespaces UNIONed with any explicit
+    `namespaces` list; this scheduler does no namespace lookup, so any
+    selector is approximated as ALL namespaces (the `{}`-selector
+    semantics — conservative for affinity visibility, logged when it
+    widens the scope). Otherwise: the explicit list, or the owning
+    pod's own namespace."""
+    if term.get("namespaceSelector") is not None:
+        if term["namespaceSelector"] or term.get("namespaces"):
+            log.warning(
+                "pod %s: namespaceSelector approximated as ALL namespaces",
+                pod_name,
+            )
+        return None  # all namespaces
+    if term.get("namespaces"):
+        return list(term["namespaces"])
+    return [own_namespace]
+
+
+def _pod_affinity_terms(
+    spec: dict, *, anti: bool, namespace: str, pod_name=None
+) -> list[PodAffinityTerm]:
     sect = (spec.get("affinity") or {}).get(
         "podAntiAffinity" if anti else "podAffinity"
     ) or {}
@@ -88,6 +113,7 @@ def _pod_affinity_terms(spec: dict, *, anti: bool) -> list[PodAffinityTerm]:
                     match_expressions=got[1],
                     topology_key=term.get("topologyKey", "kubernetes.io/hostname"),
                     anti=anti,
+                    namespaces=_term_namespaces(term, namespace, pod_name),
                 )
             )
     for wt in sect.get("preferredDuringSchedulingIgnoredDuringExecution") or []:
@@ -102,6 +128,7 @@ def _pod_affinity_terms(spec: dict, *, anti: bool) -> list[PodAffinityTerm]:
                     anti=anti,
                     preferred=True,
                     weight=int(wt.get("weight", 1)),
+                    namespaces=_term_namespaces(term, namespace, pod_name),
                 )
             )
     return out
@@ -187,6 +214,8 @@ def pod_from_api(obj: dict) -> Pod:
             # ScheduleAnyway = a soft score term (engine soft spread);
             # DoNotSchedule = a hard filter
             soft=c.get("whenUnsatisfiable", "DoNotSchedule") == "ScheduleAnyway",
+            # upstream spread selectors match only the pod's own namespace
+            namespaces=[meta.get("namespace", "default")],
         )
         for c in spec.get("topologySpreadConstraints") or []
         if (c.get("labelSelector") or {}).get("matchLabels")
@@ -240,8 +269,14 @@ def pod_from_api(obj: dict) -> Pod:
         ],
         node_affinity=required,
         pod_affinity=(
-            _pod_affinity_terms(spec, anti=False)
-            + _pod_affinity_terms(spec, anti=True)
+            _pod_affinity_terms(
+                spec, anti=False, namespace=meta.get("namespace", "default"),
+                pod_name=meta.get("name"),
+            )
+            + _pod_affinity_terms(
+                spec, anti=True, namespace=meta.get("namespace", "default"),
+                pod_name=meta.get("name"),
+            )
         ),
         preferred_node_affinity=preferred,
         topology_spread=spread,

@@ -216,6 +216,47 @@ def test_pod_from_api_or_of_ands_node_affinity():
     )
 
 
+def test_pod_from_api_affinity_namespace_scope():
+    """PodAffinityTerm namespace scope converts per upstream: default =
+    the pod's own namespace; explicit `namespaces` honored;
+    namespaceSelector approximated as all namespaces."""
+    obj = {
+        "metadata": {"name": "scoped", "namespace": "prod"},
+        "spec": {
+            "containers": [{}],
+            "affinity": {"podAntiAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [
+                    {"labelSelector": {"matchLabels": {"app": "a"}},
+                     "topologyKey": "zone"},
+                    {"labelSelector": {"matchLabels": {"app": "b"}},
+                     "namespaces": ["x", "y"], "topologyKey": "zone"},
+                    {"labelSelector": {"matchLabels": {"app": "c"}},
+                     "namespaceSelector": {}, "topologyKey": "zone"},
+                ],
+            }},
+        },
+    }
+    pod = pod_from_api(obj)
+    by_app = {t.match_labels["app"]: t.namespaces for t in pod.pod_affinity}
+    assert by_app["a"] == ["prod"]
+    assert by_app["b"] == ["x", "y"]
+    assert by_app["c"] is None  # all namespaces
+
+    # spread selectors scope to the pod's own namespace
+    obj2 = {
+        "metadata": {"name": "sp", "namespace": "prod"},
+        "spec": {
+            "containers": [{}],
+            "topologySpreadConstraints": [{
+                "maxSkew": 1, "topologyKey": "zone",
+                "whenUnsatisfiable": "DoNotSchedule",
+                "labelSelector": {"matchLabels": {"app": "w"}},
+            }],
+        },
+    }
+    assert pod_from_api(obj2).topology_spread[0].namespaces == ["prod"]
+
+
 def test_pod_from_api_preferred_term_groups():
     """Multi-expression preferred terms convert with shared group ids:
     the weight is granted once per fully-matching entry."""

@@ -1,9 +1,10 @@
 """Scheduling queue: priority ordering + retry backoff.
 
 Reproduces the two queue behaviors the reference relies on:
-- priority ordering by the `scv/priority` label, higher first (the
-  QueueSort comparator the reference defines but never registers,
-  pkg/yoda/sort/sort.go:8-18) with FIFO order among equals;
+- priority ordering, higher first, FIFO among equals: the API-server-
+  resolved `spec.priority` (upstream PriorityClass) when present, else
+  the `scv/priority` label (the QueueSort comparator the reference
+  defines but never registers, pkg/yoda/sort/sort.go:8-18);
 - unschedulable pods retry with exponential backoff between
   podInitialBackoffSeconds=1 and podMaxBackoffSeconds=10
   (deploy/yoda-scheduler.yaml:19-20).
@@ -21,7 +22,11 @@ from kubernetes_scheduler_tpu.host.types import Pod
 
 
 def pod_priority(pod: Pod) -> int:
-    """sort.go:12-18: integer `scv/priority` label, 0 when absent/garbage."""
+    """spec.priority when the API server resolved one (upstream
+    PriorityClass semantics), else the reference's integer
+    `scv/priority` label (sort.go:12-18), 0 when absent/garbage."""
+    if pod.priority is not None:
+        return int(pod.priority)
     try:
         return int(pod.labels.get("scv/priority", 0))
     except (TypeError, ValueError):

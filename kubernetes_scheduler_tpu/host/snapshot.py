@@ -17,6 +17,7 @@ import numpy as np
 
 from kubernetes_scheduler_tpu.engine import PodBatch, SnapshotArrays, make_pod_batch, make_snapshot
 from kubernetes_scheduler_tpu.host.advisor import NodeUtil
+from kubernetes_scheduler_tpu.host.queue import pod_priority
 from kubernetes_scheduler_tpu.host.types import Node, Pod
 from kubernetes_scheduler_tpu.ops import constraints as C
 from kubernetes_scheduler_tpu.ops.resources import (
@@ -524,8 +525,9 @@ class SnapshotBuilder:
                     j_hard += 1
             # diskIO annotation (algorithm.go:103; unparsable -> 0)
             r_io[i] = parse_float_or_zero(pod.annotations.get("diskIO"))
-            # scv/priority label (sort.go:12-18)
-            priority[i] = parse_int_or_zero(pod.labels.get("scv/priority"))
+            # spec.priority (PriorityClass) wins; else the scv/priority
+            # label (sort.go:12-18) — one definition with the queue's
+            priority[i] = pod_priority(pod)
             # GPU demands (filter.go:11-50): a pod with any scv demand label
             # but no explicit number wants 1 card
             has_gpu_labels = any(

@@ -238,6 +238,11 @@ class Pod:
     # kube/volumes.fold): the scheduler serializes access per cycle —
     # upstream VolumeRestrictions' at-most-one-pod exclusivity
     exclusive_claims: list[str] = field(default_factory=list)
+    # spec.priority (PriorityClass admission). None = unset: the queue
+    # and batch builder then fall back to the reference's scv/priority
+    # label (sort.go:12-18); when both exist the API-server-resolved
+    # spec wins, matching upstream
+    priority: int | None = None
 
 
 @dataclass

@@ -79,7 +79,10 @@ def _term_namespaces(term: dict, own_namespace: str, pod_name) -> list[str] | No
     widens the scope). Otherwise: the explicit list, or the owning
     pod's own namespace."""
     if term.get("namespaceSelector") is not None:
-        if term["namespaceSelector"] or term.get("namespaces"):
+        # `{}` selects ALL namespaces upstream (and unions with any
+        # explicit list) — all-namespaces is then EXACT; only a
+        # non-empty selector is genuinely approximated
+        if term["namespaceSelector"]:
             log.warning(
                 "pod %s: namespaceSelector approximated as ALL namespaces",
                 pod_name,
@@ -288,6 +291,9 @@ def pod_from_api(obj: dict) -> Pod:
         scheduler_name=spec.get("schedulerName", "default-scheduler"),
         start_time=start_time,
         volume_claims=volume_claims,
+        # spec.priority is the API-server-resolved PriorityClass value;
+        # host/queue.pod_priority prefers it over the scv/priority label
+        priority=spec.get("priority"),
     )
 
 

@@ -284,6 +284,26 @@ def test_pod_from_api_preferred_term_groups():
     assert by_term == {0: [("a", 7), ("b", 7)], 1: [("c", 3)]}
 
 
+def test_pod_from_api_spec_priority_wins():
+    """spec.priority (PriorityClass admission) outranks the reference's
+    scv/priority label; absent spec falls back to the label."""
+    from kubernetes_scheduler_tpu.host.queue import pod_priority
+
+    both = pod_from_api({
+        "metadata": {"name": "b", "labels": {"scv/priority": "3"}},
+        "spec": {"priority": 1000000, "containers": [{}]},
+    })
+    assert both.priority == 1000000 and pod_priority(both) == 1000000
+    label_only = pod_from_api({
+        "metadata": {"name": "l", "labels": {"scv/priority": "3"}},
+        "spec": {"containers": [{}]},
+    })
+    assert label_only.priority is None and pod_priority(label_only) == 3
+    neither = pod_from_api({"metadata": {"name": "n"},
+                            "spec": {"containers": [{}]}})
+    assert pod_priority(neither) == 0
+
+
 def test_node_from_api_cordoned():
     """spec.unschedulable (kubectl cordon) converts to the well-known
     unschedulable taint, so cordoned nodes filter like upstream's

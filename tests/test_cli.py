@@ -86,3 +86,84 @@ def test_cli_scheduler_no_tpu_fallback(capsys):
 def test_parser_rejects_unknown_subcommand():
     with pytest.raises(SystemExit):
         build_parser().parse_args(["frobnicate"])
+
+
+def test_shipped_manifest_host_sidecar_options_consistent():
+    """The deploy manifest's host ConfigMap and sidecar args must form a
+    working pair: the host config parses, and every option the host will
+    send (policy/assigner/normalizer/fused/auction knobs) matches what
+    the sidecar bakes — otherwise the sidecar's fail-loud option pinning
+    rejects every cycle in production."""
+    import os
+
+    import yaml
+
+    from kubernetes_scheduler_tpu.utils.config import SchedulerConfig
+
+    path = os.path.join(os.path.dirname(__file__), "..", "deploy",
+                        "yoda-tpu-scheduler.yaml")
+    docs = list(yaml.safe_load_all(open(path)))
+    cm = next(d for d in docs if d.get("kind") == "ConfigMap")
+    cfg = SchedulerConfig.from_dict(
+        json.loads(cm["data"]["scheduler-config.json"])
+    )
+    dep = next(d for d in docs if d.get("kind") == "Deployment")
+    sidecar = next(
+        c for c in dep["spec"]["template"]["spec"]["containers"]
+        if c["name"] == "tpu-engine"
+    )
+    args = sidecar["args"]
+
+    def flag(name, default=None):
+        for a in args:
+            if a == name:
+                return True
+            if a.startswith(name + "="):
+                return a.split("=", 1)[1]
+        return default
+
+    # the sharded sidecar pins these; the host sends its config values.
+    # Non-default choices must be EXPLICIT in the manifest args — the
+    # test does not mirror server.py's argparse defaults, so an implicit
+    # default could silently drift from what this compares against.
+    assert flag("--policy", "balanced_cpu_diskio") == cfg.policy
+    assert flag("--assigner") == cfg.assigner, (
+        "manifest must state --assigner explicitly"
+    )
+    assert flag("--normalizer") == cfg.normalizer, (
+        "manifest must state --normalizer explicitly"
+    )
+    if flag("--fused", False):
+        # host only sends fused=True under this exact gate
+        assert cfg.feature_gates.fused_kernel
+        assert cfg.policy == "balanced_cpu_diskio"
+        assert cfg.normalizer == "none"
+    if cfg.assigner == "auction":
+        # defaults on both sides today; if either side changes, the
+        # manifest must pin them explicitly or this drifts
+        assert float(flag("--auction-price-frac", 1.0 / 16.0)) == (
+            cfg.auction_price_frac
+        )
+        assert int(flag("--auction-rounds", 1024)) == cfg.auction_rounds
+
+    # RBAC: per-rule (apiGroup, resource) -> verbs, so a grant moved to
+    # the wrong group or stripped of a needed verb fails here instead of
+    # as runtime Forbidden errors
+    role = next(d for d in docs if d.get("kind") == "ClusterRole")
+    verbs: dict[tuple, set] = {}
+    for rule in role["rules"]:
+        for g in rule.get("apiGroups", []):
+            for r in rule.get("resources", []):
+                verbs.setdefault((g, r), set()).update(rule.get("verbs", []))
+
+    def granted(group, resource, *need):
+        have = verbs.get((group, resource), set())
+        assert set(need) <= have, (group, resource, need, have)
+
+    granted("", "nodes", "list", "watch")
+    granted("", "pods", "list", "watch", "delete")   # delete = evictor
+    granted("", "pods/binding", "create")
+    granted("", "persistentvolumes", "list", "watch")
+    granted("", "persistentvolumeclaims", "list", "watch")
+    granted("policy", "poddisruptionbudgets", "list", "watch")
+    granted("coordination.k8s.io", "leases", "create", "get", "update")

@@ -331,7 +331,7 @@ def main(argv=None):
         help="max auction rounds for the sharded auction assigner",
     )
     parser.add_argument(
-        "--auction-price-frac", type=float, default=1.0 / 16.0,
+        "--auction-price-frac", type=float, default=1.0,
         help="price step (fraction of the unit row range) for the sharded "
         "auction assigner",
     )

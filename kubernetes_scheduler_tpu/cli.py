@@ -386,7 +386,7 @@ def build_parser() -> argparse.ArgumentParser:
         "(mesh mode only; the dense engine honors per-request assigners)",
     )
     pc.add_argument("--auction-rounds", type=int, default=1024)
-    pc.add_argument("--auction-price-frac", type=float, default=1.0 / 16.0)
+    pc.add_argument("--auction-price-frac", type=float, default=1.0)
     pc.add_argument(
         "--normalizer", default="min_max",
         choices=["min_max", "softmax", "none"],

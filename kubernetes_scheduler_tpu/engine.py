@@ -665,7 +665,7 @@ def schedule_batch(
     affinity_aware: bool = True,
     soft: bool = False,
     auction_rounds: int = 1024,
-    auction_price_frac: float = 1.0 / 16.0,
+    auction_price_frac: float = 1.0,
 ) -> ScheduleResult:
     """One scheduling cycle for the whole pending window, on device.
 
@@ -741,7 +741,7 @@ def finish_cycle(
     affinity_aware: bool = True,
     soft: bool = False,
     auction_rounds: int = 1024,
-    auction_price_frac: float = 1.0 / 16.0,
+    auction_price_frac: float = 1.0,
 ) -> ScheduleResult:
     """Shared cycle tail: soft score terms → assignment → result. Any
     scorer composes with the full constraint/assignment machinery through
@@ -873,7 +873,7 @@ def schedule_windows(
     affinity_aware: bool = True,
     soft: bool = False,
     auction_rounds: int = 1024,
-    auction_price_frac: float = 1.0 / 16.0,
+    auction_price_frac: float = 1.0,
 ) -> WindowsResult:
     """Schedule many windows in ONE device program: lax.scan over the
     window axis, carrying node capacity AND (anti)affinity domain counts

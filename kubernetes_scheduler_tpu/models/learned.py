@@ -305,7 +305,7 @@ class LearnedEngine:
         affinity_aware: bool = True,
         soft: bool = False,
         auction_rounds: int = 1024,
-        auction_price_frac: float = 1.0 / 16.0,
+        auction_price_frac: float = 1.0,
     ):
         return self._run(
             self.params, snapshot, pods, assigner=assigner,
@@ -326,7 +326,7 @@ class LearnedEngine:
         affinity_aware: bool = True,
         soft: bool = False,
         auction_rounds: int = 1024,
-        auction_price_frac: float = 1.0 / 16.0,
+        auction_price_frac: float = 1.0,
     ):
         """Whole-backlog scheduling with the learned scorer: the same
         capacity- and affinity-carrying window scan as

@@ -588,7 +588,7 @@ def auction_assign(
     pod_mask: jnp.ndarray,
     *,
     rounds: int = 1024,
-    price_frac: float = 1.0 / 16.0,
+    price_frac: float = 1.0,
     affinity: AffinityState | None = None,
 ) -> AssignResult:
     """Price-guided parallel auction: rounds of bid → admit → reprice.

@@ -563,7 +563,7 @@ def make_sharded_schedule_fn(
     score_fn=None,
     assigner: str = "greedy",
     auction_rounds: int = 1024,
-    auction_price_frac: float = 1.0 / 16.0,
+    auction_price_frac: float = 1.0,
     fused: bool = False,
 ):
     """Build a jitted shard_map'd schedule function for `mesh`.
@@ -656,7 +656,7 @@ def make_sharded_windows_fn(
     score_fn=None,
     assigner: str = "greedy",
     auction_rounds: int = 1024,
-    auction_price_frac: float = 1.0 / 16.0,
+    auction_price_frac: float = 1.0,
     fused: bool = False,
 ):
     """Multi-window sharded scheduling: engine.schedule_windows with the

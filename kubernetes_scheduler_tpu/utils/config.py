@@ -49,14 +49,17 @@ class SchedulerConfig:
     assigner: str = "greedy"
     normalizer: str = "min_max"
     batch_window: int = 1024
-    # auction assigner knobs (ops/assign.auction_assign). price_frac is the
-    # quality/throughput dial: rounds-to-converge scales ~1/price_frac
-    # while mean placement score degrades ~2% from 1/16 to 1.0 (measured,
-    # PARITY.md); 1/16 keeps host scheduling quality-first. The knobs ride
-    # the gRPC wire too (ScheduleRequest.auction_*), so remote engines
-    # honor them.
+    # auction assigner knobs (ops/assign.auction_assign). price_frac is
+    # the quality/throughput dial: rounds-to-converge scales
+    # ~1/price_frac. Default 1.0: with the counter-hash tie-break jitter
+    # (round 4) the measured mean placement score at 1.0 matches 1/16 to
+    # <0.3% on every BENCH_SUITE config and never trails the greedy
+    # oracle (PARITY.md round-4 table), so the fast step is no longer a
+    # quality trade. Lower it for workloads with fine-grained score
+    # distinctions worth extra rounds. The knobs ride the gRPC wire too
+    # (ScheduleRequest.auction_*), so remote engines honor them.
     auction_rounds: int = 1024
-    auction_price_frac: float = 1.0 / 16.0
+    auction_price_frac: float = 1.0
     # resource -> weight, all 1 like the reference (scheduler.go:75-77)
     resource_weights: dict = field(
         default_factory=lambda: {

@@ -15,7 +15,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-from kubernetes_scheduler_tpu.engine import PodBatch, SnapshotArrays, make_pod_batch, make_snapshot
+from kubernetes_scheduler_tpu.engine import PodBatch, SnapshotArrays
 from kubernetes_scheduler_tpu.host.advisor import NodeUtil
 from kubernetes_scheduler_tpu.host.queue import pod_priority
 from kubernetes_scheduler_tpu.host.types import Node, Pod
@@ -265,7 +265,14 @@ class SnapshotBuilder:
             nodes, running_pods, pending_pods or [], n
         )
 
-        return make_snapshot(
+        # HOST-side numpy arrays, deliberately NOT jnp (make_snapshot
+        # would device_put them): on a remote/tunneled device every
+        # later host-side probe (np.asarray for option checks, shapes,
+        # gRPC packing) would pay a device readback round-trip — ~100 ms
+        # each over the dev tunnel, measured dominating the host loop.
+        # The engine's jit call (or the bridge codec) transfers the
+        # buffers exactly once either way.
+        return SnapshotArrays(
             allocatable=alloc, requested=requested, disk_io=disk_io,
             cpu_pct=cpu_pct, mem_pct=mem_pct, net_up=net_up,
             net_down=net_down, node_mask=mask, cards=cards,
@@ -602,7 +609,8 @@ class SnapshotBuilder:
                 if self._key_matches(pod, key):
                     pod_matches[i, sid] = True
 
-        return make_pod_batch(
+        # numpy, not device arrays — see build_snapshot's return comment
+        return PodBatch(
             request=request, r_io=r_io, priority=priority, pod_mask=pod_mask,
             want_number=want_number, want_memory=want_memory,
             want_clock=want_clock, tolerations=tols, tol_mask=tol_mask,

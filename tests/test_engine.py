@@ -487,6 +487,54 @@ def test_sharded_fused_soft_matches_dense():
     )
 
 
+def test_sharded_grouped_preferred_terms_match_dense():
+    """Multi-expression preferred node-affinity terms (pna_term groups)
+    score identically on the mesh — the grouped contraction is
+    node-local, so decisions and soft scores must match dense exactly."""
+    n = 16
+    labels = np.zeros((n, 2, 2), np.int32)
+    lmask = np.zeros((n, 2), bool)
+    # nodes 12..15 carry BOTH keys (full term match); 4..11 only key 3
+    labels[4:, 0] = (3, 7)
+    lmask[4:, 0] = True
+    labels[12:, 1] = (4, 1)
+    lmask[12:, 1] = True
+    snapshot = make_snapshot(
+        allocatable=np.full((n, 3), 1e6, np.float32),
+        requested=np.zeros((n, 3), np.float32),
+        disk_io=np.zeros(n),
+        cpu_pct=np.zeros(n),
+        mem_pct=np.zeros(n),
+        node_labels=labels,
+        node_label_mask=lmask,
+    )
+    from kubernetes_scheduler_tpu.ops.constraints import OP_EXISTS, OP_IN
+
+    pods = make_pod_batch(
+        request=np.ones((2, 3), np.float32),
+        pna_key=np.asarray([[3, 4], [3, 4]], np.int32),
+        pna_op=np.asarray([[OP_IN, OP_EXISTS]] * 2, np.int32),
+        pna_vals=np.asarray([[[7], [0]]] * 2, np.int32),
+        pna_val_mask=np.asarray([[[True], [False]]] * 2),
+        pna_weight=np.full((2, 2), 50.0, np.float32),
+        # pod 0: one AND group (weight once, only full matches);
+        # pod 1: independent terms (weights add)
+        pna_term=np.asarray([[0, 0], [0, 1]], np.int32),
+    )
+    dense = schedule_batch(snapshot, pods, soft=True)
+    sharded = make_sharded_schedule_fn(make_mesh(8), soft=True)(snapshot, pods)
+    assert (
+        np.asarray(sharded.node_idx).tolist()
+        == np.asarray(dense.node_idx).tolist()
+    )
+    np.testing.assert_allclose(
+        np.asarray(sharded.scores), np.asarray(dense.scores),
+        rtol=1e-4, atol=2e-3,
+    )
+    # the grouped pod must land on a BOTH-keys node
+    assert int(dense.node_idx[0]) >= 12
+
+
 def test_sharded_soft_spread_global_dmin():
     """ScheduleAnyway spread on the mesh: the marginal-skew term's
     min-over-domains must be GLOBAL (domains span shards) — a pod must

@@ -1,0 +1,222 @@
+"""Capstone property sweep: random clusters exercising EVERY constraint
+family at once — taints/tolerations, OR-of-ANDs node affinity, namespace-
+scoped inter-pod (anti)affinity, hard topology spread, cordons, priorities
+— through the full host pipeline (SnapshotBuilder -> schedule_batch), with
+every binding validated against pure-Python final-state oracles.
+
+Final-state checks are sound for the per-placement families too: anti-
+affinity and spread are enforced against live counts at placement time,
+and both are monotone (counts only grow, the spread min only rises), so a
+valid placement sequence implies a valid final state.
+"""
+
+import numpy as np
+import pytest
+
+from kubernetes_scheduler_tpu.engine import schedule_batch
+from kubernetes_scheduler_tpu.host.snapshot import SnapshotBuilder
+from kubernetes_scheduler_tpu.host.types import (
+    Container,
+    MatchExpression,
+    Node,
+    Pod,
+    PodAffinityTerm,
+    SpreadConstraint,
+    Taint,
+    Toleration,
+    labels_match,
+)
+from tests import oracle
+
+ZONES = ["za", "zb", "zc"]
+NAMESPACES = ["default", "prod"]
+
+
+def gen_cluster(rng, n):
+    nodes = []
+    for i in range(n):
+        labels = {"topology.kubernetes.io/zone": ZONES[i % len(ZONES)]}
+        if rng.random() < 0.5:
+            labels["disk"] = rng.choice(["ssd", "hdd"])
+        taints = []
+        if rng.random() < 0.2:
+            taints.append(Taint(key="dedicated", value="x",
+                                effect="NoSchedule"))
+        nodes.append(Node(
+            name=f"n{i}", labels=labels, taints=taints,
+            allocatable={"cpu": 8000.0, "memory": 2**33, "pods": 110},
+        ))
+    return nodes
+
+
+def gen_pod(rng, i, spread_groups=None):
+    labels = {}
+    if rng.random() < 0.6:
+        labels["app"] = rng.choice(["web", "db"])
+    kw = dict(
+        name=f"p{i}",
+        namespace=rng.choice(NAMESPACES),
+        labels=labels,
+        containers=[Container(requests={"cpu": float(rng.integers(100, 800)),
+                                        "memory": float(2**20)})],
+        annotations={"diskIO": str(rng.integers(0, 20))},
+    )
+    if rng.random() < 0.3:
+        kw["tolerations"] = [Toleration(key="dedicated", operator="Exists")]
+    if rng.random() < 0.4:
+        # OR-of-ANDs: zone in {x} OR (zone in {y} AND disk=ssd)
+        z1, z2 = rng.choice(ZONES, 2, replace=False)
+        kw["node_affinity"] = [
+            MatchExpression(key="topology.kubernetes.io/zone", operator="In",
+                            values=[z1], term=0),
+            MatchExpression(key="topology.kubernetes.io/zone", operator="In",
+                            values=[z2], term=1),
+            MatchExpression(key="disk", operator="In", values=["ssd"], term=1),
+        ]
+    terms = []
+    if rng.random() < 0.3 and labels.get("app"):
+        terms.append(PodAffinityTerm(
+            match_labels={"app": labels["app"]}, anti=True,
+            topology_key="topology.kubernetes.io/zone",
+            namespaces=[kw["namespace"]],
+        ))
+    if terms:
+        kw["pod_affinity"] = terms
+    # spread constraints attach to WHOLE (namespace, app) groups: the
+    # final-state oracle is only sound when every matcher is constrained
+    # (upstream DoNotSchedule binds only pods that DECLARE the
+    # constraint — an unconstrained matcher may legally raise the skew
+    # after a constrained pod placed)
+    if (
+        spread_groups
+        and labels.get("app")
+        and (kw["namespace"], labels["app"]) in spread_groups
+    ):
+        kw["topology_spread"] = [SpreadConstraint(
+            match_labels={"app": labels["app"]},
+            topology_key="topology.kubernetes.io/zone",
+            max_skew=2, namespaces=[kw["namespace"]],
+        )]
+    if rng.random() < 0.5:
+        kw["labels"] = {**labels, "scv/priority": str(rng.integers(0, 5))}
+    return Pod(**kw)
+
+
+def zone_of(node):
+    return node.labels["topology.kubernetes.io/zone"]
+
+
+@pytest.mark.parametrize("assigner", ["greedy", "auction"])
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_all_families_against_final_state_oracle(seed, assigner):
+    rng = np.random.default_rng(1000 + seed)
+    n, p = 24, 20
+    nodes = gen_cluster(rng, n)
+    spread_groups = {
+        (ns, app)
+        for ns in NAMESPACES
+        for app in ("web", "db")
+        if rng.random() < 0.5
+    }
+    pods = [gen_pod(rng, i, spread_groups) for i in range(p)]
+    # a few running pods occupy domains (mixed namespaces)
+    running = []
+    for i in range(6):
+        rp = gen_pod(rng, 100 + i, spread_groups)
+        rp.node_name = nodes[int(rng.integers(0, n))].name
+        running.append(rp)
+
+    from kubernetes_scheduler_tpu.host.advisor import NodeUtil
+
+    b = SnapshotBuilder()
+    utils = {nd.name: NodeUtil(cpu_pct=float(rng.uniform(0, 80)),
+                               disk_io=float(rng.uniform(0, 40)))
+             for nd in nodes}
+    snap = b.build_snapshot(nodes, utils, running, pending_pods=pods)
+    batch = b.build_pod_batch(pods)
+    res = schedule_batch(snap, batch, assigner=assigner,
+                     affinity_aware=True, soft=True)
+    idx = np.asarray(res.node_idx)[:p]
+
+    placed = [
+        (pod, nodes[int(j)]) for pod, j in zip(pods, idx) if 0 <= j < n
+    ]
+    assert placed, "sweep is vacuous if nothing schedules"
+
+    # 1. capacity: aggregate requests fit allocatable
+    used = {nd.name: 0.0 for nd in nodes}
+    for rp in running:
+        used[rp.node_name] += rp.containers[0].requests["cpu"]
+    for pod, nd in placed:
+        used[nd.name] += pod.containers[0].requests["cpu"]
+    for nd in nodes:
+        assert used[nd.name] <= nd.allocatable["cpu"] + 1e-6, nd.name
+
+    # 2. taints via the full-semantics oracle (tests/oracle.py uses the
+    # snapshot encodings: effect 1=NoSchedule; op 0=Exists, 1=Equal)
+    for pod, nd in placed:
+        taints = [(hash(t.key), hash(t.value), 1) for t in nd.taints]
+        tols = [
+            (None if tol.key is None else hash(tol.key),
+             hash(tol.value),
+             0 if tol.operator == "Exists" else 1,
+             0)
+            for tol in pod.tolerations
+        ]
+        assert oracle.taint_fit_oracle(taints, tols), (pod.name, nd.name)
+
+    # 3. OR-of-ANDs node affinity via the oracle
+    for pod, nd in placed:
+        by_term = {}
+        for e in pod.node_affinity:
+            by_term.setdefault(e.term, []).append(e)
+        terms = [
+            [(e.key, {"In": 0, "NotIn": 1, "Exists": 2,
+                      "DoesNotExist": 3}[e.operator], e.values)
+             for e in exprs]
+            for exprs in by_term.values()
+        ]
+        # oracle speaks interned-id-free dicts: use string keys/values
+        assert oracle.node_affinity_terms_oracle(nd.labels, terms), (
+            pod.name, nd.name, terms, nd.labels)
+
+    # final placement sets per (namespace, zone)
+    def members(namespace, zone):
+        out = []
+        for rp in running:
+            nd = next(x for x in nodes if x.name == rp.node_name)
+            if rp.namespace == namespace and zone_of(nd) == zone:
+                out.append(rp)
+        for pod, nd in placed:
+            if pod.namespace == namespace and zone_of(nd) == zone:
+                out.append(pod)
+        return out
+
+    # 4. hard anti-affinity final state: no OTHER matcher of the selector
+    # in the pod's zone within the scoped namespace
+    for pod, nd in placed:
+        for term in pod.pod_affinity:
+            if term.preferred or not term.anti:
+                continue
+            for other in members(term.namespaces[0], zone_of(nd)):
+                if other is pod:
+                    continue
+                assert not labels_match(
+                    other.labels, term.match_labels, term.match_expressions
+                ), (pod.name, other.name, zone_of(nd))
+
+    # 5. hard spread final state: count - min over zones <= maxSkew
+    for pod, nd in placed:
+        for sc in pod.topology_spread:
+            if sc.soft:
+                continue
+            counts = {
+                z: sum(
+                    1 for m in members(sc.namespaces[0], z)
+                    if labels_match(m.labels, sc.match_labels,
+                                    sc.match_expressions)
+                )
+                for z in ZONES
+            }
+            skew = counts[zone_of(nd)] - min(counts.values())
+            assert skew <= sc.max_skew, (pod.name, counts)

@@ -40,7 +40,8 @@ def gen_cluster(rng, n):
             labels["disk"] = rng.choice(["ssd", "hdd"])
         taints = []
         if rng.random() < 0.2:
-            taints.append(Taint(key="dedicated", value="x",
+            taints.append(Taint(key="dedicated",
+                                value=rng.choice(["x", "y"]),
                                 effect="NoSchedule"))
         nodes.append(Node(
             name=f"n{i}", labels=labels, taints=taints,
@@ -62,7 +63,15 @@ def gen_pod(rng, i, spread_groups=None):
         annotations={"diskIO": str(rng.integers(0, 20))},
     )
     if rng.random() < 0.3:
-        kw["tolerations"] = [Toleration(key="dedicated", operator="Exists")]
+        # mix Exists and value-bound Equal tolerations: an Equal for the
+        # wrong taint value must NOT admit (full upstream semantics)
+        if rng.random() < 0.5:
+            kw["tolerations"] = [Toleration(key="dedicated",
+                                            operator="Exists")]
+        else:
+            kw["tolerations"] = [Toleration(key="dedicated",
+                                            value=rng.choice(["x", "y"]),
+                                            operator="Equal")]
     if rng.random() < 0.4:
         # OR-of-ANDs: zone in {x} OR (zone in {y} AND disk=ssd)
         z1, z2 = rng.choice(ZONES, 2, replace=False)

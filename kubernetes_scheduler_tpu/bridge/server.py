@@ -98,17 +98,10 @@ class EngineService:
                     f"sidecar's sharded engine is fixed to "
                     f"{key}={have!r}; request asked for {want!r}",
                 )
-        # auction knobs are baked into the sharded program too (the dense
-        # branch honors them per-request via _auction_kw); proto3 zero
-        # means "engine default" and is always accepted
-        for key, want in _auction_kw(request).items():
-            have = self._sharded_opts.get(key)
-            if have is not None and abs(want - have) > 1e-9:
-                context.abort(
-                    grpc.StatusCode.INVALID_ARGUMENT,
-                    f"sidecar's sharded engine is fixed to "
-                    f"{key}={have!r}; request asked for {want!r}",
-                )
+        # auction knobs are NOT baked: they are traced operands of the
+        # sharded program (the round-loop bound and the price step), so
+        # request-carried values are honored per call with no recompile —
+        # the startup flags only set the defaults (proto3 zero = default)
         if request.soft:
             if fn_soft is None:
                 context.abort(
@@ -138,7 +131,7 @@ class EngineService:
                     request, context, self._sharded_fn,
                     self._sharded_fn_soft, "sharded engine",
                 )
-                res = fn(snapshot, pods)
+                res = fn(snapshot, pods, **_auction_kw(request))
             else:
                 res = self._engine.schedule_batch(
                     snapshot,
@@ -181,7 +174,7 @@ class EngineService:
                     request, context, self._sharded_windows_fn,
                     self._sharded_windows_fn_soft, "sharded windows engine",
                 )
-                res = fn(snapshot, pods_w)
+                res = fn(snapshot, pods_w, **_auction_kw(request))
             else:
                 res = self._engine.schedule_windows(
                     snapshot,
@@ -440,16 +433,14 @@ def main(argv=None):
         # the assigner is baked into the sharded program at startup; a
         # host that asked for the other one must get an error, not
         # silently different placement semantics
+        # auction knobs deliberately absent: they are per-request traced
+        # operands (the startup flags only set the defaults baked into
+        # the fn wrappers above), not pinned options
         sharded_opts = {
             "policy": args.policy,
             "assigner": args.assigner,
             "normalizer": args.normalizer,
         }
-        if args.assigner == "auction":
-            sharded_opts.update(
-                auction_rounds=args.auction_rounds,
-                auction_price_frac=args.auction_price_frac,
-            )
     else:
         sharded_fn_soft = None
         sharded_windows_fn = None

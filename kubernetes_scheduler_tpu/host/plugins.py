@@ -57,8 +57,15 @@ _CARD_METRICS = ("bandwidth", "clock", "core", "power", "free_memory", "total_me
 _FC_DISK_W, _FC_CPU_W, _FC_MEM_W = 100.0, 2.0, 3.0
 
 # policies the scalar path scores faithfully; anything else falls back to
-# the yoda formula and bumps fallback_policy_mismatch (host/scheduler)
-SCALAR_POLICIES = ("balanced_cpu_diskio", "free_capacity", "card")
+# the yoda formula and bumps fallback_policy_mismatch (host/scheduler) —
+# with all four heuristic policies mirrored, `learned` is the only policy
+# with no scalar equivalent (its scores live in device parameters)
+SCALAR_POLICIES = (
+    "balanced_cpu_diskio",
+    "balanced_diskio",
+    "free_capacity",
+    "card",
+)
 
 
 def gpu_demands(pod: Pod) -> tuple[int, float, float]:
@@ -121,10 +128,11 @@ class ScalarYodaPlugin:
       algorithm.go:47-97 structure, with CycleCache replacing Redis) then
       the live BalancedCpuDiskIO formula (algorithm.go:99-119). The
       `policy` knob swaps in the scalar mirrors of the engine's
-      free_capacity (algorithm.go:178-198) and card
-      (algorithm.go:264-291 + collection.go:30-55) kernels, so an engine
-      failure under those policies degrades to the SAME policy, not
-      silently to the yoda formula (round-3 verdict "what's weak" #1).
+      free_capacity (algorithm.go:178-198), card
+      (algorithm.go:264-291 + collection.go:30-55) and balanced_diskio
+      (algorithm.go:121-176) kernels, so an engine failure under any
+      heuristic policy degrades to the SAME policy, not silently to the
+      yoda formula; `learned` is the only remaining mismatch case.
     - normalize_scores: min-max to [0, 100] with the highest==lowest guard
       (scheduler.go:158-183).
     - pre_bind: snapshot existence check (scheduler.go:189-196).
@@ -222,12 +230,47 @@ class ScalarYodaPlugin:
                 )
         return total
 
+    def _balanced_diskio_score(self, state, pod, node, nodes: list[Node]) -> float:
+        """Scalar ops/score.balanced_diskio (BalancedDiskIOPriority,
+        algorithm.go:121-176): variance-minimization Mj per node, min-max
+        rescaled to [0, 100] with the reference's sentinel seeds
+        (M_max starts at 0, M_min at 1e6, algorithm.go:122-123) and the
+        engine's zero-denominator guard. Whole vector computed once per
+        pod, memoized under S- keys like the live formula."""
+        memo = self.cache.get(f"S-{node.name}")
+        if memo is not None:
+            return memo
+        self._ensure_stats(state, nodes)
+        info = state.read("nodeInfo")
+        r_io = parse_float_or_zero(pod.annotations.get("diskIO"))
+        n = len(nodes)
+        u_avg = self.cache.get("U-AVG")
+        m_tmp = self.cache.get("M-tmp")
+        ms = []
+        for nd in nodes:
+            uj = self.cache.get(f"U-{nd.name}")
+            fj = (info[nd.name].disk_io + r_io) / 100.0
+            f_avg = u_avg - (uj - fj) / n
+            ms.append(m_tmp - ((uj - u_avg) ** 2 - (fj - f_avg) ** 2) / n)
+        m_max = max(0.0, max(ms))
+        m_min = min(1.0e6, min(ms))
+        denom = (m_max - m_min) or 1.0
+        result = 0.0
+        for nd, mj in zip(nodes, ms):
+            s = 100.0 - 100.0 * (mj - m_min) / denom
+            self.cache.set(f"S-{nd.name}", s)
+            if nd.name == node.name:
+                result = s
+        return result
+
     def score(self, state, pod, node, *, all_nodes: list[Node] | None = None):
         nodes = all_nodes or [node]
         if self.policy == "free_capacity":
             return self._free_capacity_score(node)
         if self.policy == "card":
             return self._card_score(pod, node, nodes)
+        if self.policy == "balanced_diskio":
+            return self._balanced_diskio_score(state, pod, node, nodes)
         memo = self.cache.get(f"S-{node.name}")
         if memo is not None:
             return memo

@@ -139,27 +139,33 @@ def test_sharded_sidecar_rejects_mismatched_options():
         server.stop(grace=None)
 
 
-def test_sharded_auction_sidecar_serves_and_pins_knobs():
+def test_sharded_auction_sidecar_honors_request_knobs():
     """A mesh sidecar baked to the AUCTION assigner serves it with dense
-    parity, and rejects requests asking for different auction knobs (the
-    dense branch honors per-request knobs; the sharded program bakes them
-    at startup, so mismatches must fail loud — review finding r4)."""
+    parity and honors REQUEST-carried auction knobs: rounds and price
+    step are traced operands of the sharded program (the round-loop bound
+    and the bid increment), so per-request values cost no recompile —
+    round-4 verdict weak #5 replaced the INVALID_ARGUMENT pinning.
+    Structural options (policy/assigner/normalizer) stay pinned: those
+    ARE baked into the compiled program."""
     import jax
     from kubernetes_scheduler_tpu.engine import schedule_batch
     from kubernetes_scheduler_tpu.parallel.engine import make_sharded_schedule_fn
     from kubernetes_scheduler_tpu.parallel.mesh import make_mesh
+
+    from kubernetes_scheduler_tpu.engine import schedule_windows, stack_windows
+    from kubernetes_scheduler_tpu.parallel.engine import make_sharded_windows_fn
+    from kubernetes_scheduler_tpu.utils.padding import pad_pod_batch
 
     assert jax.device_count() == 8
     mesh = make_mesh(8)
     server, port, _ = make_server(
         "127.0.0.1:0",
         sharded_fn=make_sharded_schedule_fn(mesh, assigner="auction"),
+        sharded_windows_fn=make_sharded_windows_fn(mesh, assigner="auction"),
         sharded_opts={
             "policy": "balanced_cpu_diskio",
             "assigner": "auction",
             "normalizer": "min_max",
-            "auction_rounds": 1024,
-            "auction_price_frac": 1.0 / 16.0,
         },
     )
     server.start()
@@ -175,24 +181,39 @@ def test_sharded_auction_sidecar_serves_and_pins_knobs():
             np.asarray(remote.node_idx).tolist()
             == np.asarray(dense.node_idx).tolist()
         )
+        # structural mismatches still fail loud
         with pytest.raises(EngineUnavailable, match="INVALID_ARGUMENT"):
             client.schedule_batch(snap, pods, assigner="greedy")
-        with pytest.raises(EngineUnavailable, match="INVALID_ARGUMENT"):
-            client.schedule_batch(
-                snap, pods, assigner="auction", auction_price_frac=1.0
+        # request-carried knobs are honored and keep bit-identical parity
+        # with the dense auction run under the SAME knobs
+        # 0.3 pins the non-power-of-two case: both paths must compute
+        # the tie-jitter scale identically (traced f32 on both)
+        for rounds, frac in ((64, 1.0 / 16.0), (256, 1.0 / 4.0), (512, 0.3)):
+            r = client.schedule_batch(
+                snap, pods, assigner="auction",
+                auction_rounds=rounds, auction_price_frac=frac,
             )
-        with pytest.raises(EngineUnavailable, match="INVALID_ARGUMENT"):
-            client.schedule_batch(
-                snap, pods, assigner="auction", auction_rounds=64
+            d = schedule_batch(
+                snap, pods, assigner="auction", affinity_aware=True,
+                auction_rounds=rounds, auction_price_frac=frac,
             )
-        # baked values offered explicitly are accepted
-        ok = client.schedule_batch(
-            snap, pods, assigner="auction",
-            auction_rounds=1024, auction_price_frac=1.0 / 16.0,
+            assert (
+                np.asarray(r.node_idx).tolist()
+                == np.asarray(d.node_idx).tolist()
+            ), (rounds, frac)
+        # the WINDOWS surface threads request knobs into its per-window
+        # scan too — parity against the dense backlog under the same knobs
+        pw = stack_windows(pad_pod_batch(pods, 12), 4)
+        rw = client.schedule_windows(
+            snap, pw, assigner="auction", normalizer="min_max",
+            auction_rounds=128, auction_price_frac=0.3,
         )
-        assert (
-            np.asarray(ok.node_idx).tolist()
-            == np.asarray(dense.node_idx).tolist()
+        dw = schedule_windows(
+            snap, pw, assigner="auction", normalizer="min_max",
+            affinity_aware=True, auction_rounds=128, auction_price_frac=0.3,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(rw.node_idx), np.asarray(dw.node_idx)
         )
     finally:
         client.close()

@@ -5,16 +5,23 @@ PY ?= python
 IMAGE_REPO ?= registry.example.com/yoda-tpu
 TAG ?= latest
 
-.PHONY: local test bench native proto clean build push
+.PHONY: local test test-fast bench native proto clean build push
 
 # "make local" in the reference = fmt + vet + compile. Here: byte-compile
 # the package, build the native library, run the fast tests.
 local: native
 	$(PY) -m compileall -q kubernetes_scheduler_tpu bench.py __graft_entry__.py
-	$(PY) -m pytest tests/ -x -q
+	$(PY) -m pytest tests/ -x -q -m "not slow"
 
+# the full suite (sharding parity sweeps, e2e loops, learned-model
+# training included) — run before committing a milestone
 test:
 	$(PY) -m pytest tests/ -x -q
+
+# the iteration loop: per-kernel/unit tests only (<~2 min on 1 CPU);
+# `slow` marking lives in tests/conftest.py
+test-fast:
+	$(PY) -m pytest tests/ -x -q -m "not slow"
 
 bench:
 	$(PY) bench.py

@@ -92,6 +92,7 @@ class RemoteEngine:
         soft: bool = False,
         auction_price_frac: float = 0.0,
         auction_rounds: int = 0,
+        score_plugins: tuple | None = None,
     ) -> engine.ScheduleResult:
         request = pb.ScheduleRequest(
             policy=policy,
@@ -107,6 +108,8 @@ class RemoteEngine:
             auction_price_frac=auction_price_frac,
             auction_rounds=auction_rounds,
         )
+        for name, weight in score_plugins or ():
+            request.score_plugins.add(name=name, weight=float(weight))
         codec.pack_fields(snapshot, request.snapshot)
         codec.pack_fields(pods, request.pods)
         reply = self._call_with_retry(self._schedule, request)
@@ -125,6 +128,7 @@ class RemoteEngine:
         soft: bool = False,
         auction_price_frac: float = 0.0,
         auction_rounds: int = 0,
+        score_plugins: tuple | None = None,
     ) -> "engine.WindowsResult":
         """Whole-backlog RPC: pods_windows carries a leading [w, p, ...]
         window axis (engine.stack_windows); one sidecar dispatch
@@ -140,6 +144,8 @@ class RemoteEngine:
             auction_price_frac=auction_price_frac,
             auction_rounds=auction_rounds,
         )
+        for name, weight in score_plugins or ():
+            request.score_plugins.add(name=name, weight=float(weight))
         codec.pack_fields(snapshot, request.snapshot)
         codec.pack_fields(pods_windows, request.pods)
         reply = self._call_with_retry(self._schedule_windows, request)

@@ -41,6 +41,16 @@ def _auction_kw(request: pb.ScheduleRequest) -> dict:
         kw["auction_rounds"] = int(request.auction_rounds)
     return kw
 
+
+def _score_plugins(request: pb.ScheduleRequest) -> tuple | None:
+    """Weighted multi-plugin config from the wire (None = single-policy);
+    proto3 zero weight means 1."""
+    if not request.score_plugins:
+        return None
+    return tuple(
+        (e.name, e.weight if e.weight else 1.0) for e in request.score_plugins
+    )
+
 # Matrices are ~P*N*4 bytes; 10k nodes x 4k pods of f32 scores is ~160 MB.
 MAX_MESSAGE_BYTES = 512 * 1024 * 1024
 
@@ -98,6 +108,16 @@ class EngineService:
                     f"sidecar's sharded engine is fixed to "
                     f"{key}={have!r}; request asked for {want!r}",
                 )
+        # score_plugins are STRUCTURAL (baked into the compiled program,
+        # like policy): a request's list must match the built one exactly
+        want_sp = _score_plugins(request)
+        have_sp = self._sharded_opts.get("score_plugins")
+        if want_sp != have_sp and (want_sp or have_sp):
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"sidecar's sharded engine is built with "
+                f"score_plugins={have_sp!r}; request asked for {want_sp!r}",
+            )
         # auction knobs are NOT baked: they are traced operands of the
         # sharded program (the round-loop bound and the price step), so
         # request-carried values are honored per call with no recompile —
@@ -133,6 +153,10 @@ class EngineService:
                 )
                 res = fn(snapshot, pods, **_auction_kw(request))
             else:
+                kw = _auction_kw(request)
+                sp = _score_plugins(request)
+                if sp is not None:
+                    kw["score_plugins"] = sp
                 res = self._engine.schedule_batch(
                     snapshot,
                     pods,
@@ -142,7 +166,7 @@ class EngineService:
                     fused=request.fused,
                     affinity_aware=request.affinity_aware,
                     soft=request.soft,
-                    **_auction_kw(request),
+                    **kw,
                 )
         except ValueError as e:  # unknown policy/assigner/normalizer
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
@@ -176,6 +200,10 @@ class EngineService:
                 )
                 res = fn(snapshot, pods_w, **_auction_kw(request))
             else:
+                kw = _auction_kw(request)
+                sp = _score_plugins(request)
+                if sp is not None:
+                    kw["score_plugins"] = sp
                 res = self._engine.schedule_windows(
                     snapshot,
                     pods_w,
@@ -185,7 +213,7 @@ class EngineService:
                     fused=request.fused,
                     affinity_aware=request.affinity_aware,
                     soft=request.soft,
-                    **_auction_kw(request),
+                    **kw,
                 )
         except ValueError as e:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
@@ -341,6 +369,14 @@ def main(argv=None):
         "balanced_cpu_diskio policy)",
     )
     parser.add_argument(
+        "--score-plugins",
+        default=None,
+        help='JSON list of {"name": ..., "weight": N} — weighted '
+        "multi-plugin scoring baked into the sharded engine when "
+        "--mesh-devices is set (the dense branch honors the request's "
+        "score_plugins field instead)",
+    )
+    parser.add_argument(
         "--learned-checkpoint",
         default=None,
         help="serve the learned two-tower policy restored from this orbax "
@@ -395,6 +431,32 @@ def main(argv=None):
             "normalizer": args.normalizer,
             "fused": args.fused,
         }
+        score_plugins = None
+        if args.score_plugins:
+            import json as _json
+
+            entries = _json.loads(args.score_plugins)
+            if any(float(e.get("weight", 1)) <= 0 for e in entries):
+                # weight 0 is ambiguous on the proto wire (proto3 zero =
+                # unset -> 1); drop the entry to disable a plugin
+                raise SystemExit("--score-plugins weights must be > 0")
+            score_plugins = tuple(
+                (e["name"], float(e.get("weight", 1))) for e in entries
+            )
+            if args.fused:
+                # the fused kernel hardwires the single yoda formula; a
+                # silently-fused "weighted" sidecar would advertise
+                # score_plugins while serving single-policy placements
+                raise SystemExit(
+                    "--score-plugins is incompatible with --fused"
+                )
+            if args.learned_checkpoint:
+                raise SystemExit(
+                    "--score-plugins is incompatible with "
+                    "--learned-checkpoint (the learned scorer replaces "
+                    "the policy; it cannot be one weighted term yet)"
+                )
+            assigner_kw["score_plugins"] = score_plugins
         if args.assigner == "auction":
             assigner_kw.update(
                 auction_rounds=args.auction_rounds,
@@ -435,12 +497,15 @@ def main(argv=None):
         # silently different placement semantics
         # auction knobs deliberately absent: they are per-request traced
         # operands (the startup flags only set the defaults baked into
-        # the fn wrappers above), not pinned options
+        # the fn wrappers above), not pinned options. score_plugins ARE
+        # pinned: the combination is compiled into the program.
         sharded_opts = {
             "policy": args.policy,
             "assigner": args.assigner,
             "normalizer": args.normalizer,
         }
+        if score_plugins is not None:
+            sharded_opts["score_plugins"] = score_plugins
     else:
         sharded_fn_soft = None
         sharded_windows_fn = None

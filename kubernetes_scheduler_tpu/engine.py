@@ -36,6 +36,11 @@ from kubernetes_scheduler_tpu.ops import (
     resource_fit,
     utilization_stats,
 )
+from kubernetes_scheduler_tpu.ops.score import (
+    balanced_allocation,
+    image_locality,
+    least_allocated,
+)
 from kubernetes_scheduler_tpu.ops.assign import (
     AffinityState,
     AssignResult,
@@ -57,9 +62,20 @@ from kubernetes_scheduler_tpu.ops.constraints import (
 from kubernetes_scheduler_tpu.ops.normalize import softmax_normalize
 from kubernetes_scheduler_tpu.ops.assign import NEG
 
-POLICIES = ("balanced_cpu_diskio", "balanced_diskio", "free_capacity", "card")
+POLICIES = (
+    "balanced_cpu_diskio", "balanced_diskio", "free_capacity", "card",
+    "least_allocated", "balanced_allocation", "image_locality",
+)
 ASSIGNERS = ("greedy", "auction")
 NORMALIZERS = ("min_max", "softmax", "none")
+# plugins whose raw output is already on the framework's [0, 100]
+# MaxNodeScore scale (upstream runs NO NormalizeScore extension for
+# them); everything else min-max normalizes per pod before weighting,
+# like the framework runtime does for plugins with ScoreExtensions
+PRESCALED_PLUGINS = (
+    "least_allocated", "balanced_allocation", "image_locality",
+    "balanced_diskio",
+)
 
 
 class SnapshotArrays(NamedTuple):
@@ -103,6 +119,12 @@ class SnapshotArrays(NamedTuple):
     # (engine.compute_soft_scores).
     pref_attract: jnp.ndarray
     pref_avoid: jnp.ndarray
+    # [n, V] float32 image-locality signal (upstream ImageLocality via
+    # go.mod:13): present(n, v) * sizeBytes * (nodes holding v) / n — the
+    # spread ratio is resolved host-side (host/snapshot) so the kernel
+    # shards along the node axis with no collective. V = interned image
+    # vocabulary (bucketed); all-zeros [n, 1] when image data is absent.
+    image_scaled: jnp.ndarray
 
 
 class PodBatch(NamedTuple):
@@ -155,6 +177,11 @@ class PodBatch(NamedTuple):
     # ScheduleAnyway spread constraints: a score term, never a filter
     # (upstream PodTopologySpread scoring; compute_soft_scores)
     soft_spread_sel: jnp.ndarray     # [p, Kss] int32 selector ids, -1 pad
+    # ImageLocality inputs (ops/score.image_locality): the pod's container
+    # image ids into the snapshot's image vocabulary, and the container
+    # count scaling the upstream 23MB..1000MB-per-container ramp
+    image_ids: jnp.ndarray           # [p, Ki] int32 image ids, -1 pad
+    n_containers: jnp.ndarray        # [p] int32
 
 
 def make_snapshot(
@@ -179,6 +206,7 @@ def make_snapshot(
     avoid_counts=None,
     pref_attract=None,
     pref_avoid=None,
+    image_scaled=None,
 ) -> SnapshotArrays:
     """SnapshotArrays with no-op defaults for everything optional (no cards,
     no taints, no labels, no selector counts)."""
@@ -242,6 +270,10 @@ def make_snapshot(
             if pref_avoid is None
             else jnp.asarray(pref_avoid, jnp.float32)
         ),
+        image_scaled=(
+            z(n, 1) if image_scaled is None
+            else jnp.asarray(image_scaled, jnp.float32)
+        ),
     )
 
 
@@ -280,6 +312,8 @@ def make_pod_batch(
     spread_sel=None,
     spread_max=None,
     soft_spread_sel=None,
+    image_ids=None,
+    n_containers=None,
 ) -> PodBatch:
     """PodBatch with no-op defaults (no GPU demand, no tolerations, no
     affinity requirements, no preferences)."""
@@ -378,6 +412,16 @@ def make_pod_batch(
             if soft_spread_sel is None
             else jnp.asarray(soft_spread_sel, jnp.int32)
         ),
+        image_ids=(
+            jnp.full((p, 1), -1, jnp.int32)
+            if image_ids is None
+            else jnp.asarray(image_ids, jnp.int32)
+        ),
+        n_containers=(
+            jnp.ones((p,), jnp.int32)
+            if n_containers is None
+            else jnp.asarray(n_containers, jnp.int32)
+        ),
     )
 
 
@@ -434,7 +478,50 @@ def compute_scores(
             snapshot.cards, per_card & node_fits[:, :, None]
         )
         return card_score(snapshot.cards, snapshot.card_mask, per_card, maxima)
+    if policy == "least_allocated":
+        return least_allocated(
+            snapshot.allocatable, snapshot.requested, pods.request
+        )
+    if policy == "balanced_allocation":
+        return balanced_allocation(
+            snapshot.allocatable, snapshot.requested, pods.request
+        )
+    if policy == "image_locality":
+        return image_locality(
+            snapshot.image_scaled, pods.image_ids, pods.n_containers
+        )
     raise ValueError(f"unknown policy {policy!r}; expected one of {POLICIES}")
+
+
+def combine_scores(
+    snapshot: SnapshotArrays,
+    pods: PodBatch,
+    score_plugins: tuple,
+) -> jnp.ndarray:
+    """The upstream framework runtime's weighted multi-plugin score
+    (RunScorePlugins via /root/reference/go.mod:13): each plugin scores
+    every node, plugins with a NormalizeScore extension are min-max
+    rescaled to [0, MaxNodeScore] per pod (scheduler.go:158-183 is
+    yoda's), and the framework sums weight * score — the production
+    combination the reference's deployed config produces by enabling
+    yoda BESIDE the k8s 1.22 defaults
+    (/root/reference/deploy/yoda-scheduler.yaml:21-47 disables nothing;
+    example/config:25-27 sets yoda's weight).
+
+    score_plugins: tuple of (policy_name, weight) pairs, static under
+    jit. Returns the combined S[p, n] float32 (NOT re-normalized — the
+    framework never rescales the weighted sum).
+    """
+    if not score_plugins:
+        raise ValueError("score_plugins must name at least one plugin")
+    total = None
+    for name, weight in score_plugins:
+        raw = compute_scores(snapshot, pods, name)
+        if name not in PRESCALED_PLUGINS:
+            raw = min_max_normalize(raw, snapshot.node_mask)
+        term = raw * float(weight)
+        total = term if total is None else total + term
+    return total
 
 
 def compute_feasibility(
@@ -651,7 +738,8 @@ def _fused_masked_scores(
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "policy", "assigner", "normalizer", "fused", "affinity_aware", "soft"
+        "policy", "assigner", "normalizer", "fused", "affinity_aware",
+        "soft", "score_plugins",
     ),
 )
 def schedule_batch(
@@ -666,6 +754,7 @@ def schedule_batch(
     soft: bool = False,
     auction_rounds: int = 1024,
     auction_price_frac: float = 1.0,
+    score_plugins: tuple | None = None,
 ) -> ScheduleResult:
     """One scheduling cycle for the whole pending window, on device.
 
@@ -694,7 +783,29 @@ def schedule_batch(
     never materialized, that being the point of the fusion. Consumers that
     need scores across infeasible cells (e.g. models/learned.py teacher
     matrices) must use fused=False.
+
+    score_plugins=((name, weight), ...) replaces the single `policy` with
+    the upstream framework's weighted multi-plugin combination
+    (combine_scores); `policy` and `normalizer` are then ignored —
+    per-plugin normalization happens inside the combination and the
+    weighted sum is final, as the framework runtime computes it.
     """
+    if score_plugins:
+        if fused:
+            raise ValueError(
+                "score_plugins is incompatible with fused=True (the fused "
+                "kernel hardwires the single yoda formula)"
+            )
+        raw = combine_scores(snapshot, pods, score_plugins)
+        feasible = compute_feasibility(
+            snapshot, pods, include_pod_affinity=not affinity_aware
+        )
+        return finish_cycle(
+            snapshot, pods, raw, raw, feasible,
+            assigner=assigner, affinity_aware=affinity_aware, soft=soft,
+            auction_rounds=auction_rounds,
+            auction_price_frac=auction_price_frac,
+        )
     if fused:
         check_fused_contract(policy, normalizer)
         raw = _fused_masked_scores(
@@ -859,7 +970,8 @@ def run_windows_scan(snapshot, pods_windows, cycle_fn) -> "WindowsResult":
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "policy", "assigner", "normalizer", "fused", "affinity_aware", "soft",
+        "policy", "assigner", "normalizer", "fused", "affinity_aware",
+        "soft", "score_plugins",
     ),
 )
 def schedule_windows(
@@ -874,6 +986,7 @@ def schedule_windows(
     soft: bool = False,
     auction_rounds: int = 1024,
     auction_price_frac: float = 1.0,
+    score_plugins: tuple | None = None,
 ) -> WindowsResult:
     """Schedule many windows in ONE device program: lax.scan over the
     window axis, carrying node capacity AND (anti)affinity domain counts
@@ -903,6 +1016,7 @@ def schedule_windows(
             fused=fused, affinity_aware=affinity_aware, soft=soft,
             auction_rounds=auction_rounds,
             auction_price_frac=auction_price_frac,
+            score_plugins=score_plugins,
         )
 
     return run_windows_scan(snapshot, pods_windows, cycle)

@@ -58,14 +58,29 @@ _FC_DISK_W, _FC_CPU_W, _FC_MEM_W = 100.0, 2.0, 3.0
 
 # policies the scalar path scores faithfully; anything else falls back to
 # the yoda formula and bumps fallback_policy_mismatch (host/scheduler) —
-# with all four heuristic policies mirrored, `learned` is the only policy
+# with every heuristic policy mirrored, `learned` is the only policy
 # with no scalar equivalent (its scores live in device parameters)
 SCALAR_POLICIES = (
     "balanced_cpu_diskio",
     "balanced_diskio",
     "free_capacity",
     "card",
+    "least_allocated",
+    "balanced_allocation",
+    "image_locality",
 )
+# mirrors engine.PRESCALED_PLUGINS (kept import-free here so the scalar
+# fallback never pulls jax; tests pin the two tuples equal): plugins whose
+# raw output is already on the [0, 100] MaxNodeScore scale — the weighted
+# combination min-max normalizes everything else per pod, like the
+# upstream framework runtime
+PRESCALED_SCALAR = (
+    "least_allocated", "balanced_allocation", "image_locality",
+    "balanced_diskio",
+)
+# ImageLocality ramp (mirrors ops/score.py)
+_IMG_MIN = 23.0 * 1024 * 1024
+_IMG_MAX = 1000.0 * 1024 * 1024
 
 
 def gpu_demands(pod: Pod) -> tuple[int, float, float]:
@@ -144,8 +159,16 @@ class ScalarYodaPlugin:
         *,
         truncate: bool = True,
         policy: str = "balanced_cpu_diskio",
+        score_plugins: tuple | None = None,
     ):
-        if policy not in SCALAR_POLICIES:
+        if score_plugins:
+            bad = [n for n, _ in score_plugins if n not in SCALAR_POLICIES]
+            if bad:
+                raise ValueError(
+                    f"scalar path cannot score plugins {bad}; "
+                    f"supported: {SCALAR_POLICIES}"
+                )
+        elif policy not in SCALAR_POLICIES:
             raise ValueError(
                 f"scalar path cannot score policy {policy!r}; "
                 f"supported: {SCALAR_POLICIES}"
@@ -154,6 +177,11 @@ class ScalarYodaPlugin:
         self.cache = CycleCache()
         self.truncate = truncate
         self.policy = policy
+        # weighted multi-plugin mode (engine.combine_scores mirror):
+        # ((name, weight), ...) — scores become the framework's weighted
+        # sum; pass truncate=False for exact engine parity (the engine's
+        # yoda term never truncates)
+        self.score_plugins = tuple(score_plugins or ())
 
     def pre_filter(self, state, pod):
         return None
@@ -240,6 +268,15 @@ class ScalarYodaPlugin:
         memo = self.cache.get(f"S-{node.name}")
         if memo is not None:
             return memo
+        scores = self._balanced_diskio_vector(state, pod, nodes)
+        result = 0.0
+        for nd, s in zip(nodes, scores):
+            self.cache.set(f"S-{nd.name}", s)
+            if nd.name == node.name:
+                result = s
+        return result
+
+    def _balanced_diskio_vector(self, state, pod, nodes) -> list[float]:
         self._ensure_stats(state, nodes)
         info = state.read("nodeInfo")
         r_io = parse_float_or_zero(pod.annotations.get("diskIO"))
@@ -255,22 +292,151 @@ class ScalarYodaPlugin:
         m_max = max(0.0, max(ms))
         m_min = min(1.0e6, min(ms))
         denom = (m_max - m_min) or 1.0
+        return [100.0 - 100.0 * (mj - m_min) / denom for mj in ms]
+
+    # ---- upstream resource-shape scorers (ops/score.py mirrors) -------
+
+    def _used_after(self, pod: Pod, node: Node, free, res: str) -> float:
+        """alloc - free + this pod's request for `res` (NonZeroRequested
+        semantics — `free` was accumulated with the same defaults)."""
+        alloc = node.allocatable.get(res, 0.0)
+        node_free = free[node.name].get(res, alloc) if free else alloc
+        return alloc - node_free + pod_resource_request(pod, res)
+
+    def _least_allocated_score(self, pod, node, free) -> float:
+        total = 0.0
+        for res in ("cpu", "memory"):
+            alloc = node.allocatable.get(res, 0.0)
+            used = self._used_after(pod, node, free, res)
+            if alloc > 0 and used <= alloc:
+                total += (alloc - used) * MAX_NODE_SCORE / alloc
+        return total / 2.0
+
+    def _balanced_allocation_score(self, pod, node, free) -> float:
+        fracs = []
+        for res in ("cpu", "memory"):
+            alloc = node.allocatable.get(res, 0.0)
+            if alloc <= 0:
+                return 0.0
+            fracs.append(self._used_after(pod, node, free, res) / alloc)
+        if any(f >= 1.0 for f in fracs):
+            return 0.0
+        return (1.0 - abs(fracs[0] - fracs[1])) * MAX_NODE_SCORE
+
+    def _image_holders(self, nodes) -> dict:
+        """Image -> node count, memoized on the node LIST identity (not
+        the per-pod CycleCache, which flushes between pods — the map
+        depends only on the nodes and must survive the window)."""
+        memo = getattr(self, "_holders_memo", None)
+        if memo is not None and memo[0] is nodes:
+            return memo[1]
+        holders: dict = {}
+        for nd in nodes:
+            for img in nd.images:
+                holders[img] = holders.get(img, 0) + 1
+        self._holders_memo = (nodes, holders)
+        return holders
+
+    def _image_locality_score(self, pod, node, nodes) -> float:
+        total_nodes = max(len(nodes), 1)
+        holders = self._image_holders(nodes)
+        total = 0.0
+        for c in pod.containers:
+            if c.image and c.image in node.images:
+                total += node.images[c.image] * holders[c.image] / total_nodes
+        n_c = max(len(pod.containers), 1)
+        lo, hi = _IMG_MIN * n_c, _IMG_MAX * n_c
+        return min(max((total - lo) / (hi - lo), 0.0), 1.0) * MAX_NODE_SCORE
+
+    # ---- weighted multi-plugin combination (engine.combine_scores) ----
+
+    def _plugin_vector(self, name, state, pod, nodes, free) -> list[float]:
+        if name == "balanced_cpu_diskio":
+            self._ensure_stats(state, nodes)
+            r_io = parse_float_or_zero(pod.annotations.get("diskIO"))
+            r_cpu = pod_resource_request(pod, "cpu")
+            beta = 1.0 / (1.0 + r_cpu / r_io) if r_io > 0 else 0.0
+            alpha = 1.0 - beta
+            out = []
+            for n in nodes:
+                u = self.cache.get(f"U-{n.name}")
+                v = self.cache.get(f"V-{n.name}")
+                s = 10.0 - 10.0 * abs(alpha * v - beta * u)
+                if self.truncate:
+                    s = float(int(s)) if s >= 0 else 0.0
+                out.append(s)
+            return out
+        if name == "balanced_diskio":
+            return self._balanced_diskio_vector(state, pod, nodes)
+        if name == "free_capacity":
+            return [self._free_capacity_score(n) for n in nodes]
+        if name == "card":
+            return [self._card_score(pod, n, nodes) for n in nodes]
+        if name == "least_allocated":
+            return [self._least_allocated_score(pod, n, free) for n in nodes]
+        if name == "balanced_allocation":
+            return [
+                self._balanced_allocation_score(pod, n, free) for n in nodes
+            ]
+        if name == "image_locality":
+            return [self._image_locality_score(pod, n, nodes) for n in nodes]
+        raise ValueError(f"unknown scalar plugin {name!r}")
+
+    @staticmethod
+    def _min_max(vec: list[float]) -> list[float]:
+        """The framework's rescale (scheduler.go:161-180 /
+        ops/normalize.min_max_normalize): highest clamped >= 0, hi==lo
+        guard. The ONE implementation behind both normalize_scores (the
+        per-pod NormalizeScore hook) and the per-plugin rescale inside
+        the weighted combination — they must not drift."""
+        hi = max(0.0, *vec)
+        lo = min(vec)
+        if hi == lo:
+            lo -= 1.0
+        return [(s - lo) * MAX_NODE_SCORE / (hi - lo) for s in vec]
+
+    def _combined_score(self, state, pod, node, nodes, free) -> float:
+        memo = self.cache.get(f"S-{node.name}")
+        if memo is not None:
+            return memo
+        total = [0.0] * len(nodes)
+        for name, weight in self.score_plugins:
+            vec = self._plugin_vector(name, state, pod, nodes, free)
+            if name not in PRESCALED_SCALAR:
+                vec = self._min_max(vec)
+            for i, s in enumerate(vec):
+                total[i] += s * float(weight)
         result = 0.0
-        for nd, mj in zip(nodes, ms):
-            s = 100.0 - 100.0 * (mj - m_min) / denom
-            self.cache.set(f"S-{nd.name}", s)
-            if nd.name == node.name:
+        for n, s in zip(nodes, total):
+            self.cache.set(f"S-{n.name}", s)
+            if n.name == node.name:
                 result = s
         return result
 
-    def score(self, state, pod, node, *, all_nodes: list[Node] | None = None):
+    def score(
+        self,
+        state,
+        pod,
+        node,
+        *,
+        all_nodes: list[Node] | None = None,
+        free: dict | None = None,
+    ):
         nodes = all_nodes or [node]
+        if self.score_plugins:
+            return self._combined_score(state, pod, node, nodes, free)
         if self.policy == "free_capacity":
             return self._free_capacity_score(node)
         if self.policy == "card":
             return self._card_score(pod, node, nodes)
         if self.policy == "balanced_diskio":
             return self._balanced_diskio_score(state, pod, node, nodes)
+        if self.policy == "least_allocated":
+            return self._least_allocated_score(pod, node, free)
+        if self.policy == "balanced_allocation":
+            return self._balanced_allocation_score(pod, node, free)
+        if self.policy == "image_locality":
+            return self._image_locality_score(pod, node, nodes)
         memo = self.cache.get(f"S-{node.name}")
         if memo is not None:
             return memo
@@ -294,14 +460,10 @@ class ScalarYodaPlugin:
 
     def normalize_scores(self, state, pod, scores):
         self.cache.flush()
-        highest = max(0.0, *scores.values()) if scores else 0.0
-        lowest = min(scores.values()) if scores else 0.0
-        if highest == lowest:
-            lowest -= 1.0
-        return {
-            name: (s - lowest) * MAX_NODE_SCORE / (highest - lowest)
-            for name, s in scores.items()
-        }
+        if not scores:
+            return {}
+        names = list(scores)
+        return dict(zip(names, self._min_max([scores[n] for n in names])))
 
     def pre_bind(self, state, pod, node_name):
         return None
@@ -312,11 +474,19 @@ def scalar_schedule_one(
     pod: Pod,
     nodes: list[Node],
     free: dict[str, dict[str, float]],
+    score_free: dict[str, dict[str, float]] | None = None,
 ) -> str | None:
     """One full upstream-style scheduling cycle for one pod: the hook
     sequence of SURVEY.md §3.2, with real resource-fit filtering and
     capacity bookkeeping (which upstream's NodeResourcesFit + binding cycle
-    provide around the reference plugin)."""
+    provide around the reference plugin).
+
+    score_free: the capacity state SCORES read (the shape scorers'
+    A-Q input). The engine computes a window's score matrices against
+    PRE-window state (feasibility stays dynamic), so a fallback
+    mirroring it must score against a frozen copy while `free` keeps
+    live bookkeeping; None = score against live `free` (single-pod
+    cycles, where the two coincide)."""
     state = CycleState()
     plugin.pre_filter(state, pod)
     plugin.pre_score(state, pod, nodes)
@@ -335,7 +505,11 @@ def scalar_schedule_one(
     if not feasible:
         return None
     scores = {
-        n.name: plugin.score(state, pod, n, all_nodes=nodes) for n in feasible
+        n.name: plugin.score(
+            state, pod, n, all_nodes=nodes,
+            free=score_free if score_free is not None else free,
+        )
+        for n in feasible
     }
     scores = plugin.normalize_scores(state, pod, scores)
     # deterministic argmax: highest score, first in node order on ties

@@ -781,8 +781,10 @@ class Scheduler:
                 or (np.asarray(pods_batch.spread_sel) >= 0).any()
             )
         )
+        score_plugins = self.config.score_plugins_tuple()
         fused = (
             self.config.feature_gates.fused_kernel
+            and score_plugins is None
             and self.config.policy == "balanced_cpu_diskio"
             and self.config.normalizer == "none"
         )
@@ -794,6 +796,12 @@ class Scheduler:
             affinity_aware=affinity_aware,
             soft=soft,
         )
+        if score_plugins is not None:
+            # multi-plugin weighted scoring (upstream RunScorePlugins);
+            # gated on the engine accepting the kw so a version-skewed
+            # remote degrades loud (TypeError -> scalar fallback) rather
+            # than silently scoring single-policy
+            kw["score_plugins"] = score_plugins
         if self._engine_takes_auction_kw:
             kw.update(
                 auction_rounds=self.config.auction_rounds,
@@ -885,6 +893,26 @@ class Scheduler:
         from kubernetes_scheduler_tpu.host.plugins import SCALAR_POLICIES
 
         policy = self.config.policy
+        score_plugins = self.config.score_plugins_tuple()
+        if score_plugins is not None:
+            # weighted multi-plugin mode: every heuristic plugin has a
+            # scalar mirror; truncate=False matches the engine's
+            # combination (its yoda term never truncates)
+            bad = [n for n, _ in score_plugins if n not in SCALAR_POLICIES]
+            if bad:
+                log.warning(
+                    "scalar fallback cannot score plugins %r; scoring "
+                    "with balanced_cpu_diskio (fallback_policy_mismatch)",
+                    bad,
+                )
+                m.policy_mismatch = True
+                score_plugins = None
+            else:
+                plugin = ScalarYodaPlugin(
+                    utils, score_plugins=score_plugins, truncate=False
+                )
+                self._scalar_window(plugin, window, nodes, running, m)
+                return
         if policy == "balanced_cpu_diskio" and nodes and self._native_ok:
             self._run_scalar_native(window, nodes, running, utils, m)
             return
@@ -901,6 +929,9 @@ class Scheduler:
             m.policy_mismatch = True
             policy = "balanced_cpu_diskio"
         plugin = ScalarYodaPlugin(utils, policy=policy)
+        self._scalar_window(plugin, window, nodes, running, m)
+
+    def _scalar_window(self, plugin, window, nodes, running, m: CycleMetrics):
         free = {
             n.name: {
                 res: n.allocatable.get(res, 0.0) for res in self.builder.resource_names
@@ -911,9 +942,20 @@ class Scheduler:
             if pod.node_name in free:
                 for res in free[pod.node_name]:
                     free[pod.node_name][res] -= pod_resource_request(pod, res)
+        # scores read the PRE-window capacity state (the engine computes
+        # a window's score matrices before any in-window bind; only
+        # feasibility is dynamic) — freeze a copy for the scorers while
+        # `free` keeps live bookkeeping
+        score_free = {name: dict(res) for name, res in free.items()}
         for pod in window:
             plugin.cache.flush()
-            best = scalar_schedule_one(plugin, pod, nodes, free) if nodes else None
+            best = (
+                scalar_schedule_one(
+                    plugin, pod, nodes, free, score_free=score_free
+                )
+                if nodes
+                else None
+            )
             if best is not None:
                 self._bind(pod, best, m)
             else:

@@ -71,6 +71,12 @@ class Interner:
             self._table[s] = len(self._table)
         return self._table[s]
 
+    def lookup(self, s: str) -> int:
+        """Non-inserting probe: -1 for strings outside the vocabulary
+        (pod-side readers must not grow a table the node-side matrix was
+        already sized against)."""
+        return self._table.get(s, -1)
+
     def __len__(self):
         return len(self._table)
 
@@ -118,6 +124,10 @@ class SnapshotBuilder:
     extended_resources: list[str] = field(default_factory=list)
     label_keys: Interner = field(default_factory=Interner)
     label_values: Interner = field(default_factory=Interner)
+    # container-image vocabulary for ImageLocality (ops/score.py): ids
+    # shared between build_snapshot's [n, V] scaled-size matrix and
+    # build_pod_batch's per-pod image-id lists
+    images: Interner = field(default_factory=Interner)
     selectors: dict[tuple, int] = field(default_factory=dict)
     # hostPort conflict state (upstream NodePorts): each distinct hostPort
     # in flight becomes a capacity-1 pseudo-resource column, so the
@@ -265,6 +275,29 @@ class SnapshotBuilder:
             nodes, running_pods, pending_pods or [], n
         )
 
+        # ImageLocality signal: scaled size = present * sizeBytes *
+        # (nodes holding the image / real nodes) — the upstream
+        # scaledImageScore's spread ratio, resolved here so the engine
+        # kernel is a pure gather (shards along the node axis with no
+        # collective). The vocabulary only grows for images a node
+        # actually holds; pod-side ids for never-seen images stay -1-free
+        # but score 0 (zero column).
+        for nd in nodes:
+            for img in nd.images:
+                self.images.id(img)
+        v = bucket_size(max(len(self.images), 1), floor=1, multiple=1)
+        image_scaled = np.zeros((n, v), np.float32)
+        if len(self.images) and n_real:
+            holders = np.zeros(v, np.float32)
+            for nd in nodes:
+                for img in nd.images:
+                    holders[self.images.id(img)] += 1.0
+            ratio = holders / float(n_real)
+            for i, nd in enumerate(nodes):
+                for img, size in nd.images.items():
+                    j = self.images.id(img)
+                    image_scaled[i, j] = float(size) * ratio[j]
+
         # HOST-side numpy arrays, deliberately NOT jnp (make_snapshot
         # would device_put them): on a remote/tunneled device every
         # later host-side probe (np.asarray for option checks, shapes,
@@ -281,6 +314,7 @@ class SnapshotBuilder:
             node_label_mask=label_mask, domain_counts=domain_counts,
             domain_id=domain_id, avoid_counts=avoid_counts,
             pref_attract=pref_attract, pref_avoid=pref_avoid,
+            image_scaled=image_scaled,
         )
 
     def _selector_id(self, term) -> int:
@@ -507,12 +541,27 @@ class SnapshotBuilder:
         # default: every expression its own preferred term
         pna_term = np.tile(np.arange(ep_max, dtype=np.int32), (p, 1))
 
+        ki_max = bucket_size(
+            max((len(pd.containers) for pd in pods), default=0),
+            floor=1, multiple=1,
+        )
+        image_ids = np.full((p, ki_max), -1, np.int32)
+        n_containers = np.ones(p, np.int32)
+
         names_t = tuple(names)
         pods_col = names.index("pods")
         n_port0 = len(names) - self._port_slots
         for i, pod in enumerate(pods):
             request[i] = pod_request_vector(pod, names_t)
             request[i, pods_col] = 1
+            # ImageLocality inputs: container images mapped through the
+            # node-side vocabulary (lookup-only — an image on no node
+            # scores 0 and must not grow the table the snapshot matrix
+            # was sized against); threshold scale = container count
+            n_containers[i] = max(len(pod.containers), 1)
+            for j, c in enumerate(pod.containers[:ki_max]):
+                if c.image:
+                    image_ids[i, j] = self.images.lookup(c.image)
             for pt in pod.host_ports:
                 # ports outside the table mean build_snapshot did not see
                 # this window (_assign_port_slots) — fail loud
@@ -626,4 +675,5 @@ class SnapshotBuilder:
             pref_anti_weight=pref_anti_w, target_node=target_node,
             spread_sel=spread_sel, spread_max=spread_max,
             soft_spread_sel=soft_spread_sel,
+            image_ids=image_ids, n_containers=n_containers,
         )

@@ -40,6 +40,9 @@ def parse_cpu_milli(q: str | int | float) -> float:
 @dataclass
 class Container:
     requests: dict[str, float] = field(default_factory=dict)  # canonical units
+    # container image reference (upstream ImageLocality scoring input);
+    # "" = unknown/absent
+    image: str = ""
 
 
 @dataclass
@@ -110,6 +113,12 @@ class PodAffinityTerm:
     # what kube/convert fills in ([pod.namespace]) when the term
     # carries no explicit list
     namespaces: list[str] | None = None
+    # a non-empty namespaceSelector (labels-selected namespaces,
+    # k8s >= 1.21), stored as (match_labels, match_expressions) so
+    # kube/convert.resolve_namespace_selectors can turn it into the
+    # concrete list — upstream semantics: selector-matched namespaces
+    # UNIONed with any explicit `namespaces` entries. None = no selector.
+    namespace_selector: tuple | None = None
 
 
 @dataclass
@@ -300,3 +309,7 @@ class Node:
     taints: list[Taint] = field(default_factory=list)
     allocatable: dict[str, float] = field(default_factory=dict)
     cards: list[Card] = field(default_factory=list)
+    # container images present on the node: image reference -> sizeBytes
+    # (node.status.images; every entry's name aliases share the size) —
+    # upstream ImageLocality's input
+    images: dict[str, float] = field(default_factory=dict)

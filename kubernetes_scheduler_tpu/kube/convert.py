@@ -14,8 +14,11 @@ Documented simplifications (each is a capability note, not an accident):
 - pod-(anti)affinity and spread label selectors support matchLabels AND
   matchExpressions (host/types.labels_match) with upstream namespace
   scoping (own namespace by default, explicit `namespaces` honored;
-  a namespaceSelector is approximated as ALL namespaces, logged);
-  spread carries both whenUnsatisfiable modes (DoNotSchedule hard,
+  a non-empty namespaceSelector resolves EXACTLY against the live
+  namespace set via resolve_namespace_selectors — the k8s >= 1.21
+  union-with-explicit-list semantics; only when namespace data is
+  unavailable does it degrade to ALL namespaces, logged); spread
+  carries both whenUnsatisfiable modes (DoNotSchedule hard,
   ScheduleAnyway soft).
 - GPU cards come from the SCV CRD in the reference (filter.go:8); the
   core API carries no card inventory, so nodes converted here have no
@@ -61,7 +64,9 @@ def _requests(resources: dict | None) -> dict[str, float]:
 
 
 def _container(c: dict) -> Container:
-    return Container(requests=_requests(c.get("resources")))
+    return Container(
+        requests=_requests(c.get("resources")), image=c.get("image") or ""
+    )
 
 
 def _match_expr(e: dict) -> MatchExpression:
@@ -70,31 +75,81 @@ def _match_expr(e: dict) -> MatchExpression:
     )
 
 
-def _term_namespaces(term: dict, own_namespace: str, pod_name) -> list[str] | None:
-    """Upstream PodAffinityTerm namespace scope. A namespaceSelector
-    means label-selected namespaces UNIONed with any explicit
-    `namespaces` list; this scheduler does no namespace lookup, so any
-    selector is approximated as ALL namespaces (the `{}`-selector
-    semantics — conservative for affinity visibility, logged when it
-    widens the scope). Otherwise: the explicit list, or the owning
-    pod's own namespace."""
-    if term.get("namespaceSelector") is not None:
-        # `{}` selects ALL namespaces upstream (and unions with any
-        # explicit list) — all-namespaces is then EXACT; only a
-        # non-empty selector is genuinely approximated
-        if term["namespaceSelector"]:
-            log.warning(
-                "pod %s: namespaceSelector approximated as ALL namespaces",
-                pod_name,
+def _term_namespaces(
+    term: dict, own_namespace: str
+) -> tuple[list[str] | None, tuple | None]:
+    """Upstream PodAffinityTerm namespace scope -> (namespaces,
+    namespace_selector). `{}` as namespaceSelector selects ALL
+    namespaces (None); a NON-empty selector is captured as
+    (match_labels, match_expressions) for
+    `resolve_namespace_selectors` to union with the explicit list
+    against the live namespace set — exact k8s >= 1.21 semantics (the
+    round-4 ALL-namespaces approximation is gone). Without a selector:
+    the explicit list, or the owning pod's own namespace."""
+    sel = term.get("namespaceSelector")
+    if sel is not None:
+        if sel:
+            captured = (
+                dict(sel.get("matchLabels") or {}),
+                [_match_expr(e) for e in sel.get("matchExpressions") or []],
             )
-        return None  # all namespaces
+            return list(term.get("namespaces") or []), captured
+        return None, None  # {} = all namespaces (exact)
     if term.get("namespaces"):
-        return list(term["namespaces"])
-    return [own_namespace]
+        return list(term["namespaces"]), None
+    return [own_namespace], None
+
+
+def resolve_namespace_selectors(
+    pod: Pod, namespace_labels: dict[str, dict] | None
+) -> Pod:
+    """Resolve every pod-affinity term's namespaceSelector against the
+    live namespace set (name -> labels): term.namespaces becomes the
+    UNION of the explicit entries and the selector-matched namespaces —
+    upstream InterPodAffinity's namespace scoping. A selector matching
+    nothing (and no explicit entries) leaves an empty list, which
+    matches no pods: required affinity is then unsatisfiable and anti
+    trivially satisfied, as upstream.
+
+    namespace_labels=None means no namespace data is available (informer
+    unavailable / RBAC missing): degrade to the ALL-namespaces
+    approximation, logged — over-admits affinity and over-constrains
+    anti-affinity, the conservative pre-informer stance."""
+    import dataclasses
+
+    if not any(t.namespace_selector for t in pod.pod_affinity):
+        return pod
+    terms = []
+    for t in pod.pod_affinity:
+        if not t.namespace_selector:
+            terms.append(t)
+            continue
+        if namespace_labels is None:
+            log.warning(
+                "pod %s/%s: no namespace data; namespaceSelector "
+                "approximated as ALL namespaces",
+                pod.namespace, pod.name,
+            )
+            terms.append(dataclasses.replace(t, namespaces=None))
+            continue
+        from kubernetes_scheduler_tpu.host.types import labels_match
+
+        ml, mx = t.namespace_selector
+        matched = {
+            name
+            for name, labels in namespace_labels.items()
+            if labels_match(labels, ml, mx)
+        }
+        terms.append(
+            dataclasses.replace(
+                t, namespaces=sorted(matched | set(t.namespaces or ()))
+            )
+        )
+    return dataclasses.replace(pod, pod_affinity=terms)
 
 
 def _pod_affinity_terms(
-    spec: dict, *, anti: bool, namespace: str, pod_name=None
+    spec: dict, *, anti: bool, namespace: str
 ) -> list[PodAffinityTerm]:
     sect = (spec.get("affinity") or {}).get(
         "podAntiAffinity" if anti else "podAffinity"
@@ -110,19 +165,22 @@ def _pod_affinity_terms(
     for term in sect.get("requiredDuringSchedulingIgnoredDuringExecution") or []:
         got = selector(term)
         if got:
+            ns, ns_sel = _term_namespaces(term, namespace)
             out.append(
                 PodAffinityTerm(
                     match_labels=got[0],
                     match_expressions=got[1],
                     topology_key=term.get("topologyKey", "kubernetes.io/hostname"),
                     anti=anti,
-                    namespaces=_term_namespaces(term, namespace, pod_name),
+                    namespaces=ns,
+                    namespace_selector=ns_sel,
                 )
             )
     for wt in sect.get("preferredDuringSchedulingIgnoredDuringExecution") or []:
         term = wt.get("podAffinityTerm") or {}
         got = selector(term)
         if got:
+            ns, ns_sel = _term_namespaces(term, namespace)
             out.append(
                 PodAffinityTerm(
                     match_labels=got[0],
@@ -131,7 +189,8 @@ def _pod_affinity_terms(
                     anti=anti,
                     preferred=True,
                     weight=int(wt.get("weight", 1)),
-                    namespaces=_term_namespaces(term, namespace, pod_name),
+                    namespaces=ns,
+                    namespace_selector=ns_sel,
                 )
             )
     return out
@@ -273,12 +332,10 @@ def pod_from_api(obj: dict) -> Pod:
         node_affinity=required,
         pod_affinity=(
             _pod_affinity_terms(
-                spec, anti=False, namespace=meta.get("namespace", "default"),
-                pod_name=meta.get("name"),
+                spec, anti=False, namespace=meta.get("namespace", "default")
             )
             + _pod_affinity_terms(
-                spec, anti=True, namespace=meta.get("namespace", "default"),
-                pod_name=meta.get("name"),
+                spec, anti=True, namespace=meta.get("namespace", "default")
             )
         ),
         preferred_node_affinity=preferred,
@@ -391,6 +448,14 @@ def node_from_api(obj: dict) -> Node:
         )
         for t in spec.get("taints") or []
     ]
+    # node.status.images -> ImageLocality input: every name alias of an
+    # image entry maps to its size (upstream keys its image states by
+    # every listed name too)
+    images: dict[str, float] = {}
+    for entry in status.get("images") or []:
+        size = float(entry.get("sizeBytes") or 0)
+        for alias in entry.get("names") or []:
+            images[alias] = size
     # cordoned node (kubectl cordon sets spec.unschedulable): upstream's
     # NodeUnschedulable plugin filters it, tolerable via the well-known
     # taint key — expressed here as exactly that taint, so the existing
@@ -408,4 +473,5 @@ def node_from_api(obj: dict) -> Node:
         taints=taints,
         allocatable=allocatable,
         cards=cards,
+        images=images,
     )

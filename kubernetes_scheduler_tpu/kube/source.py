@@ -58,6 +58,11 @@ class InformerCache:
         self._pdbs: dict[str, object] = {}
         self._pvcs: dict[str, object] = {}
         self._pvs: dict[str, object] = {}
+        # namespace name -> labels, for exact namespaceSelector
+        # resolution (convert.resolve_namespace_selectors); None until
+        # synced or when the list is denied (RBAC) — readers then fall
+        # back to the ALL-namespaces approximation
+        self._namespaces: dict[str, dict] | None = None
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._synced = {
@@ -66,13 +71,16 @@ class InformerCache:
             "pdbs": threading.Event(),
             "pvcs": threading.Event(),
             "pvs": threading.Event(),
+            "namespaces": threading.Event(),
         }
         self._threads: list[threading.Thread] = []
 
     # -- lifecycle -------------------------------------------------------
 
     def start(self) -> "InformerCache":
-        loops = [self._node_loop, self._pod_loop, self._pdb_loop]
+        loops = [
+            self._node_loop, self._pod_loop, self._pdb_loop, self._ns_loop,
+        ]
         if self.volumes:
             loops += [self._pvc_loop, self._pv_loop]
         else:
@@ -125,6 +133,13 @@ class InformerCache:
         """Point lookup by PV name — no map copy."""
         with self._lock:
             return self._pvs.get(name)
+
+    def namespace_labels(self) -> dict[str, dict] | None:
+        """name -> labels of every namespace, watch-fed; None when the
+        namespace list is unavailable (callers then approximate
+        namespaceSelectors as ALL namespaces)."""
+        with self._lock:
+            return dict(self._namespaces) if self._namespaces is not None else None
 
     def assume(self, pod: Pod) -> None:
         """Record a just-bound pod before the watch echoes it back —
@@ -238,6 +253,55 @@ class InformerCache:
             elif ev.get("type") in ("ADDED", "MODIFIED"):
                 self._pdbs[key] = pdb_from_api(obj)
 
+    # -- namespace loop --------------------------------------------------
+
+    def _ns_loop(self) -> None:
+        """Namespace names + labels, for exact namespaceSelector
+        resolution on inter-pod (anti)affinity terms (k8s >= 1.21
+        semantics). Optional: a control plane denying the list (RBAC)
+        flips the store to None and selector-carrying terms degrade to
+        the logged ALL-namespaces approximation instead of silently
+        matching nothing."""
+        self._resource_loop(
+            "namespaces",
+            "/api/v1/namespaces",
+            params=None,
+            replace=self._replace_namespaces,
+            apply=self._apply_ns_event,
+            optional=True,
+            unavailable=self._namespaces_unavailable,
+        )
+
+    def _replace_namespaces(self, items: list[dict]) -> None:
+        fresh = {
+            (o.get("metadata") or {}).get("name", ""): dict(
+                (o.get("metadata") or {}).get("labels") or {}
+            )
+            for o in items
+        }
+        fresh.pop("", None)
+        with self._lock:
+            self._namespaces = fresh
+
+    def _namespaces_unavailable(self) -> None:
+        with self._lock:
+            self._namespaces = None
+
+    def _apply_ns_event(self, ev: dict) -> None:
+        obj = ev.get("object") or {}
+        name = (obj.get("metadata") or {}).get("name")
+        if not name:
+            return
+        with self._lock:
+            if self._namespaces is None:
+                self._namespaces = {}
+            if ev.get("type") == "DELETED":
+                self._namespaces.pop(name, None)
+            elif ev.get("type") in ("ADDED", "MODIFIED"):
+                self._namespaces[name] = dict(
+                    (obj.get("metadata") or {}).get("labels") or {}
+                )
+
     # -- volume loops ----------------------------------------------------
 
     def _pvc_loop(self) -> None:
@@ -302,7 +366,8 @@ class InformerCache:
     # -- shared loop -----------------------------------------------------
 
     def _resource_loop(
-        self, name, path, *, params, replace, apply, optional: bool = False
+        self, name, path, *, params, replace, apply, optional: bool = False,
+        unavailable=None,
     ) -> None:
         """list -> watch-from-resourceVersion -> apply, relisting only on
         410 Gone (rv expired), errors, or the periodic resync — NOT on
@@ -355,7 +420,10 @@ class InformerCache:
                         "%s unavailable (HTTP %s); continuing without",
                         name, e.status,
                     )
-                    replace([])
+                    # default: empty-but-synced; resources distinguishing
+                    # "none exist" from "cannot know" (namespaces) supply
+                    # their own unavailable state
+                    (unavailable or (lambda: replace([])))()
                     self._synced[name].set()
                     rv = None
                     self._stop.wait(self.resync_interval)
@@ -402,6 +470,12 @@ class KubeClusterSource:
         self.pdb_ttl = pdb_ttl
         self._pdb_cache: list | None = None
         self._pdb_expiry = 0.0
+        # cache-less namespace snapshot for namespaceSelector resolution
+        # (TTL like the PDB list); the informer path reads its watch-fed
+        # namespace store instead
+        self._ns_cache: dict | None = None
+        self._ns_expiry = 0.0
+        self._ns_denied = False
         # bound PVs constrain placement (VolumeZone/VolumeBinding parity):
         # the pending stream hands the scheduler pods whose node-affinity
         # already carries their volumes' topology (kube/volumes.py). With
@@ -415,6 +489,53 @@ class KubeClusterSource:
         if self.volumes is None or not pod.volume_claims:
             return pod
         return self.volumes.fold(pod)
+
+    def _namespace_labels(self) -> dict[str, dict] | None:
+        """Namespace name -> labels for namespaceSelector resolution.
+        Informer-cached when available; else a TTL LIST; None (= degrade
+        to the ALL-namespaces approximation) when the list is denied."""
+        if self.cache is not None:
+            return self.cache.namespace_labels()
+        if self._ns_denied:
+            return None
+        now = time.monotonic()
+        if self._ns_cache is not None and now < self._ns_expiry:
+            return self._ns_cache
+        try:
+            items = self.client.list_all("/api/v1/namespaces")
+        except KubeApiError as e:
+            if e.status in (403, 404):
+                log.warning(
+                    "namespace list unavailable (HTTP %s); "
+                    "namespaceSelectors approximate ALL namespaces",
+                    e.status,
+                )
+                self._ns_denied = True
+                return None
+            raise
+        self._ns_cache = {
+            (o.get("metadata") or {}).get("name", ""): dict(
+                (o.get("metadata") or {}).get("labels") or {}
+            )
+            for o in items
+        }
+        self._ns_cache.pop("", None)
+        self._ns_expiry = now + self.pdb_ttl
+        return self._ns_cache
+
+    def _resolve_ns(self, pods: list[Pod]) -> list[Pod]:
+        """Exact namespaceSelector resolution (lazy: the namespace set is
+        only consulted when some pod actually carries a selector)."""
+        from kubernetes_scheduler_tpu.kube.convert import (
+            resolve_namespace_selectors,
+        )
+
+        if not any(
+            t.namespace_selector for p in pods for t in p.pod_affinity
+        ):
+            return pods
+        nss = self._namespace_labels()
+        return [resolve_namespace_selectors(p, nss) for p in pods]
 
     def _pods_path(self) -> str:
         if self.namespace:
@@ -464,15 +585,15 @@ class KubeClusterSource:
         schedule onto effectively-full nodes. Only the pending stream is
         namespace-scoped."""
         if self.cache is not None:
-            return self.cache.running_pods()
+            return self._resolve_ns(self.cache.running_pods())
         items = self.client.list_all(
             "/api/v1/pods", {"fieldSelector": "spec.nodeName!="}
         )
-        return [
+        return self._resolve_ns([
             pod_from_api(o)
             for o in items
             if (o.get("status") or {}).get("phase") not in FINISHED_PHASES
-        ]
+        ])
 
     def list_pending_pods(self) -> list[Pod]:
         """Unassigned pods addressed to this scheduler, bound volumes'
@@ -481,7 +602,9 @@ class KubeClusterSource:
             self._pods_path(),
             {"fieldSelector": f"spec.nodeName=,spec.schedulerName={self.scheduler_name}"},
         )
-        return [self._fold_volumes(pod_from_api(o)) for o in items]
+        return self._resolve_ns(
+            [self._fold_volumes(pod_from_api(o)) for o in items]
+        )
 
     def watch_pending_events(self, *, timeout_seconds: float = 60.0):
         """Yield (event_type, Pod) for this scheduler's pending stream —
@@ -498,7 +621,7 @@ class KubeClusterSource:
             if etype in ("ADDED", "MODIFIED", "DELETED"):
                 pod = pod_from_api(ev.get("object") or {})
                 if etype != "DELETED":
-                    pod = self._fold_volumes(pod)
+                    pod = self._resolve_ns([self._fold_volumes(pod)])[0]
                 yield etype, pod
 
     def watch_pending(self, *, timeout_seconds: float = 60.0):

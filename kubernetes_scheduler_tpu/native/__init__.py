@@ -27,7 +27,7 @@ _REPO_ROOT = os.path.dirname(
 )
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libyoda_host.so")
-ABI_VERSION = 2
+ABI_VERSION = 3
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
@@ -95,6 +95,12 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         i64, i64, i64, vp, vp, vp, vp, vp, vp, ctypes.c_int, vp,
     ]
     lib.yoda_aggregate_requested.argtypes = [i64, i64, i64, vp, vp, vp]
+    lib.yoda_native_loop.restype = i64
+    lib.yoda_native_loop.argtypes = [
+        ctypes.c_void_p, i64, i64, i64, i64, i64, vp, vp, vp, vp, vp, vp,
+        ctypes.c_int, ctypes.c_int, ctypes.c_double, ctypes.c_double,
+        vp, vp,
+    ]
     return lib
 
 
@@ -307,6 +313,108 @@ class ScalarCycler:
         """One cycle; results land in .node_idx / .free_after. Returns
         the number of pods bound."""
         return int(self._lib.yoda_scalar_cycle_buf(*self._args))
+
+
+class NativeLoop:
+    """The fully-native tiny-cycle host loop (native/loop.cc): queue pop
+    -> scalar cycle -> bind/requeue, many cycles per foreign call.
+
+    This is the single-pod-regime answer to the ctypes dispatch floor
+    (PARITY.md): where ScalarCycler pays one foreign call PER cycle
+    (~2us, ~20x the C++ work), this pays one per `run(n_cycles)` batch.
+    Decisions are identical to driving the scalar cycle one popped
+    window at a time from Python — pinned by tests/test_native.py.
+
+    Pod handles are row indices into the bound [M, R] pod arrays; push
+    them with `submit`. The clock is simulated: it starts at 0 and each
+    cycle advances dt_per_cycle, so backoff requeues behave
+    deterministically.
+    """
+
+    __slots__ = (
+        "_lib", "_queue", "_pod_req", "_r_io", "_prio", "_free",
+        "_disk_io", "_cpu_pct", "_node_idx", "_truncate", "_dt", "_now",
+        "_window", "_reset_free",
+    )
+
+    def __init__(self, pod_req, r_io, prio, free_cap, disk_io, cpu_pct, *,
+                 window: int = 1, truncate: bool = True,
+                 initial_backoff: float = 1.0, max_backoff: float = 10.0,
+                 dt_per_cycle: float = 1e-6, reset_free: bool = False):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._pod_req = _f32(pod_req).copy()
+        self._r_io = _f32(r_io).copy()
+        self._prio = np.ascontiguousarray(prio, dtype=np.int32).copy()
+        self._free = _f32(free_cap).copy()
+        self._disk_io = _f32(disk_io).copy()
+        self._cpu_pct = _f32(cpu_pct).copy()
+        m, r = self._pod_req.shape
+        n = self._free.shape[0]
+        if self._free.shape != (n, r):
+            raise ValueError(f"free_cap shape {self._free.shape} != ({n}, {r})")
+        if self._r_io.shape != (m,) or self._prio.shape != (m,):
+            raise ValueError("inconsistent NativeLoop pod-side shapes")
+        if self._disk_io.shape != (n,) or self._cpu_pct.shape != (n,):
+            raise ValueError("inconsistent NativeLoop node-side shapes")
+        self._node_idx = np.full(m, -1, dtype=np.int32)
+        self._truncate = int(truncate)
+        # reset_free: every cycle schedules against the original capacity
+        # (steady-state cluster regime; see loop.cc)
+        self._reset_free = int(reset_free)
+        self._dt = float(dt_per_cycle)
+        self._now = 0.0
+        self._window = int(window)
+        self._queue = lib.yoda_queue_new(initial_backoff, max_backoff)
+
+    node_idx = property(lambda self: self._node_idx)
+    free = property(lambda self: self._free)
+
+    def __len__(self) -> int:
+        return int(self._lib.yoda_queue_len(self._queue))
+
+    def submit(self, handle: int) -> None:
+        """Queue pod `handle` (a row of the bound pod arrays)."""
+        self._lib.yoda_queue_push(
+            self._queue, int(handle), int(self._prio[handle])
+        )
+
+    def submit_all(self) -> None:
+        for h in range(self._pod_req.shape[0]):
+            self.submit(h)
+
+    def run(self, n_cycles: int) -> tuple[int, int]:
+        """Run up to n_cycles cycles natively; returns (binds, cycles)."""
+        out_cycles = ctypes.c_int64(0)
+        bound = self._lib.yoda_native_loop(
+            self._queue, int(n_cycles), self._window,
+            self._pod_req.shape[0], self._free.shape[0],
+            self._free.shape[1],
+            _addr(self._pod_req), _addr(self._r_io), _addr(self._prio),
+            _addr(self._free), _addr(self._disk_io), _addr(self._cpu_pct),
+            self._truncate, self._reset_free, self._now, self._dt,
+            _addr(self._node_idx), ctypes.addressof(out_cycles),
+        )
+        if bound < 0:
+            raise RuntimeError("native loop: pod handle out of range")
+        cycles = int(out_cycles.value)
+        self._now += cycles * self._dt
+        return int(bound), cycles
+
+    def reset(self, free_cap=None) -> None:
+        """Restore capacity (and clear decisions) for a fresh pass."""
+        if free_cap is not None:
+            self._free[...] = _f32(free_cap)
+        self._node_idx[...] = -1
+        self._now = 0.0
+
+    def __del__(self):
+        q = getattr(self, "_queue", None)
+        if q:
+            self._lib.yoda_queue_free(q)
+            self._queue = None
 
 
 def aggregate_requested(pod_node, pod_req, n_nodes: int) -> np.ndarray:

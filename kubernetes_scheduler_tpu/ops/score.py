@@ -239,3 +239,108 @@ def card_score(
     per_card = (ratio * weights).sum(-1)  # [p, n, c]
     valid = fits & card_mask[None, :, :]
     return (per_card * valid).sum(-1)
+
+
+# ---- upstream default resource-shape scorers --------------------------------
+# The reference's deployed config enables yoda WITHOUT disabling the
+# kube-scheduler defaults (/root/reference/deploy/yoda-scheduler.yaml:21-47
+# has no `disabled: [{name: "*"}]`), so its production score is the
+# framework's weighted sum of yoda + the k8s 1.22 default score plugins
+# (via /root/reference/go.mod:13). These kernels vectorize the three
+# defaults this engine did not already carry as soft terms:
+# NodeResourcesLeastAllocated, NodeResourcesBalancedAllocation, and
+# ImageLocality. All produce [0, 100] like the framework's MaxNodeScore.
+
+MAX_NODE_SCORE = 100.0
+# ImageLocality thresholds (upstream pkg/scheduler/.../image_locality.go):
+# per-container min/max image footprint the linear ramp runs between
+IMAGE_MIN_THRESHOLD = 23.0 * 1024 * 1024
+IMAGE_MAX_THRESHOLD = 1000.0 * 1024 * 1024
+
+
+def least_allocated(
+    allocatable: jnp.ndarray,
+    requested: jnp.ndarray,
+    pod_request: jnp.ndarray,
+    *,
+    resource_cols: tuple = (0, 1),
+) -> jnp.ndarray:
+    """NodeResourcesLeastAllocated (k8s 1.22 default, weight 1): prefer
+    nodes with the most free share AFTER placing the pod.
+
+        frame_r = (alloc_r - req_r - pod_r) * 100 / alloc_r
+        S = sum_r w_r * frame_r / sum_r w_r        (w_r = 1 for cpu, memory)
+
+    A resource with alloc == 0 or req+pod > alloc contributes 0 (the
+    upstream guards). resource_cols picks the cpu/memory columns of the
+    [.., r] matrices (the 1.22 default resource set). Returns S[p, n].
+    """
+    cols = jnp.asarray(resource_cols, jnp.int32)
+    alloc = allocatable[:, cols]                       # [n, 2]
+    req = requested[:, cols][None] + pod_request[:, cols][:, None]  # [p,n,2]
+    free = alloc[None] - req
+    frac = jnp.where(
+        (alloc[None] > 0) & (free >= 0),
+        free * MAX_NODE_SCORE / jnp.maximum(alloc[None], 1e-9),
+        0.0,
+    )
+    return frac.mean(-1)
+
+
+def balanced_allocation(
+    allocatable: jnp.ndarray,
+    requested: jnp.ndarray,
+    pod_request: jnp.ndarray,
+    *,
+    resource_cols: tuple = (0, 1),
+) -> jnp.ndarray:
+    """NodeResourcesBalancedAllocation (k8s 1.22 default, weight 1):
+    prefer nodes whose cpu and memory utilization FRACTIONS stay close
+    after placing the pod.
+
+        cpuF = (req_cpu + pod_cpu) / alloc_cpu ; memF likewise
+        any fraction >= 1 (or alloc == 0)  ->  S = 0
+        else S = (1 - |cpuF - memF|) * 100
+
+    (The 1.22 two-resource formula; the volume fraction rides a
+    default-off feature gate upstream.) Returns S[p, n].
+    """
+    cols = jnp.asarray(resource_cols, jnp.int32)
+    alloc = allocatable[:, cols]                       # [n, 2]
+    req = requested[:, cols][None] + pod_request[:, cols][:, None]  # [p,n,2]
+    frac = req / jnp.maximum(alloc[None], 1e-9)
+    ok = (alloc[None] > 0).all(-1) & (frac < 1.0).all(-1)  # [p, n]
+    diff = jnp.abs(frac[..., 0] - frac[..., 1])
+    return jnp.where(ok, (1.0 - diff) * MAX_NODE_SCORE, 0.0)
+
+
+def image_locality(
+    image_scaled: jnp.ndarray,
+    image_ids: jnp.ndarray,
+    n_containers: jnp.ndarray,
+) -> jnp.ndarray:
+    """ImageLocality (k8s 1.22 default, weight 1): prefer nodes already
+    holding the pod's container images, discounted by how widely each
+    image is spread (so a ubiquitous image doesn't pin placement).
+
+    image_scaled: [n, V] float32 — host-precomputed
+        present(n, v) * sizeBytes(n, v) * (nodes holding v) / (total nodes)
+        (the upstream scaledImageScore, with the spread ratio resolved
+        host-side so the kernel shards along the node axis with no
+        collective)
+    image_ids:    [p, Ki] int32 image-vocabulary ids, -1 padded
+    n_containers: [p] int32 — the per-pod threshold scale: upstream ramps
+        between 23MB and 1000MB PER CONTAINER
+
+        S = clip((sum - 23MB*c) / (1000MB*c - 23MB*c), 0, 1) * 100
+
+    Returns S[p, n].
+    """
+    v = image_scaled.shape[1]
+    ids = jnp.clip(image_ids, 0, max(v - 1, 0))        # [p, Ki]
+    got = image_scaled[:, ids]                         # [n, p, Ki]
+    summed = (got * (image_ids >= 0)[None]).sum(-1).T  # [p, n]
+    c = jnp.maximum(n_containers.astype(jnp.float32), 1.0)[:, None]
+    lo = IMAGE_MIN_THRESHOLD * c
+    hi = IMAGE_MAX_THRESHOLD * c
+    return jnp.clip((summed - lo) / (hi - lo), 0.0, 1.0) * MAX_NODE_SCORE

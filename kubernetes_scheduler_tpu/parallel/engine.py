@@ -94,7 +94,37 @@ def _sharded_scores(
         )
         maxima = jnp.maximum(jax.lax.pmax(local_max, axes), 1.0)
         return card_score(snapshot.cards, snapshot.card_mask, per_card, maxima)
+    if policy in ("least_allocated", "balanced_allocation", "image_locality"):
+        # purely node-local (A/Q matrices / the host-prescaled image
+        # signal): the dense kernels shard along the node axis with no
+        # collective — reuse them so the paths cannot diverge
+        from kubernetes_scheduler_tpu.engine import compute_scores
+
+        return compute_scores(snapshot, pods, policy)
     raise ValueError(f"unknown policy {policy!r}")
+
+
+def _sharded_combined_scores(
+    snapshot: SnapshotArrays, pods: PodBatch, score_plugins: tuple, axes
+) -> jnp.ndarray:
+    """engine.combine_scores on the mesh: per-plugin matrices from
+    _sharded_scores (each already globally exact), min-max rescaled with
+    GLOBAL pmax/pmin bounds for plugins the framework normalizes, then
+    the weighted sum — term order and f32 arithmetic match the dense
+    combination, so decisions stay bit-identical."""
+    from kubernetes_scheduler_tpu.engine import PRESCALED_PLUGINS
+
+    total = None
+    for name, weight in score_plugins:
+        raw = _sharded_scores(snapshot, pods, name, axes)
+        if name not in PRESCALED_PLUGINS:
+            hi, lo = score_bounds(raw, snapshot.node_mask)
+            hi = jax.lax.pmax(hi, axes)
+            lo = jax.lax.pmin(lo, axes)
+            raw = min_max_normalize(raw, snapshot.node_mask, bounds=(hi, lo))
+        term = raw * float(weight)
+        total = term if total is None else total + term
+    return total
 
 
 def _sharded_greedy(
@@ -460,7 +490,7 @@ def _mesh_specs(mesh: Mesh, node_axes):
 
 
 def _window_pipeline(snapshot, pods, policy, normalizer, soft, axes,
-                     score_fn=None, fused=False):
+                     score_fn=None, fused=False, score_plugins=None):
     """Scores + static feasibility + normalization for one window on one
     shard — the shared front half of the sharded single-window and
     multi-window programs (they must not diverge).
@@ -498,6 +528,19 @@ def _window_pipeline(snapshot, pods, policy, normalizer, soft, axes,
             snapshot, pods_local, include_pod_affinity=False
         )
         feasible = raw > NEG * 0.5
+        norm = raw
+        if soft:
+            norm = norm + _sharded_soft_scores(snapshot, pods, axes)
+        return raw, norm, feasible
+
+    if score_plugins:
+        # weighted multi-plugin combination: per-plugin normalization
+        # happens inside (with global bounds) and the weighted sum is
+        # final — `normalizer` is ignored like the dense path
+        raw = _sharded_combined_scores(snapshot, pods, score_plugins, axes)
+        feasible = compute_feasibility(
+            snapshot, pods_local, include_pod_affinity=False
+        )
         norm = raw
         if soft:
             norm = norm + _sharded_soft_scores(snapshot, pods, axes)
@@ -593,6 +636,7 @@ def make_sharded_schedule_fn(
     auction_rounds: int = 1024,
     auction_price_frac: float = 1.0,
     fused: bool = False,
+    score_plugins: tuple | None = None,
 ):
     """Build a jitted shard_map'd schedule function for `mesh`.
 
@@ -630,6 +674,14 @@ def make_sharded_schedule_fn(
     """
     if assigner not in ("greedy", "auction"):
         raise ValueError(f"unknown assigner {assigner!r}")
+    if score_plugins and (fused or score_fn is not None):
+        # the fused kernel hardwires the single yoda formula and a
+        # custom score_fn replaces the policy outright — silently
+        # preferring either over the weighted combination would serve
+        # different placements than the options advertise
+        raise ValueError(
+            "score_plugins cannot combine with fused=True or score_fn"
+        )
     _check_fused(fused, policy, normalizer, score_fn)
     axes, node, rep, snap_specs, pod_specs = _mesh_specs(mesh, node_axes)
     out_specs = ScheduleResult(
@@ -645,7 +697,8 @@ def make_sharded_schedule_fn(
         snapshot: SnapshotArrays, pods: PodBatch, rounds, price_frac
     ) -> ScheduleResult:
         raw, norm, feasible = _window_pipeline(
-            snapshot, pods, policy, normalizer, soft, axes, score_fn, fused
+            snapshot, pods, policy, normalizer, soft, axes, score_fn,
+            fused, score_plugins,
         )
         free0 = compute_free_capacity(snapshot)
         if assigner == "greedy":
@@ -690,6 +743,7 @@ def make_sharded_windows_fn(
     auction_rounds: int = 1024,
     auction_price_frac: float = 1.0,
     fused: bool = False,
+    score_plugins: tuple | None = None,
 ):
     """Multi-window sharded scheduling: engine.schedule_windows with the
     node axis sharded over `mesh`.
@@ -708,6 +762,14 @@ def make_sharded_windows_fn(
 
     if assigner not in ("greedy", "auction"):
         raise ValueError(f"unknown assigner {assigner!r}")
+    if score_plugins and (fused or score_fn is not None):
+        # the fused kernel hardwires the single yoda formula and a
+        # custom score_fn replaces the policy outright — silently
+        # preferring either over the weighted combination would serve
+        # different placements than the options advertise
+        raise ValueError(
+            "score_plugins cannot combine with fused=True or score_fn"
+        )
     _check_fused(fused, policy, normalizer, score_fn)
     axes, node, rep, snap_specs, pod_specs = _mesh_specs(mesh, node_axes)
     out_specs = WindowsResult(node_idx=rep, free_after=node, n_assigned=rep)
@@ -741,7 +803,8 @@ def make_sharded_windows_fn(
                 + added2[0][snapshot.domain_id, cols],
             )
             _, norm, feasible = _window_pipeline(
-                snap_pipe, w, policy, normalizer, soft, axes, score_fn, fused
+                snap_pipe, w, policy, normalizer, soft, axes, score_fn,
+                fused, score_plugins,
             )
             # the assigner takes the ORIGINAL counts plus the added2 carry
             # (it layers the carry itself — snap_pipe's folded counts

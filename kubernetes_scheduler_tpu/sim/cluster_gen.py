@@ -40,6 +40,8 @@ def gen_cluster(
     n_taint_keys: int = 4,
     n_label_keys: int = 8,
     n_selectors: int = 8,
+    images: bool = False,
+    n_images: int = 64,
 ) -> SnapshotArrays:
     """A cluster snapshot: allocatable/requested resources, utilization
     series (what the advisor would scrape), optional GPU cards, taints on
@@ -107,6 +109,16 @@ def gen_cluster(
                 np.float32
             ),
         )
+    if images:
+        # ImageLocality signal (host/snapshot precomputes the same form
+        # from node.status.images): presence ~30%, sizes 50MB..2GB,
+        # scaled by each image's cross-node spread ratio
+        present = rng.random((n_nodes, n_images)) < 0.3
+        sizes = rng.uniform(50, 2000, n_images).astype(np.float32) * 2**20
+        ratio = present.sum(0).astype(np.float32) / max(n_nodes, 1)
+        kwargs["image_scaled"] = (
+            present * (sizes * ratio)[None, :]
+        ).astype(np.float32)
     return make_snapshot(
         allocatable=alloc,
         requested=requested,
@@ -129,6 +141,8 @@ def gen_pods(
     n_taint_keys: int = 4,
     n_label_keys: int = 8,
     n_selectors: int = 8,
+    images: bool = False,
+    n_images: int = 64,
 ) -> PodBatch:
     """A pending-pod window shaped like example/test-pod.yaml at scale:
     CPU/memory requests (with the k8s non-zero defaults for the ~10%% of
@@ -189,6 +203,13 @@ def gen_pods(
             # one window interact (the hard case for batched assignment)
             pod_matches=rng.random((n_pods, n_selectors)) < 0.15,
         )
+    if images:
+        # 1-3 container images per pod from the shared vocabulary
+        ki = 3
+        ids = rng.integers(0, n_images, (n_pods, ki)).astype(np.int32)
+        n_c = rng.integers(1, ki + 1, n_pods).astype(np.int32)
+        ids[np.arange(ki)[None, :] >= n_c[:, None]] = -1
+        kwargs.update(image_ids=ids, n_containers=n_c)
     return make_pod_batch(
         request=request,
         r_io=rng.gamma(2.0, 5.0, n_pods).clip(0.1, 45),

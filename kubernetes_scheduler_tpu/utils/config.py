@@ -46,6 +46,18 @@ class AdvisorConfig:
 class SchedulerConfig:
     scheduler_name: str = "yoda-tpu"
     policy: str = "balanced_cpu_diskio"
+    # weighted multi-plugin scoring (upstream framework RunScorePlugins):
+    # a non-empty list of {"name": <policy>, "weight": N} replaces the
+    # single `policy` with the framework's weighted sum — the combination
+    # the reference's deployed config produces by enabling yoda BESIDE
+    # the k8s 1.22 defaults (deploy/yoda-scheduler.yaml:21-47 disables
+    # nothing; example/config:25-27 weights yoda at 2). E.g.:
+    #   [{"name": "balanced_cpu_diskio", "weight": 2},
+    #    {"name": "least_allocated", "weight": 1},
+    #    {"name": "balanced_allocation", "weight": 1},
+    #    {"name": "image_locality", "weight": 1}]
+    # Empty = single-policy scoring (engine.compute_scores on `policy`).
+    score_plugins: list = field(default_factory=list)
     assigner: str = "greedy"
     normalizer: str = "min_max"
     batch_window: int = 1024
@@ -116,7 +128,36 @@ class SchedulerConfig:
         unknown = set(d) - known
         if unknown:
             raise ValueError(f"unknown config keys: {sorted(unknown)}")
-        return cls(**d)
+        cfg = cls(**d)
+        for entry in cfg.score_plugins:
+            if not isinstance(entry, dict) or "name" not in entry:
+                raise ValueError(
+                    "score_plugins entries must be {'name': ..., "
+                    "'weight': N} dicts; got " + repr(entry)
+                )
+            extra = set(entry) - {"name", "weight"}
+            if extra:
+                raise ValueError(
+                    f"unknown score_plugins keys: {sorted(extra)}"
+                )
+            # weight 0 is ambiguous on the proto wire (proto3 zero =
+            # unset) and silently disables the plugin locally — a
+            # disabled plugin should be REMOVED from the list instead
+            if float(entry.get("weight", 1)) <= 0:
+                raise ValueError(
+                    f"score_plugins weight must be > 0 (drop the entry "
+                    f"to disable a plugin): {entry!r}"
+                )
+        return cfg
+
+    def score_plugins_tuple(self) -> tuple | None:
+        """The engine's static score_plugins encoding: ((name, weight),
+        ...) or None when single-policy scoring is configured."""
+        if not self.score_plugins:
+            return None
+        return tuple(
+            (e["name"], float(e.get("weight", 1))) for e in self.score_plugins
+        )
 
     @classmethod
     def from_json(cls, path: str) -> "SchedulerConfig":

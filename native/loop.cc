@@ -1,0 +1,85 @@
+// Fully-native tiny-cycle host loop: queue pop -> scalar cycle -> bind.
+//
+// The per-cycle floor of the Python host on tiny constraint-free cycles
+// is the ctypes foreign-call dispatch (~2us), ~20x the C++ scheduling
+// work itself (PARITY.md "single-pod floor analysis"). This loop moves
+// the whole cycle sequence native: ONE foreign call runs up to n_cycles
+// full cycles — each popping a window from the native queue (queue.cc),
+// scoring it with the scalar cycle's exact decisions (scalar.cc), then
+// binding (capacity decrement + mark-scheduled) or requeueing
+// unschedulable pods with backoff. Decisions are identical to driving
+// yoda_scalar_cycle one window at a time from Python; only the dispatch
+// overhead changes.
+//
+// The clock is injected and advances dt_per_cycle per cycle so backoff
+// behaves deterministically in benchmarks and tests.
+
+#include "yoda_host.h"
+
+#include <cstdint>
+#include <vector>
+
+// Runs up to n_cycles cycles (stopping early once the queue is fully
+// drained, backoff entries included). Pod handles pushed to the queue
+// must be indices into the [M, R] pod arrays. out_idx[M] must arrive
+// initialized (typically -1); each bind overwrites the pod's slot, so a
+// later bind of a retried pod wins. Returns the total number of binds;
+// *out_cycles reports how many cycles actually ran.
+extern "C" int64_t yoda_native_loop(YodaQueue* q, int64_t n_cycles,
+                                    int64_t window, int64_t M, int64_t N,
+                                    int64_t R, const float* pod_req,
+                                    const float* r_io, const int32_t* prio,
+                                    float* free_cap, const float* disk_io,
+                                    const float* cpu_pct, int truncate,
+                                    int reset_free, double now,
+                                    double dt_per_cycle, int32_t* out_idx,
+                                    int64_t* out_cycles) {
+  std::vector<uint64_t> handles(window);
+  std::vector<float> w_req(window * R);
+  std::vector<float> w_rio(window);
+  std::vector<int32_t> w_idx(window);
+  // reset_free: each cycle schedules against the ORIGINAL capacity — the
+  // steady-state regime where the snapshot is rebuilt from cluster state
+  // between cycles and earlier test pods have moved on (what the
+  // ScalarCycler benchmark's rebound free buffer models)
+  std::vector<float> free0;
+  if (reset_free) free0.assign(free_cap, free_cap + N * R);
+  int64_t bound_total = 0;
+  int64_t cycles = 0;
+  for (; cycles < n_cycles; ++cycles) {
+    if (yoda_queue_len(q) == 0) break;
+    const int64_t p =
+        yoda_queue_pop_window(q, now, handles.data(), window);
+    if (p == 0) {
+      // everything queued is in backoff: idle-tick the clock forward
+      now += dt_per_cycle;
+      continue;
+    }
+    if (reset_free) {
+      for (int64_t k = 0; k < N * R; ++k) free_cap[k] = free0[k];
+    }
+    for (int64_t i = 0; i < p; ++i) {
+      const uint64_t h = handles[i];
+      if (h >= static_cast<uint64_t>(M)) return -1;  // caller bug
+      const float* src = pod_req + h * R;
+      float* dst = w_req.data() + i * R;
+      for (int64_t r = 0; r < R; ++r) dst[r] = src[r];
+      w_rio[i] = r_io[h];
+    }
+    bound_total += yoda_scalar_cycle(p, N, R, w_req.data(), w_rio.data(),
+                                     free_cap, disk_io, cpu_pct, truncate,
+                                     w_idx.data());
+    for (int64_t i = 0; i < p; ++i) {
+      const uint64_t h = handles[i];
+      out_idx[h] = w_idx[i];
+      if (w_idx[i] >= 0) {
+        yoda_queue_mark_scheduled(q, h);
+      } else {
+        yoda_queue_requeue_unschedulable(q, h, prio[h], now);
+      }
+    }
+    now += dt_per_cycle;
+  }
+  *out_cycles = cycles;
+  return bound_total;
+}

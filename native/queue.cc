@@ -97,6 +97,6 @@ int64_t yoda_queue_len(const YodaQueue* q) {
   return static_cast<int64_t>(q->active.size() + q->backoff.size());
 }
 
-int32_t yoda_host_abi_version(void) { return 2; }
+int32_t yoda_host_abi_version(void) { return 3; }
 
 }  // extern "C"

@@ -72,6 +72,26 @@ int64_t yoda_scalar_cycle_buf(int64_t P, int64_t N, int64_t R,
                               const float* disk_io, const float* cpu_pct,
                               int truncate, int32_t* out_idx);
 
+/* ---- native tiny-cycle loop ------------------------------------------
+ * One foreign call runs up to n_cycles full host cycles: pop a window of
+ * pod handles (indices into the [M,R] pod arrays) from q, score it with
+ * yoda_scalar_cycle's exact decisions, bind (capacity decrement +
+ * mark-scheduled) or requeue unschedulable with backoff. Stops early
+ * when the queue drains. The clock starts at `now` and advances
+ * dt_per_cycle per cycle (deterministic backoff). out_idx [M] must be
+ * caller-initialized (-1); binds of retried pods overwrite their slot.
+ * Returns total binds (-1 on a handle out of range); *out_cycles reports
+ * cycles actually run.
+ */
+int64_t yoda_native_loop(YodaQueue* q, int64_t n_cycles, int64_t window,
+                         int64_t M, int64_t N, int64_t R,
+                         const float* pod_req, const float* r_io,
+                         const int32_t* prio, float* free_cap,
+                         const float* disk_io, const float* cpu_pct,
+                         int truncate, int reset_free, double now,
+                         double dt_per_cycle, int32_t* out_idx,
+                         int64_t* out_cycles);
+
 /* ---- snapshot aggregation --------------------------------------------
  * Sum running-pod requests into the per-node requested matrix
  * (the host-side analog of CalculateResourceAllocatableRequest's

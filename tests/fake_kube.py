@@ -32,6 +32,9 @@ class FakeKube:
         self.pdbs: list[dict] = []          # policy/v1 PDB objects
         self.pvcs: list[dict] = []          # v1 PersistentVolumeClaims
         self.pvs: list[dict] = []           # v1 PersistentVolumes
+        # v1 Namespace objects; None = no route (404, the pre-1.21 /
+        # RBAC-denied regime some tests exercise)
+        self.namespaces: list[dict] | None = None
         self.bindings: list[tuple[str, str]] = []
         # node -> {cpu_pct, mem_pct, disk_io, net_up, net_down}: served
         # Prometheus-style from POST /api/v1/query so one fixture covers
@@ -75,6 +78,14 @@ class FakeKube:
         key = f"{meta['namespace']}/{meta['name']}"
         with self.lock:
             self.pods[key] = obj
+
+    def add_namespace(self, name: str, labels: dict | None = None) -> None:
+        with self.lock:
+            if self.namespaces is None:
+                self.namespaces = []
+            self.namespaces.append(
+                {"metadata": {"name": name, "labels": labels or {}}}
+            )
 
     # -- request handling ------------------------------------------------
 
@@ -147,6 +158,15 @@ class FakeKube:
                 if path == "/api/v1/persistentvolumes":
                     with fake.lock:
                         return self._send(200, {"items": list(fake.pvs)})
+                if path == "/api/v1/namespaces":
+                    with fake.lock:
+                        if fake.namespaces is None:
+                            return self._send(
+                                404, {"message": "namespaces disabled"}
+                            )
+                        return self._send(
+                            200, {"items": list(fake.namespaces)}
+                        )
                 m = _LEASE_RE.match(path)
                 if m and m.group(2):
                     with fake.lock:

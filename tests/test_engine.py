@@ -641,9 +641,16 @@ def test_dense_node_name_pinning():
     the pod unconstrained."""
     n, p = 16, 3
     snapshot, pods = random_state(n, p)
+    # generous capacity: every node feasible for every pod, so the pin
+    # target is independent of the shared RNG stream (this test must not
+    # depend on which tests ran before it)
+    snapshot = snapshot._replace(
+        allocatable=jnp.full_like(snapshot.allocatable, 1e6),
+        requested=jnp.zeros_like(snapshot.requested),
+    )
     free = schedule_batch(snapshot, pods)
-    pin = int(np.asarray(free.node_idx)[1])
-    # pin pod 0 to a node pod 1 would otherwise win, pod 2 to an absent one
+    natural = int(np.asarray(free.node_idx)[0])
+    pin = (natural + 1) % n  # NOT pod 0's natural (highest-score) choice
     target = np.array([pin, -1, n + 7], np.int32)
     pods = pods._replace(target_node=jnp.asarray(target))
     res = schedule_batch(snapshot, pods)

@@ -218,8 +218,8 @@ def test_pod_from_api_or_of_ands_node_affinity():
 
 def test_pod_from_api_affinity_namespace_scope():
     """PodAffinityTerm namespace scope converts per upstream: default =
-    the pod's own namespace; explicit `namespaces` honored;
-    namespaceSelector approximated as all namespaces."""
+    the pod's own namespace; explicit `namespaces` honored; the `{}`
+    namespaceSelector selects ALL namespaces (exactly, per upstream)."""
     obj = {
         "metadata": {"name": "scoped", "namespace": "prod"},
         "spec": {
@@ -255,6 +255,48 @@ def test_pod_from_api_affinity_namespace_scope():
         },
     }
     assert pod_from_api(obj2).topology_spread[0].namespaces == ["prod"]
+
+
+def test_namespace_selector_resolution():
+    """A NON-empty namespaceSelector captures the label selector at
+    conversion and resolves exactly against a namespace set: matched
+    namespaces UNION any explicit `namespaces` entries (upstream
+    k8s >= 1.21 semantics); with no namespace data it degrades to the
+    ALL-namespaces approximation."""
+    from kubernetes_scheduler_tpu.kube.convert import (
+        resolve_namespace_selectors,
+    )
+
+    obj = {
+        "metadata": {"name": "sel", "namespace": "prod"},
+        "spec": {
+            "containers": [{}],
+            "affinity": {"podAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [
+                    {"labelSelector": {"matchLabels": {"app": "db"}},
+                     "namespaceSelector": {"matchLabels": {"team": "be"}},
+                     "namespaces": ["extra"], "topologyKey": "zone"},
+                ],
+            }},
+        },
+    }
+    pod = pod_from_api(obj)
+    term = pod.pod_affinity[0]
+    assert term.namespace_selector == ({"team": "be"}, [])
+    assert term.namespaces == ["extra"]  # unresolved: explicit only
+
+    nss = {"a": {"team": "be"}, "b": {"team": "web"}, "c": {"team": "be"}}
+    resolved = resolve_namespace_selectors(pod, nss)
+    assert resolved.pod_affinity[0].namespaces == ["a", "c", "extra"]
+    # selector matches nothing and no explicit list -> empty scope
+    obj["spec"]["affinity"]["podAffinity"][
+        "requiredDuringSchedulingIgnoredDuringExecution"
+    ][0].pop("namespaces")
+    none = resolve_namespace_selectors(pod_from_api(obj), {"b": {"team": "web"}})
+    assert none.pod_affinity[0].namespaces == []
+    # no namespace data: ALL-namespaces approximation (logged)
+    degraded = resolve_namespace_selectors(pod, None)
+    assert degraded.pod_affinity[0].namespaces is None
 
 
 def test_pod_from_api_preferred_term_groups():
@@ -508,6 +550,93 @@ def test_evictor_deletes_with_uid_precondition(fake):
     # already gone: 404 swallowed
     ev.evict(victim, preemptor=preemptor)
     assert fake.deleted == ["default/victim"]
+
+
+def _ns_selector_spec(team: str, anti: bool = False) -> dict:
+    kind = "podAntiAffinity" if anti else "podAffinity"
+    return {"affinity": {kind: {
+        "requiredDuringSchedulingIgnoredDuringExecution": [{
+            "labelSelector": {"matchLabels": {"app": "db"}},
+            "namespaceSelector": {"matchLabels": {"team": team}},
+            "topologyKey": "kubernetes.io/hostname",
+        }],
+    }}}
+
+
+def test_namespace_selector_exact_e2e(fake):
+    """Exact namespaceSelector end-to-end: terms resolve against the
+    live namespace set, so affinity admits only selector-matched
+    namespaces and anti-affinity is not over-constrained by pods in
+    unmatched ones (round-4 verdict: the ALL-namespaces approximation
+    over-admitted the first and wrongly blocked the second)."""
+    fake.add_namespace("default")
+    fake.add_namespace("ns-a", {"team": "backend"})
+    fake.add_namespace("ns-b", {"team": "web"})
+    fake.add_node(make_node_obj("n0"))
+    # anchor: a running db pod in ns-a (team=backend) on the only node
+    fake.add_pod(make_pod_obj(
+        "anchor", namespace="ns-a", node_name="n0", labels={"app": "db"}
+    ))
+    fake.add_pod(make_pod_obj(
+        "wants-backend", extra_spec=_ns_selector_spec("backend")
+    ))
+    fake.add_pod(make_pod_obj(
+        "wants-web", extra_spec=_ns_selector_spec("web")
+    ))
+    fake.add_pod(make_pod_obj(
+        "avoids-web", extra_spec=_ns_selector_spec("web", anti=True)
+    ))
+    fake.add_pod(make_pod_obj(
+        "avoids-backend", extra_spec=_ns_selector_spec("backend", anti=True)
+    ))
+    client = client_for(fake)
+    src = KubeClusterSource(client, scheduler_name="yoda-tpu")
+    sched = Scheduler(
+        SchedulerConfig(batch_window=64, min_device_work=0),
+        advisor=StaticAdvisor({"n0": NodeUtil(cpu_pct=10, disk_io=3)}),
+        binder=KubeBinder(client),
+        list_nodes=src.list_nodes,
+        list_running_pods=src.list_running_pods,
+    )
+    for p in src.list_pending_pods():
+        sched.submit(p)
+    sched.run_cycle()
+    bound = {k.split("/")[1] for k, _ in fake.bindings}
+    # affinity: the anchor's namespace matches team=backend -> binds;
+    # team=web selects only the db-less ns-b -> unschedulable
+    assert "wants-backend" in bound
+    assert "wants-web" not in bound
+    # anti-affinity: the anchor is OUTSIDE team=web's scope -> n0 open;
+    # inside team=backend's scope -> blocked
+    assert "avoids-web" in bound
+    assert "avoids-backend" not in bound
+
+
+def test_namespace_selector_degrades_without_namespace_data(fake):
+    """With the namespace list unavailable (404/RBAC), selectors fall
+    back to the logged ALL-namespaces approximation — over-admitting
+    affinity rather than silently matching nothing."""
+    assert fake.namespaces is None  # route disabled
+    fake.add_node(make_node_obj("n0"))
+    fake.add_pod(make_pod_obj(
+        "anchor", namespace="ns-a", node_name="n0", labels={"app": "db"}
+    ))
+    fake.add_pod(make_pod_obj(
+        "wants-web", extra_spec=_ns_selector_spec("web")
+    ))
+    client = client_for(fake)
+    src = KubeClusterSource(client, scheduler_name="yoda-tpu")
+    sched = Scheduler(
+        SchedulerConfig(batch_window=64, min_device_work=0),
+        advisor=StaticAdvisor({"n0": NodeUtil(cpu_pct=10, disk_io=3)}),
+        binder=KubeBinder(client),
+        list_nodes=src.list_nodes,
+        list_running_pods=src.list_running_pods,
+    )
+    for p in src.list_pending_pods():
+        sched.submit(p)
+    sched.run_cycle()
+    assert {k.split("/")[1] for k, _ in fake.bindings} == {"wants-web"}
 
 
 def test_kube_loop_watch_cycle_bind_e2e(fake):
@@ -1101,9 +1230,13 @@ def test_cli_kube_uses_informer_cache(fake, capsys, tmp_path):
                      "max_backoff_seconds": 0.2, "initial_backoff_seconds": 0.1,
                      "advisor": {"prometheus_host": host}})
     )
+    # --max-cycles 3: the pod is unschedulable by design, so without a
+    # cycle cap the loop would retry it for the full default 1000 cycles
+    # (~0.25s of backoff each — the 258s this test used to take)
     rc = main(
         ["scheduler", "--source", "kube", "--kube-server", fake.url,
-         "--config", str(cfg_file), "--watch-timeout", "2"]
+         "--config", str(cfg_file), "--watch-timeout", "2",
+         "--max-cycles", "3"]
     )
     assert rc == 0
     out = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])

@@ -268,3 +268,80 @@ def test_scheduler_native_scalar_path_binds():
     assert [b.node_name for b in sched.binder.bindings] == [
         b.node_name for b in sched2.binder.bindings
     ]
+
+
+@pytest.mark.parametrize("seed", [3, 9])
+def test_native_loop_matches_per_window_cycles(seed):
+    """The fully-native tiny-cycle loop (loop.cc: queue pop -> scalar
+    cycle -> bind/requeue, many cycles per foreign call) makes exactly
+    the decisions of driving the same native queue + scalar cycle one
+    popped window at a time from Python."""
+    rng = np.random.default_rng(seed)
+    m_pods, n_nodes, r = 13, 4, 3
+    pod_req = rng.uniform(0.1, 1.5, (m_pods, r)).astype(np.float32)
+    r_io = rng.uniform(0, 8, m_pods).astype(np.float32)
+    prio = rng.integers(0, 4, m_pods).astype(np.int32)
+    free = rng.uniform(1.5, 4.0, (n_nodes, r)).astype(np.float32)
+    disk_io = rng.uniform(0, 50, n_nodes).astype(np.float32)
+    cpu_pct = rng.uniform(0, 100, n_nodes).astype(np.float32)
+    window, dt = 3, 1e-6
+
+    loop = native.NativeLoop(
+        pod_req, r_io, prio, free, disk_io, cpu_pct,
+        window=window, dt_per_cycle=dt,
+    )
+    loop.submit_all()
+    bound, cycles = loop.run(64)
+
+    q = native.NativeQueue(initial_backoff=1.0, max_backoff=10.0)
+    for h in range(m_pods):
+        q.push(h, int(prio[h]))
+    free2 = free.copy()
+    idx2 = np.full(m_pods, -1, np.int32)
+    now, bound2 = 0.0, 0
+    for _ in range(cycles):
+        hs = q.pop_window(window, now)
+        if len(hs):
+            out, free2, nb = native.scalar_cycle(
+                pod_req[hs], r_io[hs], free2, disk_io, cpu_pct
+            )
+            bound2 += nb
+            for i, h in enumerate(hs):
+                idx2[h] = out[i]
+                if out[i] >= 0:
+                    q.mark_scheduled(int(h))
+                else:
+                    q.requeue_unschedulable(int(h), int(prio[h]), now)
+        now += dt
+    assert bound == bound2
+    assert loop.node_idx.tolist() == idx2.tolist()
+    np.testing.assert_allclose(loop.free, free2, rtol=1e-6)
+
+
+def test_native_loop_reset_free_steady_state():
+    """reset_free=True: every cycle schedules against the ORIGINAL
+    capacity (the steady-state regime bench.py's tiny configs measure),
+    so identical arrivals all bind to the identical node."""
+    pod_req = np.full((6, 2), 1.0, np.float32)
+    r_io = np.full(6, 5.0, np.float32)
+    prio = np.zeros(6, np.int32)
+    free = np.array([[1.5, 1.5], [8.0, 8.0]], np.float32)
+    disk_io = np.array([10.0, 20.0], np.float32)
+    cpu_pct = np.array([10.0, 20.0], np.float32)
+
+    loop = native.NativeLoop(
+        pod_req, r_io, prio, free, disk_io, cpu_pct,
+        window=1, reset_free=True,
+    )
+    loop.submit_all()
+    bound, cycles = loop.run(6)
+    assert bound == 6 and cycles == 6
+    # all six cycles saw the same capacity: same decision every time
+    assert len(set(loop.node_idx.tolist())) == 1
+    # without reset, the 1.5-capacity node fills and decisions shift
+    loop2 = native.NativeLoop(
+        pod_req, r_io, prio, free, disk_io, cpu_pct, window=1
+    )
+    loop2.submit_all()
+    bound2, _ = loop2.run(6)
+    assert np.asarray(loop2.free).min() < free.min()

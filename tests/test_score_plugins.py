@@ -1,0 +1,412 @@
+"""Weighted multi-plugin scoring (upstream framework RunScorePlugins):
+the k8s 1.22 default shape scorers + the framework's weighted sum, which
+the reference's deployed config produces by enabling yoda BESIDE the
+defaults (/root/reference/deploy/yoda-scheduler.yaml:21-47 disables
+nothing; example/config:25-27 weights yoda at 2)."""
+
+import numpy as np
+import pytest
+
+from kubernetes_scheduler_tpu.engine import (
+    PRESCALED_PLUGINS,
+    combine_scores,
+    compute_scores,
+    make_pod_batch,
+    make_snapshot,
+    schedule_batch,
+)
+from kubernetes_scheduler_tpu.host import (
+    Container,
+    Node,
+    NodeUtil,
+    Pod,
+    Scheduler,
+    StaticAdvisor,
+)
+from kubernetes_scheduler_tpu.utils.config import SchedulerConfig
+
+MB = 1024.0 * 1024
+
+SP = (
+    ("balanced_cpu_diskio", 2.0),
+    ("least_allocated", 1.0),
+    ("balanced_allocation", 1.0),
+    ("image_locality", 1.0),
+)
+SP_CFG = [
+    {"name": "balanced_cpu_diskio", "weight": 2},
+    {"name": "least_allocated", "weight": 1},
+    {"name": "balanced_allocation", "weight": 1},
+    {"name": "image_locality", "weight": 1},
+]
+
+
+def tiny_snapshot():
+    alloc = np.array([[1000.0, 4e9, 110], [2000.0, 8e9, 110]], np.float32)
+    reqd = np.array([[200.0, 1e9, 3], [1500.0, 2e9, 5]], np.float32)
+    return make_snapshot(
+        alloc, reqd, np.array([5.0, 5.0]), np.array([10.0, 10.0]),
+        np.array([10.0, 10.0]),
+    )
+
+
+def test_least_allocated_matches_hand_oracle():
+    """NodeResourcesLeastAllocated: mean over cpu/memory of
+    (alloc - req - pod) * 100 / alloc, 0 on overflow/zero-alloc."""
+    s = tiny_snapshot()
+    pb = make_pod_batch(np.array([[300.0, 1e9, 1]], np.float32))
+    got = np.asarray(compute_scores(s, pb, "least_allocated"))[0]
+    want0 = ((1000 - 500) * 100 / 1000 + (4e9 - 2e9) * 100 / 4e9) / 2
+    want1 = ((2000 - 1800) * 100 / 2000 + (8e9 - 3e9) * 100 / 8e9) / 2
+    np.testing.assert_allclose(got, [want0, want1], rtol=1e-5)
+    # request overflowing a resource zeroes that resource's contribution
+    pb2 = make_pod_batch(np.array([[900.0, 1e9, 1]], np.float32))
+    got2 = np.asarray(compute_scores(s, pb2, "least_allocated"))[0]
+    assert got2[0] == pytest.approx((0 + (4e9 - 2e9) * 100 / 4e9) / 2)
+
+
+def test_balanced_allocation_matches_hand_oracle():
+    """NodeResourcesBalancedAllocation: (1 - |cpuF - memF|) * 100, zero
+    when any post-placement fraction reaches 1."""
+    s = tiny_snapshot()
+    pb = make_pod_batch(np.array([[300.0, 1e9, 1]], np.float32))
+    got = np.asarray(compute_scores(s, pb, "balanced_allocation"))[0]
+    want0 = (1 - abs(500 / 1000 - 2e9 / 4e9)) * 100
+    want1 = (1 - abs(1800 / 2000 - 3e9 / 8e9)) * 100
+    np.testing.assert_allclose(got, [want0, want1], rtol=1e-5)
+    pb2 = make_pod_batch(np.array([[900.0, 1e9, 1]], np.float32))
+    assert np.asarray(compute_scores(s, pb2, "balanced_allocation"))[0][0] == 0.0
+
+
+def test_image_locality_matches_hand_oracle():
+    """ImageLocality: sum of host-prescaled (size * spread-ratio) over
+    the pod's images present on the node, ramped 23MB..1000MB per
+    container and clipped to [0, 100]."""
+    import jax.numpy as jnp
+
+    s = tiny_snapshot()
+    img = np.zeros((2, 2), np.float32)
+    img[0, 0] = 500 * MB * 0.5  # node0 holds img0; 1 of 2 nodes -> ratio .5
+    img[1, 1] = 2000 * MB * 0.5
+    s = s._replace(image_scaled=jnp.asarray(img))
+    pb = make_pod_batch(np.array([[100.0, 1e8, 1]], np.float32)).\
+        _replace(image_ids=jnp.asarray([[0]], np.int32),
+                 n_containers=jnp.asarray([1], np.int32))
+    got = np.asarray(compute_scores(s, pb, "image_locality"))[0]
+    want = (250 * MB - 23 * MB) / (1000 * MB - 23 * MB) * 100
+    np.testing.assert_allclose(got, [want, 0.0], rtol=1e-5)
+    # a huge image clips at 100; 2 containers double both thresholds
+    pb2 = pb._replace(image_ids=jnp.asarray([[1]], np.int32),
+                      n_containers=jnp.asarray([2], np.int32))
+    got2 = np.asarray(compute_scores(s, pb2, "image_locality"))[0]
+    want2 = (1000 * MB - 46 * MB) / (2000 * MB - 46 * MB) * 100
+    np.testing.assert_allclose(got2, [0.0, want2], rtol=1e-5)
+
+
+def test_combine_scores_weighting_and_normalization():
+    """Plugins with a NormalizeScore extension (yoda) are min-maxed per
+    pod before weighting; prescaled shape scorers enter raw — then the
+    weighted sum, never re-normalized (the framework runtime's math)."""
+    from kubernetes_scheduler_tpu.ops.normalize import min_max_normalize
+
+    s = tiny_snapshot()
+    pb = make_pod_batch(np.array([[300.0, 1e9, 1]], np.float32),
+                        r_io=np.array([5.0]))
+    combined = np.asarray(combine_scores(s, pb, SP))
+    yoda = min_max_normalize(
+        compute_scores(s, pb, "balanced_cpu_diskio"), s.node_mask
+    )
+    want = (
+        2.0 * np.asarray(yoda)
+        + np.asarray(compute_scores(s, pb, "least_allocated"))
+        + np.asarray(compute_scores(s, pb, "balanced_allocation"))
+        + np.asarray(compute_scores(s, pb, "image_locality"))
+    )
+    np.testing.assert_allclose(combined, want, rtol=1e-6)
+
+
+def test_weights_change_decisions():
+    """The combination is not cosmetic: a heavily weighted shape scorer
+    must be able to overturn the yoda-only choice."""
+    # node0 wins on yoda balance; node1 wins hugely on free share
+    alloc = np.array([[2000.0, 8e9, 110], [32000.0, 128e9, 110]], np.float32)
+    reqd = np.array([[1000.0, 4e9, 3], [1000.0, 4e9, 3]], np.float32)
+    s = make_snapshot(
+        alloc, reqd,
+        np.array([10.0, 30.0]),   # disk_io: u = .2 / .6
+        np.array([20.0, 60.0]),   # cpu_pct: v = .2 / .6
+        np.array([50.0, 50.0]),
+    )
+    pb = make_pod_batch(np.array([[500.0, 1e9, 1]], np.float32),
+                        r_io=np.array([10.0]))
+    yoda_only = int(np.asarray(
+        schedule_batch(s, pb, policy="balanced_cpu_diskio").node_idx
+    )[0])
+    weighted = int(np.asarray(
+        schedule_batch(
+            s, pb,
+            score_plugins=(("balanced_cpu_diskio", 1.0),
+                           ("least_allocated", 50.0)),
+        ).node_idx
+    )[0])
+    assert yoda_only == 0 and weighted == 1
+
+
+def test_sharded_combined_matches_dense():
+    """Bit-identical decisions for the weighted combination on an
+    8-device node-sharded mesh, both assigners."""
+    import jax
+
+    from kubernetes_scheduler_tpu.parallel.engine import make_sharded_schedule_fn
+    from kubernetes_scheduler_tpu.parallel.mesh import make_mesh
+    from kubernetes_scheduler_tpu.sim import gen_cluster, gen_pods
+
+    assert jax.device_count() == 8
+    mesh = make_mesh(8)
+    snap = gen_cluster(32, seed=5, constraints=True, images=True)
+    pods = gen_pods(10, seed=6, constraints=True, images=True)
+    for assigner in ("greedy", "auction"):
+        fn = make_sharded_schedule_fn(mesh, assigner=assigner, score_plugins=SP)
+        sh = fn(snap, pods)
+        de = schedule_batch(
+            snap, pods, score_plugins=SP, assigner=assigner,
+            affinity_aware=True,
+        )
+        assert (
+            np.asarray(sh.node_idx).tolist()
+            == np.asarray(de.node_idx).tolist()
+        ), assigner
+
+
+def _weighted_cluster():
+    nodes, utils = [], {}
+    for i in range(4):
+        nodes.append(Node(
+            name=f"n{i}",
+            allocatable={"cpu": 4000.0 + 4000 * i,
+                         "memory": (16 + 16 * i) * 2.0**30, "pods": 110},
+            images={"app:v1": 600 * MB} if i in (1, 2) else {},
+        ))
+        utils[f"n{i}"] = NodeUtil(
+            cpu_pct=10 + 22 * i, disk_io=3 + 11 * i, mem_pct=15 + 18 * i
+        )
+    return nodes, utils
+
+
+def _weighted_pod(i):
+    # sized so n0 (4000m) holds two: the window must spill across nodes,
+    # exercising live capacity bookkeeping against frozen score state
+    return Pod(
+        name=f"p{i}",
+        containers=[Container(requests={"cpu": 1500.0, "memory": 6 * 2.0**30},
+                              image="app:v1")],
+        annotations={"diskIO": str(2 + 3 * i)},
+    )
+
+
+def test_scalar_fallback_mirrors_weighted_combination():
+    """An engine failure under score_plugins degrades to the SAME
+    weighted combination (scalar mirrors of every plugin + the
+    framework's per-plugin normalization), binding pod-for-pod
+    identically — and without the mismatch counter."""
+    nodes, utils = _weighted_cluster()
+    cfg = dict(min_device_work=0, batch_window=16, score_plugins=SP_CFG)
+
+    def build():
+        return Scheduler(
+            SchedulerConfig.from_dict(dict(cfg)),
+            advisor=StaticAdvisor(utils),
+            list_nodes=lambda: nodes,
+            list_running_pods=lambda: [],
+        )
+
+    a, b = build(), build()
+
+    def boom(*args, **kw):
+        raise RuntimeError("device path down")
+
+    b._run_batched = boom
+    for s in (a, b):
+        for i in range(6):
+            s.submit(_weighted_pod(i))
+        s.run_cycle()
+    assert not a.metrics[-1].used_fallback
+    assert b.metrics[-1].used_fallback and not b.metrics[-1].policy_mismatch
+    ba = {x.pod.name: x.node_name for x in a.binder.bindings}
+    bb = {x.pod.name: x.node_name for x in b.binder.bindings}
+    assert ba == bb and len(ba) == 6, (ba, bb)
+    # the test is vacuous if every pod lands on one node — require spread
+    assert len(set(ba.values())) >= 2, ba
+
+
+def test_prescaled_tuples_stay_in_sync():
+    """plugins.PRESCALED_SCALAR deliberately duplicates
+    engine.PRESCALED_PLUGINS (the scalar path must not import jax);
+    this pin is the drift guard."""
+    from kubernetes_scheduler_tpu.host.plugins import (
+        SCALAR_POLICIES,
+        PRESCALED_SCALAR,
+    )
+
+    assert set(PRESCALED_SCALAR) == set(PRESCALED_PLUGINS)
+    from kubernetes_scheduler_tpu.engine import POLICIES
+
+    assert set(SCALAR_POLICIES) == set(POLICIES) - set()  # all mirrored
+
+
+def test_config_validation():
+    cfg = SchedulerConfig.from_dict({"score_plugins": SP_CFG})
+    assert cfg.score_plugins_tuple() == SP
+    assert SchedulerConfig().score_plugins_tuple() is None
+    with pytest.raises(ValueError, match="score_plugins entries"):
+        SchedulerConfig.from_dict({"score_plugins": ["nope"]})
+    with pytest.raises(ValueError, match="unknown score_plugins keys"):
+        SchedulerConfig.from_dict(
+            {"score_plugins": [{"name": "x", "wieght": 2}]}
+        )
+    # weight 0 is ambiguous on the proto wire (proto3 zero = unset) and
+    # silently disables locally — rejected at the config altitude
+    with pytest.raises(ValueError, match="weight must be > 0"):
+        SchedulerConfig.from_dict(
+            {"score_plugins": [{"name": "image_locality", "weight": 0}]}
+        )
+    # sharded factories refuse silently-conflicting structural options
+    from kubernetes_scheduler_tpu.parallel.engine import make_sharded_schedule_fn
+    from kubernetes_scheduler_tpu.parallel.mesh import make_mesh
+
+    with pytest.raises(ValueError, match="cannot combine"):
+        make_sharded_schedule_fn(
+            make_mesh(8), score_plugins=SP, fused=True, normalizer="none"
+        )
+    with pytest.raises(ValueError, match="unknown policy"):
+        combine_scores(
+            tiny_snapshot(),
+            make_pod_batch(np.array([[1.0, 1.0, 1]], np.float32)),
+            (("nope", 1.0),),
+        )
+
+
+def test_builder_image_vocabulary_and_pod_ids():
+    """host/snapshot: node images intern into a shared vocabulary with
+    spread-ratio prescaling; pod-side ids are LOOKUP-only (an image on
+    no node must not grow the table the matrix was sized against)."""
+    from kubernetes_scheduler_tpu.host.snapshot import SnapshotBuilder
+
+    nodes = [
+        Node(name="a", allocatable={"cpu": 1000, "memory": 2**30, "pods": 10},
+             images={"app:v1": 400 * MB, "base:v2": 100 * MB}),
+        Node(name="b", allocatable={"cpu": 1000, "memory": 2**30, "pods": 10},
+             images={"app:v1": 400 * MB}),
+    ]
+    b = SnapshotBuilder()
+    snap = b.build_snapshot(nodes, {}, [])
+    ia, ib = b.images.id("app:v1"), b.images.id("base:v2")
+    img = np.asarray(snap.image_scaled)
+    assert img[0, ia] == pytest.approx(400 * MB * 1.0)   # both nodes
+    assert img[1, ia] == pytest.approx(400 * MB * 1.0)
+    assert img[0, ib] == pytest.approx(100 * MB * 0.5)   # one of two
+    assert img[1, ib] == 0.0
+
+    pods = [
+        Pod(name="p", containers=[
+            Container(requests={"cpu": 100}, image="app:v1"),
+            Container(requests={"cpu": 100}, image="unseen:v9"),
+        ]),
+    ]
+    pb = b.build_pod_batch(pods)
+    ids = np.asarray(pb.image_ids)[0]
+    assert ids[0] == ia and ids[1] == -1  # unseen image never interned
+    assert int(np.asarray(pb.n_containers)[0]) == 2
+    assert len(b.images) == 2
+
+
+def test_kube_conversion_carries_images():
+    from kubernetes_scheduler_tpu.kube import node_from_api, pod_from_api
+
+    node = node_from_api({
+        "metadata": {"name": "n0"},
+        "status": {
+            "allocatable": {"cpu": "4"},
+            "images": [
+                {"names": ["app@sha256:abc", "app:v1"], "sizeBytes": 1000},
+                {"names": ["base:v2"], "sizeBytes": 50},
+            ],
+        },
+    })
+    assert node.images == {
+        "app@sha256:abc": 1000.0, "app:v1": 1000.0, "base:v2": 50.0
+    }
+    pod = pod_from_api({
+        "metadata": {"name": "p"},
+        "spec": {"containers": [
+            {"image": "app:v1",
+             "resources": {"requests": {"cpu": "100m"}}},
+            {},
+        ]},
+    })
+    assert pod.containers[0].image == "app:v1"
+    assert pod.containers[1].image == ""
+
+
+def test_bridge_carries_score_plugins():
+    """Dense sidecar: request-carried score_plugins produce the same
+    decisions as the local combination; a sharded sidecar built WITHOUT
+    them rejects such requests (they are baked into the compiled
+    program, like policy)."""
+    import pytest
+
+    from kubernetes_scheduler_tpu.bridge.client import (
+        EngineUnavailable,
+        RemoteEngine,
+    )
+    from kubernetes_scheduler_tpu.bridge.server import make_server
+    from kubernetes_scheduler_tpu.sim import gen_cluster, gen_pods
+
+    snap = gen_cluster(16, seed=7, images=True)
+    pods = gen_pods(5, seed=8, images=True)
+    server, port, _ = make_server("127.0.0.1:0")
+    server.start()
+    client = RemoteEngine(f"127.0.0.1:{port}", deadline_seconds=120.0)
+    try:
+        remote = client.schedule_batch(snap, pods, score_plugins=SP)
+        local = schedule_batch(snap, pods, score_plugins=SP)
+        assert (
+            np.asarray(remote.node_idx).tolist()
+            == np.asarray(local.node_idx).tolist()
+        )
+    finally:
+        client.close()
+        server.stop(grace=None)
+
+    from kubernetes_scheduler_tpu.parallel.engine import make_sharded_schedule_fn
+    from kubernetes_scheduler_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(8)
+    server, port, _ = make_server(
+        "127.0.0.1:0",
+        sharded_fn=make_sharded_schedule_fn(mesh, score_plugins=SP),
+        sharded_opts={
+            "policy": "balanced_cpu_diskio",
+            "normalizer": "min_max",
+            "score_plugins": SP,
+        },
+    )
+    server.start()
+    client = RemoteEngine(f"127.0.0.1:{port}", deadline_seconds=120.0)
+    try:
+        ok = client.schedule_batch(snap, pods, score_plugins=SP)
+        want = schedule_batch(snap, pods, score_plugins=SP, affinity_aware=True)
+        assert (
+            np.asarray(ok.node_idx).tolist()
+            == np.asarray(want.node_idx).tolist()
+        )
+        with pytest.raises(EngineUnavailable, match="INVALID_ARGUMENT"):
+            client.schedule_batch(snap, pods)  # built WITH, asked without
+        with pytest.raises(EngineUnavailable, match="INVALID_ARGUMENT"):
+            client.schedule_batch(
+                snap, pods,
+                score_plugins=(("balanced_cpu_diskio", 3.0),),
+            )
+    finally:
+        client.close()
+        server.stop(grace=None)

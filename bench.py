@@ -473,6 +473,16 @@ def main():
         ),
         flush=True,
     )
+    # the END-TO-END host loop (queue pop -> snapshot build -> device
+    # program -> binds) recorded beside the engine headline — the number
+    # a real deployment experiences (round-4 verdict #1). Failures must
+    # not cost the headline metric.
+    try:
+        print(json.dumps(loop_rate()), flush=True)
+    except Exception as e:  # pragma: no cover - diagnostic path
+        print(json.dumps({"diag": "host_loop_failed", "error": str(e)[-200:]}),
+              flush=True)
+
     # the reference's PRODUCTION scoring: yoda at weight 2 beside the
     # k8s 1.22 default shape scorers (example/config:25-27 +
     # deploy/yoda-scheduler.yaml:21-47 disabling nothing) — measured as

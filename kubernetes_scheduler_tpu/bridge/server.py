@@ -403,6 +403,40 @@ def main(argv=None):
         learned_params = engine_override.params
         learned_model = engine_override.model
         args.policy = "learned"
+    # --score-plugins parsed/validated for EVERY mode: a dense sidecar
+    # silently ignoring the flag would advertise weighted scoring it
+    # never serves (the dense branch honors the REQUEST's score_plugins
+    # field instead — hosts carry their config on the wire)
+    score_plugins = None
+    if args.score_plugins:
+        import json as _json
+
+        entries = _json.loads(args.score_plugins)
+        if any(float(e.get("weight", 1)) <= 0 for e in entries):
+            # weight 0 is ambiguous on the proto wire (proto3 zero =
+            # unset -> 1); drop the entry to disable a plugin
+            raise SystemExit("--score-plugins weights must be > 0")
+        score_plugins = tuple(
+            (e["name"], float(e.get("weight", 1))) for e in entries
+        )
+        if args.fused:
+            # the fused kernel hardwires the single yoda formula; a
+            # silently-fused "weighted" sidecar would advertise
+            # score_plugins while serving single-policy placements
+            raise SystemExit("--score-plugins is incompatible with --fused")
+        if args.learned_checkpoint:
+            raise SystemExit(
+                "--score-plugins is incompatible with "
+                "--learned-checkpoint (the learned scorer replaces "
+                "the policy; it cannot be one weighted term yet)"
+            )
+        if args.mesh_devices <= 1:
+            raise SystemExit(
+                "--score-plugins only configures the SHARDED engine "
+                "(--mesh-devices > 1); the dense engine honors the "
+                "request-carried score_plugins field instead — set the "
+                "host's score_plugins config"
+            )
     sharded_fn = None
     if args.mesh_devices > 1:
         from jax.sharding import Mesh
@@ -431,31 +465,7 @@ def main(argv=None):
             "normalizer": args.normalizer,
             "fused": args.fused,
         }
-        score_plugins = None
-        if args.score_plugins:
-            import json as _json
-
-            entries = _json.loads(args.score_plugins)
-            if any(float(e.get("weight", 1)) <= 0 for e in entries):
-                # weight 0 is ambiguous on the proto wire (proto3 zero =
-                # unset -> 1); drop the entry to disable a plugin
-                raise SystemExit("--score-plugins weights must be > 0")
-            score_plugins = tuple(
-                (e["name"], float(e.get("weight", 1))) for e in entries
-            )
-            if args.fused:
-                # the fused kernel hardwires the single yoda formula; a
-                # silently-fused "weighted" sidecar would advertise
-                # score_plugins while serving single-policy placements
-                raise SystemExit(
-                    "--score-plugins is incompatible with --fused"
-                )
-            if args.learned_checkpoint:
-                raise SystemExit(
-                    "--score-plugins is incompatible with "
-                    "--learned-checkpoint (the learned scorer replaces "
-                    "the policy; it cannot be one weighted term yet)"
-                )
+        if score_plugins is not None:
             assigner_kw["score_plugins"] = score_plugins
         if args.assigner == "auction":
             assigner_kw.update(

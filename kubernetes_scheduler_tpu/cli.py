@@ -127,11 +127,12 @@ def cmd_scheduler_kube(args, cfg) -> int:
     sched = Scheduler(
         cfg,
         advisor=PrometheusAdvisor(cfg.advisor.prometheus_host),
-        binder=KubeBinder(client, cache=cache),
+        binder=KubeBinder(client, cache=cache, volumes=source.volumes),
         evictor=KubeEvictor(client),
         list_nodes=source.list_nodes,
         list_running_pods=source.list_running_pods,
         list_pdbs=source.list_pdbs,
+        controller_replicas=source.controller_replicas,
         engine=engine,
     )
     # exporter FIRST: a standby replica blocks in acquire_blocking below,

@@ -1046,14 +1046,29 @@ def preempt_batch(
     evaluation when the sidecar predates the RPC.
     """
     from kubernetes_scheduler_tpu.ops.preempt import (
+        PreemptAffinity,
         build_victim_tables,
         preempt_candidates,
     )
 
+    # node-local families only: the count-based (anti)affinity/spread
+    # families are evaluated per (pod, node, k) against the counts AS
+    # ADJUSTED by the candidate evictions (upstream RemovePod parity) —
+    # ops/preempt.affinity_after_evictions
     static_ok = compute_feasibility(
         snapshot._replace(requested=jnp.zeros_like(snapshot.requested)),
         pods,
-        include_pod_affinity=True,
+        include_pod_affinity=False,
+    )
+    s = snapshot.domain_counts.shape[1]
+    m = victims.req.shape[0]
+    matches = (
+        victims.matches
+        if victims.matches is not None
+        else jnp.zeros((m, s), bool)
+    )
+    anti = (
+        victims.anti if victims.anti is not None else jnp.zeros((m, s), bool)
     )
     tables = build_victim_tables(
         victims.node,
@@ -1063,6 +1078,19 @@ def preempt_batch(
         n_nodes=snapshot.allocatable.shape[0],
         k_cap=k_cap,
         victim_start=victims.start,
+        victim_matches=matches,
+        victim_anti=anti,
+    )
+    affinity = PreemptAffinity(
+        domain_counts=snapshot.domain_counts,
+        avoid_counts=snapshot.avoid_counts,
+        domain_id=snapshot.domain_id,
+        node_mask=snapshot.node_mask,
+        affinity_sel=pods.affinity_sel,
+        anti_affinity_sel=pods.anti_affinity_sel,
+        pod_matches=pods.pod_matches,
+        spread_sel=pods.spread_sel,
+        spread_max=pods.spread_max,
     )
     return preempt_candidates(
         pods.request,
@@ -1071,4 +1099,5 @@ def preempt_batch(
         static_ok,
         compute_free_capacity(snapshot),
         tables,
+        affinity=affinity,
     )

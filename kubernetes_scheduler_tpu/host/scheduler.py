@@ -118,6 +118,7 @@ class Scheduler:
         list_nodes: Callable[[], list[Node]],
         list_running_pods: Callable[[], list[Pod]],
         list_pdbs: Callable[[], list] | None = None,
+        controller_replicas: Callable[[str, str, str], int | None] | None = None,
         engine=None,
     ):
         self.config = config
@@ -195,6 +196,10 @@ class Scheduler:
         # PodDisruptionBudgets for the preemption pass (None = no budgets
         # consulted, e.g. simulated clusters without PDBs)
         self.list_pdbs = list_pdbs
+        # (kind, namespace, name) -> spec.replicas resolver for the PDB
+        # percentage math's expected count (upstream disruption-controller
+        # semantics); None = current-count fallback
+        self.controller_replicas = controller_replicas
         if config.feature_gates.native_host:
             from kubernetes_scheduler_tpu import native
 
@@ -468,6 +473,28 @@ class Scheduler:
         self._record(m)
         return m
 
+    def _pdb_expected_count(self, matching: list[Pod]) -> int | None:
+        """The upstream disruption controller's expected count for
+        percentage budgets: the summed spec.replicas of the DISTINCT
+        controllers owning the matching pods (via ownerReferences).
+        None — the documented current-count fallback — when there is no
+        resolver, any pod is controller-less, or a controller is
+        unknown to the informer."""
+        if self.controller_replicas is None or not matching:
+            return None
+        owners: set[tuple] = set()
+        for pd in matching:
+            if pd.owner is None:
+                return None
+            owners.add((pd.owner[0], pd.namespace, pd.owner[1]))
+        total = 0
+        for kind, ns, name in owners:
+            replicas = self.controller_replicas(kind, ns, name)
+            if replicas is None:
+                return None
+            total += replicas
+        return total
+
     def _run_preemption(self, pods, nodes, running, utils, m: CycleMetrics):
         """Select and evict victims for this cycle's unschedulable pods.
 
@@ -532,7 +559,11 @@ class Scheduler:
                 and _pod_key(pd) not in self._pending_evictions
             ]
             for pdb in pdbs:
-                allowed = pdb.allowed(sum(1 for pd in real if pdb.selects(pd)))
+                matching = [pd for pd in real if pdb.selects(pd)]
+                allowed = pdb.allowed(
+                    len(matching),
+                    expected_count=self._pdb_expected_count(matching),
+                )
                 if pdb.disruptions_allowed is not None:
                     # the server-computed status predates our in-flight
                     # evictions (informer/TTL lag): a victim still
@@ -576,12 +607,30 @@ class Scheduler:
             if any(budgets[b] <= 0 for b in victim_budgets.get(i, ())):
                 continue  # an exhausted budget protects this victim
             vnode[i] = node_index.get(pd.node_name, -1)
+        # victim selector data for the RemovePod re-simulation
+        # (ops/preempt.affinity_after_evictions): matches = the victims'
+        # pod_matches rows; anti = one-hot union of their REQUIRED anti
+        # terms. Column count pinned to the SNAPSHOT's selector axis —
+        # building the victim batch can mint selector ids the snapshot
+        # tables never saw (running pods' required attract terms), and
+        # no pending pod references those.
+        s_cols = int(np.asarray(snapshot.domain_counts).shape[1])
+        vmatches = np.zeros((m_slots, s_cols), bool)
+        vanti = np.zeros((m_slots, s_cols), bool)
+        pm = np.asarray(vics.pod_matches)
+        take = min(s_cols, pm.shape[1])
+        vmatches[:, :take] = pm[: m_slots, :take]
+        asel = np.asarray(vics.anti_affinity_sel)
+        rows, cols = np.nonzero((asel >= 0) & (asel < s_cols))
+        vanti[rows, asel[rows, cols]] = True
         victims = VictimArrays(
             node=jnp.asarray(vnode),
             prio=vics.priority,
             req=vics.request,
             mask=vics.pod_mask,
             start=jnp.asarray(vstart),
+            matches=jnp.asarray(vmatches),
+            anti=jnp.asarray(vanti),
         )
         # the pass runs on the engine — on a bridged deployment that is
         # the sidecar's Preempt RPC, keeping PostFilter on the compute

@@ -170,29 +170,41 @@ class PodDisruptionBudget:
             return False
         return labels_match(pod.labels, self.match_labels, self.match_expressions)
 
-    def allowed(self, matching_count: int) -> int:
+    def allowed(
+        self, matching_count: int, expected_count: int | None = None
+    ) -> int:
         """Evictions this budget permits given the current healthy count.
 
-        Documented deviation (ADVICE r3): a percentage `minAvailable`
-        without a server-computed status resolves against the CURRENT
-        matching count, not the controller's expected replica count
-        (which would need a controller lookup this scheduler does not
-        do) — with replicas already down this over-allows evictions
-        (e.g. 50% of 10 replicas with 6 healthy: k8s allows 1, this
-        allows 3). Real clusters are unaffected: the PDB controller
-        maintains status.disruptionsAllowed, which takes precedence."""
+        Percentage budgets resolve against `expected_count` — the owning
+        controllers' summed replica counts, as the upstream disruption
+        controller computes it (host/scheduler resolves it through the
+        informer-cached ReplicaSet/StatefulSet stores via the pods'
+        ownerReferences). Narrowed deviation: when NO expected count is
+        resolvable (controller-less pods, or no controller informer —
+        simulated clusters), percentages fall back to the CURRENT
+        matching count, which over-allows when replicas are already down
+        (50% of 10 with 6 healthy: k8s allows 1, the fallback allows 3).
+        Real clusters are doubly covered: the PDB controller maintains
+        status.disruptionsAllowed, which takes precedence over all spec
+        math."""
         if self.disruptions_allowed is not None:
             return max(0, int(self.disruptions_allowed))
+        base = expected_count if expected_count is not None else matching_count
 
         def resolve(v) -> int:
             if isinstance(v, str) and v.endswith("%"):
                 import math
 
-                return math.ceil(matching_count * float(v[:-1]) / 100.0)
+                return math.ceil(base * float(v[:-1]) / 100.0)
             return int(v)
 
         if self.max_unavailable is not None:
-            return max(0, resolve(self.max_unavailable))
+            # upstream: healthy - (expected - maxUnavailable) — with
+            # replicas already down, the missing ones count as
+            # disruptions in flight (base == matching_count reduces to
+            # the plain maxUnavailable resolve)
+            desired_healthy = max(0, base - resolve(self.max_unavailable))
+            return max(0, matching_count - desired_healthy)
         if self.min_available is not None:
             return max(0, matching_count - resolve(self.min_available))
         return matching_count  # no constraint given
@@ -252,6 +264,15 @@ class Pod:
     # label (sort.go:12-18); when both exist the API-server-resolved
     # spec wins, matching upstream
     priority: int | None = None
+    # attachable-volumes-csi-<driver> units this pod's bound CSI
+    # volumes consume (kube/volumes.attach_demands; upstream
+    # NodeVolumeLimits) — folded into the pod's request vector
+    attach_demands: dict[str, float] = field(default_factory=dict)
+    # the controller ownerReference as (kind, name) — e.g.
+    # ("ReplicaSet", "web-7d4b9"); None = controller-less. Feeds the
+    # PDB percentage math's expected-replica lookup (upstream disruption
+    # controller semantics)
+    owner: tuple | None = None
 
 
 @dataclass
@@ -264,6 +285,10 @@ class PersistentVolume:
 
     name: str
     terms: list[list[MatchExpression]] = field(default_factory=list)
+    # spec.csi.driver — feeds NodeVolumeLimits: each bound CSI volume
+    # consumes one attachable-volumes-csi-<driver> capacity unit on its
+    # node. "" = not a CSI volume (no attach-limit accounting).
+    csi_driver: str = ""
 
 
 @dataclass
@@ -279,6 +304,12 @@ class PersistentVolumeClaim:
     name: str
     volume_name: str | None = None
     access_modes: list[str] = field(default_factory=list)
+    # spec.storageClassName — resolves the class's volumeBindingMode for
+    # the WFFC selected-node handoff (VolumeBinding's active half)
+    storage_class: str | None = None
+    # volume.kubernetes.io/selected-node annotation, when already set
+    # (idempotency: the binder does not re-PATCH it)
+    selected_node: str | None = None
 
 
 @dataclass

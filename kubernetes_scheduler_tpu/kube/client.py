@@ -204,6 +204,7 @@ class KubeClient:
         *,
         timeout: float | None = None,
         stream: bool = False,
+        content_type: str = "application/json",
     ):
         self._bucket.take()
         data = None if body is None else json.dumps(body).encode()
@@ -212,7 +213,7 @@ class KubeClient:
         )
         req.add_header("Accept", "application/json")
         if data is not None:
-            req.add_header("Content-Type", "application/json")
+            req.add_header("Content-Type", content_type)
         token = self._token()
         if token:
             req.add_header("Authorization", f"Bearer {token}")
@@ -241,6 +242,14 @@ class KubeClient:
 
     def put(self, path: str, body: dict):
         return self._request("PUT", path, body=body)
+
+    def patch(self, path: str, body: dict):
+        """Strategic-merge PATCH (the content type kubectl uses for
+        annotation updates like VolumeBinding's selected-node)."""
+        return self._request(
+            "PATCH", path, body=body,
+            content_type="application/strategic-merge-patch+json",
+        )
 
     def delete(self, path: str, body: dict | None = None):
         return self._request("DELETE", path, body=body)

@@ -351,6 +351,14 @@ def pod_from_api(obj: dict) -> Pod:
         # spec.priority is the API-server-resolved PriorityClass value;
         # host/queue.pod_priority prefers it over the scv/priority label
         priority=spec.get("priority"),
+        owner=next(
+            (
+                (o.get("kind", ""), o.get("name", ""))
+                for o in meta.get("ownerReferences") or []
+                if o.get("controller")
+            ),
+            None,
+        ),
     )
 
 
@@ -388,7 +396,11 @@ def pv_from_api(obj: dict) -> PersistentVolume:
         terms = (
             [t + zone_exprs for t in terms] if terms else [zone_exprs]
         )
-    return PersistentVolume(name=meta.get("name", ""), terms=terms)
+    return PersistentVolume(
+        name=meta.get("name", ""),
+        terms=terms,
+        csi_driver=((spec.get("csi") or {}).get("driver") or ""),
+    )
 
 
 def pvc_from_api(obj: dict) -> PersistentVolumeClaim:
@@ -399,6 +411,10 @@ def pvc_from_api(obj: dict) -> PersistentVolumeClaim:
         name=meta.get("name", ""),
         volume_name=spec.get("volumeName") or None,
         access_modes=list(spec.get("accessModes") or []),
+        storage_class=spec.get("storageClassName") or None,
+        selected_node=(meta.get("annotations") or {}).get(
+            "volume.kubernetes.io/selected-node"
+        ),
     )
 
 

@@ -63,6 +63,13 @@ class InformerCache:
         # synced or when the list is denied (RBAC) — readers then fall
         # back to the ALL-namespaces approximation
         self._namespaces: dict[str, dict] | None = None
+        # (kind, namespace, name) -> spec.replicas of workload
+        # controllers, for the PDB percentage math's expected-count
+        # lookup (upstream disruption-controller semantics)
+        self._controllers: dict[tuple, int] = {}
+        # StorageClass name -> volumeBindingMode, for the WFFC
+        # selected-node handoff (VolumeBinding's active half)
+        self._storage_classes: dict[str, str] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._synced = {
@@ -72,6 +79,9 @@ class InformerCache:
             "pvcs": threading.Event(),
             "pvs": threading.Event(),
             "namespaces": threading.Event(),
+            "replicasets": threading.Event(),
+            "statefulsets": threading.Event(),
+            "storageclasses": threading.Event(),
         }
         self._threads: list[threading.Thread] = []
 
@@ -80,12 +90,13 @@ class InformerCache:
     def start(self) -> "InformerCache":
         loops = [
             self._node_loop, self._pod_loop, self._pdb_loop, self._ns_loop,
+            self._rs_loop, self._sts_loop,
         ]
         if self.volumes:
-            loops += [self._pvc_loop, self._pv_loop]
+            loops += [self._sc_loop, self._pvc_loop, self._pv_loop]
         else:
-            self._synced["pvcs"].set()
-            self._synced["pvs"].set()
+            for name in ("storageclasses", "pvcs", "pvs"):
+                self._synced[name].set()
         for target in loops:
             t = threading.Thread(target=target, daemon=True)
             t.start()
@@ -133,6 +144,19 @@ class InformerCache:
         """Point lookup by PV name — no map copy."""
         with self._lock:
             return self._pvs.get(name)
+
+    def controller_replicas(self, kind: str, namespace: str, name: str):
+        """spec.replicas of a workload controller (ReplicaSet/
+        StatefulSet), watch-fed; None = unknown (callers fall back to
+        current-count PDB math)."""
+        with self._lock:
+            return self._controllers.get((kind, namespace, name))
+
+    def storage_class_mode(self, name: str) -> str | None:
+        """volumeBindingMode of a StorageClass (None = unknown class or
+        no data — callers then skip the WFFC handoff)."""
+        with self._lock:
+            return self._storage_classes.get(name)
 
     def namespace_labels(self) -> dict[str, dict] | None:
         """name -> labels of every namespace, watch-fed; None when the
@@ -302,7 +326,89 @@ class InformerCache:
                     (obj.get("metadata") or {}).get("labels") or {}
                 )
 
+    # -- workload-controller loops (PDB expected counts) -----------------
+
+    def _rs_loop(self) -> None:
+        self._controller_loop(
+            "replicasets", "ReplicaSet", "/apis/apps/v1/replicasets"
+        )
+
+    def _sts_loop(self) -> None:
+        self._controller_loop(
+            "statefulsets", "StatefulSet", "/apis/apps/v1/statefulsets"
+        )
+
+    def _controller_loop(self, sync_name: str, kind: str, path: str) -> None:
+        """spec.replicas per workload controller, for the PDB
+        percentage math (upstream resolves expected counts through the
+        owning controllers' scale). Optional: RBAC denial degrades to
+        current-count math, the documented conservative fallback."""
+
+        def replace(items: list[dict]) -> None:
+            fresh = {}
+            for o in items:
+                meta = o.get("metadata") or {}
+                fresh[(kind, meta.get("namespace", "default"),
+                       meta.get("name", ""))] = int(
+                    (o.get("spec") or {}).get("replicas") or 0
+                )
+            with self._lock:
+                self._controllers = {
+                    k: v for k, v in self._controllers.items() if k[0] != kind
+                } | fresh
+
+        def apply(ev: dict) -> None:
+            obj = ev.get("object") or {}
+            meta = obj.get("metadata") or {}
+            key = (kind, meta.get("namespace", "default"),
+                   meta.get("name", ""))
+            with self._lock:
+                if ev.get("type") == "DELETED":
+                    self._controllers.pop(key, None)
+                elif ev.get("type") in ("ADDED", "MODIFIED"):
+                    self._controllers[key] = int(
+                        (obj.get("spec") or {}).get("replicas") or 0
+                    )
+
+        self._resource_loop(
+            sync_name, path, params=None, replace=replace, apply=apply,
+            optional=True,
+        )
+
     # -- volume loops ----------------------------------------------------
+
+    def _sc_loop(self) -> None:
+        """StorageClass volumeBindingMode, for VolumeBinding's active
+        half: a pod binding with an unbound WaitForFirstConsumer claim
+        gets the claim annotated with the chosen node (KubeBinder)."""
+
+        def replace(items: list[dict]) -> None:
+            fresh = {
+                (o.get("metadata") or {}).get("name", ""):
+                    o.get("volumeBindingMode") or "Immediate"
+                for o in items
+            }
+            fresh.pop("", None)
+            with self._lock:
+                self._storage_classes = fresh
+
+        def apply(ev: dict) -> None:
+            obj = ev.get("object") or {}
+            name = (obj.get("metadata") or {}).get("name")
+            if not name:
+                return
+            with self._lock:
+                if ev.get("type") == "DELETED":
+                    self._storage_classes.pop(name, None)
+                elif ev.get("type") in ("ADDED", "MODIFIED"):
+                    self._storage_classes[name] = (
+                        obj.get("volumeBindingMode") or "Immediate"
+                    )
+
+        self._resource_loop(
+            "storageclasses", "/apis/storage.k8s.io/v1/storageclasses",
+            params=None, replace=replace, apply=apply, optional=True,
+        )
 
     def _pvc_loop(self) -> None:
         self._resource_loop(
@@ -475,7 +581,10 @@ class KubeClusterSource:
         # namespace store instead
         self._ns_cache: dict | None = None
         self._ns_expiry = 0.0
-        self._ns_denied = False
+        # monotonic time before which a denied (403/404) namespace LIST
+        # is not retried — a TTL, not a permanent latch: transient RBAC
+        # propagation must not degrade selectors for the process lifetime
+        self._ns_denied_until = 0.0
         # bound PVs constrain placement (VolumeZone/VolumeBinding parity):
         # the pending stream hands the scheduler pods whose node-affinity
         # already carries their volumes' topology (kube/volumes.py). With
@@ -496,9 +605,9 @@ class KubeClusterSource:
         to the ALL-namespaces approximation) when the list is denied."""
         if self.cache is not None:
             return self.cache.namespace_labels()
-        if self._ns_denied:
-            return None
         now = time.monotonic()
+        if now < self._ns_denied_until:
+            return None
         if self._ns_cache is not None and now < self._ns_expiry:
             return self._ns_cache
         try:
@@ -507,10 +616,11 @@ class KubeClusterSource:
             if e.status in (403, 404):
                 log.warning(
                     "namespace list unavailable (HTTP %s); "
-                    "namespaceSelectors approximate ALL namespaces",
+                    "namespaceSelectors approximate ALL namespaces "
+                    "(retrying in 60s)",
                     e.status,
                 )
-                self._ns_denied = True
+                self._ns_denied_until = now + 60.0
                 return None
             raise
         self._ns_cache = {
@@ -576,6 +686,14 @@ class KubeClusterSource:
         self._pdb_expiry = now + self.pdb_ttl
         return self._pdb_cache
 
+    def controller_replicas(self, kind: str, namespace: str, name: str):
+        """Workload-controller replicas for the PDB percentage math;
+        informer-backed only (None without a cache — callers then use
+        the conservative current-count fallback)."""
+        if self.cache is not None:
+            return self.cache.controller_replicas(kind, namespace, name)
+        return None
+
     def list_running_pods(self) -> list[Pod]:
         """Assigned, unfinished pods — the capacity + affinity base state
         (what the upstream snapshot's NodeInfo.Pods aggregates).
@@ -585,15 +703,35 @@ class KubeClusterSource:
         schedule onto effectively-full nodes. Only the pending stream is
         namespace-scoped."""
         if self.cache is not None:
-            return self._resolve_ns(self.cache.running_pods())
+            return self._resolve_attach(
+                self._resolve_ns(self.cache.running_pods())
+            )
         items = self.client.list_all(
             "/api/v1/pods", {"fieldSelector": "spec.nodeName!="}
         )
-        return self._resolve_ns([
+        return self._resolve_attach(self._resolve_ns([
             pod_from_api(o)
             for o in items
             if (o.get("status") or {}).get("phase") not in FINISHED_PHASES
-        ])
+        ]))
+
+    def _resolve_attach(self, pods: list[Pod]) -> list[Pod]:
+        """NodeVolumeLimits usage accounting: running pods' bound CSI
+        volumes consume attach units on their nodes — resolved here (the
+        pending stream gets demands from fold()) and only for the rare
+        claim-carrying pods."""
+        if self.volumes is None:
+            return pods
+        import dataclasses
+
+        out = []
+        for p in pods:
+            if p.volume_claims and not p.attach_demands:
+                d = self.volumes.attach_demands(p)
+                if d:
+                    p = dataclasses.replace(p, attach_demands=d)
+            out.append(p)
+        return out
 
     def list_pending_pods(self) -> list[Pod]:
         """Unassigned pods addressed to this scheduler, bound volumes'
@@ -641,14 +779,50 @@ def pod_key(pod: Pod) -> str:
 
 
 class KubeBinder:
-    """POST pods/<name>/binding — the upstream bind step."""
+    """POST pods/<name>/binding — the upstream bind step. With a
+    VolumeTopology attached, unbound WaitForFirstConsumer claims are
+    annotated with the chosen node FIRST (upstream VolumeBinding's
+    PreBind handoff: the external provisioner reads
+    volume.kubernetes.io/selected-node and provisions in that node's
+    topology)."""
 
-    def __init__(self, client: KubeClient, *, cache: InformerCache | None = None):
+    def __init__(
+        self,
+        client: KubeClient,
+        *,
+        cache: InformerCache | None = None,
+        volumes=None,
+    ):
         self.client = client
         self.cache = cache
+        self.volumes = volumes
         self.bound: list[tuple[str, str]] = []
 
+    def _annotate_wffc(self, pod: Pod, node_name: str) -> None:
+        for pvc in self.volumes.wffc_unbound(pod):
+            if pvc.selected_node == node_name:
+                continue  # idempotent retry
+            try:
+                self.client.patch(
+                    f"/api/v1/namespaces/{pvc.namespace}"
+                    f"/persistentvolumeclaims/{pvc.name}",
+                    {"metadata": {"annotations": {
+                        "volume.kubernetes.io/selected-node": node_name
+                    }}},
+                )
+            except KubeApiError as e:
+                if e.status == 404:
+                    # claim deleted underfoot; the Binding POST settles
+                    # the pod's own fate
+                    continue
+                # abort the bind: a pod placed without its volume
+                # handoff would wait on provisioning that never targets
+                # its node — the scheduler requeues with backoff instead
+                raise
+
     def bind(self, pod: Pod, node_name: str) -> None:
+        if self.volumes is not None and pod.volume_claims:
+            self._annotate_wffc(pod, node_name)
         meta = {"name": pod.name, "namespace": pod.namespace}
         if pod.uid:
             # UID precondition: the API server rejects the bind (409) if

@@ -85,6 +85,10 @@ class VolumeTopology:
         self._pvcs: dict[str, object] = {}
         self._pvs: dict[str, object] = {}
         self._expiry = 0.0
+        # StorageClass name -> volumeBindingMode (TTL path; the informer
+        # path reads the cache's watch-fed store)
+        self._classes: dict[str, str] = {}
+        self._classes_expiry = 0.0
 
     def _refresh(self) -> None:
         now = time.monotonic()
@@ -131,6 +135,59 @@ class VolumeTopology:
         pv = self._pvs.get(pv_name) if pv_name else None
         return pvc, pv
 
+    def storage_class_mode(self, name: str | None) -> str | None:
+        """volumeBindingMode of a StorageClass; None = unknown (the WFFC
+        handoff is then skipped — conservative)."""
+        if not name:
+            return None
+        if self.cache is not None:
+            return self.cache.storage_class_mode(name)
+        now = time.monotonic()
+        if now >= self._classes_expiry:
+            try:
+                items = self.client.list_all(
+                    "/apis/storage.k8s.io/v1/storageclasses"
+                )
+                self._classes = {
+                    (o.get("metadata") or {}).get("name", ""):
+                        o.get("volumeBindingMode") or "Immediate"
+                    for o in items
+                }
+                self._classes_expiry = now + self.ttl
+            except KubeApiError as e:
+                self._classes_expiry = now + self.ERROR_RETRY_SECONDS
+                log.warning("storageclass LIST failed (%s)", e)
+        return self._classes.get(name)
+
+    def wffc_unbound(self, pod: Pod) -> list:
+        """The pod's UNBOUND WaitForFirstConsumer claims — the set the
+        binder must annotate with the chosen node before the Binding
+        POST (upstream VolumeBinding's PreBind handoff)."""
+        out = []
+        for claim in pod.volume_claims:
+            pvc, _ = self._lookup(f"{pod.namespace}/{claim}", None)
+            if pvc is None or pvc.volume_name:
+                continue
+            if self.storage_class_mode(pvc.storage_class) == "WaitForFirstConsumer":
+                out.append(pvc)
+        return out
+
+    def attach_demands(self, pod: Pod) -> dict[str, float]:
+        """NodeVolumeLimits input: attachable-volumes-csi-<driver> units
+        this pod's BOUND CSI volumes consume (one per volume), matching
+        the capacity keys kubelet publishes in status.allocatable."""
+        demands: dict[str, float] = {}
+        for claim in pod.volume_claims:
+            key = f"{pod.namespace}/{claim}"
+            pvc, _ = self._lookup(key, None)
+            if pvc is None or not pvc.volume_name:
+                continue
+            _, pv = self._lookup(key, pvc.volume_name)
+            if pv is not None and pv.csi_driver:
+                res = f"attachable-volumes-csi-{pv.csi_driver}"
+                demands[res] = demands.get(res, 0.0) + 1.0
+        return demands
+
     def fold(self, pod: Pod) -> Pod:
         """Pod with every bound claim's PV topology ANDed into its
         node-affinity requirement; claims that are unbound (WFFC) or
@@ -155,9 +212,13 @@ class VolumeTopology:
             _, pv = self._lookup(key, pvc.volume_name)
             if pv is not None and pv.terms:
                 term_sets.append(pv.terms)
+        # NodeVolumeLimits: the ONE accounting implementation
+        demands = self.attach_demands(pod)
         out = fold_volume_terms(pod, term_sets)
+        if (exclusive or demands) and out is pod:
+            out = dataclasses.replace(pod)
         if exclusive:
-            if out is pod:
-                out = dataclasses.replace(pod)
             out.exclusive_claims = exclusive
+        if demands:
+            out.attach_demands = demands
         return out

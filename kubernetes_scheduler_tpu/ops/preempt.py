@@ -26,15 +26,19 @@ Deviations from upstream, documented:
   the apply loop never overdraws a budget), but strictly: upstream
   orders candidates by fewest PDB violations and may still preempt
   past a budget as a last resort; this framework never violates one.
-- Constraint families (taints, node/pod affinity, spread) are checked
-  against the CURRENT cluster state via the caller-supplied
-  `static_ok` mask; the marginal effect of removing the victims
-  themselves on (anti)affinity domain counts is not re-simulated.
-  Upstream's RemovePod/AddPod accounting does simulate it; for count-
-  based families this can only make a chosen node conservatively wrong
-  in the pod's favor (victims leaving a domain free anti-affinity slots,
-  never consume them), and the next cycle re-checks everything against
-  real state before binding.
+
+Count-based constraint families (inter-pod (anti)affinity, reverse
+anti-affinity, topology spread) RE-SIMULATE the victims' removal, like
+upstream's RemovePod/AddPod accounting: the victim prefix tables carry
+per-(node, k) freed selector-match and freed-avoider counts
+(cfreed/afreed, mirroring `freed`), and preempt_candidates evaluates
+each (pod, node, k) against the counts as they would stand after the
+evictions — so a preemptor whose required anti-affinity is satisfied
+ONLY by evicting a victim finds the candidate, and one whose required
+affinity depends on a victim staying does not waste an eviction.
+Node-local families (taints, node affinity, resources vs full
+allocatable) stay in the caller-supplied `static_ok` mask — victims
+cannot change node labels or taints.
 
 Candidate ordering reproduces upstream pickOneNodeForPreemption's
 criteria 2-6 in order: lowest highest-victim priority, lowest sum of
@@ -86,6 +90,14 @@ class VictimTables(NamedTuple):
     psum_hi: jnp.ndarray
     psum_lo: jnp.ndarray
     start: jnp.ndarray
+    # [n, K, S] — selector-match counts (cfreed) and freed-avoider
+    # counts (afreed) released by evicting victims 0..k of node n: the
+    # RemovePod side of upstream's RemovePod/AddPod accounting, so
+    # candidate evaluation can re-simulate the victims' effect on
+    # (anti)affinity/spread domain counts. All-zero when the caller
+    # supplies no victim selector data.
+    cfreed: jnp.ndarray
+    afreed: jnp.ndarray
 
 
 class VictimArrays(NamedTuple):
@@ -99,6 +111,10 @@ class VictimArrays(NamedTuple):
     req:   [m, r] f32 — request vectors with non-zero defaults
     mask:  [m] bool
     start: [m] int32 — relative start seconds (larger = later)
+    matches: [m, S] bool — victim's labels match selector s (the pod
+           batch's pod_matches rows for the running set)
+    anti:  [m, S] bool — victim carries a REQUIRED anti term using
+           selector s (an avoider whose eviction frees the domain)
     """
 
     node: jnp.ndarray
@@ -106,6 +122,90 @@ class VictimArrays(NamedTuple):
     req: jnp.ndarray
     mask: jnp.ndarray
     start: jnp.ndarray
+    # None = no selector data (affinity evaluated against unadjusted
+    # counts; a local-engine convenience — the host always fills these,
+    # and the bridge codec requires real arrays on the wire)
+    matches: jnp.ndarray | None = None
+    anti: jnp.ndarray | None = None
+
+
+class PreemptAffinity(NamedTuple):
+    """Inputs for re-simulating the victims' effect on the count-based
+    constraint families per candidate (pod, node, k) — the RemovePod
+    half of upstream's accounting. Node-side tables come from the
+    snapshot; pod-side selectors from the preemptors' PodBatch."""
+
+    domain_counts: jnp.ndarray      # [n, S]
+    avoid_counts: jnp.ndarray       # [n, S]
+    domain_id: jnp.ndarray          # [n, S]
+    node_mask: jnp.ndarray          # [n]
+    affinity_sel: jnp.ndarray       # [p, Ka] required attract, -1 pad
+    anti_affinity_sel: jnp.ndarray  # [p, Ka] required anti, -1 pad
+    pod_matches: jnp.ndarray        # [p, S]
+    spread_sel: jnp.ndarray         # [p, Ks] hard spread, -1 pad
+    spread_max: jnp.ndarray         # [p, Ks]
+
+
+def affinity_after_evictions(
+    a: PreemptAffinity, tables: VictimTables
+) -> jnp.ndarray:
+    """OK[p, n, K]: do the count-based families hold at node n after
+    evicting its k-prefix victims?
+
+    The prefix victims all live on node n, and node n belongs to its own
+    domain under every topology key, so the post-eviction counts AT THE
+    CANDIDATE NODE are exactly counts - cfreed/afreed. For spread, the
+    global minimum can only change through the candidate's own domain:
+    min_after = min(min over OTHER domains, adjusted own count), with
+    the other-domain minimum from the two-smallest-domains trick."""
+    n, k_cap, s = tables.cfreed.shape
+    dc = a.domain_counts[:, None, :] - tables.cfreed     # [n, K, S]
+    av = a.avoid_counts[:, None, :] - tables.afreed
+
+    inv_aff = a.affinity_sel >= s                        # [p, Ka]
+    sel_a = jnp.clip(a.affinity_sel, 0, max(s - 1, 0))
+    aff_ok = (
+        (dc[:, :, sel_a] > 0) | (a.affinity_sel < 0)[None, None]
+    ).all(-1)                                            # [n, K, p]
+    inv_anti = a.anti_affinity_sel >= s
+    sel_t = jnp.clip(a.anti_affinity_sel, 0, max(s - 1, 0))
+    anti_ok = (
+        (dc[:, :, sel_t] <= 0) | (a.anti_affinity_sel < 0)[None, None]
+    ).all(-1)
+    # reverse direction: bad iff the pod matches s and an AVOIDER of s
+    # remains in the domain after the evictions
+    rev_bad = (
+        (av > 0)[:, :, None, :] & a.pod_matches[None, None]
+    ).any(-1)                                            # [n, K, p]
+
+    # hard topology spread: two-smallest-domains for the min excluding
+    # the candidate's own domain (only it changes)
+    big = jnp.asarray(jnp.finfo(jnp.float32).max, jnp.float32)
+    cols = jnp.arange(s)
+    masked = jnp.where(a.node_mask[:, None], a.domain_counts, big)
+    min1 = masked.min(0)                                 # [S]
+    rep1 = a.domain_id[masked.argmin(0), cols]           # [S] min domain rep
+    same1 = a.domain_id == rep1[None, :]                 # [n, S]
+    masked2 = jnp.where(
+        a.node_mask[:, None] & ~same1, a.domain_counts, big
+    )
+    min2 = masked2.min(0)
+    min_excl = jnp.where(same1, min2[None, :], min1[None, :])  # [n, S]
+    new_min = jnp.minimum(min_excl[:, None, :], dc)      # [n, K, S]
+    inv_sp = a.spread_sel >= s
+    sel_s = jnp.clip(a.spread_sel, 0, max(s - 1, 0))
+    sp_ok = (
+        (dc[:, :, sel_s] + 1.0 - new_min[:, :, sel_s]
+         <= a.spread_max[None, None].astype(jnp.float32))
+        | (a.spread_sel < 0)[None, None]
+    ).all(-1)                                            # [n, K, p]
+    valid = ~(
+        inv_aff.any(-1) | inv_anti.any(-1) | inv_sp.any(-1)
+    )                                                    # [p]
+    return (
+        (aff_ok & anti_ok & ~rev_bad & sp_ok).transpose(2, 0, 1)
+        & valid[:, None, None]
+    )
 
 
 class PreemptResult(NamedTuple):
@@ -128,12 +228,17 @@ def build_victim_tables(
     n_nodes: int,
     k_cap: int,
     victim_start: jnp.ndarray | None = None,
+    victim_matches: jnp.ndarray | None = None,
+    victim_anti: jnp.ndarray | None = None,
 ) -> VictimTables:
     """Lay running pods out into per-node prefix tables sorted by
     (priority asc, start time desc). victim_node [m] int32 (entries
     outside [0, n) ignored), victim_prio [m] int32, victim_req [m, r]
     f32, victim_mask [m] bool, victim_start [m] int32 relative seconds
     (None = all equal, reducing the tie-break to input order).
+    victim_matches/victim_anti [m, S] bool feed the cfreed/afreed
+    count-freed prefix tables (None = [*, 1] zeros — no affinity
+    re-simulation data).
 
     One sort + one scatter over the m running pods, paid once per
     preemption pass (not per candidate)."""
@@ -206,6 +311,20 @@ def build_victim_tables(
         jnp.zeros((n_nodes + 1, k_cap), jnp.int32)
         .at[row, pos].set(jnp.where(keep, start_s, 0))[:n_nodes]
     )
+
+    def count_table(per_victim: jnp.ndarray | None) -> jnp.ndarray:
+        """[m, S] bool -> [n, K, S] inclusive prefix counts in victim
+        order (mirrors `freed` for selector-match counts)."""
+        if per_victim is None:
+            return jnp.zeros((n_nodes, k_cap, 1), jnp.float32)
+        sel_s = per_victim[order].astype(jnp.float32)          # [m, S]
+        s_dim = sel_s.shape[1]
+        sel_steps = (
+            jnp.zeros((n_nodes + 1, k_cap, s_dim), jnp.float32)
+            .at[row, pos].set(jnp.where(keep[:, None], sel_s, 0.0))[:n_nodes]
+        )
+        return jnp.cumsum(sel_steps, axis=1)
+
     return VictimTables(
         prio=prio,
         freed=jnp.cumsum(steps, axis=1),
@@ -213,6 +332,8 @@ def build_victim_tables(
         psum_hi=psum_hi,
         psum_lo=psum_lo,
         start=start,
+        cfreed=count_table(victim_matches),
+        afreed=count_table(victim_anti),
     )
 
 
@@ -223,15 +344,20 @@ def preempt_candidates(
     static_ok: jnp.ndarray,
     free: jnp.ndarray,
     tables: VictimTables,
+    affinity: PreemptAffinity | None = None,
 ) -> PreemptResult:
     """Choose a preemption candidate per pending pod.
 
     pend_req [p, r], pend_prio [p] int32, pend_mask [p] bool,
-    static_ok [p, n] bool (non-resource constraint families hold),
+    static_ok [p, n] bool (node-local constraint families hold —
+    taints, node affinity, resources vs full allocatable),
     free [n, r] current free capacity.
 
     Candidate (pod p, node n, count k) is valid iff all k victims have
-    priority strictly below p's and p's request fits free + freed[k-1].
+    priority strictly below p's, p's request fits free + freed[k-1],
+    and — when `affinity` is given — the count-based families hold
+    against the domain counts AS ADJUSTED by evicting those k victims
+    (affinity_after_evictions; upstream RemovePod/AddPod parity).
     Per pod the minimal k per node is kept, then nodes compete on
     upstream pickOneNodeForPreemption's ordering: lowest highest-victim
     priority, lowest sum of victim priorities, fewest victims, latest
@@ -247,6 +373,8 @@ def preempt_candidates(
     # below the preemptor (PRIO_PAD padding fails automatically)
     elig = tables.prio[None, :, :] < pend_prio[:, None, None]   # [p,n,K]
     ok = fits & elig & static_ok[:, :, None] & pend_mask[:, None, None]
+    if affinity is not None:
+        ok = ok & affinity_after_evictions(affinity, tables)
     has_k = ok.any(-1)                                          # [p,n]
     kstar = jnp.argmax(ok, axis=-1)                             # first True
 

@@ -58,7 +58,15 @@ class SchedulerConfig:
     #    {"name": "image_locality", "weight": 1}]
     # Empty = single-policy scoring (engine.compute_scores on `policy`).
     score_plugins: list = field(default_factory=list)
-    assigner: str = "greedy"
+    # auction is the deployed default since round 5: it enforces hard
+    # (anti)affinity exactly (per-round dynamic masks + same-round
+    # conflict eviction), its measured placement quality matches greedy
+    # on every BENCH_SUITE config at the default price step (PARITY.md
+    # round-4 table: assigned counts and mean chosen scores equal or
+    # better), and its parallel rounds are ~90x faster than the
+    # sequential greedy scan at scale — the greedy path remains for
+    # strict upstream-order semantics (assigner="greedy")
+    assigner: str = "auction"
     normalizer: str = "min_max"
     batch_window: int = 1024
     # auction assigner knobs (ops/assign.auction_assign). price_frac is
@@ -147,6 +155,17 @@ class SchedulerConfig:
                 raise ValueError(
                     f"score_plugins weight must be > 0 (drop the entry "
                     f"to disable a plugin): {entry!r}"
+                )
+            # fail fast on typo'd names: a bad plugin would otherwise
+            # error every cycle into the yoda-formula fallback forever.
+            # SCALAR_POLICIES is the jax-free mirror of engine.POLICIES
+            # (test-pinned equal)
+            from kubernetes_scheduler_tpu.host.plugins import SCALAR_POLICIES
+
+            if entry["name"] not in SCALAR_POLICIES:
+                raise ValueError(
+                    f"unknown score plugin {entry['name']!r}; "
+                    f"expected one of {SCALAR_POLICIES}"
                 )
         return cfg
 

@@ -35,6 +35,12 @@ class FakeKube:
         # v1 Namespace objects; None = no route (404, the pre-1.21 /
         # RBAC-denied regime some tests exercise)
         self.namespaces: list[dict] | None = None
+        # apps/v1 workload controllers; None = route disabled (404)
+        self.replicasets: list[dict] | None = None
+        self.statefulsets: list[dict] | None = None
+        # storage.k8s.io/v1 StorageClasses; None = route disabled (404)
+        self.storageclasses: list[dict] | None = None
+        self.pvc_patches: list[tuple[str, dict]] = []  # PATCH log
         self.bindings: list[tuple[str, str]] = []
         # node -> {cpu_pct, mem_pct, disk_io, net_up, net_down}: served
         # Prometheus-style from POST /api/v1/query so one fixture covers
@@ -78,6 +84,25 @@ class FakeKube:
         key = f"{meta['namespace']}/{meta['name']}"
         with self.lock:
             self.pods[key] = obj
+
+    def add_replicaset(
+        self, name: str, replicas: int, *, namespace: str = "default"
+    ) -> None:
+        with self.lock:
+            if self.replicasets is None:
+                self.replicasets = []
+            self.replicasets.append({
+                "metadata": {"name": name, "namespace": namespace},
+                "spec": {"replicas": replicas},
+            })
+
+    def add_storageclass(self, name: str, mode: str) -> None:
+        with self.lock:
+            if self.storageclasses is None:
+                self.storageclasses = []
+            self.storageclasses.append(
+                {"metadata": {"name": name}, "volumeBindingMode": mode}
+            )
 
     def add_namespace(self, name: str, labels: dict | None = None) -> None:
         with self.lock:
@@ -167,6 +192,19 @@ class FakeKube:
                         return self._send(
                             200, {"items": list(fake.namespaces)}
                         )
+                for route, store in (
+                    ("/apis/apps/v1/replicasets", fake.replicasets),
+                    ("/apis/apps/v1/statefulsets", fake.statefulsets),
+                    ("/apis/storage.k8s.io/v1/storageclasses",
+                     fake.storageclasses),
+                ):
+                    if path == route:
+                        with fake.lock:
+                            if store is None:
+                                return self._send(
+                                    404, {"message": "route disabled"}
+                                )
+                            return self._send(200, {"items": list(store)})
                 m = _LEASE_RE.match(path)
                 if m and m.group(2):
                     with fake.lock:
@@ -294,6 +332,40 @@ class FakeKube:
                         ] = fake.next_rv()
                         fake.leases[key] = body
                     return self._send(200, body)
+                return self._send(404, {"message": f"no route {path}"})
+
+            def do_PATCH(self):
+                if not self._auth_ok():
+                    return self._send(401, {"message": "unauthorized"})
+                path = urllib.parse.urlparse(self.path).path
+                m = re.match(
+                    r"^/api/v1/namespaces/([^/]+)"
+                    r"/persistentvolumeclaims/([^/]+)$", path,
+                )
+                if m:
+                    ns, name = m.group(1), m.group(2)
+                    body = self._read_body()
+                    with fake.lock:
+                        pvc = next(
+                            (
+                                o for o in fake.pvcs
+                                if (o.get("metadata") or {}).get("name") == name
+                                and (o.get("metadata") or {}).get(
+                                    "namespace", "default") == ns
+                            ),
+                            None,
+                        )
+                        if pvc is None:
+                            return self._send(404, {"message": "not found"})
+                        ann = pvc.setdefault("metadata", {}).setdefault(
+                            "annotations", {}
+                        )
+                        ann.update(
+                            (body.get("metadata") or {}).get("annotations")
+                            or {}
+                        )
+                        fake.pvc_patches.append((f"{ns}/{name}", body))
+                    return self._send(200, pvc)
                 return self._send(404, {"message": f"no route {path}"})
 
             def do_DELETE(self):

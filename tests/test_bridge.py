@@ -240,12 +240,15 @@ def test_preempt_rpc_matches_local(live_server):
     # concentrated on a few nodes so evicting a small prefix demonstrably
     # frees room
     vic_req = np.asarray(gen_pods(m, seed=42).request)
+    s_cols = int(np.asarray(snap.domain_counts).shape[1])
     victims = VictimArrays(
         node=jnp.asarray(rng.integers(0, 4, m), jnp.int32),
         prio=jnp.asarray(rng.integers(0, 5, m), jnp.int32),
         req=jnp.asarray(vic_req * 3.0, jnp.float32),
         mask=jnp.ones((m,), bool),
         start=jnp.asarray(rng.integers(0, 1000, m), jnp.int32),
+        matches=jnp.zeros((m, s_cols), bool),
+        anti=jnp.zeros((m, s_cols), bool),
     )
     local = engine.preempt_batch(snap, pend, victims, k_cap=4)
     remote = client.preempt(snap, pend, victims, k_cap=4)
@@ -271,6 +274,8 @@ def test_preempt_rpc_rejects_bad_k_cap(live_server):
         req=jnp.zeros((1, np.asarray(pend.request).shape[1]), jnp.float32),
         mask=jnp.ones((1,), bool),
         start=jnp.zeros((1,), jnp.int32),
+        matches=jnp.zeros((1, 1), bool),
+        anti=jnp.zeros((1, 1), bool),
     )
     with pytest.raises(EngineUnavailable, match="INVALID_ARGUMENT"):
         client.preempt(snap, pend, victims, k_cap=0)

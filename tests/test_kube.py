@@ -1285,3 +1285,155 @@ def test_stale_pod_cannot_bind_recreated_name(fake):
     # scheduling identities differ, so the feeder would resubmit it
     from kubernetes_scheduler_tpu.kube.source import pod_key
     assert pod_key(stale) != pod_key(fresh)
+
+
+def test_owner_reference_and_controller_replicas(fake):
+    """pod_from_api captures the controller ownerReference; the informer
+    watches apps/v1 workloads so the PDB percentage math can resolve
+    expected replica counts."""
+    from kubernetes_scheduler_tpu.kube.source import InformerCache
+
+    obj = make_pod_obj("web-abc", node_name="n0")
+    obj["metadata"]["ownerReferences"] = [
+        {"kind": "ReplicaSet", "name": "web-rs", "controller": True},
+        {"kind": "Thing", "name": "x"},  # non-controller ignored
+    ]
+    pod = pod_from_api(obj)
+    assert pod.owner == ("ReplicaSet", "web-rs")
+    assert pod_from_api(make_pod_obj("solo")).owner is None
+
+    fake.add_replicaset("web-rs", 10)
+    cache = InformerCache(client_for(fake), watch_timeout=1.0).start()
+    try:
+        assert cache.wait_synced(timeout=30)
+        assert cache.controller_replicas("ReplicaSet", "default", "web-rs") == 10
+        assert cache.controller_replicas("ReplicaSet", "default", "nope") is None
+        # statefulsets route disabled (404): optional resource degrades
+        assert cache.controller_replicas("StatefulSet", "default", "x") is None
+    finally:
+        cache.stop()
+
+
+def test_wffc_selected_node_handoff_e2e(fake):
+    """VolumeBinding's ACTIVE half: binding a pod with an unbound
+    WaitForFirstConsumer claim PATCHes volume.kubernetes.io/selected-node
+    onto the PVC BEFORE the Binding POST, so the external provisioner
+    creates the volume in the chosen node's topology (upstream
+    VolumeBinding PreBind via /root/reference/go.mod:13). Bound and
+    Immediate-class claims are left alone."""
+    from kubernetes_scheduler_tpu.host import Scheduler, StaticAdvisor
+    from kubernetes_scheduler_tpu.host.advisor import NodeUtil
+    from kubernetes_scheduler_tpu.host.types import Node
+    from kubernetes_scheduler_tpu.kube.volumes import VolumeTopology
+
+    fake.add_storageclass("fast-wffc", "WaitForFirstConsumer")
+    fake.add_storageclass("std", "Immediate")
+    fake.add_node(make_node_obj("n0"))
+    fake.pvcs.append({
+        "metadata": {"name": "scratch", "namespace": "default"},
+        "spec": {"storageClassName": "fast-wffc"},   # unbound WFFC
+    })
+    fake.pvcs.append({
+        "metadata": {"name": "plain", "namespace": "default"},
+        "spec": {"storageClassName": "std"},         # unbound Immediate
+    })
+    fake.add_pod({
+        "metadata": {"name": "wants-scratch"},
+        "spec": {
+            "schedulerName": "yoda-tpu",
+            "containers": [{"resources": {"requests": {"cpu": "100m"}}}],
+            "volumes": [
+                {"persistentVolumeClaim": {"claimName": "scratch"}},
+                {"persistentVolumeClaim": {"claimName": "plain"}},
+            ],
+        },
+        "status": {"phase": "Pending"},
+    })
+    client = client_for(fake)
+    src = KubeClusterSource(client, scheduler_name="yoda-tpu")
+    binder = KubeBinder(client, volumes=src.volumes)
+    nodes = [Node(name="n0",
+                  allocatable={"cpu": 8000.0, "memory": 2**33, "pods": 100})]
+    sched = Scheduler(
+        SchedulerConfig(batch_window=8, min_device_work=0,
+                        adaptive_dispatch=False),
+        advisor=StaticAdvisor({"n0": NodeUtil(cpu_pct=10, disk_io=5)}),
+        binder=binder,
+        list_nodes=lambda: nodes,
+        list_running_pods=lambda: [],
+    )
+    for p in src.list_pending_pods():
+        sched.submit(p)
+    m = sched.run_cycle()
+    assert m.pods_bound == 1 and fake.bindings == [
+        ("default/wants-scratch", "n0")
+    ]
+    # only the WFFC claim was annotated, with the chosen node
+    assert [k for k, _ in fake.pvc_patches] == ["default/scratch"]
+    ann = (fake.pvcs[-2]["metadata"].get("annotations") or {})
+    assert ann.get("volume.kubernetes.io/selected-node") == "n0"
+    assert "annotations" not in fake.pvcs[-1].get("metadata", {})
+
+
+def test_csi_attach_limits_cap_placement(fake):
+    """NodeVolumeLimits: a node at its attachable-volumes-csi-* limit
+    filters out — the running pod's bound CSI volume consumes the one
+    attach unit, so the pending pod's CSI claim forces it elsewhere."""
+    from kubernetes_scheduler_tpu.host import Scheduler, StaticAdvisor
+    from kubernetes_scheduler_tpu.host.advisor import NodeUtil
+
+    for name in ("full", "open"):
+        obj = make_node_obj(name)
+        obj["status"]["allocatable"]["attachable-volumes-csi-ebs.x"] = "1"
+        fake.add_node(obj)
+    for pv, claim in (("pv-a", "vol-a"), ("pv-b", "vol-b")):
+        fake.pvs.append({
+            "metadata": {"name": pv},
+            "spec": {"csi": {"driver": "ebs.x"}},
+        })
+        fake.pvcs.append({
+            "metadata": {"name": claim, "namespace": "default"},
+            "spec": {"volumeName": pv},
+        })
+    fake.add_pod({
+        "metadata": {"name": "holder"},
+        "spec": {
+            "schedulerName": "yoda-tpu", "nodeName": "full",
+            "containers": [{"resources": {"requests": {"cpu": "100m"}}}],
+            "volumes": [{"persistentVolumeClaim": {"claimName": "vol-a"}}],
+        },
+        "status": {"phase": "Running"},
+    })
+    fake.add_pod({
+        "metadata": {"name": "wants-vol"},
+        "spec": {
+            "schedulerName": "yoda-tpu",
+            "containers": [{"resources": {"requests": {"cpu": "100m"}}}],
+            "volumes": [{"persistentVolumeClaim": {"claimName": "vol-b"}}],
+        },
+        "status": {"phase": "Pending"},
+    })
+    client = client_for(fake)
+    src = KubeClusterSource(client, scheduler_name="yoda-tpu")
+    # make "full" the score-preferred node so the test fails loud if the
+    # attach column is ignored
+    utils = {"full": NodeUtil(cpu_pct=5, disk_io=1),
+             "open": NodeUtil(cpu_pct=80, disk_io=40)}
+    sched = Scheduler(
+        SchedulerConfig(batch_window=8, min_device_work=0,
+                        adaptive_dispatch=False),
+        advisor=StaticAdvisor(utils),
+        binder=KubeBinder(client, volumes=src.volumes),
+        list_nodes=src.list_nodes,
+        list_running_pods=src.list_running_pods,
+    )
+    pending = src.list_pending_pods()
+    assert pending[0].attach_demands == {"attachable-volumes-csi-ebs.x": 1.0}
+    running = src.list_running_pods()
+    holder = next(p for p in running if p.name == "holder")
+    assert holder.attach_demands == {"attachable-volumes-csi-ebs.x": 1.0}
+    for p in pending:
+        sched.submit(p)
+    m = sched.run_cycle()
+    assert m.pods_bound == 1
+    assert fake.bindings == [("default/wants-vol", "open")]

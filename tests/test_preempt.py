@@ -261,7 +261,7 @@ def _cluster():
     return nodes, utils, [low0, low1]
 
 
-def _sched(nodes, utils, running, evictor=None, **cfg):
+def _sched(nodes, utils, running, evictor=None, controller_replicas=None, **cfg):
     from kubernetes_scheduler_tpu.host import RecordingEvictor, Scheduler, StaticAdvisor
     from kubernetes_scheduler_tpu.utils.config import SchedulerConfig
 
@@ -274,6 +274,7 @@ def _sched(nodes, utils, running, evictor=None, **cfg):
         evictor=evictor,
         list_nodes=lambda: nodes,
         list_running_pods=lambda: running,
+        controller_replicas=controller_replicas,
     )
 
 
@@ -732,3 +733,227 @@ def test_padded_and_masked_victims_ignored():
         jnp.asarray(free, jnp.float32), tables,
     )
     assert int(res.node[0]) == -1  # only 1 unit can be freed, need 2
+
+
+# ---- RemovePod re-simulation (round-5: victims' effect on counts) --------
+
+
+def _affinity_case(*, anti_sel=-1, aff_sel=-1, victim_matches_s0=True,
+                   victim_anti_s0=False, free_units=0.0):
+    """One node, one selector column, one victim: engine-level
+    preempt_batch with domain counts reflecting the victim."""
+    import jax.numpy as jnp
+
+    from kubernetes_scheduler_tpu import engine as E
+
+    snap = E.make_snapshot(
+        allocatable=np.array([[4.0]], np.float32),
+        requested=np.array([[4.0 - free_units]], np.float32),
+        disk_io=np.array([5.0]), cpu_pct=np.array([10.0]),
+        mem_pct=np.array([10.0]),
+        domain_counts=np.array([[1.0 if victim_matches_s0 else 0.0]],
+                               np.float32),
+        avoid_counts=np.array([[1.0 if victim_anti_s0 else 0.0]], np.float32),
+    )
+    pods = E.make_pod_batch(
+        request=np.array([[2.0]], np.float32),
+        priority=np.array([9], np.int32),
+        affinity_sel=np.array([[aff_sel]], np.int32),
+        anti_affinity_sel=np.array([[anti_sel]], np.int32),
+        pod_matches=np.array([[True]]),
+    )
+    from kubernetes_scheduler_tpu.ops.preempt import VictimArrays
+
+    victims = VictimArrays(
+        node=jnp.asarray([0], jnp.int32),
+        prio=jnp.asarray([1], jnp.int32),
+        req=jnp.asarray([[2.0]], jnp.float32),
+        mask=jnp.ones(1, bool),
+        start=jnp.zeros(1, jnp.int32),
+        matches=jnp.asarray([[victim_matches_s0]]),
+        anti=jnp.asarray([[victim_anti_s0]]),
+    )
+    return E.preempt_batch(snap, pods, victims, k_cap=2)
+
+
+def test_eviction_satisfies_required_anti_affinity():
+    """The preemptor's required ANTI-affinity is violated by the victim
+    itself: static counts say the domain is occupied, but evicting the
+    victim clears it — upstream's RemovePod accounting finds the
+    candidate (the round-4 deviation rejected it)."""
+    res = _affinity_case(anti_sel=0)
+    assert int(res.node[0]) == 0
+    assert int(res.n_victims[0]) == 1
+
+
+def test_eviction_breaks_required_affinity():
+    """The preemptor's required AFFINITY is satisfied ONLY by the victim
+    whose eviction frees the capacity: the candidate must be rejected —
+    evicting would strand the preemptor (bind-time re-check would fail)
+    and waste the eviction."""
+    res = _affinity_case(aff_sel=0)
+    assert int(res.node[0]) == -1
+
+
+def test_eviction_of_avoider_clears_reverse_anti():
+    """The victim is an AVOIDER (its required anti term forbids pods
+    matching s0); the preemptor matches s0. Statically the node is
+    barred (reverse anti-affinity), but evicting the avoider clears
+    it."""
+    res = _affinity_case(victim_matches_s0=False, victim_anti_s0=True)
+    assert int(res.node[0]) == 0
+    assert int(res.n_victims[0]) == 1
+
+
+def test_remaining_avoider_still_bars_candidate():
+    """Two avoiders, only one evictable prefix member needed for
+    capacity: the remaining avoider keeps the node barred, so the
+    candidate needs BOTH victims (k=2), not one."""
+    import jax.numpy as jnp
+
+    from kubernetes_scheduler_tpu import engine as E
+    from kubernetes_scheduler_tpu.ops.preempt import VictimArrays
+
+    snap = E.make_snapshot(
+        allocatable=np.array([[4.0]], np.float32),
+        requested=np.array([[4.0]], np.float32),
+        disk_io=np.array([5.0]), cpu_pct=np.array([10.0]),
+        mem_pct=np.array([10.0]),
+        avoid_counts=np.array([[2.0]], np.float32),
+    )
+    pods = E.make_pod_batch(
+        request=np.array([[2.0]], np.float32),
+        priority=np.array([9], np.int32),
+        pod_matches=np.array([[True]]),
+    )
+    victims = VictimArrays(
+        node=jnp.asarray([0, 0], jnp.int32),
+        prio=jnp.asarray([1, 2], jnp.int32),
+        req=jnp.asarray([[2.0], [1.0]], jnp.float32),
+        mask=jnp.ones(2, bool),
+        start=jnp.zeros(2, jnp.int32),
+        matches=jnp.zeros((2, 1), bool),
+        anti=jnp.ones((2, 1), bool),
+    )
+    res = E.preempt_batch(snap, pods, victims, k_cap=2)
+    assert int(res.node[0]) == 0
+    assert int(res.n_victims[0]) == 2  # capacity alone needed only 1
+
+
+def test_eviction_relaxes_spread_skew():
+    """Hard topology spread: placing on n0 (3 matching pods) violates
+    maxSkew=1 against n1's domain (1 matching). Evicting two matching
+    victims from n0 brings its count to 1 — skew 1 — so the candidate
+    exists with k=2 even though capacity alone needs only one."""
+    import jax.numpy as jnp
+
+    from kubernetes_scheduler_tpu import engine as E
+    from kubernetes_scheduler_tpu.ops.preempt import VictimArrays
+
+    snap = E.make_snapshot(
+        allocatable=np.array([[8.0], [2.0]], np.float32),
+        requested=np.array([[8.0], [2.0]], np.float32),
+        disk_io=np.array([5.0, 5.0]), cpu_pct=np.array([10.0, 10.0]),
+        mem_pct=np.array([10.0, 10.0]),
+        domain_counts=np.array([[3.0], [1.0]], np.float32),
+    )
+    pods = E.make_pod_batch(
+        request=np.array([[2.0]], np.float32),
+        priority=np.array([9], np.int32),
+        spread_sel=np.array([[0]], np.int32),
+        spread_max=np.array([[1]], np.int32),
+        pod_matches=np.array([[True]]),
+    )
+    victims = VictimArrays(
+        node=jnp.asarray([0, 0, 0], jnp.int32),
+        prio=jnp.asarray([1, 2, 3], jnp.int32),
+        req=jnp.asarray([[2.0], [1.0], [1.0]], jnp.float32),
+        mask=jnp.ones(3, bool),
+        start=jnp.zeros(3, jnp.int32),
+        matches=jnp.ones((3, 1), bool),
+        anti=jnp.zeros((3, 1), bool),
+    )
+    res = E.preempt_batch(snap, pods, victims, k_cap=3)
+    assert int(res.node[0]) == 0
+    assert int(res.n_victims[0]) == 2
+
+
+def test_pdb_percentage_expected_count():
+    """Percentage minAvailable resolves against the owning controller's
+    replica count when resolvable (upstream disruption-controller
+    semantics): 50% of a 10-replica set with 6 healthy allows exactly
+    ONE eviction (6 - ceil(5)), where the current-count fallback would
+    over-allow three."""
+    from kubernetes_scheduler_tpu.host.types import PodDisruptionBudget
+
+    pdb = PodDisruptionBudget("web", min_available="50%",
+                              match_labels={"app": "web"})
+    assert pdb.allowed(6, expected_count=10) == 1
+    assert pdb.allowed(6) == 3  # documented controller-less fallback
+    # maxUnavailable resolves against expected too (upstream: healthy -
+    # (expected - maxUnavailable)): 30% of 10 with 6 healthy -> the 4
+    # missing replicas already spend the budget
+    pdb_mu = PodDisruptionBudget("web", max_unavailable="30%")
+    assert pdb_mu.allowed(6, expected_count=10) == 0
+    assert pdb_mu.allowed(10, expected_count=10) == 3
+    assert pdb_mu.allowed(6) == 2  # fallback: 6 - (6 - ceil(1.8))
+    # status always wins
+    pdb2 = PodDisruptionBudget("web", min_available="50%",
+                               disruptions_allowed=0)
+    assert pdb2.allowed(6, expected_count=10) == 0
+
+
+def test_host_preemption_caps_by_expected_count():
+    """End-to-end: a 50%-of-10 budget with 6 healthy replicas lets the
+    preemption pass evict at most ONE victim per cycle once the
+    controller resolver reports the replica count."""
+    from kubernetes_scheduler_tpu.host import NodeUtil, RecordingEvictor
+    from kubernetes_scheduler_tpu.host.types import PodDisruptionBudget
+    from tests.test_host import make_node, make_pod
+
+    nodes = [make_node(f"n{i}", cpu=1000) for i in range(3)]
+    utils = {n.name: NodeUtil(cpu_pct=10, disk_io=5) for n in nodes}
+    running = []
+    for i in range(6):
+        v = make_pod(f"web-{i}", cpu=450,
+                     labels={"scv/priority": "1", "app": "web"})
+        v.node_name = f"n{i % 3}"
+        v.owner = ("ReplicaSet", "web-rs")
+        running.append(v)
+    pdbs = [PodDisruptionBudget("web-pdb", match_labels={"app": "web"},
+                                min_available="50%")]
+    replicas = {("ReplicaSet", "default", "web-rs"): 10}
+
+    ev = RecordingEvictor()
+    s = _sched(nodes, utils, running, evictor=ev,
+               initial_backoff_seconds=0.0, max_backoff_seconds=0.0,
+               controller_replicas=lambda k, ns, n: replicas.get((k, ns, n)))
+    s.list_pdbs = lambda: pdbs
+    # two preemptors, each needing one eviction on separate nodes — the
+    # budget (allowed=1) must cap the cycle at ONE victim
+    for i in range(2):
+        s.submit(make_pod(f"urgent-{i}", cpu=500,
+                          labels={"scv/priority": "9"}))
+    # two cycles: the first spends the whole budget (allowed = 6 - 5 =
+    # 1); the second sees 5 healthy replicas -> allowed 0 -> no eviction
+    m = s.run_cycle()
+    m_second = s.run_cycle()
+    assert m.victims_evicted + m_second.victims_evicted == 1, (m, m_second)
+
+    # without the resolver the fallback math allows 3 -> both evict
+    ev2 = RecordingEvictor()
+    running2 = []
+    for i in range(6):
+        v = make_pod(f"web-{i}", cpu=450,
+                     labels={"scv/priority": "1", "app": "web"})
+        v.node_name = f"n{i % 3}"
+        running2.append(v)
+    s2 = _sched(nodes, utils, running2, evictor=ev2,
+                initial_backoff_seconds=0.0, max_backoff_seconds=0.0)
+    s2.list_pdbs = lambda: pdbs
+    for i in range(2):
+        s2.submit(make_pod(f"urgent-{i}", cpu=500,
+                           labels={"scv/priority": "9"}))
+    m2a = s2.run_cycle()
+    m2b = s2.run_cycle()
+    assert m2a.victims_evicted + m2b.victims_evicted == 2, (m2a, m2b)

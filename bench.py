@@ -312,46 +312,65 @@ def loop_rate() -> dict:
 
     n_nodes = int(os.environ.get("BENCH_LOOP_NODES", 4000))
     n_pods = int(os.environ.get("BENCH_LOOP_PODS", 8192))
-    # two identical passes over fresh clusters: the first compiles the
-    # device program(s) (tens of seconds on a cold chip, paid once per
-    # process in a real deployment), the second measures the steady
-    # state the latency metric is about
-    for _phase in ("warmup", "measured"):
-        nodes, advisor = gen_host_cluster(n_nodes, seed=0)
-        pods = gen_host_pods(n_pods, seed=1)
-        running: list = []
-        sched = Scheduler(
-            SchedulerConfig(batch_window=1024, normalizer="none"),
-            advisor=advisor,
-            list_nodes=lambda: nodes,
-            list_running_pods=lambda: running,
-        )
-        for pod in pods:
-            sched.submit(pod)
+    # ONE scheduler, two backlogs: the first compiles the device
+    # program(s) and warms the steady-state caches a resident scheduler
+    # accumulates (request-row/flag memos, the engine's uniform-leaf
+    # device constants); the second — fresh pods, with the first
+    # backlog's binds as the running set — is the measured steady state,
+    # paying the real per-cycle costs (snapshot re-sum over every
+    # running pod, cold pod-side caches for newly arrived pods).
+    nodes, advisor = gen_host_cluster(n_nodes, seed=0)
+    running: list = []
+    sched = Scheduler(
+        SchedulerConfig(batch_window=1024, normalizer="none"),
+        advisor=advisor,
+        list_nodes=lambda: nodes,
+        list_running_pods=lambda: running,
+    )
+
+    def drain() -> tuple[list, float]:
         t0 = time.perf_counter()
-        cycles = []
-        seen = 0
+        out = []
+        seen = len(sched.binder.bindings)
         for _ in range(64):
             if len(sched.queue) == 0:
                 break
-            cycles.append(sched.run_cycle())
-            # feed this cycle's binds back as running pods, so later
-            # cycles pay the real steady-state snapshot cost
-            # (NonZeroRequested re-sum over every bound pod) and
-            # capacity accrues
+            out.append(sched.run_cycle())
+            # feed binds back as running pods, so later cycles pay the
+            # real steady-state snapshot cost and capacity accrues
             for b in sched.binder.bindings[seen:]:
                 running.append(b.pod)
             seen = len(sched.binder.bindings)
-        dt = time.perf_counter() - t0
+        return out, time.perf_counter() - t0
+
+    for pod in gen_host_pods(n_pods, seed=1):
+        sched.submit(pod)
+    drain()  # warmup backlog (compiles; populates `running`)
+    cycles = []
+    for seed in (2, 3, 4):  # several samples: the tunnel's per-RPC
+        for pod in gen_host_pods(n_pods, seed=seed):  # latency is bimodal
+            sched.submit(pod)
+        got, _ = drain()
+        cycles.extend(got)
     bound = sum(c.pods_bound for c in cycles)
     lat = [c.cycle_seconds for c in cycles]
     eng = [c.engine_seconds for c in cycles]
+    p50 = float(np.percentile(lat, 50))
+    rates = [
+        c.pods_bound / c.cycle_seconds
+        for c in cycles
+        if c.cycle_seconds > 0
+    ]
     return {
         "metric": f"host_loop_{n_nodes}nodes",
         "cycles": len(cycles),
         "pods_bound": bound,
-        "pods_per_sec": round(bound / dt, 1),
-        "cycle_p50_ms": round(1e3 * float(np.percentile(lat, 50)), 2),
+        # steady-state rate = MEDIAN of the per-cycle rates (each cycle's
+        # own binds over its own latency): robust to the tunnel's bimodal
+        # per-RPC latency without letting a low-bind drain cycle pair
+        # with another cycle's latency
+        "pods_per_sec": round(float(np.percentile(rates, 50)), 1),
+        "cycle_p50_ms": round(1e3 * p50, 2),
         "cycle_p99_ms": round(1e3 * float(np.percentile(lat, 99)), 2),
         # device dispatch+compute+sync; on a tunneled dev chip the per-RPC
         # round-trip dominates — a colocated sidecar pays ~ms

@@ -411,14 +411,16 @@ def main(argv=None):
     if args.score_plugins:
         import json as _json
 
-        entries = _json.loads(args.score_plugins)
-        if any(float(e.get("weight", 1)) <= 0 for e in entries):
-            # weight 0 is ambiguous on the proto wire (proto3 zero =
-            # unset -> 1); drop the entry to disable a plugin
-            raise SystemExit("--score-plugins weights must be > 0")
-        score_plugins = tuple(
-            (e["name"], float(e.get("weight", 1))) for e in entries
-        )
+        from kubernetes_scheduler_tpu.utils.config import SchedulerConfig
+
+        # ONE validation implementation: names, weights and entry keys
+        # are checked by the same code the host's config path uses
+        try:
+            score_plugins = SchedulerConfig.from_dict(
+                {"score_plugins": _json.loads(args.score_plugins)}
+            ).score_plugins_tuple()
+        except ValueError as e:
+            raise SystemExit(f"--score-plugins: {e}") from None
         if args.fused:
             # the fused kernel hardwires the single yoda formula; a
             # silently-fused "weighted" sidecar would advertise

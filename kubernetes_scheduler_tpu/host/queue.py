@@ -85,6 +85,12 @@ class SchedulingQueue:
         with self._lock:
             self._attempts.pop(f"{pod.namespace}/{pod.name}", None)
 
+    def mark_scheduled_many(self, pods: list[Pod]) -> None:
+        """Batch form: one lock round for a whole cycle's binds."""
+        with self._lock:
+            for pod in pods:
+                self._attempts.pop(f"{pod.namespace}/{pod.name}", None)
+
     def _drain_backoff(self) -> None:
         now = self._clock()
         while self._backoff and self._backoff[0][0] <= now:
@@ -173,6 +179,26 @@ class NativeBackedQueue:
             if h is not None:
                 self._q.mark_scheduled(h)
                 self._drop_if_done(h)
+
+    def mark_scheduled_many(self, pods: list[Pod]) -> None:
+        """Batch form: ONE foreign call clears every bind's retry
+        counter (native yoda_queue_mark_scheduled_batch), one lock round
+        for the Python bookkeeping — the per-bind ctypes dispatch was a
+        visible slice of big-backlog cycles."""
+        import numpy as np
+
+        with self._lock:
+            handles = []
+            for pod in pods:
+                h = self._by_uid.get(f"{pod.namespace}/{pod.name}")
+                if h is not None:
+                    handles.append(h)
+            if handles:
+                self._q.mark_scheduled_batch(
+                    np.asarray(handles, np.uint64)
+                )
+                for h in handles:
+                    self._drop_if_done(h)
 
     def pop_window(self, max_pods: int) -> list[Pod]:
         with self._lock:

@@ -27,12 +27,42 @@ import numpy as np
 from kubernetes_scheduler_tpu.engine import LocalEngine
 from kubernetes_scheduler_tpu.host.advisor import NodeUtil
 from kubernetes_scheduler_tpu.host.plugins import ScalarYodaPlugin, scalar_schedule_one
-from kubernetes_scheduler_tpu.host.queue import make_queue
+from kubernetes_scheduler_tpu.host.queue import make_queue, pod_priority
 from kubernetes_scheduler_tpu.host.snapshot import SnapshotBuilder, pod_resource_request
 from kubernetes_scheduler_tpu.host.types import Node, Pod
 from kubernetes_scheduler_tpu.utils.config import SchedulerConfig
 
 log = logging.getLogger("yoda_tpu.scheduler")
+
+
+_FLAG_PLAIN = 1   # no constraint family beyond score + resource fit
+_FLAG_SOFT = 2    # carries preferred (soft) score terms
+
+
+def _pod_flags(pod: Pod) -> int:
+    """Per-pod dispatch flags, memoized on the pod object (specs are
+    immutable in k8s): the per-cycle eligibility scans probe EVERY
+    window pod every cycle, and a retried pod must not re-pay the
+    attribute walk."""
+    flags = pod.__dict__.get("_flags_cache")
+    if flags is None:
+        plain = not (
+            pod.tolerations or pod.node_affinity or pod.pod_affinity
+            or pod.preferred_node_affinity or pod.topology_spread
+            or pod.host_ports or pod.target_node is not None
+            or any(
+                k.startswith("scv/") and k != "scv/priority"
+                for k in pod.labels
+            )
+        )
+        soft = bool(
+            pod.preferred_node_affinity
+            or any(t.preferred for t in pod.pod_affinity)
+            or any(sc.soft for sc in pod.topology_spread)
+        )
+        flags = (_FLAG_PLAIN if plain else 0) | (_FLAG_SOFT if soft else 0)
+        pod.__dict__["_flags_cache"] = flags
+    return flags
 
 
 def _pod_key(pod: Pod) -> str:
@@ -453,6 +483,12 @@ class Scheduler:
                     False, cells, time.perf_counter() - t_path
                 )
 
+        # successful binds clear their retry counters in ONE batch (the
+        # native path pays one foreign call instead of one per bind);
+        # the 404/409 drop path inside _bind still marks immediately
+        if self._cycle_bound:
+            self.queue.mark_scheduled_many(self._cycle_bound)
+
         # PostFilter parity: unschedulable pods may preempt strictly-
         # lower-priority running pods (ops/preempt.py). A failure here
         # must never lose the cycle's bindings — preemptors are already
@@ -514,6 +550,13 @@ class Scheduler:
         k_cap = self.config.preemption_max_victims
         if k_cap <= 0 or not nodes:
             return
+        cap = self.config.preemption_max_candidates
+        if cap > 0 and len(pods) > cap:
+            # highest-priority preemptors first; the rest retry next
+            # cycle (the device pass's candidate tensors scale with the
+            # preemptor count, and only one proposal lands per node per
+            # cycle anyway)
+            pods = sorted(pods, key=pod_priority, reverse=True)[:cap]
         # THIS cycle's bindings must be part of the capacity model: the
         # `running` list was read before they happened, and a preemption
         # computed against pre-bind free capacity can kill victims for a
@@ -745,15 +788,8 @@ class Scheduler:
         running pod with pod_affinity terms forces the engine path."""
         if any(nd.taints or nd.cards for nd in nodes):
             return False
-        for pod in window:
-            if (
-                pod.tolerations or pod.node_affinity or pod.pod_affinity
-                or pod.preferred_node_affinity or pod.topology_spread
-                or pod.host_ports or pod.target_node is not None
-            ):
-                return False
-            if any(k.startswith("scv/") and k != "scv/priority" for k in pod.labels):
-                return False
+        if not all(_pod_flags(pod) & _FLAG_PLAIN for pod in window):
+            return False
         if any(pod.pod_affinity for pod in running):
             return False
         return True
@@ -782,10 +818,12 @@ class Scheduler:
                 self.queue.requeue_unschedulable(pod)
                 m.pods_unschedulable += 1
             return
-        self.queue.mark_scheduled(pod)
+        # retry-counter clearing is deferred to the cycle-end batch
+        # (queue.mark_scheduled_many over _cycle_bound)
         m.pods_bound += 1
         self._cycle_bound.append(pod)
-        self._nominations.pop(_pod_key(pod), None)
+        if self._nominations:  # skip the key build on the common path
+            self._nominations.pop(_pod_key(pod), None)
 
     def _requeue_unschedulable(self, pod: Pod, m: CycleMetrics) -> None:
         """Nothing fit this pod this cycle: requeue with backoff and
@@ -811,12 +849,7 @@ class Scheduler:
         with identical decisions; silently unavailable outside its
         (policy, normalizer) domain."""
         soft = (
-            any(
-                pd.preferred_node_affinity
-                or any(t.preferred for t in pd.pod_affinity)
-                or any(sc.soft for sc in pd.topology_spread)
-                for pd in window
-            )
+            any(_pod_flags(pd) & _FLAG_SOFT for pd in window)
             or any(t.preferred for pd in running for t in pd.pod_affinity)
             or any(
                 t.effect == "PreferNoSchedule" for nd in nodes for t in nd.taints

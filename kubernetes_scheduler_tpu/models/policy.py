@@ -49,6 +49,28 @@ HEURISTIC_POLICIES = {
         reference="pkg/yoda/score/algorithm.go:264-291",
         live_in_reference=False,
     ),
+    "least_allocated": PolicyInfo(
+        name="least_allocated",
+        description="NodeResourcesLeastAllocated (k8s 1.22 default): mean "
+        "free share of cpu/memory after placement",
+        reference="k8s 1.22 default score plugin via go.mod:13 "
+        "(deploy/yoda-scheduler.yaml:21-47 disables nothing)",
+        live_in_reference=True,
+    ),
+    "balanced_allocation": PolicyInfo(
+        name="balanced_allocation",
+        description="NodeResourcesBalancedAllocation (k8s 1.22 default): "
+        "(1 - |cpuFrac - memFrac|) * 100 after placement",
+        reference="k8s 1.22 default score plugin via go.mod:13",
+        live_in_reference=True,
+    ),
+    "image_locality": PolicyInfo(
+        name="image_locality",
+        description="ImageLocality (k8s 1.22 default): spread-scaled image "
+        "footprint already present on the node, 23MB..1GB/container ramp",
+        reference="k8s 1.22 default score plugin via go.mod:13",
+        live_in_reference=True,
+    ),
     "learned": PolicyInfo(
         name="learned",
         description="two-tower learned scorer (models/learned.py), distilled"

@@ -27,7 +27,7 @@ _REPO_ROOT = os.path.dirname(
 )
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libyoda_host.so")
-ABI_VERSION = 3
+ABI_VERSION = 4
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
@@ -76,6 +76,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int32, ctypes.c_double,
     ]
     lib.yoda_queue_mark_scheduled.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.yoda_queue_mark_scheduled_batch.argtypes = [ctypes.c_void_p, u64p, i64]
     lib.yoda_queue_pop_window.restype = i64
     lib.yoda_queue_pop_window.argtypes = [ctypes.c_void_p, ctypes.c_double, u64p, i64]
     lib.yoda_queue_len.restype = i64
@@ -173,6 +174,12 @@ class NativeQueue:
 
     def mark_scheduled(self, pod: int) -> None:
         self._lib.yoda_queue_mark_scheduled(self._q, pod)
+
+    def mark_scheduled_batch(self, pods: np.ndarray) -> None:
+        """One foreign call for a whole cycle's binds (uint64 handles)."""
+        self._lib.yoda_queue_mark_scheduled_batch(
+            self._q, _ptr(pods, ctypes.c_uint64), len(pods)
+        )
 
     def pop_window(self, max_pods: int, now: float) -> np.ndarray:
         out = np.empty(max_pods, dtype=np.uint64)

@@ -119,6 +119,12 @@ class SchedulerConfig:
     # KubeEvictor live); without one the pass is inert.
     preemption: bool = True
     preemption_max_victims: int = 8
+    # preemptors evaluated per pass, highest priority first: the
+    # RemovePod re-simulation's candidate tensors scale with
+    # nodes x k_cap x preemptors x selectors, and the host applies at
+    # most one proposal per node per cycle anyway — a mass-unschedulable
+    # event must not feed the whole backlog into one device pass
+    preemption_max_candidates: int = 128
     # how long a preemptor's nominated-node capacity reservation survives
     # if the preemptor never comes back to bind (deleted while pending)
     preemption_nomination_ttl_seconds: float = 120.0

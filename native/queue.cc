@@ -78,6 +78,11 @@ void yoda_queue_mark_scheduled(YodaQueue* q, uint64_t pod) {
   q->attempts.erase(pod);
 }
 
+void yoda_queue_mark_scheduled_batch(YodaQueue* q, const uint64_t* pods,
+                                     int64_t n) {
+  for (int64_t i = 0; i < n; ++i) q->attempts.erase(pods[i]);
+}
+
 int64_t yoda_queue_pop_window(YodaQueue* q, double now, uint64_t* out,
                               int64_t max_n) {
   while (!q->backoff.empty() && q->backoff.top().ready_at <= now) {
@@ -97,6 +102,6 @@ int64_t yoda_queue_len(const YodaQueue* q) {
   return static_cast<int64_t>(q->active.size() + q->backoff.size());
 }
 
-int32_t yoda_host_abi_version(void) { return 3; }
+int32_t yoda_host_abi_version(void) { return 4; }
 
 }  // extern "C"

@@ -35,6 +35,9 @@ void yoda_queue_requeue_unschedulable(YodaQueue* q, uint64_t pod,
                                       int32_t priority, double now);
 /* Successful bind: clear the retry counter. */
 void yoda_queue_mark_scheduled(YodaQueue* q, uint64_t pod);
+/* Batch form: one foreign call for a whole cycle's binds. */
+void yoda_queue_mark_scheduled_batch(YodaQueue* q, const uint64_t* pods,
+                                     int64_t n);
 /* Drain due backoff entries, then pop up to max_n pods in priority order.
  * Returns the number written to out. */
 int64_t yoda_queue_pop_window(YodaQueue* q, double now, uint64_t* out,

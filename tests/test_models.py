@@ -23,9 +23,16 @@ import functools
 def test_policy_registry():
     assert set(HEURISTIC_POLICIES) == {
         "balanced_cpu_diskio", "balanced_diskio", "free_capacity", "card",
+        "least_allocated", "balanced_allocation", "image_locality",
         "learned",
     }
     assert get_policy("balanced_cpu_diskio").live_in_reference
+    # every engine-schedulable registry entry is a real engine policy
+    from kubernetes_scheduler_tpu.engine import POLICIES
+
+    assert {
+        n for n, p in HEURISTIC_POLICIES.items() if p.engine_schedulable
+    } == set(POLICIES)
     with pytest.raises(ValueError):
         get_policy("nope")
 

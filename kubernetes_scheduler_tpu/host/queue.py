@@ -24,13 +24,20 @@ from kubernetes_scheduler_tpu.host.types import Pod
 def pod_priority(pod: Pod) -> int:
     """spec.priority when the API server resolved one (upstream
     PriorityClass semantics), else the reference's integer
-    `scv/priority` label (sort.go:12-18), 0 when absent/garbage."""
-    if pod.priority is not None:
-        return int(pod.priority)
-    try:
-        return int(pod.labels.get("scv/priority", 0))
-    except (TypeError, ValueError):
-        return 0
+    `scv/priority` label (sort.go:12-18), 0 when absent/garbage.
+    Memoized on the pod object (immutable spec): probed per pod by the
+    queue key, the batch builder, and preemption ordering every cycle."""
+    v = pod.__dict__.get("_prio_cache")
+    if v is None:
+        if pod.priority is not None:
+            v = int(pod.priority)
+        else:
+            try:
+                v = int(pod.labels.get("scv/priority", 0))
+            except (TypeError, ValueError):
+                v = 0
+        pod.__dict__["_prio_cache"] = v
+    return v
 
 
 @dataclass(order=True)

@@ -301,17 +301,29 @@ def suite_rate(name: str) -> dict:
     }
 
 
-def loop_rate() -> dict:
+def loop_rate(
+    *,
+    n_pods: int | None = None,
+    max_windows: int = 8,
+    metric_suffix: str = "",
+) -> dict:
     """END-TO-END host loop at the north-star scale: queue pop -> snapshot
     build -> device program -> binds, through host.Scheduler on a simulated
     cluster (the BASELINE.md latency metric: per-cycle bind latency p50/p99
-    including all host-side work, not just the device step)."""
+    including all host-side work, not just the device step).
+
+    max_windows is SchedulerConfig.max_windows_per_cycle: how deep a
+    pending backlog one cycle pops into a single device dispatch. The
+    default (8) is the deployed default; the deep-backlog variant (16)
+    amortizes the device round-trip over twice the pods — higher
+    throughput, higher per-cycle latency, both reported honestly."""
     from kubernetes_scheduler_tpu.host.scheduler import Scheduler
     from kubernetes_scheduler_tpu.sim.host_gen import gen_host_cluster, gen_host_pods
     from kubernetes_scheduler_tpu.utils.config import SchedulerConfig
 
     n_nodes = int(os.environ.get("BENCH_LOOP_NODES", 4000))
-    n_pods = int(os.environ.get("BENCH_LOOP_PODS", 8192))
+    if n_pods is None:
+        n_pods = int(os.environ.get("BENCH_LOOP_PODS", 1024 * max_windows))
     # ONE scheduler, two backlogs: the first compiles the device
     # program(s) and warms the steady-state caches a resident scheduler
     # accumulates (request-row/flag memos, the engine's uniform-leaf
@@ -322,7 +334,11 @@ def loop_rate() -> dict:
     nodes, advisor = gen_host_cluster(n_nodes, seed=0)
     running: list = []
     sched = Scheduler(
-        SchedulerConfig(batch_window=1024, normalizer="none"),
+        SchedulerConfig(
+            batch_window=1024,
+            normalizer="none",
+            max_windows_per_cycle=max_windows,
+        ),
         advisor=advisor,
         list_nodes=lambda: nodes,
         list_running_pods=lambda: running,
@@ -362,7 +378,7 @@ def loop_rate() -> dict:
         if c.cycle_seconds > 0
     ]
     return {
-        "metric": f"host_loop_{n_nodes}nodes",
+        "metric": f"host_loop_{n_nodes}nodes{metric_suffix}",
         "cycles": len(cycles),
         "pods_bound": bound,
         # HEADLINE = aggregate throughput (all binds / all cycle time),
@@ -454,6 +470,7 @@ def main():
     _backend_diag()
     if "--loop" in sys.argv:
         print(json.dumps(loop_rate()))
+        print(json.dumps(loop_rate(max_windows=16, metric_suffix="_deep16w")))
         return
     if "--suite" in sys.argv:
         from kubernetes_scheduler_tpu.sim.cluster_gen import BENCH_CONFIGS
@@ -497,10 +514,16 @@ def main():
     )
     # the END-TO-END host loop (queue pop -> snapshot build -> device
     # program -> binds) recorded beside the engine headline — the number
-    # a real deployment experiences (round-4 verdict #1). Failures must
+    # a real deployment experiences (round-4 verdict #1): the deployed
+    # default (8 windows/cycle) and the deep-backlog configuration (16
+    # windows/cycle, amortizing the device round-trip). Failures must
     # not cost the headline metric.
     try:
         print(json.dumps(loop_rate()), flush=True)
+        print(
+            json.dumps(loop_rate(max_windows=16, metric_suffix="_deep16w")),
+            flush=True,
+        )
     except Exception as e:  # pragma: no cover - diagnostic path
         print(json.dumps({"diag": "host_loop_failed", "error": str(e)[-200:]}),
               flush=True)

@@ -20,7 +20,7 @@ import logging
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Callable
+from typing import Callable, NamedTuple
 
 import numpy as np
 
@@ -28,9 +28,13 @@ from kubernetes_scheduler_tpu.engine import LocalEngine
 from kubernetes_scheduler_tpu.host.advisor import NodeUtil
 from kubernetes_scheduler_tpu.host.plugins import ScalarYodaPlugin, scalar_schedule_one
 from kubernetes_scheduler_tpu.host.queue import make_queue, pod_priority
+from kubernetes_scheduler_tpu.ops.constraints import (
+    PREFER_NO_SCHEDULE as _PREFER_NO_SCHEDULE,
+)
 from kubernetes_scheduler_tpu.host.snapshot import (
     FLAG_PLAIN as _FLAG_PLAIN,
     FLAG_SOFT as _FLAG_SOFT,
+    _SCAL_DT,
     SnapshotBuilder,
     pod_batch_record,
     pod_flags as _pod_flags,
@@ -50,8 +54,10 @@ def _pod_key(pod: Pod) -> str:
     return pod.uid or f"{pod.namespace}/{pod.name}"
 
 
-@dataclass
-class Binding:
+class Binding(NamedTuple):
+    # NamedTuple (not dataclass): RecordingBinder.bind_many constructs
+    # one per bind — tuple __new__ measured ~2x faster than dataclass
+    # __init__ at 8k binds/cycle, and bindings are immutable records
     pod: Pod
     node_name: str
 
@@ -397,9 +403,14 @@ class Scheduler:
         # when adaptive_dispatch is on (utils/adaptive.py); cells below
         # min_device_work route scalar until both models are fitted.
         cells = len(window) * len(nodes)
+        # with reservations, `running` is a per-cycle throwaway
+        # concatenation: probes must not record prefix caches on it
+        eph_running = bool(reservations)
         scalar_eligible = (
             self.config.policy in ("balanced_cpu_diskio", "free_capacity")
-            and self._scalar_sufficient(window, nodes, running)
+            and self._scalar_sufficient(
+                window, nodes, running, record=not eph_running
+            )
         )
         if not scalar_eligible:
             use_device = True
@@ -417,7 +428,10 @@ class Scheduler:
                 # dispatch when the engine serves the windows surface
                 if backlog:
                     try:
-                        self._run_backlog(window, nodes, running, utils, m)
+                        self._run_backlog(
+                            window, nodes, running, utils, m,
+                            ephemeral=eph_running,
+                        )
                     except NotImplementedError:
                         # version-skewed sidecar without the windows RPC:
                         # degrade to per-window dispatches (same
@@ -443,7 +457,8 @@ class Scheduler:
                             try:
                                 self._run_batched(
                                     chunk, nodes, run_now, utils, m,
-                                    ephemeral=run_now is not running,
+                                    ephemeral=eph_running
+                                    or run_now is not running,
                                 )
                             except Exception:
                                 # chunk-local fallback: earlier chunks'
@@ -458,7 +473,10 @@ class Scheduler:
                                     chunk, nodes, run_now, utils, m
                                 )
                 else:
-                    self._run_batched(window, nodes, running, utils, m)
+                    self._run_batched(
+                        window, nodes, running, utils, m,
+                        ephemeral=eph_running,
+                    )
                 # backlog cycles amortize dispatch over many windows — a
                 # different cost curve than the single-dispatch cycles
                 # the scalar/device crossover model is about, so only
@@ -795,29 +813,82 @@ class Scheduler:
             if key not in in_window
         ]
 
-    def _running_features(self, running) -> tuple[bool, bool]:
+    def _running_features(self, running, *, record: bool = True) -> tuple[bool, bool]:
         """(any pod with (anti)affinity terms, any PREFERRED term) over
         the running set, with a prefix-identity cache: the cluster source
         passes the SAME append-only list cycle after cycle, so only pods
         added since the last probe are walked (two O(running) scans per
         cycle otherwise — a visible cost at 20k+ running pods). A rebuilt
-        or shrunk list falls back to a full scan."""
+        or shrunk list falls back to a full scan.
+
+        record=False probes without storing the prefix record — for
+        throwaway concatenations (nomination reservations, per-chunk
+        running + cycle_bound): recording those would evict the
+        steady-state record and force a full rescan next cycle (the same
+        rule as the snapshot builder's ephemeral=True)."""
         rf = self.__dict__.get("_run_feat")
         start = suffix_start(rf[0] if rf else None, running)
         any_aff, any_pref = (rf[1], rf[2]) if start else (False, False)
         if start < len(running):
             for pd in running[start:]:
+                fl = pd.__dict__.get("_flags_cache")
+                if fl is not None and fl & _FLAG_PLAIN:
+                    continue  # plain pods carry no pod_affinity terms
                 pa = pd.pod_affinity
                 if pa:
                     any_aff = True
                     if not any_pref and any(t.preferred for t in pa):
                         any_pref = True
-            self.__dict__["_run_feat"] = (
-                suffix_record(running), any_aff, any_pref,
-            )
+            if record:
+                self.__dict__["_run_feat"] = (
+                    suffix_record(running), any_aff, any_pref,
+                )
         return any_aff, any_pref
 
-    def _scalar_sufficient(self, window, nodes, running) -> bool:
+    def _window_flags(self, window) -> tuple[bool, bool]:
+        """(every pod FLAG_PLAIN, any pod FLAG_SOFT) over the window,
+        computed in ONE pass and identity-cached on the window list:
+        _scalar_sufficient and _engine_options otherwise each ran their
+        own full-window flag scan per cycle (~13ms each at 8k pods).
+
+        The pass assembles the window's batch records (warmed at submit)
+        and reduces their packed flag column vectorized; the records are
+        kept for build_pod_batch so the window is only walked once."""
+        wf = self.__dict__.get("_wflags")
+        if wf is not None and wf[0] is window:
+            return wf[1], wf[2]
+        if not window:
+            res = (window, True, False)
+        else:
+            names_t = self.builder.resource_names_tuple()
+            recs = [
+                rc
+                if (rc := pd.__dict__.get("_batch_rec_cache")) is not None
+                and rc[0] is names_t
+                else pod_batch_record(pd, names_t)
+                for pd in window
+            ]
+            flags = np.frombuffer(
+                b"".join([rc[7] for rc in recs]), _SCAL_DT
+            )["fl"]
+            res = (
+                window,
+                bool(((flags & _FLAG_PLAIN) != 0).all()),
+                bool((flags & _FLAG_SOFT).any()),
+            )
+            self.__dict__["_wrecs"] = (window, recs)
+        self.__dict__["_wflags"] = res
+        return res[1], res[2]
+
+    def _window_recs(self, window):
+        """The batch records _window_flags assembled for this window, or
+        None when a different window was flagged last."""
+        wr = self.__dict__.get("_wrecs")
+        return wr[1] if wr is not None and wr[0] is window else None
+
+    def _scalar_sufficient(
+        self, window, nodes, running, *, record: bool = True
+    ) -> bool:
         """True when this cycle uses no constraint family beyond the scalar
         path's surface (live score + resource fit).
 
@@ -828,9 +899,9 @@ class Scheduler:
         running pod with pod_affinity terms forces the engine path."""
         if any(nd.taints or nd.cards for nd in nodes):
             return False
-        if not all(_pod_flags(pod) & _FLAG_PLAIN for pod in window):
+        if not self._window_flags(window)[0]:
             return False
-        any_aff, _ = self._running_features(running)
+        any_aff, _ = self._running_features(running, record=record)
         return not any_aff
 
     def _bind(self, pod, node_name: str, m: CycleMetrics) -> None:
@@ -872,7 +943,10 @@ class Scheduler:
         m.pods_unschedulable += 1
         self._cycle_unsched.append(pod)
 
-    def _engine_options(self, window, nodes, running, pods_batch) -> dict:
+    def _engine_options(
+        self, window, nodes, running, pods_batch, snapshot=None,
+        *, record: bool = True,
+    ) -> dict:
         """Per-cycle engine options, shared by the single-window and
         backlog device paths so their semantics cannot diverge.
 
@@ -887,12 +961,25 @@ class Scheduler:
         terms, soft taints). The fused Pallas path is an optimization
         with identical decisions; silently unavailable outside its
         (policy, normalizer) domain."""
-        soft = (
-            any(_pod_flags(pd) & _FLAG_SOFT for pd in window)
-            or self._running_features(running)[1]
-            or any(
+        if snapshot is not None:
+            # vectorized soft-taint probe over the already-built arrays
+            # (taints[..., 2] is the encoded effect column); the nested
+            # generator scan over 4k nodes measured ~1ms/cycle
+            tmask = np.asarray(snapshot.taint_mask)
+            soft_taints = bool(tmask.any()) and bool(
+                (
+                    (np.asarray(snapshot.taints)[..., 2] == _PREFER_NO_SCHEDULE)
+                    & tmask
+                ).any()
+            )
+        else:
+            soft_taints = any(
                 t.effect == "PreferNoSchedule" for nd in nodes for t in nd.taints
             )
+        soft = (
+            self._window_flags(window)[1]
+            or self._running_features(running, record=record)[1]
+            or soft_taints
         )
         affinity_aware = bool(
             np.asarray(pods_batch.pod_matches).any()
@@ -930,7 +1017,10 @@ class Scheduler:
             )
         return kw
 
-    def _run_backlog(self, window, nodes, running, utils, m: CycleMetrics):
+    def _run_backlog(
+        self, window, nodes, running, utils, m: CycleMetrics,
+        *, ephemeral: bool = False,
+    ):
         """Deep-queue cycle: schedule the whole backlog as stacked
         windows in ONE engine dispatch (engine.schedule_windows /
         the ScheduleWindows RPC), capacity and (anti)affinity carried
@@ -940,9 +1030,12 @@ class Scheduler:
 
         bw = self.config.batch_window
         snapshot = self.builder.build_snapshot(
-            nodes, utils, running, pending_pods=window
+            nodes, utils, running, pending_pods=window, ephemeral=ephemeral,
+            pending_all_plain=self._window_flags(window)[0],
         )
-        pods_batch = self.builder.build_pod_batch(window)
+        pods_batch = self.builder.build_pod_batch(
+            window, recs=self._window_recs(window)
+        )
         n_padded = -(-len(window) // bw) * bw
         p_have = int(np.asarray(pods_batch.request).shape[0])
         if p_have < n_padded:
@@ -954,7 +1047,10 @@ class Scheduler:
                 *[np.asarray(a)[:n_padded] for a in pods_batch]
             )
         windows = stack_windows(pods_batch, bw)
-        kw = self._engine_options(window, nodes, running, pods_batch)
+        kw = self._engine_options(
+            window, nodes, running, pods_batch, snapshot,
+            record=not ephemeral,
+        )
         t0 = time.perf_counter()
         res = self.engine.schedule_windows(snapshot, windows, **kw)
         idx = np.asarray(res.node_idx).reshape(-1)
@@ -1014,10 +1110,16 @@ class Scheduler:
         # running avoider would be missing from pod_matches and the reverse
         # check would silently pass.
         snapshot = self.builder.build_snapshot(
-            nodes, utils, running, pending_pods=window, ephemeral=ephemeral
+            nodes, utils, running, pending_pods=window, ephemeral=ephemeral,
+            pending_all_plain=self._window_flags(window)[0],
         )
-        pods_batch = self.builder.build_pod_batch(window)
-        kw = self._engine_options(window, nodes, running, pods_batch)
+        pods_batch = self.builder.build_pod_batch(
+            window, recs=self._window_recs(window)
+        )
+        kw = self._engine_options(
+            window, nodes, running, pods_batch, snapshot,
+            record=not ephemeral,
+        )
         t0 = time.perf_counter()
         res = self.engine.schedule_batch(snapshot, pods_batch, **kw)
         idx = np.asarray(res.node_idx)

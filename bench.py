@@ -323,7 +323,13 @@ def loop_rate(
 
     n_nodes = int(os.environ.get("BENCH_LOOP_NODES", 4000))
     if n_pods is None:
-        n_pods = int(os.environ.get("BENCH_LOOP_PODS", 1024 * max_windows))
+        # BENCH_LOOP_PODS names the DEFAULT (8-window) backlog size; the
+        # deep variant scales it so an override keeps the configurations
+        # proportional (a flat override would quietly turn the "deep"
+        # run into the default workload under a different label)
+        n_pods = (
+            int(os.environ.get("BENCH_LOOP_PODS", 8192)) * max_windows // 8
+        )
     # ONE scheduler, two backlogs: the first compiles the device
     # program(s) and warms the steady-state caches a resident scheduler
     # accumulates (request-row/flag memos, the engine's uniform-leaf

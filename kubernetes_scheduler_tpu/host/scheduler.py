@@ -530,7 +530,8 @@ class Scheduler:
         ):
             try:
                 self._run_preemption(
-                    self._cycle_unsched, nodes, running, utils, m
+                    self._cycle_unsched, nodes, running, utils, m,
+                    ephemeral=eph_running,
                 )
             except Exception:
                 log.exception("preemption pass failed; retrying next cycle")
@@ -561,7 +562,10 @@ class Scheduler:
             total += replicas
         return total
 
-    def _run_preemption(self, pods, nodes, running, utils, m: CycleMetrics):
+    def _run_preemption(
+        self, pods, nodes, running, utils, m: CycleMetrics,
+        *, ephemeral: bool = False,
+    ):
         """Select and evict victims for this cycle's unschedulable pods.
 
         Device pass (ops/preempt.py) proposes (node, victims) per
@@ -610,14 +614,15 @@ class Scheduler:
         # ever fit here after evictions" — while every other constraint
         # family applies unchanged (see ops/preempt.py for the
         # documented affinity-recheck deviation)
-        # ephemeral: when this cycle bound pods, `running` here is a
+        # ephemeral: when this cycle bound pods (or held nomination
+        # reservations), `running` here is a
         # throwaway concatenation — recording it would clobber the
         # steady-state prefix caches the main cycle build relies on,
         # silently re-enabling full O(running) rescans every cycle in
         # exactly the saturated regime preemption runs in
         snapshot = self.builder.build_snapshot(
             nodes, utils, running, pending_pods=pods,
-            ephemeral=bool(self._cycle_bound),
+            ephemeral=bool(self._cycle_bound) or ephemeral,
         )
         pend = self.builder.build_pod_batch(pods)
         vics = self.builder.build_pod_batch(running)

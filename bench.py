@@ -33,7 +33,9 @@ BASELINE_PODS = int(os.environ.get("BENCH_BASELINE_PODS", 64))
 # 12 back-to-back backlogs per measurement: the one final sync is a pure
 # tunnel round-trip (~70-90ms on the dev chip) and at 4 reps it was ~25%
 # of the measured window, swinging the headline with tunnel weather; at
-# 12 the measurement converges to the steady-state pipelined rate
+# 12 the measurement converges to the steady-state pipelined rate.
+# suite_rate shares the knob (capped by its 65536-cell budget) — its
+# small configs gain the same stability for sub-second extra wall time.
 REPS = int(os.environ.get("BENCH_REPS", 12))
 # fused Pallas score+feasibility kernel (identical decisions; fewer HBM passes)
 FUSED = os.environ.get("BENCH_FUSED", "1") != "0"

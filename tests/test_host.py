@@ -889,3 +889,143 @@ def test_running_avoider_forces_engine_path_and_blocks_domain():
     assert not m.used_fallback  # running avoider forced the engine path
     bound = {b.pod.name: b.node_name for b in s.binder.bindings}
     assert bound["web-0"] != "n0", bound
+
+
+# ---- round-5 host fast path: byte-packed records, window flags, queue memo
+
+
+def test_pod_batch_record_bytes_slots_match_scalar_slots():
+    """The byte-packed slots (6: request-row f32 bytes, 7: scalar block)
+    must decode to exactly the tuple slots the scalar paths read — the
+    builders assemble window matrices from the bytes, the scalar
+    fallback from the tuples, and they must never diverge."""
+    import numpy as np
+
+    from kubernetes_scheduler_tpu.host.snapshot import (
+        _SCAL_DT,
+        pod_batch_record,
+    )
+
+    pod = make_pod("p", cpu=250, annotations={"diskIO": "7"},
+                   labels={"scv/priority": "3"})
+    names = ("cpu", "memory", "pods")
+    rec = pod_batch_record(pod, names)
+    row = np.frombuffer(rec[6], np.float32)
+    assert row.tolist() == [float(x) for x in rec[1]]
+    scal = np.frombuffer(rec[7], _SCAL_DT)[0]
+    assert float(scal["rio"]) == rec[2] == 7.0
+    assert int(scal["pri"]) == rec[3] == 3
+    assert int(scal["nc"]) == rec[4] == 1
+    assert int(scal["fl"]) == rec[5]
+
+
+def test_pod_batch_record_names_change_recomputes_row_and_bytes():
+    """A column-layout change must refresh the request row AND its bytes
+    form while keeping the layout-independent scalar block."""
+    import numpy as np
+
+    from kubernetes_scheduler_tpu.host.snapshot import pod_batch_record
+
+    pod = make_pod("p", cpu=250, annotations={"diskIO": "7"})
+    n1 = ("cpu", "memory", "pods")
+    n2 = ("cpu", "memory", "pods", "nvidia.com/gpu")
+    r1 = pod_batch_record(pod, n1)
+    r2 = pod_batch_record(pod, n2)
+    assert len(r2[1]) == 4 and r2[1][:3] == r1[1][:3]
+    assert np.frombuffer(r2[6], np.float32).shape == (4,)
+    assert r2[7] == r1[7]  # scalar block carried, not recomputed
+
+
+def test_build_pod_batch_rejects_stale_recs_layout():
+    """A handed-in recs list built against an older column layout must be
+    discarded, not trusted (build_snapshot can grow hostPort/attach
+    columns between the flag pass and the batch build)."""
+    import numpy as np
+
+    from kubernetes_scheduler_tpu.host.snapshot import (
+        SnapshotBuilder,
+        pod_batch_record,
+    )
+
+    b = SnapshotBuilder()
+    pods = [make_pod("a", cpu=100), make_pod("b", cpu=200)]
+    stale_names = ("cpu",)
+    stale = [pod_batch_record(p, stale_names) for p in pods]
+    batch = b.build_pod_batch(pods, recs=stale)
+    req = np.asarray(batch.request)
+    # correct layout: cpu at column 0, memory present, pods column = 1
+    names = b.resource_names
+    assert req[0, names.index("cpu")] == 100.0
+    assert req[1, names.index("cpu")] == 200.0
+    assert req[0, names.index("pods")] == 1.0
+
+
+def test_window_flags_single_walk_and_identity_cache():
+    """_window_flags computes (all plain, any soft) once per window list
+    and hands its records to build_pod_batch — a second probe of the same
+    list must be a cache hit (no rewalk)."""
+    nodes = [make_node("n0")]
+    s = make_sched(nodes, [], {"n0": NodeUtil(cpu_pct=10, disk_io=5)})
+    from kubernetes_scheduler_tpu.host.types import WeightedExpression, MatchExpression
+
+    soft_pod = make_pod("soft", preferred_node_affinity=[
+        WeightedExpression(weight=1, expr=MatchExpression(
+            key="zone", operator="In", values=["z1"]))
+    ])
+    window = [make_pod("plain"), soft_pod]
+    all_plain, any_soft = s._window_flags(window)
+    assert (all_plain, any_soft) == (False, True)
+    assert s._window_recs(window) is not None
+    # identity cache: same tuple back without recomputation
+    s.__dict__["_wflags"] = (window, "ALL", "SOFT")
+    assert s._window_flags(window) == ("ALL", "SOFT")
+    # a different list recomputes
+    assert s._window_flags([make_pod("q")]) == (True, False)
+
+
+def test_running_features_record_false_preserves_steady_state_record():
+    """Probing a throwaway concatenation with record=False must not evict
+    the canonical list's prefix record (the reservations / per-chunk
+    regression the round-5 review caught)."""
+    nodes = [make_node("n0")]
+    canonical = [make_pod(f"r{i}") for i in range(4)]
+    s = make_sched(nodes, canonical, {"n0": NodeUtil(cpu_pct=10, disk_io=5)})
+    s._running_features(canonical)
+    rec = s.__dict__["_run_feat"]
+    assert rec[0][0] is canonical
+    throwaway = canonical + [make_pod("resv")]
+    s._running_features(throwaway, record=False)
+    assert s.__dict__["_run_feat"] is rec  # untouched
+    # default (record=True) on the canonical list extends the record
+    canonical.append(make_pod("r4"))
+    s._running_features(canonical)
+    assert s.__dict__["_run_feat"][0][0] is canonical
+
+
+def test_queue_handle_memo_cross_queue_and_resubmission():
+    """mark_scheduled_many resolves handles via the pod-side memo; pods
+    whose memo points at another queue fall back to the uid path, dead
+    handles are skipped, and a same-uid resubmission schedules cleanly."""
+    from kubernetes_scheduler_tpu.host.queue import make_queue
+
+    q1, q2 = make_queue(), make_queue()
+    pa, pb = make_pod("qa"), make_pod("qb")
+    q1.push(pa)
+    q1.push(pb)
+    q2.push(pb)  # pb's memo now points at q2; its q1 entry is live
+    got = q1.pop_window(10)
+    assert got == [pa, pb] or got == [pb, pa]
+    # pb resolves via the uid fallback (memo names q2), pa via the memo;
+    # never-queued pods are skipped
+    q1.mark_scheduled_many([pa, pb, make_pod("never-queued")])
+    assert len(q1) == 0
+    # dead-handle skip: pa's handle was dropped by the mark above, but
+    # its memo still names q1 — re-marking must hit the `h in pods_d`
+    # guard and fall through without touching anything
+    q1.mark_scheduled_many([pa])
+    assert len(q1) == 0
+    pa2 = make_pod("qa")  # same uid, new object
+    q1.push(pa2)
+    assert q1.pop_window(10) == [pa2]
+    q1.mark_scheduled_many([pa2])
+    assert len(q1) == 0

@@ -111,6 +111,11 @@ class SchedulerConfig:
     # schedule them in ONE engine dispatch (engine.schedule_windows /
     # the ScheduleWindows RPC) with capacity + affinity carried between
     # windows on device. 1 = one window per cycle (the upstream shape).
+    # Throughput/latency dial: 16 amortizes the engine round-trip over
+    # twice the pods (~+35% loop throughput on a tunneled chip,
+    # host_loop_4000nodes_deep16w in bench.py) at ~1.5x cycle latency;
+    # remote sidecars see the biggest gains, colocated engines pay ~ms
+    # round-trips and gain little.
     max_windows_per_cycle: int = 8
     # preemption (upstream PostFilter parity, ops/preempt.py): when a pod
     # fits nowhere, evict <= preemption_max_victims strictly-lower-

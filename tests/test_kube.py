@@ -1437,3 +1437,53 @@ def test_csi_attach_limits_cap_placement(fake):
     m = sched.run_cycle()
     assert m.pods_bound == 1
     assert fake.bindings == [("default/wants-vol", "open")]
+
+
+def test_deep_backlog_live_e2e(fake):
+    """Deep-queue cycle against the live API path: one run_cycle pops
+    max_windows_per_cycle windows and schedules them in ONE engine
+    dispatch (capacity + window-internal anti-affinity carried on
+    device), with every bind landing on the server through KubeBinder's
+    per-pod POSTs. Pins the deep-backlog configuration
+    (examples/scheduler-config-deep-backlog.json) to the kube surface,
+    not just the simulated host loop."""
+    for i in range(3):
+        fake.add_node(make_node_obj(f"n{i}", cpu="64"))
+    anti = {"affinity": {"podAntiAffinity": {
+        "requiredDuringSchedulingIgnoredDuringExecution": [{
+            "labelSelector": {"matchLabels": {"app": "db"}},
+            "topologyKey": "kubernetes.io/hostname",
+        }],
+    }}}
+    # 3 mutually anti-affine db pods FIRST (FIFO pop order puts all
+    # three inside cycle 1's single deep dispatch), then 30 plain pods
+    for i in range(3):
+        fake.add_pod(make_pod_obj(
+            f"db-{i}", cpu="100m", labels={"app": "db"}, extra_spec=anti
+        ))
+    for i in range(30):
+        fake.add_pod(make_pod_obj(f"plain-{i}", cpu="100m"))
+    client = client_for(fake)
+    src = KubeClusterSource(client, scheduler_name="yoda-tpu")
+    utils = {f"n{i}": NodeUtil(cpu_pct=10 + i, disk_io=3) for i in range(3)}
+    sched = Scheduler(
+        SchedulerConfig(
+            batch_window=8, max_windows_per_cycle=4, min_device_work=0
+        ),
+        advisor=StaticAdvisor(utils),
+        binder=KubeBinder(client),
+        list_nodes=src.list_nodes,
+        list_running_pods=src.list_running_pods,
+    )
+    for p in src.list_pending_pods():
+        sched.submit(p)
+    m1 = sched.run_cycle()
+    assert m1.pods_in == 32  # 4 windows x 8 popped in ONE cycle
+    m2 = sched.run_cycle()
+    assert m1.pods_bound + m2.pods_bound == 33
+    bound = {k.split("/")[1]: v for k, v in fake.bindings}
+    assert len(bound) == 33
+    # the three db pods are mutually anti-affine: three distinct nodes,
+    # enforced WITHIN the single deep dispatch
+    db_nodes = {bound[f"db-{i}"] for i in range(3)}
+    assert len(db_nodes) == 3, db_nodes

@@ -111,6 +111,31 @@ def gen_pod(rng, i, spread_groups=None):
     return Pod(**kw)
 
 
+def gen_scenario(rng, n, n_running):
+    """Shared fixture recipe: cluster, spread-group membership, pending
+    pod factory inputs, placed running pods, and advisor utils — one
+    definition so the capstone sweep and the windows-carry sweep cannot
+    diverge in what they exercise."""
+    from kubernetes_scheduler_tpu.host.advisor import NodeUtil
+
+    nodes = gen_cluster(rng, n)
+    spread_groups = {
+        (ns, app)
+        for ns in NAMESPACES
+        for app in ("web", "db")
+        if rng.random() < 0.5
+    }
+    running = []
+    for i in range(n_running):
+        rp = gen_pod(rng, 100 + i, spread_groups)
+        rp.node_name = nodes[int(rng.integers(0, n))].name
+        running.append(rp)
+    utils = {nd.name: NodeUtil(cpu_pct=float(rng.uniform(0, 80)),
+                               disk_io=float(rng.uniform(0, 40)))
+             for nd in nodes}
+    return nodes, spread_groups, running, utils
+
+
 def zone_of(node):
     return node.labels["topology.kubernetes.io/zone"]
 
@@ -120,27 +145,10 @@ def zone_of(node):
 def test_all_families_against_final_state_oracle(seed, assigner):
     rng = np.random.default_rng(1000 + seed)
     n, p = 24, 20
-    nodes = gen_cluster(rng, n)
-    spread_groups = {
-        (ns, app)
-        for ns in NAMESPACES
-        for app in ("web", "db")
-        if rng.random() < 0.5
-    }
+    nodes, spread_groups, running, utils = gen_scenario(rng, n, 6)
     pods = [gen_pod(rng, i, spread_groups) for i in range(p)]
-    # a few running pods occupy domains (mixed namespaces)
-    running = []
-    for i in range(6):
-        rp = gen_pod(rng, 100 + i, spread_groups)
-        rp.node_name = nodes[int(rng.integers(0, n))].name
-        running.append(rp)
-
-    from kubernetes_scheduler_tpu.host.advisor import NodeUtil
 
     b = SnapshotBuilder()
-    utils = {nd.name: NodeUtil(cpu_pct=float(rng.uniform(0, 80)),
-                               disk_io=float(rng.uniform(0, 40)))
-             for nd in nodes}
     snap = b.build_snapshot(nodes, utils, running, pending_pods=pods)
     batch = b.build_pod_batch(pods)
     res = schedule_batch(snap, batch, assigner=assigner,
@@ -229,3 +237,52 @@ def test_all_families_against_final_state_oracle(seed, assigner):
             }
             skew = counts[zone_of(nd)] - min(counts.values())
             assert skew <= sc.max_skew, (pod.name, counts)
+
+
+@pytest.mark.parametrize("assigner", ["greedy", "auction"])
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_windows_carry_matches_sequential_rebuild(seed, assigner):
+    """The deep-backlog scan (schedule_windows: capacity + (anti)affinity
+    domain counts folded BETWEEN windows on device) must make exactly the
+    decisions of sequential per-window schedule_batch dispatches where the
+    host re-snapshots between windows with the prior windows' placements
+    as running pods — the production one-window-per-cycle shape. Pins
+    fold_window_counts/free_after against the from-scratch rebuild."""
+    import dataclasses
+
+    from kubernetes_scheduler_tpu.engine import schedule_windows, stack_windows
+
+    rng = np.random.default_rng(2000 + seed)
+    n, w, n_windows = 16, 8, 3
+    p = w * n_windows
+    nodes, spread_groups, running, utils = gen_scenario(rng, n, 4)
+    pods = [gen_pod(rng, i, spread_groups) for i in range(p)]
+    kw = dict(assigner=assigner, normalizer="none",
+              affinity_aware=True, soft=True)
+
+    # (a) one deep dispatch, carries on device
+    b1 = SnapshotBuilder()
+    snap = b1.build_snapshot(nodes, utils, running, pending_pods=pods)
+    batch = b1.build_pod_batch(pods)
+    wres = schedule_windows(snap, stack_windows(batch, w), **kw)
+    deep_idx = np.asarray(wres.node_idx).reshape(-1)[:p]
+
+    # (b) sequential per-window dispatches, host re-snapshot between
+    b2 = SnapshotBuilder()
+    run2 = list(running)
+    seq_idx = []
+    for k in range(n_windows):
+        win = pods[k * w:(k + 1) * w]
+        # fresh Pod objects: the deep path's builder cached rows on the
+        # originals; cloning guards against accidental cache coupling
+        win = [dataclasses.replace(pd) for pd in win]
+        s2 = b2.build_snapshot(nodes, utils, run2, pending_pods=win)
+        r2 = schedule_batch(s2, b2.build_pod_batch(win), **kw)
+        idx2 = np.asarray(r2.node_idx)[:w]
+        seq_idx.extend(int(j) for j in idx2)
+        for pd, j in zip(win, idx2):
+            if 0 <= j < n:
+                placed = dataclasses.replace(pd, node_name=nodes[int(j)].name)
+                run2.append(placed)
+    assert deep_idx.tolist() == seq_idx, (deep_idx.tolist(), seq_idx)
+    assert any(0 <= j < n for j in seq_idx), "sweep is vacuous"

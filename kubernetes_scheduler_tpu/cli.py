@@ -93,7 +93,10 @@ def _kube_config(args):
 def cmd_scheduler_kube(args, cfg) -> int:
     """Live-cluster mode: list/watch via the API server, bind via the
     Binding subresource, leader-elect on the cluster Lease."""
-    from kubernetes_scheduler_tpu.host.advisor import PrometheusAdvisor
+    from kubernetes_scheduler_tpu.host.advisor import (
+        BackgroundAdvisor,
+        PrometheusAdvisor,
+    )
     from kubernetes_scheduler_tpu.host.leader import LeaderElector
     from kubernetes_scheduler_tpu.host.scheduler import Scheduler
     from kubernetes_scheduler_tpu.kube import (
@@ -124,9 +127,19 @@ def cmd_scheduler_kube(args, cfg) -> int:
         from kubernetes_scheduler_tpu.bridge.client import RemoteEngine
 
         engine = RemoteEngine(args.engine)
+    # background refresh keeps the five Prometheus round-trips OFF the
+    # scheduling cycle's latency path (the reference pays them inside
+    # PreScore); refresh_interval_seconds=0 restores direct fetching
+    advisor = PrometheusAdvisor(cfg.advisor.prometheus_host)
+    if cfg.advisor.refresh_interval_seconds > 0:
+        advisor = BackgroundAdvisor(
+            advisor,
+            interval=cfg.advisor.refresh_interval_seconds,
+            max_staleness=cfg.advisor.max_staleness_seconds,
+        )
     sched = Scheduler(
         cfg,
-        advisor=PrometheusAdvisor(cfg.advisor.prometheus_host),
+        advisor=advisor,
         binder=KubeBinder(client, cache=cache, volumes=source.volumes),
         evictor=KubeEvictor(client),
         list_nodes=source.list_nodes,
@@ -174,6 +187,8 @@ def cmd_scheduler_kube(args, cfg) -> int:
         cycles = sched.totals["cycles"]
     finally:
         cache.stop()
+        if hasattr(advisor, "close"):
+            advisor.close()  # stop the background refresh thread
         if elector is not None:
             elector.release()
         if exporter is not None:

@@ -132,3 +132,111 @@ class StaticAdvisor:
 
     def fetch(self) -> dict[str, NodeUtil]:
         return self.utils
+
+
+class BackgroundAdvisor:
+    """Cycle-path decoupled advisor: a daemon thread refreshes the inner
+    advisor every `interval` seconds and fetch() returns the latest
+    snapshot WITHOUT blocking the scheduling cycle on the five
+    Prometheus HTTP round-trips. The reference pays those round-trips
+    inside the scheduling cycle itself (advisor.Result.Init() from
+    PreScore, scheduler.go:104,126 + advisor.go:149-265), and so did
+    this host's direct wiring — at a 100ms Prometheus RTT that is most
+    of a cycle's latency budget.
+
+    Degradation contract: a snapshot older than `max_staleness` is not
+    served. fetch() then falls through to ONE synchronous inner fetch
+    (covering startup and advisor recovery); if that raises, the
+    exception propagates so Scheduler.run_cycle's fetch-failure path
+    requeues the window — exactly the direct wiring's outage behavior,
+    just `max_staleness` later. `stale_served` counts fetches served a
+    snapshot older than TWICE the refresh interval (one interval of
+    slack covers the healthy gap between a scrape completing and the
+    next starting) — exported as advisor_stale_served_total.
+
+    The refresh thread starts LAZILY on the first fetch(): an HA standby
+    replica constructs the advisor and then blocks waiting for
+    leadership without running cycles — it must not scrape Prometheus
+    for its whole standby life (the direct wiring only scraped inside
+    cycles).
+    """
+
+    def __init__(
+        self,
+        inner,
+        *,
+        interval: float = 5.0,
+        max_staleness: float = 60.0,
+        clock: Callable[[], float] | None = None,
+        start_thread: bool = True,
+    ):
+        import threading
+        import time
+
+        if float(interval) > float(max_staleness):
+            # a budget below the refresh period would put every fetch on
+            # the synchronous fallback path WHILE the thread scrapes
+            # redundantly — strictly worse than direct wiring
+            raise ValueError(
+                f"refresh interval ({interval}s) must not exceed "
+                f"max_staleness ({max_staleness}s)"
+            )
+        self.inner = inner
+        self.interval = float(interval)
+        self.max_staleness = float(max_staleness)
+        self._clock = clock or time.monotonic
+        self._lock = threading.Lock()
+        self._snap: dict[str, NodeUtil] | None = None
+        self._ts: float = float("-inf")
+        self.stale_served = 0
+        self._stop = threading.Event()
+        self._thread = None
+        self._want_thread = bool(start_thread)
+
+    def _ensure_thread(self) -> None:
+        import threading
+
+        if not self._want_thread or self._thread is not None:
+            return
+        with self._lock:
+            if self._thread is None and not self._stop.is_set():
+                self._thread = threading.Thread(
+                    target=self._run, name="advisor-refresh", daemon=True
+                )
+                self._thread.start()
+
+    def _refresh_once(self) -> None:
+        snap = self.inner.fetch()
+        with self._lock:
+            self._snap = snap
+            self._ts = self._clock()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._refresh_once()
+            except Exception:
+                # keep serving the last snapshot inside the staleness
+                # budget; fetch() surfaces the outage when it expires
+                pass
+            self._stop.wait(self.interval)
+
+    def fetch(self) -> dict[str, NodeUtil]:
+        self._ensure_thread()
+        now = self._clock()
+        with self._lock:
+            snap, ts = self._snap, self._ts
+        if snap is not None and now - ts <= self.max_staleness:
+            if now - ts > 2 * self.interval:
+                self.stale_served += 1
+            return snap
+        # no usable snapshot (startup, or the refresher has been failing
+        # past the budget): one synchronous attempt, errors propagating
+        self._refresh_once()
+        with self._lock:
+            return self._snap
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)

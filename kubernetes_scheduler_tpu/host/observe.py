@@ -110,12 +110,21 @@ _HELP = {
     "engine_step_p50_seconds": "Median device (engine) step time",
     "engine_step_p99_seconds": "p99 device (engine) step time",
     "batch_size_mean": "Mean pods per scheduling window",
+    "advisor_stale_served_total": (
+        "Cycles served a utilization snapshot older than twice the "
+        "advisor refresh interval (BackgroundAdvisor brown-out signal)"
+    ),
 }
 
 
-def render_prometheus(metrics, totals: dict | None = None) -> str:
+def render_prometheus(
+    metrics, totals: dict | None = None, extra: dict | None = None
+) -> str:
+    rows = summarize(metrics, totals)
+    if extra:
+        rows = {**rows, **extra}
     out = []
-    for key, value in summarize(metrics, totals).items():
+    for key, value in rows.items():
         name = f"{PREFIX}_{key}"
         kind = "counter" if key.endswith("_total") else "gauge"
         out.append(f"# HELP {name} {_HELP[key]}")
@@ -143,7 +152,15 @@ class MetricsExporter:
                         window, totals = sched.metrics_snapshot()
                     else:
                         window, totals = list(sched.metrics), None
-                    body = render_prometheus(window, totals).encode()
+                    stale = getattr(
+                        getattr(sched, "advisor", None), "stale_served", None
+                    )
+                    extra = (
+                        {"advisor_stale_served_total": stale}
+                        if stale is not None
+                        else None
+                    )
+                    body = render_prometheus(window, totals, extra).encode()
                     ctype = "text/plain; version=0.0.4"
                 elif self.path == "/healthz":
                     body, ctype = b"ok\n", "text/plain"

@@ -40,6 +40,16 @@ class AdvisorConfig:
     # normalization divisors (algorithm.go:71,73)
     disk_io_divisor: float = 50.0
     cpu_divisor: float = 100.0
+    # background refresh (host.advisor.BackgroundAdvisor): a daemon
+    # thread scrapes every refresh_interval_seconds so the scheduling
+    # cycle never blocks on the five Prometheus round-trips (the
+    # reference pays them inside PreScore, advisor.go:149-265). 0 =
+    # fetch directly inside the cycle. Snapshots older than
+    # max_staleness_seconds are not served — fetch falls back to one
+    # synchronous scrape whose failure requeues the window, the direct
+    # wiring's outage behavior.
+    refresh_interval_seconds: float = 5.0
+    max_staleness_seconds: float = 60.0
 
 
 @dataclass

@@ -1029,3 +1029,107 @@ def test_queue_handle_memo_cross_queue_and_resubmission():
     assert q1.pop_window(10) == [pa2]
     q1.mark_scheduled_many([pa2])
     assert len(q1) == 0
+
+
+# ---- BackgroundAdvisor: cycle-path decoupled metrics refresh -------------
+
+
+class _CountingAdvisor:
+    def __init__(self):
+        self.calls = 0
+        self.fail = False
+
+    def fetch(self):
+        self.calls += 1
+        if self.fail:
+            raise RuntimeError("prometheus down")
+        return {"n0": NodeUtil(cpu_pct=float(self.calls))}
+
+
+def test_background_advisor_serves_snapshot_without_inner_fetch():
+    from kubernetes_scheduler_tpu.host.advisor import BackgroundAdvisor
+
+    inner = _CountingAdvisor()
+    clock = [0.0]
+    adv = BackgroundAdvisor(
+        inner, interval=5.0, max_staleness=60.0,
+        clock=lambda: clock[0], start_thread=False,
+    )
+    adv._refresh_once()
+    assert inner.calls == 1
+    # cycle fetches inside the refresh interval: no inner calls
+    for _ in range(10):
+        snap = adv.fetch()
+    assert inner.calls == 1 and snap["n0"].cpu_pct == 1.0
+    assert adv.stale_served == 0
+    # older than the interval but inside the budget: served, counted
+    clock[0] = 30.0
+    assert adv.fetch()["n0"].cpu_pct == 1.0
+    assert inner.calls == 1 and adv.stale_served == 1
+
+
+def test_background_advisor_staleness_budget_and_outage_contract():
+    from kubernetes_scheduler_tpu.host.advisor import BackgroundAdvisor
+
+    inner = _CountingAdvisor()
+    clock = [0.0]
+    adv = BackgroundAdvisor(
+        inner, interval=5.0, max_staleness=60.0,
+        clock=lambda: clock[0], start_thread=False,
+    )
+    # startup with no snapshot: fetch() does ONE synchronous scrape
+    assert adv.fetch()["n0"].cpu_pct == 1.0
+    assert inner.calls == 1
+    # past the staleness budget with the scraper failing: the outage
+    # propagates (run_cycle's fetch-failure path requeues the window)
+    clock[0] = 120.0
+    inner.fail = True
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError):
+        adv.fetch()
+    # recovery: next fetch scrapes fresh
+    inner.fail = False
+    assert adv.fetch()["n0"].cpu_pct == 3.0  # calls: 1 ok, 2 fail, 3 ok
+
+
+def test_background_advisor_thread_refreshes():
+    import time as _time
+
+    from kubernetes_scheduler_tpu.host.advisor import BackgroundAdvisor
+
+    inner = _CountingAdvisor()
+    adv = BackgroundAdvisor(inner, interval=0.02, max_staleness=60.0)
+    try:
+        assert inner.calls == 0  # lazy: no scraping before first fetch
+        adv.fetch()  # first fetch starts the refresh thread
+        deadline = _time.time() + 5.0
+        while inner.calls < 3 and _time.time() < deadline:
+            _time.sleep(0.02)
+        assert inner.calls >= 3  # the daemon thread is scraping
+        assert adv.fetch()["n0"].cpu_pct >= 1.0
+    finally:
+        adv.close()
+    settled = inner.calls
+    _time.sleep(0.08)
+    assert inner.calls == settled  # close() stopped the thread
+
+
+def test_background_advisor_rejects_interval_above_staleness():
+    import pytest as _pytest
+
+    from kubernetes_scheduler_tpu.host.advisor import BackgroundAdvisor
+
+    with _pytest.raises(ValueError):
+        BackgroundAdvisor(
+            _CountingAdvisor(), interval=120.0, max_staleness=60.0,
+            start_thread=False,
+        )
+
+
+def test_stale_served_exported_on_metrics_endpoint():
+    from kubernetes_scheduler_tpu.host.observe import render_prometheus
+
+    text = render_prometheus([], None, {"advisor_stale_served_total": 3})
+    assert "advisor_stale_served_total 3" in text
+    assert "# TYPE yoda_tpu_advisor_stale_served_total counter" in text

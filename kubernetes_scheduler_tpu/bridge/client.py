@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import logging
 import time
+import uuid
 
 import grpc
 import numpy as np
@@ -78,6 +79,61 @@ class RemoteEngine:
             response_deserializer=pb.HealthReply.FromString,
         )
         self.last_engine_seconds = 0.0
+        # wire field cache (Tensor.same_as_last): most snapshot leaves
+        # are bytewise identical cycle after cycle — after the sidecar
+        # advertises HealthReply.field_cache, unchanged leaves ride the
+        # wire as one-bit markers. Keyed per (rpc, map) so batch and
+        # windows shapes never flap each other's slots.
+        self._session_id = uuid.uuid4().hex
+        self._wire_cache: dict[str, dict] = {}
+        self._field_cache_ok: bool | None = None
+
+    def _field_cache_enabled(self) -> bool:
+        """Resolve the sidecar's field-cache capability ONCE per client
+        (older sidecars would read a marker as a malformed empty
+        tensor). Called once per schedule call, never inside the
+        per-map packing — a down sidecar must not add health-probe
+        latency twice per cycle on the outage path."""
+        if self._field_cache_ok is None:
+            info = self.health_info()
+            # only a positive health reply resolves it; an unreachable
+            # sidecar stays unknown and is probed again next call
+            if info is not None:
+                self._field_cache_ok = bool(info.field_cache)
+        return bool(self._field_cache_ok)
+
+    def _cache_for(self, key: str, enabled: bool):
+        if not enabled:
+            return None
+        return self._wire_cache.setdefault(key, {})
+
+    def _call_cached(self, method, build_request):
+        """Send with field-cache recovery: on FAILED_PRECONDITION
+        "field-cache-miss" (sidecar restart / session eviction), clear
+        the local cache and resend ONE full request. Any OTHER failure
+        also clears the cache: packing commits values the server may
+        never have processed, and a desynced cache would silently
+        resolve later markers to stale server-side tensors."""
+        try:
+            return self._call_with_retry(method, build_request())
+        except EngineUnavailable as e:
+            cause = e.__cause__
+            if (
+                isinstance(cause, grpc.RpcError)
+                and cause.code() == grpc.StatusCode.FAILED_PRECONDITION
+                and "field-cache-miss" in (cause.details() or "")
+            ):
+                log.warning(
+                    "sidecar %s lost the wire field cache (restart?); "
+                    "resending in full", self.target,
+                )
+                self._wire_cache.clear()
+                return self._call_with_retry(method, build_request())
+            self._wire_cache.clear()
+            raise
+        except Exception:
+            self._wire_cache.clear()
+            raise
 
     def schedule_batch(
         self,
@@ -108,11 +164,21 @@ class RemoteEngine:
             auction_price_frac=auction_price_frac,
             auction_rounds=auction_rounds,
         )
+        def build_request():
+            req = pb.ScheduleRequest()
+            req.CopyFrom(request)
+            enabled = self._field_cache_enabled()
+            snap_cache = self._cache_for("batch:snapshot", enabled)
+            pods_cache = self._cache_for("batch:pods", enabled)
+            if enabled:
+                req.session_id = self._session_id
+            codec.pack_fields(snapshot, req.snapshot, cache=snap_cache)
+            codec.pack_fields(pods, req.pods, cache=pods_cache)
+            return req
+
         for name, weight in score_plugins or ():
             request.score_plugins.add(name=name, weight=float(weight))
-        codec.pack_fields(snapshot, request.snapshot)
-        codec.pack_fields(pods, request.pods)
-        reply = self._call_with_retry(self._schedule, request)
+        reply = self._call_cached(self._schedule, build_request)
         return self._unpack_result(reply, snapshot, pods)
 
     def schedule_windows(
@@ -144,11 +210,21 @@ class RemoteEngine:
             auction_price_frac=auction_price_frac,
             auction_rounds=auction_rounds,
         )
+        def build_request():
+            req = pb.ScheduleRequest()
+            req.CopyFrom(request)
+            enabled = self._field_cache_enabled()
+            snap_cache = self._cache_for("windows:snapshot", enabled)
+            pods_cache = self._cache_for("windows:pods", enabled)
+            if enabled:
+                req.session_id = self._session_id
+            codec.pack_fields(snapshot, req.snapshot, cache=snap_cache)
+            codec.pack_fields(pods_windows, req.pods, cache=pods_cache)
+            return req
+
         for name, weight in score_plugins or ():
             request.score_plugins.add(name=name, weight=float(weight))
-        codec.pack_fields(snapshot, request.snapshot)
-        codec.pack_fields(pods_windows, request.pods)
-        reply = self._call_with_retry(self._schedule_windows, request)
+        reply = self._call_cached(self._schedule_windows, build_request)
         return codec.unpack_fields(engine.WindowsResult, reply.result)
 
     def preempt(self, snapshot, pods, victims, *, k_cap: int):

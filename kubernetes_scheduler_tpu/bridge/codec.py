@@ -40,20 +40,62 @@ def unpack_array(t: pb.Tensor) -> np.ndarray:
     return arr.reshape(shape)
 
 
-def pack_fields(nt, out: pb.NamedTensors, *, only=None) -> pb.NamedTensors:
-    """Pack a NamedTuple of arrays field-by-field into a NamedTensors map."""
+def pack_fields(
+    nt, out: pb.NamedTensors, *, only=None, cache: dict | None = None
+) -> pb.NamedTensors:
+    """Pack a NamedTuple of arrays field-by-field into a NamedTensors map.
+
+    With `cache` (the client side of the wire field cache — a plain
+    {field: ndarray} of this session's previously sent values), a leaf
+    bytewise-identical to its predecessor is replaced by a
+    `same_as_last` marker instead of its payload — most snapshot leaves
+    (allocatable, labels, taints, masks, utilization series) are
+    identical cycle after cycle. The caller owns the protocol
+    preconditions: the sidecar advertised HealthReply.field_cache and
+    the request carries the session_id the cache is scoped to."""
     for name, value in zip(type(nt)._fields, nt):
         if only is not None and name not in only:
             continue
+        if cache is not None:
+            arr = np.ascontiguousarray(np.asarray(value))
+            prev = cache.get(name)
+            if (
+                prev is not None
+                and prev.dtype == arr.dtype
+                and prev.shape == arr.shape
+                and np.array_equal(prev, arr)
+            ):
+                out.tensors[name].same_as_last = True
+                continue
+            # own copy: the comparison must never read a buffer the
+            # caller mutates after the send
+            cache[name] = arr.copy()
         out.tensors[name].CopyFrom(pack_array(value))
     return out
 
 
-def unpack_fields(cls, named: pb.NamedTensors, *, defaults: dict | None = None):
+class FieldCacheMiss(KeyError):
+    """A same_as_last tensor referenced a field this server has no
+    cached value for (sidecar restart, evicted session, skewed client)."""
+
+
+def unpack_fields(
+    cls,
+    named: pb.NamedTensors,
+    *,
+    defaults: dict | None = None,
+    cache: dict | None = None,
+):
     """Rebuild NamedTuple `cls` from a NamedTensors map.
 
     Missing fields fall back to `defaults` (used for decisions_only
     replies); unknown wire fields are rejected so schema drift fails loud.
+
+    With `cache` (the server side of the wire field cache), a
+    `same_as_last` tensor resolves to the session's previously received
+    value — raising FieldCacheMiss when there is none (the handler
+    aborts FAILED_PRECONDITION "field-cache-miss" and the client resends
+    in full) — and every full tensor refreshes its cache slot.
     """
     fields = cls._fields
     unknown = set(named.tensors) - set(fields)
@@ -62,7 +104,18 @@ def unpack_fields(cls, named: pb.NamedTensors, *, defaults: dict | None = None):
     kwargs = {}
     for name in fields:
         if name in named.tensors:
-            kwargs[name] = unpack_array(named.tensors[name])
+            t = named.tensors[name]
+            if t.same_as_last:
+                if cache is None or name not in cache:
+                    raise FieldCacheMiss(
+                        f"field-cache-miss: {cls.__name__}.{name}"
+                    )
+                kwargs[name] = cache[name]
+            else:
+                arr = unpack_array(t)
+                if cache is not None:
+                    cache[name] = arr
+                kwargs[name] = arr
         elif defaults is not None and name in defaults:
             kwargs[name] = defaults[name]
         else:

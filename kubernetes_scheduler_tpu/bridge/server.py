@@ -16,6 +16,7 @@ import argparse
 import logging
 import threading
 import time
+from collections import OrderedDict
 from concurrent import futures
 
 import grpc
@@ -30,6 +31,10 @@ log = logging.getLogger("yoda_tpu.bridge.server")
 
 SERVICE = "yodatpu.Engine"
 _DECISION_FIELDS = ("node_idx", "free_after", "n_assigned")
+# wire field cache: per-session last-value tensors (Tensor.same_as_last).
+# One deep-backlog session is ~a few MB; 8 sessions bound the sidecar's
+# exposure to clients that churn session ids.
+_MAX_CACHE_SESSIONS = 8
 
 
 def _auction_kw(request: pb.ScheduleRequest) -> dict:
@@ -87,6 +92,28 @@ class EngineService:
         self._sharded_opts = sharded_opts or {}
         self.cycles_served = 0
         self._lock = threading.Lock()
+        # session id -> {"<rpc>:<map>": {field: ndarray}} (LRU-bounded)
+        self._field_cache: "OrderedDict[str, dict]" = OrderedDict()
+
+    def _session_caches(self, request, which: str):
+        """(snapshot_cache, pods_cache) for this request's session, or
+        (None, None) when the client did not opt into the field cache."""
+        sid = request.session_id
+        if not sid:
+            return None, None
+        with self._lock:
+            sess = self._field_cache.get(sid)
+            if sess is None:
+                sess = {}
+                self._field_cache[sid] = sess
+                while len(self._field_cache) > _MAX_CACHE_SESSIONS:
+                    self._field_cache.popitem(last=False)
+            else:
+                self._field_cache.move_to_end(sid)
+        return (
+            sess.setdefault(f"{which}:snapshot", {}),
+            sess.setdefault(f"{which}:pods", {}),
+        )
 
     def _pick_sharded_fn(self, request, context, fn, fn_soft, what):
         """Validate the request against the options baked into the
@@ -134,9 +161,18 @@ class EngineService:
         return fn
 
     def schedule_batch(self, request: pb.ScheduleRequest, context) -> pb.ScheduleReply:
+        snap_cache, pods_cache = self._session_caches(request, "batch")
         try:
-            snapshot = codec.unpack_fields(engine.SnapshotArrays, request.snapshot)
-            pods = codec.unpack_fields(engine.PodBatch, request.pods)
+            snapshot = codec.unpack_fields(
+                engine.SnapshotArrays, request.snapshot, cache=snap_cache
+            )
+            pods = codec.unpack_fields(
+                engine.PodBatch, request.pods, cache=pods_cache
+            )
+        except codec.FieldCacheMiss as e:
+            # sidecar restarted or the session was evicted: the client
+            # clears its cache and resends everything in full
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
         except (ValueError, TypeError) as e:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         t0 = time.perf_counter()
@@ -186,9 +222,16 @@ class EngineService:
         axis; the reply holds engine.WindowsResult fields. One device
         dispatch schedules every window with capacity + (anti)affinity
         carries threaded between them."""
+        snap_cache, pods_cache = self._session_caches(request, "windows")
         try:
-            snapshot = codec.unpack_fields(engine.SnapshotArrays, request.snapshot)
-            pods_w = codec.unpack_fields(engine.PodBatch, request.pods)
+            snapshot = codec.unpack_fields(
+                engine.SnapshotArrays, request.snapshot, cache=snap_cache
+            )
+            pods_w = codec.unpack_fields(
+                engine.PodBatch, request.pods, cache=pods_cache
+            )
+        except codec.FieldCacheMiss as e:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
         except (ValueError, TypeError) as e:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         t0 = time.perf_counter()
@@ -239,6 +282,10 @@ class EngineService:
             k_cap = int(request.preempt_k_cap)
             if k_cap <= 0:
                 raise ValueError("preempt_k_cap must be positive")
+        except codec.FieldCacheMiss as e:
+            # the Preempt surface is uncached (victims churn per pass);
+            # a marker here is a skewed/confused client — same recovery
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
         except (ValueError, TypeError) as e:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         t0 = time.perf_counter()
@@ -258,6 +305,7 @@ class EngineService:
             device_count=len(devs),
             platform=devs[0].platform if devs else "none",
             cycles_served=self.cycles_served,
+            field_cache=True,
         )
 
 

@@ -24,6 +24,8 @@ scheduling (advisor.go:219,242 swallow errors).
 from __future__ import annotations
 
 import json
+import threading
+import time
 import urllib.parse
 import urllib.request
 from dataclasses import dataclass, field
@@ -170,9 +172,6 @@ class BackgroundAdvisor:
         clock: Callable[[], float] | None = None,
         start_thread: bool = True,
     ):
-        import threading
-        import time
-
         if float(interval) > float(max_staleness):
             # a budget below the refresh period would put every fetch on
             # the synchronous fallback path WHILE the thread scrapes
@@ -190,12 +189,15 @@ class BackgroundAdvisor:
         self._ts: float = float("-inf")
         self.stale_served = 0
         self._stop = threading.Event()
+        # serializes scrapes: the cycle-path staleness fallback must
+        # never run a second set of the five PromQL queries concurrently
+        # with the refresh thread's — doubling load on a Prometheus that
+        # is already struggling is exactly the wrong failure response
+        self._refresh_lock = threading.Lock()
         self._thread = None
         self._want_thread = bool(start_thread)
 
     def _ensure_thread(self) -> None:
-        import threading
-
         if not self._want_thread or self._thread is not None:
             return
         with self._lock:
@@ -206,10 +208,11 @@ class BackgroundAdvisor:
                 self._thread.start()
 
     def _refresh_once(self) -> None:
-        snap = self.inner.fetch()
-        with self._lock:
-            self._snap = snap
-            self._ts = self._clock()
+        with self._refresh_lock:
+            snap = self.inner.fetch()
+            with self._lock:
+                self._snap = snap
+                self._ts = self._clock()
 
     def _run(self) -> None:
         while not self._stop.is_set():
@@ -231,10 +234,21 @@ class BackgroundAdvisor:
                 self.stale_served += 1
             return snap
         # no usable snapshot (startup, or the refresher has been failing
-        # past the budget): one synchronous attempt, errors propagating
-        self._refresh_once()
-        with self._lock:
-            return self._snap
+        # past the budget): one synchronous attempt, errors propagating.
+        # Serialized with the refresh thread — and re-checked after
+        # taking the scrape lock, because the scrape we were about to
+        # duplicate may have just landed
+        with self._refresh_lock:
+            now = self._clock()
+            with self._lock:
+                snap, ts = self._snap, self._ts
+            if snap is not None and now - ts <= self.max_staleness:
+                return snap
+            inner_snap = self.inner.fetch()
+            with self._lock:
+                self._snap = inner_snap
+                self._ts = self._clock()
+                return self._snap
 
     def close(self) -> None:
         self._stop.set()

@@ -457,3 +457,127 @@ def test_scheduler_falls_back_when_sidecar_down():
         client.close()
     assert m.used_fallback
     assert m.pods_bound == 3
+
+
+# ---- wire field cache (Tensor.same_as_last) -------------------------------
+
+
+def test_codec_field_cache_markers_and_resolution():
+    """Client packing with a cache replaces unchanged leaves with
+    same_as_last markers; server unpacking with a cache resolves them;
+    a changed leaf rides full and refreshes both sides."""
+    snap = gen_cluster(16, seed=0, constraints=True)
+    client_cache: dict = {}
+    server_cache: dict = {}
+    n1 = codec.pack_fields(snap, pb.NamedTensors(), cache=client_cache)
+    assert not any(t.same_as_last for t in n1.tensors.values())
+    back1 = codec.unpack_fields(engine.SnapshotArrays, n1, cache=server_cache)
+    # identical second cycle: every leaf is a marker
+    n2 = codec.pack_fields(snap, pb.NamedTensors(), cache=client_cache)
+    assert all(t.same_as_last for t in n2.tensors.values())
+    assert sum(len(t.data) for t in n2.tensors.values()) == 0
+    back2 = codec.unpack_fields(engine.SnapshotArrays, n2, cache=server_cache)
+    for name, a, b in zip(snap._fields, back1, back2):
+        np.testing.assert_array_equal(np.asarray(a), b, err_msg=name)
+    # one leaf changes: only it rides full
+    snap3 = snap._replace(disk_io=np.asarray(snap.disk_io) + 1.0)
+    n3 = codec.pack_fields(snap3, pb.NamedTensors(), cache=client_cache)
+    full = [k for k, t in n3.tensors.items() if not t.same_as_last]
+    assert full == ["disk_io"]
+    back3 = codec.unpack_fields(engine.SnapshotArrays, n3, cache=server_cache)
+    np.testing.assert_array_equal(
+        np.asarray(back3.disk_io), np.asarray(snap3.disk_io)
+    )
+
+
+def test_codec_field_cache_miss_raises():
+    snap = gen_cluster(8, seed=0)
+    client_cache: dict = {}
+    codec.pack_fields(snap, pb.NamedTensors(), cache=client_cache)
+    marked = codec.pack_fields(snap, pb.NamedTensors(), cache=client_cache)
+    assert any(t.same_as_last for t in marked.tensors.values())
+    with pytest.raises(codec.FieldCacheMiss):
+        codec.unpack_fields(engine.SnapshotArrays, marked, cache={})
+    with pytest.raises(codec.FieldCacheMiss):
+        codec.unpack_fields(engine.SnapshotArrays, marked, cache=None)
+
+
+def test_remote_field_cache_steady_state_and_restart_recovery():
+    """E2E: the second identical cycle rides markers (client cache
+    populated, decisions unchanged); killing the sidecar and starting a
+    fresh one on the same port forces a field-cache miss, which the
+    client recovers from by resending in full — one warning, no error."""
+    snap = gen_cluster(16, seed=0)
+    pods = gen_pods(8, seed=1)
+    server, port, _ = make_server("127.0.0.1:0")
+    server.start()
+    client = RemoteEngine(f"127.0.0.1:{port}", deadline_seconds=60.0)
+    try:
+        r1 = client.schedule_batch(snap, pods, assigner="greedy")
+        assert client._field_cache_ok is True
+        assert client._wire_cache["batch:snapshot"]  # populated
+        r2 = client.schedule_batch(snap, pods, assigner="greedy")
+        np.testing.assert_array_equal(
+            np.asarray(r1.node_idx), np.asarray(r2.node_idx)
+        )
+        # sidecar restart: same port, empty session store
+        server.stop(grace=None)
+        server2, _, _ = make_server(f"127.0.0.1:{port}")
+        server2.start()
+        try:
+            r3 = client.schedule_batch(snap, pods, assigner="greedy")
+            np.testing.assert_array_equal(
+                np.asarray(r1.node_idx), np.asarray(r3.node_idx)
+            )
+        finally:
+            server2.stop(grace=None)
+            server = None
+    finally:
+        client.close()
+        if server is not None:
+            server.stop(grace=None)
+
+
+def test_remote_field_cache_disabled_for_old_sidecar():
+    """A sidecar that does not advertise the capability must never see
+    markers or a session id — simulated by pinning the probe result."""
+    snap = gen_cluster(8, seed=0)
+    pods = gen_pods(4, seed=1)
+    server, port, _ = make_server("127.0.0.1:0")
+    server.start()
+    client = RemoteEngine(f"127.0.0.1:{port}", deadline_seconds=60.0)
+    try:
+        client._field_cache_ok = False  # what an old HealthReply yields
+        client.schedule_batch(snap, pods, assigner="greedy")
+        client.schedule_batch(snap, pods, assigner="greedy")
+        assert client._wire_cache == {}  # never engaged
+    finally:
+        client.close()
+        server.stop(grace=None)
+
+
+def test_remote_field_cache_cleared_on_failed_send():
+    """A send that never reaches the sidecar must clear the client-side
+    cache: packing commits values optimistically, and a desynced cache
+    would resolve later markers to stale server tensors (silent wrong
+    snapshot — the round-5 review's top finding)."""
+    snap = gen_cluster(8, seed=0)
+    pods = gen_pods(4, seed=1)
+    server, port, _ = make_server("127.0.0.1:0")
+    server.start()
+    client = RemoteEngine(
+        f"127.0.0.1:{port}", deadline_seconds=10.0, retries=0
+    )
+    try:
+        client.schedule_batch(snap, pods, assigner="greedy")
+        assert client._wire_cache["batch:snapshot"]
+        server.stop(grace=None)
+        server = None
+        snap2 = snap._replace(disk_io=np.asarray(snap.disk_io) + 1.0)
+        with pytest.raises(EngineUnavailable):
+            client.schedule_batch(snap2, pods, assigner="greedy")
+        assert client._wire_cache == {}  # desync impossible: wiped
+    finally:
+        client.close()
+        if server is not None:
+            server.stop(grace=None)

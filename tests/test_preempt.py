@@ -534,7 +534,15 @@ def test_host_nominated_capacity_not_stolen_by_lower_priority_arrival():
     low.node_name = "n0"
     running = [low]
     ev = RecordingEvictor()
-    s = _sched(nodes, utils, running, evictor=ev)
+    # backoff far above any cold-compile time: cycle 1 jit-compiles the
+    # preemption program (~seconds solo, warm in full-suite runs), and
+    # the default 1s backoff could expire DURING it, popping the
+    # preemptor alongside sneaky in cycle 2 and flipping the verdict
+    # with JAX cache temperature
+    s = _sched(
+        nodes, utils, running, evictor=ev,
+        initial_backoff_seconds=3600.0, max_backoff_seconds=3600.0,
+    )
     s.submit(make_pod("urgent", cpu=800, labels={"scv/priority": "9"}))
     assert s.run_cycle().pods_preempted == 1
 

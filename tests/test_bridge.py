@@ -581,3 +581,80 @@ def test_remote_field_cache_cleared_on_failed_send():
         client.close()
         if server is not None:
             server.stop(grace=None)
+
+
+def test_remote_field_cache_constraint_sweep_matches_local():
+    """Capstone for the wire cache: three consecutive cycles of the
+    property generator's full constraint surface (taints, OR-affinity,
+    namespace-scoped (anti)affinity, spread) through a LIVE sidecar with
+    the field cache engaged — decisions must be identical to the
+    in-process engine even when most leaves ride as markers and the
+    running set (hence domain counts and `requested`) shifts between
+    cycles."""
+    import dataclasses
+
+    from kubernetes_scheduler_tpu.host.snapshot import SnapshotBuilder
+    from tests.test_property_families import gen_pod, gen_scenario
+
+    rng = np.random.default_rng(7)
+    nodes, spread_groups, running, utils = gen_scenario(rng, 12, 3)
+    pods_per_cycle = [
+        [gen_pod(rng, 100 * c + i, spread_groups) for i in range(6)]
+        for c in range(3)
+    ]
+    server, port, _ = make_server("127.0.0.1:0")
+    server.start()
+    client = RemoteEngine(f"127.0.0.1:{port}", deadline_seconds=120.0)
+    b_remote, b_local = SnapshotBuilder(), SnapshotBuilder()
+    run_remote, run_local = list(running), list(running)
+    marker_counts = []
+    orig_send = client._schedule
+
+    def counting_send(req, timeout=None):
+        marker_counts.append(sum(
+            t.same_as_last for t in req.snapshot.tensors.values()
+        ))
+        return orig_send(req, timeout=timeout)
+
+    client._schedule = counting_send
+    try:
+        for cyc, pods in enumerate(pods_per_cycle):
+            pods_l = [dataclasses.replace(p) for p in pods]
+            sr = b_remote.build_snapshot(
+                nodes, utils, run_remote, pending_pods=pods
+            )
+            pr = b_remote.build_pod_batch(pods)
+            rr = client.schedule_batch(
+                sr, pr, assigner="auction", normalizer="none",
+                affinity_aware=True, soft=True,
+            )
+            sl = b_local.build_snapshot(
+                nodes, utils, run_local, pending_pods=pods_l
+            )
+            pl = b_local.build_pod_batch(pods_l)
+            rl = engine.schedule_batch(
+                sl, pl, assigner="auction", normalizer="none",
+                affinity_aware=True, soft=True,
+            )
+            np.testing.assert_array_equal(
+                np.asarray(rr.node_idx), np.asarray(rl.node_idx),
+                err_msg=f"cycle {cyc}",
+            )
+            for pod, pod_l, j in zip(
+                pods, pods_l, np.asarray(rl.node_idx)[: len(pods)]
+            ):
+                if 0 <= j < len(nodes):
+                    run_remote.append(
+                        dataclasses.replace(pod, node_name=nodes[int(j)].name)
+                    )
+                    run_local.append(
+                        dataclasses.replace(pod_l, node_name=nodes[int(j)].name)
+                    )
+        # the cache really engaged: cycle 1 all-full, cycles 2-3 rode
+        # markers for the unchanged snapshot leaves
+        assert client._field_cache_ok is True
+        assert marker_counts[0] == 0
+        assert marker_counts[1] > 0 and marker_counts[2] > 0
+    finally:
+        client.close()
+        server.stop(grace=None)

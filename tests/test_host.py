@@ -1133,3 +1133,44 @@ def test_stale_served_exported_on_metrics_endpoint():
     text = render_prometheus([], None, {"advisor_stale_served_total": 3})
     assert "advisor_stale_served_total 3" in text
     assert "# TYPE yoda_tpu_advisor_stale_served_total counter" in text
+
+
+def test_scheduler_rides_stale_advisor_through_brownout_then_requeues():
+    """End-to-end degradation contract: with the background advisor's
+    scraper failing, cycles keep scheduling on the last snapshot inside
+    the staleness budget (stale_served ticks); past the budget the
+    synchronous fallback's failure surfaces as the cycle's fetch-failure
+    path — window requeued, fetch_failures counted, nothing bound."""
+    from kubernetes_scheduler_tpu.host.advisor import BackgroundAdvisor
+
+    nodes = [make_node("n0"), make_node("n1")]
+    inner = _CountingAdvisor()
+    clock = [0.0]
+    adv = BackgroundAdvisor(
+        inner, interval=5.0, max_staleness=60.0,
+        clock=lambda: clock[0], start_thread=False,
+    )
+    adv._refresh_once()  # healthy scrape at t=0
+    s = Scheduler(
+        SchedulerConfig(batch_window=8, min_device_work=0,
+                        adaptive_dispatch=False),
+        advisor=adv,
+        list_nodes=lambda: nodes,
+        list_running_pods=lambda: [],
+    )
+    inner.fail = True  # Prometheus goes down right after the scrape
+    clock[0] = 30.0    # inside the budget: stale snapshot serves
+    s.submit(make_pod("a", cpu=100, annotations={"diskIO": "2"}))
+    m1 = s.run_cycle()
+    assert m1.pods_bound == 1 and not m1.fetch_failed
+    assert adv.stale_served >= 1
+    clock[0] = 120.0   # past the budget: outage surfaces
+    s.submit(make_pod("b", cpu=100, annotations={"diskIO": "2"}))
+    m2 = s.run_cycle()
+    assert m2.fetch_failed and m2.pods_bound == 0
+    assert m2.pods_unschedulable == 1  # window requeued with backoff
+    # recovery: scraper heals, the requeued pod binds next eligible cycle
+    inner.fail = False
+    s.queue._clock = lambda: 1e9  # expire the backoff
+    m3 = s.run_cycle()
+    assert m3.pods_bound == 1 and not m3.fetch_failed

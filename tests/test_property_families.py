@@ -113,13 +113,21 @@ def gen_pod(rng, i, spread_groups=None):
     return Pod(**kw)
 
 
+def gen_utils(rng, nodes):
+    """Random advisor utilization block, shared by every sweep so the
+    families exercise one input distribution."""
+    from kubernetes_scheduler_tpu.host.advisor import NodeUtil
+
+    return {nd.name: NodeUtil(cpu_pct=float(rng.uniform(0, 80)),
+                              disk_io=float(rng.uniform(0, 40)))
+            for nd in nodes}
+
+
 def gen_scenario(rng, n, n_running):
     """Shared fixture recipe: cluster, spread-group membership, pending
     pod factory inputs, placed running pods, and advisor utils — one
     definition so the capstone sweep and the windows-carry sweep cannot
     diverge in what they exercise."""
-    from kubernetes_scheduler_tpu.host.advisor import NodeUtil
-
     nodes = gen_cluster(rng, n)
     spread_groups = {
         (ns, app)
@@ -132,10 +140,7 @@ def gen_scenario(rng, n, n_running):
         rp = gen_pod(rng, 100 + i, spread_groups)
         rp.node_name = nodes[int(rng.integers(0, n))].name
         running.append(rp)
-    utils = {nd.name: NodeUtil(cpu_pct=float(rng.uniform(0, 80)),
-                               disk_io=float(rng.uniform(0, 40)))
-             for nd in nodes}
-    return nodes, spread_groups, running, utils
+    return nodes, spread_groups, running, gen_utils(rng, nodes)
 
 
 def zone_of(node):
@@ -298,8 +303,6 @@ def test_incremental_builder_churn_sweep_matches_fresh(seed):
     Pins the identity-keyed caches (_node_static, _acc_cache,
     _ports_prefix, _dc_prefix, per-pod byte records) through every
     invalidation path at once."""
-    from kubernetes_scheduler_tpu.host.advisor import NodeUtil
-
     rng = np.random.default_rng(3000 + seed)
     nodes = gen_cluster(rng, 10)
     spread_groups = {("default", "web"), ("prod", "db")}
@@ -314,11 +317,6 @@ def test_incremental_builder_churn_sweep_matches_fresh(seed):
         # keeps the zone set diverse instead of drifting toward za
         nd.labels["topology.kubernetes.io/zone"] = rng.choice(ZONES)
         return nd
-
-    def utils_for(nds):
-        return {nd.name: NodeUtil(cpu_pct=float(rng.uniform(0, 80)),
-                                  disk_io=float(rng.uniform(0, 40)))
-                for nd in nds}
 
     for cycle in range(12):
         # node churn: add / remove / replace-with-modified-object
@@ -338,7 +336,7 @@ def test_incremental_builder_churn_sweep_matches_fresh(seed):
             running = list(running)
         pods = [gen_pod(rng, 1000 * cycle + i, spread_groups)
                 for i in range(6)]
-        utils = utils_for(nodes)
+        utils = gen_utils(rng, nodes)
         s_inc = inc.build_snapshot(nodes, utils, running, pending_pods=pods)
         b_inc = inc.build_pod_batch(pods)
         fresh = SnapshotBuilder()

@@ -5,13 +5,20 @@ PY ?= python
 IMAGE_REPO ?= registry.example.com/yoda-tpu
 TAG ?= latest
 
-.PHONY: local test test-fast bench native proto clean build push
+.PHONY: local test test-fast bench lint native native-asan native-tsan proto clean build push
 
 # "make local" in the reference = fmt + vet + compile. Here: byte-compile
-# the package, build the native library, run the fast tests.
-local: native
+# the package, build the native library, lint, run the fast tests.
+local: native lint
 	$(PY) -m compileall -q kubernetes_scheduler_tpu bench.py __graft_entry__.py
 	$(PY) -m pytest tests/ -x -q -m "not slow"
+
+# repo-native static analysis (kubernetes_scheduler_tpu/analysis):
+# jit-purity, host-sync, lock-discipline, wire-schema, dtype-shape,
+# timeout-hygiene. Exits non-zero on any unwaived violation; see the
+# README's "Static analysis" section for the inline-waiver syntax.
+lint:
+	$(PY) -m kubernetes_scheduler_tpu.analysis
 
 # the full suite (sharding parity sweeps, e2e loops, learned-model
 # training included) — run before committing a milestone. xdist cuts the
@@ -36,6 +43,16 @@ bench:
 native:
 	$(MAKE) -C native
 
+# sanitized native builds (ASan+UBSan / TSan) for the host loop;
+# tests/test_native_sanitized.py drives the full native test surface
+# against the ASan library (also: make test SANITIZED=... not needed —
+# the slow suite includes it)
+native-asan:
+	$(MAKE) -C native asan
+
+native-tsan:
+	$(MAKE) -C native tsan
+
 # regenerate the gRPC schema (bridge/schedule.proto -> schedule_pb2.py)
 proto:
 	protoc --python_out=kubernetes_scheduler_tpu/bridge \
@@ -50,5 +67,5 @@ push: build
 	docker push $(IMAGE_REPO)/sidecar:$(TAG)
 
 clean:
-	rm -rf native/build
+	$(MAKE) -C native clean
 	find . -name __pycache__ -type d -exec rm -rf {} +

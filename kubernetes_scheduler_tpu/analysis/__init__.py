@@ -1,0 +1,29 @@
+"""graftlint: repo-native static analysis.
+
+The scheduler's correctness rests on invariants no test can check
+exhaustively — pure jitted scoring kernels, lock-guarded shared caches
+between the advisor/queue/bridge threads, a stable wire schema between
+host and sidecar. This package machine-enforces them as AST-level lint
+rules over the repo's own source:
+
+  jit-purity       no side effects reachable from jax.jit entry points
+  host-sync        no device barriers / per-element syncs in the cycle path
+  lock-discipline  attrs mutated under a class's lock stay under it
+  wire-schema      schedule_pb2 field usage must exist in schedule.proto
+  dtype-shape      no float64 promotion / traced-bool branching in kernels
+  timeout-hygiene  external calls (HTTP, subprocess, waits) carry timeouts
+
+Run:  python -m kubernetes_scheduler_tpu.analysis   (or `make lint`)
+
+A genuine-but-intended site is waived inline with a justification:
+
+  x = a.item()  # graftlint: disable=host-sync -- host numpy by contract
+
+A waiver without the `-- reason` clause is itself a violation.
+"""
+
+from kubernetes_scheduler_tpu.analysis.core import (  # noqa: F401
+    Context,
+    Violation,
+    run_lint,
+)

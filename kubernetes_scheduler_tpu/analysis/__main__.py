@@ -1,0 +1,69 @@
+"""graftlint CLI: `python -m kubernetes_scheduler_tpu.analysis`.
+
+Exits non-zero on any unwaived violation; `make lint` wires this into
+the build. Waived sites are listed (with their justifications) under
+--verbose so the allow-list stays reviewable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from kubernetes_scheduler_tpu.analysis.core import run_lint
+from kubernetes_scheduler_tpu.analysis.rules import RULES
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m kubernetes_scheduler_tpu.analysis",
+        description="repo-native static analysis (graftlint)",
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files to lint (default: the whole package)",
+    )
+    parser.add_argument(
+        "--rules",
+        help=f"comma-separated rule subset of: {', '.join(sorted(RULES))}",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text",
+    )
+    parser.add_argument(
+        "--verbose", action="store_true",
+        help="also list waived violations with their justifications",
+    )
+    args = parser.parse_args(argv)
+
+    rules = (
+        [r.strip() for r in args.rules.split(",") if r.strip()]
+        if args.rules
+        else None
+    )
+    try:
+        violations = run_lint(args.paths or None, rules=rules)
+    except ValueError as e:
+        parser.error(str(e))
+    active = [v for v in violations if not v.waived]
+    waived = [v for v in violations if v.waived]
+
+    if args.format == "json":
+        print(json.dumps([v.__dict__ for v in violations], indent=2))
+    else:
+        for v in active:
+            print(v.format())
+        if args.verbose:
+            for v in waived:
+                print(v.format())
+        print(
+            f"graftlint: {len(active)} violation(s), "
+            f"{len(waived)} waived",
+            file=sys.stderr,
+        )
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

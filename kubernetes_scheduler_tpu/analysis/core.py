@@ -1,0 +1,206 @@
+"""graftlint core: violations, inline waivers, file collection, runner.
+
+Rules are pure functions `check(ctx) -> list[Violation]` registered in
+rules/__init__.py. The runner parses every in-scope file once; rules pick
+their own file subsets (kernel dirs, host cycle path, bridge) unless the
+caller passed explicit paths (fixture mode), in which case every given
+file is in scope for every requested rule.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import os
+import re
+from dataclasses import dataclass, field
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_PKG_DIR = os.path.join(_REPO_ROOT, "kubernetes_scheduler_tpu")
+
+# generated / vendored files never linted
+_EXCLUDE = ("*_pb2.py",)
+
+# graftlint: disable=<rule>[,<rule>|all] -- <justification>
+_WAIVER_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([\w,\-]+)(?:\s+--\s*(\S.*))?"
+)
+
+
+@dataclass
+class Violation:
+    rule: str
+    path: str          # repo-relative
+    line: int
+    message: str
+    waived: bool = False
+    waiver_reason: str | None = None
+
+    def format(self) -> str:
+        tag = " (waived: %s)" % self.waiver_reason if self.waived else ""
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}{tag}"
+
+
+@dataclass
+class SourceFile:
+    path: str          # repo-relative, forward slashes
+    abspath: str
+    source: str
+    tree: ast.AST
+    lines: list[str] = field(default_factory=list)
+    # line -> (set of rule names | {"all"}, reason | None)
+    waivers: dict[int, tuple[set, str | None]] = field(default_factory=dict)
+
+    def matches(self, patterns) -> bool:
+        return any(fnmatch.fnmatch(self.path, p) for p in patterns)
+
+
+@dataclass
+class Context:
+    root: str
+    files: list[SourceFile]
+    # explicit file list given (fixture mode): rules scan everything
+    explicit: bool = False
+    # proto override for the wire-schema rule (tests)
+    proto_path: str | None = None
+
+    def scoped(self, patterns) -> list[SourceFile]:
+        if self.explicit:
+            return self.files
+        return [f for f in self.files if f.matches(patterns)]
+
+
+def _parse_waivers(sf: SourceFile) -> list[Violation]:
+    """Populate sf.waivers; a waiver with no justification is itself a
+    violation (`bad-waiver`, unwaivable)."""
+    bad = []
+    for i, line in enumerate(sf.lines, start=1):
+        m = _WAIVER_RE.search(line)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        reason = m.group(2)
+        if not reason:
+            bad.append(
+                Violation(
+                    "bad-waiver", sf.path, i,
+                    "waiver missing justification: write "
+                    "`# graftlint: disable=<rule> -- <why this is safe>`",
+                )
+            )
+            continue
+        target = i
+        # a comment-only line waives the NEXT line
+        if line.split("#", 1)[0].strip() == "":
+            target = i + 1
+        entry = sf.waivers.setdefault(target, (set(), reason.strip()))
+        entry[0].update(rules)
+    return bad
+
+
+def load_file(abspath: str, root: str) -> SourceFile | None:
+    with open(abspath, encoding="utf-8") as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=abspath)
+    except SyntaxError:
+        return None
+    rel = os.path.relpath(abspath, root).replace(os.sep, "/")
+    return SourceFile(
+        path=rel, abspath=abspath, source=source, tree=tree,
+        lines=source.splitlines(),
+    )
+
+
+def collect_files(root: str | None = None) -> list[str]:
+    """Every lintable .py file in the package (the linter's own code
+    included — it must hold itself to the repo's invariants)."""
+    root = root or _REPO_ROOT
+    out = []
+    for dirpath, dirnames, filenames in os.walk(
+        os.path.join(root, "kubernetes_scheduler_tpu")
+    ):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            if any(fnmatch.fnmatch(name, p) for p in _EXCLUDE):
+                continue
+            out.append(os.path.join(dirpath, name))
+    return out
+
+
+def run_lint(
+    paths: list[str] | None = None,
+    *,
+    rules: list[str] | None = None,
+    root: str | None = None,
+    proto_path: str | None = None,
+) -> list[Violation]:
+    """Lint `paths` (default: the whole package) with `rules` (default:
+    all). Returns every violation, waived ones flagged."""
+    from kubernetes_scheduler_tpu.analysis.rules import RULES
+
+    root = root or _REPO_ROOT
+    explicit = paths is not None
+    abspaths = (
+        [os.path.abspath(p) for p in paths]
+        if explicit
+        else collect_files(root)
+    )
+    files = []
+    violations: list[Violation] = []
+    for p in abspaths:
+        sf = load_file(p, root)
+        if sf is None:
+            violations.append(
+                Violation(
+                    "parse", os.path.relpath(p, root).replace(os.sep, "/"),
+                    1, "file does not parse",
+                )
+            )
+            continue
+        violations.extend(_parse_waivers(sf))
+        files.append(sf)
+    ctx = Context(
+        root=root, files=files, explicit=explicit, proto_path=proto_path
+    )
+    selected = rules or list(RULES)
+    unknown = set(selected) - set(RULES)
+    if unknown:
+        raise ValueError(f"unknown lint rules: {sorted(unknown)}")
+    for name in selected:
+        violations.extend(RULES[name](ctx))
+    # apply waivers
+    by_path = {f.path: f for f in files}
+    for v in violations:
+        sf = by_path.get(v.path)
+        if sf is None or v.rule == "bad-waiver":
+            continue
+        w = sf.waivers.get(v.line)
+        if w and (v.rule in w[0] or "all" in w[0]):
+            v.waived = True
+            v.waiver_reason = w[1]
+    violations.sort(key=lambda v: (v.path, v.line, v.rule))
+    return violations
+
+
+# ---- shared AST helpers ---------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def has_kwarg(call: ast.Call, name: str) -> bool:
+    return any(kw.arg == name for kw in call.keywords)

@@ -1,0 +1,19 @@
+"""Rule registry: name -> check(ctx) -> list[Violation]."""
+
+from kubernetes_scheduler_tpu.analysis.rules import (
+    dtype_shape,
+    host_sync,
+    jit_purity,
+    lock_discipline,
+    timeout_hygiene,
+    wire_schema,
+)
+
+RULES = {
+    jit_purity.RULE: jit_purity.check,
+    host_sync.RULE: host_sync.check,
+    lock_discipline.RULE: lock_discipline.check,
+    wire_schema.RULE: wire_schema.check,
+    dtype_shape.RULE: dtype_shape.check,
+    timeout_hygiene.RULE: timeout_hygiene.check,
+}

@@ -1,0 +1,102 @@
+"""Shared jit call-graph: which functions are reachable from jax.jit /
+pjit entry points.
+
+Resolution is name-based and conservative: every FunctionDef (nested
+included) across the given files is indexed by bare name; a call or a
+bare function reference (e.g. `lax.scan(step, ...)`) to a known name
+marks every same-named def reachable. Over-approximation flags at worst
+an extra site — the waiver syntax absorbs those — while attribute calls
+on `self.` are skipped so host-object plumbing never leaks in.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kubernetes_scheduler_tpu.analysis.core import SourceFile, dotted_name
+
+_JIT_MAKERS = {"jit", "jax.jit", "pjit", "jax.experimental.pjit.pjit"}
+
+
+def _decorator_is_jit(dec: ast.AST) -> bool:
+    name = dotted_name(dec)
+    if name in _JIT_MAKERS:
+        return True
+    if isinstance(dec, ast.Call):
+        fname = dotted_name(dec.func)
+        if fname in _JIT_MAKERS:
+            return True
+        # functools.partial(jax.jit, ...) / partial(jit, ...)
+        if fname in ("functools.partial", "partial") and dec.args:
+            return dotted_name(dec.args[0]) in _JIT_MAKERS
+    return False
+
+
+def _collect_defs(files: list[SourceFile]):
+    """name -> [(SourceFile, FunctionDef)] over every def, nested included."""
+    defs: dict[str, list] = {}
+    for sf in files:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, []).append((sf, node))
+    return defs
+
+
+def _entry_names(files: list[SourceFile]) -> set[str]:
+    entries: set[str] = set()
+    for sf in files:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(_decorator_is_jit(d) for d in node.decorator_list):
+                    entries.add(node.name)
+            elif isinstance(node, ast.Call):
+                # jax.jit(fn, ...) applied as an expression
+                if dotted_name(node.func) in _JIT_MAKERS:
+                    for arg in node.args[:1]:
+                        name = dotted_name(arg)
+                        if name:
+                            entries.add(name.rsplit(".", 1)[-1])
+    return entries
+
+
+def _referenced_names(fn: ast.AST) -> set[str]:
+    """Names this function may transfer control to: called names and
+    bare function references passed as call arguments (scan/vmap/cond
+    bodies). `self.x(...)` attribute chains are skipped — bound host
+    objects are not kernel code."""
+    out: set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        cname = dotted_name(node.func)
+        if cname and not cname.startswith("self."):
+            out.add(cname.rsplit(".", 1)[-1])
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            aname = dotted_name(arg)
+            if aname and not aname.startswith("self."):
+                out.add(aname.rsplit(".", 1)[-1])
+    return out
+
+
+def jit_reachable(files: list[SourceFile]):
+    """[(SourceFile, FunctionDef)] reachable from any jit entry point in
+    `files`, the entry defs included."""
+    defs = _collect_defs(files)
+    seen_ids: set[int] = set()
+    out = []
+    queue = sorted(_entry_names(files))
+    visited_names: set[str] = set()
+    while queue:
+        name = queue.pop()
+        if name in visited_names:
+            continue
+        visited_names.add(name)
+        for sf, fn in defs.get(name, ()):
+            if id(fn) in seen_ids:
+                continue
+            seen_ids.add(id(fn))
+            out.append((sf, fn))
+            for ref in _referenced_names(fn):
+                if ref in defs and ref not in visited_names:
+                    queue.append(ref)
+    return out

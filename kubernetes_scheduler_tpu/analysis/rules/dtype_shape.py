@@ -1,0 +1,126 @@
+"""dtype-shape: no float64 promotion or traced-bool branching in kernels.
+
+The engine is a float32 machine end to end (the codec's allowed dtypes,
+the Pallas tiles, the wire contract): one float64 leaf silently doubles
+transfer volume and, under jax's default x64-disabled config, produces
+weights that differ between host and device paths. And a Python `if` on
+a traced predicate (`.any()` / `.all()` / `.item()` / `bool(...)`) is a
+TracerBoolConversionError at best, a trace-time-frozen branch at worst.
+
+Flagged in the kernel dirs:
+
+- dtype arguments / astype targets that resolve to float64 (`float`,
+  `np.float64`, `jnp.float64`, `"float64"`, `"double"`);
+- `if`/`while` tests inside jit-reachable functions that call
+  `.any()` / `.all()` / `.item()` / `bool(...)` on traced values.
+
+Static-shape branching (`if x.shape[0] < n:`) is idiomatic JAX and
+deliberately NOT flagged — shapes are Python ints at trace time.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kubernetes_scheduler_tpu.analysis.core import (
+    Context,
+    Violation,
+    dotted_name,
+)
+from kubernetes_scheduler_tpu.analysis.rules._jitgraph import jit_reachable
+
+RULE = "dtype-shape"
+
+SCOPE = (
+    "kubernetes_scheduler_tpu/engine.py",
+    "kubernetes_scheduler_tpu/ops/*.py",
+    "kubernetes_scheduler_tpu/parallel/*.py",
+    "kubernetes_scheduler_tpu/models/*.py",
+)
+
+_F64_NAMES = {
+    "float", "np.float64", "numpy.float64", "jnp.float64", "np.double",
+    "numpy.double", "jnp.double",
+}
+_F64_STRINGS = {"float64", "double", "f8", "<f8"}
+
+
+def _is_f64(node: ast.AST) -> bool:
+    name = dotted_name(node)
+    if name in _F64_NAMES:
+        return True
+    return (
+        isinstance(node, ast.Constant)
+        and isinstance(node.value, str)
+        and node.value in _F64_STRINGS
+    )
+
+
+def _check_f64(sf, tree, out: list[Violation]) -> None:
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        attr = fn.attr if isinstance(fn, ast.Attribute) else None
+        if attr == "astype" and node.args and _is_f64(node.args[0]):
+            out.append(
+                Violation(
+                    RULE, sf.path, node.lineno,
+                    "astype to float64 in kernel code (the engine is "
+                    "float32 end to end)",
+                )
+            )
+        for kw in node.keywords:
+            if kw.arg == "dtype" and _is_f64(kw.value):
+                out.append(
+                    Violation(
+                        RULE, sf.path, kw.value.lineno,
+                        "float64 dtype argument in kernel code (the "
+                        "engine is float32 end to end)",
+                    )
+                )
+
+
+_TRACED_BOOL_ATTRS = {"any", "all", "item"}
+
+
+def _traced_bool_call(test: ast.AST) -> ast.Call | None:
+    for node in ast.walk(test):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if (
+            isinstance(fn, ast.Attribute)
+            and fn.attr in _TRACED_BOOL_ATTRS
+        ):
+            return node
+        if isinstance(fn, ast.Name) and fn.id == "bool":
+            return node
+    return None
+
+
+def _check_branching(sf, fn, out: list[Violation]) -> None:
+    for node in ast.walk(fn):
+        if not isinstance(node, (ast.If, ast.While, ast.IfExp)):
+            continue
+        bad = _traced_bool_call(node.test)
+        if bad is not None:
+            what = dotted_name(bad.func) or "bool"
+            out.append(
+                Violation(
+                    RULE, sf.path, node.test.lineno,
+                    f"Python branch on `{what}(...)` inside jit-reachable "
+                    f"`{fn.name}` — a traced predicate cannot drive host "
+                    "control flow (use jnp.where / lax.cond)",
+                )
+            )
+
+
+def check(ctx: Context) -> list[Violation]:
+    out: list[Violation] = []
+    files = ctx.scoped(SCOPE)
+    for sf in files:
+        _check_f64(sf, sf.tree, out)
+    for sf, fn in jit_reachable(files):
+        _check_branching(sf, fn, out)
+    return out

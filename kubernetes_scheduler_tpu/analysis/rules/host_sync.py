@@ -1,0 +1,113 @@
+"""host-sync: no device barriers or per-element syncs in the cycle path.
+
+The scheduling cycle's contract is ONE bulk host<->device sync per
+dispatch (`np.asarray` on the whole result). Flagged in the cycle-path
+files:
+
+- `jax.block_until_ready(...)` / `.block_until_ready()` anywhere — a
+  full device barrier has no place in the serving path (benchmarks waive
+  it with a justification);
+- `.item()` inside a loop/comprehension — on a device array this is one
+  blocking transfer per element;
+- `np.asarray(...)` / `jax.device_get(...)` inside a loop/comprehension
+  — hoist one bulk conversion out of the loop instead.
+
+Sites operating on host numpy by construction are waived inline — the
+per-site triage IS the allow-list, kept next to the code it blesses.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kubernetes_scheduler_tpu.analysis.core import (
+    Context,
+    Violation,
+    dotted_name,
+)
+
+RULE = "host-sync"
+
+SCOPE = (
+    "kubernetes_scheduler_tpu/engine.py",
+    "kubernetes_scheduler_tpu/host/scheduler.py",
+    "kubernetes_scheduler_tpu/host/queue.py",
+    "kubernetes_scheduler_tpu/host/observe.py",
+    "kubernetes_scheduler_tpu/bridge/client.py",
+    "kubernetes_scheduler_tpu/bridge/server.py",
+)
+
+_LOOPY_SYNCS = {"np.asarray", "numpy.asarray", "jax.device_get"}
+
+
+def _iter_children_with_loop(node: ast.AST, in_loop: bool):
+    """(child, in_loop) pairs. A loop's per-iteration parts (body, each
+    element expression) count as in-loop; its once-evaluated parts do
+    not — `for x in np.asarray(xs):` IS the recommended bulk hoist, and
+    a comprehension's FIRST source iterable likewise runs exactly once."""
+    if isinstance(node, (ast.For, ast.AsyncFor)):
+        yield node.target, in_loop
+        yield node.iter, in_loop  # evaluated once, before iteration
+        for stmt in node.body + node.orelse:
+            yield stmt, True
+        return
+    if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                         ast.GeneratorExp)):
+        for i, gen in enumerate(node.generators):
+            # the first generator's source is evaluated once; nested
+            # generators' sources re-evaluate per outer iteration
+            yield gen.iter, in_loop if i == 0 else True
+            yield gen.target, True
+            for cond in gen.ifs:
+                yield cond, True
+        if isinstance(node, ast.DictComp):
+            yield node.key, True
+            yield node.value, True
+        else:
+            yield node.elt, True
+        return
+    for child in ast.iter_child_nodes(node):
+        yield child, in_loop or isinstance(child, ast.While)
+
+
+def _visit(node: ast.AST, in_loop: bool, sf, out: list[Violation]) -> None:
+    for child, child_in_loop in _iter_children_with_loop(node, in_loop):
+        if isinstance(child, ast.Call):
+            name = dotted_name(child.func)
+            attr = (
+                child.func.attr
+                if isinstance(child.func, ast.Attribute)
+                else None
+            )
+            if attr == "block_until_ready" or name == "jax.block_until_ready":
+                out.append(
+                    Violation(
+                        RULE, sf.path, child.lineno,
+                        "device barrier (block_until_ready) in the host "
+                        "cycle path",
+                    )
+                )
+            elif child_in_loop and attr == "item":
+                out.append(
+                    Violation(
+                        RULE, sf.path, child.lineno,
+                        ".item() inside a loop — one blocking device "
+                        "transfer per element; sync once in bulk outside",
+                    )
+                )
+            elif child_in_loop and name in _LOOPY_SYNCS:
+                out.append(
+                    Violation(
+                        RULE, sf.path, child.lineno,
+                        f"{name}() inside a loop — hoist one bulk "
+                        "conversion out of the loop",
+                    )
+                )
+        _visit(child, child_in_loop, sf, out)
+
+
+def check(ctx: Context) -> list[Violation]:
+    out: list[Violation] = []
+    for sf in ctx.scoped(SCOPE):
+        _visit(sf.tree, False, sf, out)
+    return out

@@ -1,0 +1,127 @@
+"""jit-purity: no side effects inside functions reachable from jax.jit.
+
+A jitted program traces once and replays as XLA: a `print` fires only at
+trace time (silently lying thereafter), host RNG freezes its first draw
+into the compiled artifact, and mutation of module state is a
+trace-order-dependent heisenbug. Flagged inside the jit-reachable set:
+
+- calls into host-side effect land: print/open/input/breakpoint,
+  time.*, logging, stdlib random.* and np.random.* (jax.random is fine —
+  it is functional);
+- `global` / `nonlocal` declarations;
+- assignments through an attribute/subscript whose base name is not a
+  local binding (module-state mutation at trace time).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kubernetes_scheduler_tpu.analysis.core import (
+    Context,
+    Violation,
+    dotted_name,
+)
+from kubernetes_scheduler_tpu.analysis.rules._jitgraph import jit_reachable
+
+RULE = "jit-purity"
+
+SCOPE = (
+    "kubernetes_scheduler_tpu/engine.py",
+    "kubernetes_scheduler_tpu/ops/*.py",
+    "kubernetes_scheduler_tpu/parallel/*.py",
+    "kubernetes_scheduler_tpu/models/*.py",
+)
+
+_BANNED_EXACT = {"print", "input", "open", "breakpoint", "exec", "eval"}
+_BANNED_PREFIX = (
+    "time.", "random.", "np.random.", "numpy.random.", "logging.",
+    "log.", "os.", "sys.stdout.", "sys.stderr.",
+)
+
+
+def _local_names(fn: ast.AST) -> set:
+    """Parameters + every name bound by assignment/for/with/comprehension
+    inside `fn` (nested defs excluded — they have their own scopes)."""
+    names: set[str] = set()
+    args = fn.args
+    for a in (
+        args.posonlyargs + args.args + args.kwonlyargs
+        + ([args.vararg] if args.vararg else [])
+        + ([args.kwarg] if args.kwarg else [])
+    ):
+        names.add(a.arg)
+
+    def walk(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                names.add(child.name)
+                continue  # separate scope
+            if isinstance(child, ast.Name) and isinstance(
+                child.ctx, ast.Store
+            ):
+                names.add(child.id)
+            walk(child)
+
+    walk(fn)
+    return names
+
+
+def _base_name(node: ast.AST) -> str | None:
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def check(ctx: Context) -> list[Violation]:
+    out: list[Violation] = []
+    files = ctx.scoped(SCOPE)
+    for sf, fn in jit_reachable(files):
+        local = _local_names(fn)
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Global, ast.Nonlocal)):
+                out.append(
+                    Violation(
+                        RULE, sf.path, node.lineno,
+                        f"`{type(node).__name__.lower()}` inside "
+                        f"jit-reachable `{fn.name}` mutates outer state "
+                        "at trace time",
+                    )
+                )
+            elif isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                if name is None:
+                    continue
+                if name in _BANNED_EXACT or any(
+                    name.startswith(p) for p in _BANNED_PREFIX
+                ):
+                    out.append(
+                        Violation(
+                            RULE, sf.path, node.lineno,
+                            f"side-effecting call `{name}(...)` inside "
+                            f"jit-reachable `{fn.name}` (fires at trace "
+                            "time only)",
+                        )
+                    )
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    if not isinstance(t, (ast.Attribute, ast.Subscript)):
+                        continue
+                    base = _base_name(t)
+                    if base is not None and base not in local:
+                        out.append(
+                            Violation(
+                                RULE, sf.path, node.lineno,
+                                f"jit-reachable `{fn.name}` assigns "
+                                f"through non-local `{base}` (module-state "
+                                "mutation at trace time)",
+                            )
+                        )
+    return out

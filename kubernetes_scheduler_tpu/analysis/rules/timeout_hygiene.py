@@ -1,0 +1,92 @@
+"""timeout-hygiene: external calls carry explicit timeout policies.
+
+The host loop's degradation story (ADVICE/SURVEY: advisor outage
+requeues the window, sidecar outage flips one cycle to scalar) only
+works if nothing in the cycle path can block forever. Flagged across the
+whole package:
+
+- `urllib.request.urlopen(...)` without a `timeout=`;
+- `subprocess.run/call/check_call/check_output/Popen.communicate(...)`
+  without a `timeout=`;
+- zero-argument `.wait()` — a threading.Event / grpc event wait with no
+  timeout blocks a thread unboundedly on a peer that may never signal
+  (`wait_for_termination` serve loops are intentionally unbounded and
+  not flagged);
+- zero-argument `.join()` on thread-like receivers (name contains
+  "thread") — joining a wedged worker hangs shutdown.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kubernetes_scheduler_tpu.analysis.core import (
+    Context,
+    Violation,
+    dotted_name,
+    has_kwarg,
+)
+
+RULE = "timeout-hygiene"
+
+SCOPE = ("kubernetes_scheduler_tpu/**/*.py", "kubernetes_scheduler_tpu/*.py")
+
+_SUBPROCESS = {
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output",
+}
+
+
+def check(ctx: Context) -> list[Violation]:
+    out: list[Violation] = []
+    for sf in ctx.scoped(SCOPE):
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func) or ""
+            attr = (
+                node.func.attr
+                if isinstance(node.func, ast.Attribute)
+                else None
+            )
+            if (
+                name in ("urllib.request.urlopen", "urlopen")
+                or name in _SUBPROCESS
+                or attr == "communicate"
+            ):
+                if not has_kwarg(node, "timeout"):
+                    out.append(
+                        Violation(
+                            RULE, sf.path, node.lineno,
+                            f"`{name or attr}(...)` without timeout= — an "
+                            "external call in a scheduler must bound its "
+                            "wait",
+                        )
+                    )
+            elif (
+                attr == "wait"
+                and not node.args
+                and not node.keywords
+            ):
+                out.append(
+                    Violation(
+                        RULE, sf.path, node.lineno,
+                        ".wait() with no timeout blocks a thread "
+                        "unboundedly on a peer that may never signal",
+                    )
+                )
+            elif (
+                attr == "join"
+                and not node.args
+                and not node.keywords
+            ):
+                recv = dotted_name(node.func.value) or ""
+                if "thread" in recv.lower():
+                    out.append(
+                        Violation(
+                            RULE, sf.path, node.lineno,
+                            f"`{recv}.join()` with no timeout — a wedged "
+                            "worker thread would hang shutdown",
+                        )
+                    )
+    return out

@@ -1,0 +1,209 @@
+"""wire-schema: schedule_pb2 field usage must exist in schedule.proto.
+
+The bridge's hand-written stubs mean no compiler checks that the Python
+side's field names still exist in the .proto — a renamed field would
+silently serialize nothing (proto3 default) instead of failing. This
+rule parses the .proto's message blocks and checks, in every file that
+imports a `*_pb2` module:
+
+- keyword arguments of `pb.<Message>(...)` constructors;
+- first-level attribute access on variables whose Message type is known
+  (parameter annotations `x: pb.Message` and direct `x = pb.Message(...)`
+  assignments).
+
+Protobuf runtime API names (CopyFrom, SerializeToString, ...) pass.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from kubernetes_scheduler_tpu.analysis.core import (
+    Context,
+    SourceFile,
+    Violation,
+    dotted_name,
+)
+
+RULE = "wire-schema"
+
+SCOPE = ("kubernetes_scheduler_tpu/bridge/*.py",)
+
+_DEFAULT_PROTO = os.path.join(
+    "kubernetes_scheduler_tpu", "bridge", "schedule.proto"
+)
+
+_PROTOBUF_API = {
+    "CopyFrom", "MergeFrom", "SerializeToString", "FromString",
+    "ParseFromString", "HasField", "ClearField", "WhichOneof",
+    "ByteSize", "IsInitialized", "DESCRIPTOR", "Clear",
+}
+
+_MSG_RE = re.compile(r"^\s*message\s+(\w+)\s*\{", re.M)
+_FIELD_RE = re.compile(
+    r"^\s*(?:repeated\s+|optional\s+)?"
+    r"(?:map\s*<[^>]+>|[\w.]+)\s+(\w+)\s*=\s*\d+\s*;",
+)
+
+
+def parse_proto(path: str) -> dict[str, set]:
+    """message name -> set of field names, by brace-tracking text scan
+    (enough for the proto3 subset this repo uses)."""
+    messages: dict[str, set] = {}
+    current = None
+    depth = 0
+    with open(path, encoding="utf-8") as f:
+        for raw in f:
+            line = raw.split("//", 1)[0]
+            m = _MSG_RE.match(line)
+            if m and depth == 0:
+                current = m.group(1)
+                messages[current] = set()
+                # count the rest of the line too: `message Empty {}`
+                # opens and closes in one line
+                depth = line.count("{") - line.count("}")
+                if depth <= 0:
+                    current = None
+                    depth = 0
+                continue
+            if current is not None:
+                if depth == 1:
+                    fm = _FIELD_RE.match(line)
+                    if fm:
+                        messages[current].add(fm.group(1))
+                depth += line.count("{") - line.count("}")
+                if depth <= 0:
+                    current = None
+                    depth = 0
+    return messages
+
+
+def _pb_aliases(tree: ast.AST) -> set:
+    """Local names bound to a *_pb2 module import."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.endswith("_pb2"):
+                    out.add(a.asname or a.name.split(".")[-1])
+        elif isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name.endswith("_pb2"):
+                    out.add(a.asname or a.name)
+    return out
+
+
+def _proto_for(ctx: Context, sf: SourceFile) -> str | None:
+    if ctx.proto_path:
+        return ctx.proto_path
+    sibling_dir = os.path.dirname(sf.abspath)
+    for name in sorted(os.listdir(sibling_dir)):
+        if name.endswith(".proto"):
+            return os.path.join(sibling_dir, name)
+    default = os.path.join(ctx.root, _DEFAULT_PROTO)
+    return default if os.path.exists(default) else None
+
+
+def _message_of(node: ast.AST, aliases: set) -> str | None:
+    """Message name when `node` is `pb.<Message>` / `pb.<Message>(...)`."""
+    if isinstance(node, ast.Call):
+        node = node.func
+    name = dotted_name(node)
+    if not name:
+        return None
+    parts = name.split(".")
+    if len(parts) == 2 and parts[0] in aliases:
+        return parts[1]
+    return None
+
+
+def check(ctx: Context) -> list[Violation]:
+    out: list[Violation] = []
+    for sf in ctx.scoped(SCOPE):
+        aliases = _pb_aliases(sf.tree)
+        if not aliases:
+            continue
+        proto = _proto_for(ctx, sf)
+        if proto is None:
+            out.append(
+                Violation(
+                    RULE, sf.path, 1,
+                    "imports a *_pb2 module but no .proto schema found "
+                    "to check against",
+                )
+            )
+            continue
+        messages = parse_proto(proto)
+
+        # pass 1: constructor kwargs anywhere in the file
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            msg = _message_of(node, aliases)
+            if msg is None:
+                continue
+            if msg not in messages:
+                out.append(
+                    Violation(
+                        RULE, sf.path, node.lineno,
+                        f"message `{msg}` does not exist in "
+                        f"{os.path.basename(proto)}",
+                    )
+                )
+                continue
+            for kw in node.keywords:
+                if kw.arg and kw.arg not in messages[msg]:
+                    out.append(
+                        Violation(
+                            RULE, sf.path, kw.value.lineno,
+                            f"`{msg}` has no field `{kw.arg}` in "
+                            f"{os.path.basename(proto)}",
+                        )
+                    )
+
+        # pass 2: attribute access on vars of known Message type,
+        # function by function
+        for fn in ast.walk(sf.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            var_types: dict[str, str] = {}
+            for a in fn.args.args + fn.args.kwonlyargs + fn.args.posonlyargs:
+                if a.annotation is not None:
+                    msg = _message_of(a.annotation, aliases)
+                    if msg:
+                        var_types[a.arg] = msg
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call
+                ):
+                    msg = _message_of(node.value, aliases)
+                    if msg:
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                var_types[t.id] = msg
+            if not var_types:
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Attribute):
+                    continue
+                if not (
+                    isinstance(node.value, ast.Name)
+                    and node.value.id in var_types
+                ):
+                    continue
+                msg = var_types[node.value.id]
+                fields = messages.get(msg)
+                if fields is None:
+                    continue
+                if node.attr in fields or node.attr in _PROTOBUF_API:
+                    continue
+                out.append(
+                    Violation(
+                        RULE, sf.path, node.lineno,
+                        f"`{node.value.id}.{node.attr}`: `{msg}` has no "
+                        f"field `{node.attr}` in {os.path.basename(proto)}",
+                    )
+                )
+    return out

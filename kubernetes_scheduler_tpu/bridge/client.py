@@ -111,9 +111,17 @@ class RemoteEngine:
         """Send with field-cache recovery: on FAILED_PRECONDITION
         "field-cache-miss" (sidecar restart / session eviction), clear
         the local cache and resend ONE full request. Any OTHER failure
-        also clears the cache: packing commits values the server may
+        also clears the cache — packing commits values the server may
         never have processed, and a desynced cache would silently
-        resolve later markers to stale server-side tensors."""
+        resolve later markers to stale server-side tensors — AND drops
+        the latched capability back to unknown: the sidecar behind this
+        target may have been replaced by an older build without
+        field_cache support (its INVALID_ARGUMENT on a marker-bearing
+        send would otherwise repeat forever), so the next call re-probes
+        health instead of trusting a dead sidecar's advertisement. A
+        resend that itself fails gets the same treatment — its
+        build_request() just repopulated the cache with values the
+        server never stored."""
         try:
             return self._call_with_retry(method, build_request())
         except EngineUnavailable as e:
@@ -128,11 +136,18 @@ class RemoteEngine:
                     "resending in full", self.target,
                 )
                 self._wire_cache.clear()
-                return self._call_with_retry(method, build_request())
+                try:
+                    return self._call_with_retry(method, build_request())
+                except Exception:
+                    self._wire_cache.clear()
+                    self._field_cache_ok = None
+                    raise
             self._wire_cache.clear()
+            self._field_cache_ok = None
             raise
         except Exception:
             self._wire_cache.clear()
+            self._field_cache_ok = None
             raise
 
     def schedule_batch(

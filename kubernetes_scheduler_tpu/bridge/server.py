@@ -594,7 +594,10 @@ def main(argv=None):
         # host to its scalar fallback for one window, which is fine but
         # unnecessary when shutdown can just finish the RPC
         log.info("shutting down; draining in-flight RPCs")
-        server.stop(grace=10).wait()
+        # the grace bounds the drain; the event fires at most ~10s out,
+        # and the belt-and-braces timeout keeps shutdown finite even if
+        # the grpc core wedges
+        server.stop(grace=10).wait(timeout=15)
 
 
 if __name__ == "__main__":

@@ -167,6 +167,7 @@ class NativeBackedQueue:
 
     def _drop_if_done(self, h: int) -> None:
         if self._outstanding.get(h, 0) <= 0:
+            # graftlint: disable=lock-discipline -- callers (mark_scheduled, pop_window) hold self._lock
             self._outstanding.pop(h, None)
             pod = self._pods.pop(h, None)
             if pod is not None:
@@ -216,16 +217,24 @@ class NativeBackedQueue:
                     h = uid_d.get(uid)
                     if h is None:
                         continue
-                append(h)
+                append((h, uid))
+            if handles:
+                self._q.mark_scheduled_batch(
+                    np.asarray([h for h, _ in handles], np.uint64)
+                )
+            # Python bookkeeping drops only AFTER the native marks
+            # succeeded (mark-then-drop, like the serial path): a raising
+            # native call must leave the maps intact so the binds can be
+            # re-marked. A pod appearing twice in one batch resolves its
+            # handle twice — harmless, the native mark is an idempotent
+            # attempts.erase — where an early drop would instead lose the
+            # second lookup mid-batch
+            for h, uid in handles:
                 # inline _drop_if_done with the uid already in hand
                 if out_d.get(h, 0) <= 0:
                     out_d.pop(h, None)
                     if pods_d.pop(h, None) is not None:
                         uid_d.pop(uid, None)
-            if handles:
-                self._q.mark_scheduled_batch(
-                    np.asarray(handles, np.uint64)
-                )
 
     def pop_window(self, max_pods: int) -> list[Pod]:
         with self._lock:

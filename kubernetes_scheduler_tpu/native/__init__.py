@@ -112,6 +112,27 @@ def _load() -> ctypes.CDLL | None:
     with _lock:
         if _lib is not None or _load_failed:
             return _lib
+        # YODA_NATIVE_LIB: load an alternate build verbatim (the
+        # sanitizer harness points here at build-asan/; no rebuild logic
+        # — a stale override should fail its ABI check, not be silently
+        # replaced by an unsanitized rebuild)
+        override = os.environ.get("YODA_NATIVE_LIB")
+        if override:
+            try:
+                lib = _bind(ctypes.CDLL(override))
+            except OSError as e:
+                log.warning("could not load YODA_NATIVE_LIB=%s: %s", override, e)
+                _load_failed = True
+                return None
+            if lib.yoda_host_abi_version() != ABI_VERSION:
+                log.warning(
+                    "YODA_NATIVE_LIB=%s has ABI %d, expected %d",
+                    override, lib.yoda_host_abi_version(), ABI_VERSION,
+                )
+                _load_failed = True
+                return None
+            _lib = lib
+            return _lib
         if _sources_newer_than_lib() and not _build():
             _load_failed = True
             return None
@@ -125,7 +146,9 @@ def _load() -> ctypes.CDLL | None:
         if got != ABI_VERSION:
             log.warning("native ABI %d != expected %d; rebuilding", got, ABI_VERSION)
             subprocess.run(
-                ["make", "-C", _NATIVE_DIR, "clean"], capture_output=True
+                ["make", "-C", _NATIVE_DIR, "clean"],
+                capture_output=True,
+                timeout=60,
             )
             if not _build():
                 _load_failed = True

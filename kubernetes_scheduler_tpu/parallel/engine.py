@@ -20,11 +20,16 @@ unless the mesh itself spans hosts.
 from __future__ import annotations
 
 import functools
+import inspect
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+try:  # jax >= 0.6 exports shard_map at top level with check_vma
+    from jax import shard_map as _shard_map
+except ImportError:  # older jax: experimental module, check_rep spelling
+    from jax.experimental.shard_map import shard_map as _shard_map
 
 from kubernetes_scheduler_tpu.engine import (
     PodBatch,
@@ -56,6 +61,37 @@ from kubernetes_scheduler_tpu.ops.score import (
 )
 from kubernetes_scheduler_tpu.ops.stats import CPU_DIVISOR, DISK_IO_DIVISOR, UtilizationStats
 from kubernetes_scheduler_tpu.parallel.mesh import NODE_AXIS
+
+_VMA_KW = (
+    "check_vma"
+    if "check_vma" in inspect.signature(_shard_map).parameters
+    else "check_rep"
+)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+    """shard_map across jax versions (check_vma was called check_rep
+    before the experimental module graduated). The pre-graduation
+    check_rep verifier has no replication rule for while_loop (the
+    auction assigner's round loop), so on old jax the checker is off
+    entirely — it is a trace-time development aid; decisions are
+    identical either way."""
+    if _VMA_KW == "check_rep":
+        check_vma = False
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        **{_VMA_KW: check_vma},
+    )
+
+
+if hasattr(jax.lax, "pcast"):
+    def _pcast_varying(x, axes):
+        return jax.lax.pcast(x, axes, to="varying")
+else:
+    def _pcast_varying(x, axes):
+        # pre-pcast jax has no varying-manual-axes annotations; the
+        # check_rep checker infers replication on its own
+        return x
 
 
 def _sharded_stats(snapshot: SnapshotArrays, axes) -> UtilizationStats:
@@ -168,9 +204,7 @@ def _sharded_greedy(
     added0 = (
         added2_0
         if added2_0 is not None
-        else jax.lax.pcast(
-            jnp.zeros((2, n_global, s), jnp.float32), axes, to="varying"
-        )
+        else _pcast_varying(jnp.zeros((2, n_global, s), jnp.float32), axes)
     )
 
     def step(carry, i):
@@ -346,7 +380,7 @@ def _sharded_auction(
     big = jnp.asarray(jnp.finfo(jnp.float32).max, jnp.float32)
 
     def varying(x):
-        return jax.lax.pcast(x, axes, to="varying")
+        return _pcast_varying(x, axes)
 
     added2_init = (
         added2_0
@@ -781,8 +815,8 @@ def make_sharded_windows_fn(
         n_local = snapshot.allocatable.shape[0]
         n_global = n_local * jax.lax.psum(1, axes)
         free0 = compute_free_capacity(snapshot)
-        added0 = jax.lax.pcast(
-            jnp.zeros((2, n_global, s), jnp.float32), axes, to="varying"
+        added0 = _pcast_varying(
+            jnp.zeros((2, n_global, s), jnp.float32), axes
         )
 
         cols = jnp.arange(s)[None, :]

@@ -1,0 +1,13 @@
+"""graftlint fixture: dtype/shape-disciplined kernel code."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def kernel(x, mask):
+    y = jnp.zeros(x.shape, dtype=jnp.float32)
+    if x.shape[0] > 4:  # static-shape branch: idiomatic, never flagged
+        y = y[:4]
+        mask = mask[:4]
+    return jnp.where(mask, y, x[: y.shape[0]].astype(jnp.float32))

@@ -1,0 +1,14 @@
+"""graftlint fixture: dtype/shape violations (never imported)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def kernel(x, mask):
+    y = jnp.zeros(x.shape, dtype=np.float64)  # LINE 10: float64 dtype
+    z = x.astype(float)  # LINE 11: astype to float64
+    if mask.any():  # LINE 12: Python branch on a traced predicate
+        z = z + 1.0
+    return y + z

@@ -1,0 +1,11 @@
+"""graftlint fixture: clean host path — one bulk sync, loops on host data."""
+
+import numpy as np
+
+
+def apply_results(window, res):
+    idx = np.asarray(res.node_idx)  # ONE bulk device->host sync
+    out = []
+    for i in range(len(window)):
+        out.append(int(idx[i]))  # host numpy indexing: fine
+    return out

@@ -1,0 +1,13 @@
+"""graftlint fixture: host-sync violations (never imported, only parsed)."""
+
+import jax
+import numpy as np
+
+
+def apply_results(window, res):
+    jax.block_until_ready(res)  # LINE 8: device barrier in the cycle path
+    out = []
+    for i in range(len(window)):
+        out.append(np.asarray(res.node_idx)[i])  # LINE 11: asarray per element
+    scores = [s.item() for s in res.scores]  # LINE 12: item per element
+    return out, scores

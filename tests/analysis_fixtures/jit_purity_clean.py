@@ -1,0 +1,24 @@
+"""graftlint fixture: clean jit code — no false positives expected."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def pure_helper(x):
+    acc = {}
+    acc["scaled"] = x * 2.0  # local mutation: fine
+    return acc["scaled"]
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def kernel(x, *, k=1):
+    y = pure_helper(x)
+    return jnp.where(y > 0, y, 0.0) * k
+
+
+def host_only_reporting(result):
+    # impure, but NOT reachable from any jit entry point
+    print("cycle done:", result)
+    return result

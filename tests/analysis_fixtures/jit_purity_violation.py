@@ -1,0 +1,27 @@
+"""graftlint fixture: jit-purity violations (never imported, only parsed)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+TRACE_LOG = {}
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def score_kernel(x, *, k=1):
+    print("scoring", k)  # LINE 14: side-effecting call at trace time
+    TRACE_LOG[k] = x.shape  # LINE 15: module-state mutation
+    return jnp.tanh(x) * k
+
+
+def impure_helper(x):
+    global _CALLS  # LINE 20: global declaration
+    _CALLS = x
+    return x * 2
+
+
+@jax.jit
+def entry(x):
+    # the helper is reachable from a jit entry, so its impurity counts
+    return impure_helper(x)

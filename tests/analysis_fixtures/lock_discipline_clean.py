@@ -1,0 +1,22 @@
+"""graftlint fixture: clean lock usage — every guarded mutation locked."""
+
+import threading
+
+
+class SharedCache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._store = {}
+        self.hits = 0  # __init__ writes are exempt (happens-before)
+
+    def put(self, key, value):
+        with self._lock:
+            self._store[key] = value
+
+    def drop(self, key):
+        with self._lock:
+            self._store.pop(key, None)
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self._store)  # reads: unrestricted

@@ -1,0 +1,21 @@
+"""graftlint fixture: lock-discipline violation (never imported)."""
+
+import threading
+
+
+class SharedCache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._store = {}
+        self.hits = 0
+
+    def put(self, key, value):
+        with self._lock:
+            self._store[key] = value
+
+    def drop(self, key):
+        # LINE 18: `_store` is lock-guarded in put(), mutated bare here
+        self._store.pop(key, None)
+
+    def bump_hits(self):
+        self.hits += 1  # never guarded anywhere: not a violation

@@ -1,0 +1,19 @@
+"""graftlint fixture: timeout-disciplined external calls."""
+
+import subprocess
+import urllib.request
+
+
+def fetch(url):
+    return urllib.request.urlopen(url, timeout=10.0).read()
+
+
+def build():
+    subprocess.run(["make"], check=True, timeout=120)
+
+
+def shutdown(worker_thread, done_event, proc):
+    done_event.wait(5.0)
+    proc.communicate(timeout=10)
+    worker_thread.join(timeout=2.0)
+    "".join(["a", "b"])  # str.join with args: never flagged

@@ -1,0 +1,18 @@
+"""graftlint fixture: timeout-hygiene violations (never imported)."""
+
+import subprocess
+import urllib.request
+
+
+def fetch(url):
+    return urllib.request.urlopen(url).read()  # LINE 8: no timeout
+
+
+def build():
+    subprocess.run(["make"], check=True)  # LINE 12: no timeout
+
+
+def shutdown(worker_thread, done_event, proc):
+    done_event.wait()  # LINE 16: unbounded event wait
+    proc.communicate()  # LINE 17: unbounded process drain
+    worker_thread.join()  # LINE 18: unbounded thread join

@@ -1,0 +1,20 @@
+"""graftlint fixture: waiver mechanics (never imported)."""
+
+import subprocess
+
+
+def waived_inline():
+    subprocess.run(["make"], check=True)  # graftlint: disable=timeout-hygiene -- CI harness bounds the build
+
+
+def waived_preceding_line():
+    # graftlint: disable=timeout-hygiene -- one-shot tool, bounded by caller
+    subprocess.run(["make"], check=True)
+
+
+def bad_waiver_no_reason():
+    subprocess.run(["make"], check=True)  # graftlint: disable=timeout-hygiene
+
+
+def wrong_rule_waived():
+    subprocess.run(["make"], check=True)  # graftlint: disable=jit-purity -- waives the wrong rule

@@ -1,0 +1,17 @@
+"""graftlint fixture: wire-schema-conformant usage of fixture.proto."""
+
+from tests.analysis_fixtures import fixture_pb2 as pb
+
+
+def send(req: pb.Ping):
+    req.seq = 7
+    req.payload.append(1)
+    copy = pb.Ping(name="x", seq=2)
+    copy.CopyFrom(req)  # protobuf runtime API: fine
+    return copy.SerializeToString()
+
+
+def receive(data):
+    reply = pb.Pong()
+    reply.ParseFromString(data)
+    return reply

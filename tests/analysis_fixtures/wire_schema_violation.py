@@ -1,0 +1,21 @@
+"""graftlint fixture: wire-schema drift (never imported, only parsed).
+
+The `fixture.proto` sibling defines Ping{name, seq, payload, tags} and
+Pong{}; everything below drifts from it.
+"""
+
+from tests.analysis_fixtures import fixture_pb2 as pb
+
+
+def send(req: pb.Ping):
+    req.nonexistent = 3  # LINE 11: Ping has no field `nonexistent`
+    return pb.Ping(name="x", bogus=1)  # LINE 12: no field `bogus`
+
+
+def bad_message():
+    return pb.Missing()  # LINE 16: message `Missing` not in the schema
+
+
+def assigned_var_drift():
+    reply = pb.Pong()
+    return reply.status  # LINE 21: Pong has no field `status`

@@ -2,6 +2,7 @@
 in-process engine, decisions_only wire slimming, health, and the
 unreachable-sidecar fallback path in the host scheduler."""
 
+import grpc
 import numpy as np
 import pytest
 
@@ -581,6 +582,177 @@ def test_remote_field_cache_cleared_on_failed_send():
         client.close()
         if server is not None:
             server.stop(grace=None)
+
+
+def _start_pre_field_cache_server(address):
+    """A sidecar predating the wire field cache: HealthReply does not
+    advertise the capability, and a marker-bearing tensor is read as a
+    malformed empty payload (INVALID_ARGUMENT) — exactly what an old
+    build's codec does."""
+    from concurrent import futures
+
+    import grpc as _grpc
+
+    from kubernetes_scheduler_tpu.bridge.server import (
+        MAX_MESSAGE_BYTES,
+        SERVICE,
+    )
+
+    local = LocalEngine()
+
+    def schedule_batch(request, context):
+        import jax
+
+        for nt in (request.snapshot, request.pods):
+            for name, t in nt.tensors.items():
+                if t.same_as_last:
+                    context.abort(
+                        _grpc.StatusCode.INVALID_ARGUMENT,
+                        f"unsupported dtype '' for tensor {name!r}",
+                    )
+        snapshot = codec.unpack_fields(engine.SnapshotArrays, request.snapshot)
+        pods = codec.unpack_fields(engine.PodBatch, request.pods)
+        res = jax.tree_util.tree_map(
+            np.asarray, local.schedule_batch(snapshot, pods)
+        )
+        reply = pb.ScheduleReply(engine_seconds=1e-9)
+        codec.pack_fields(res, reply.result)
+        return reply
+
+    def health(request, context):
+        return pb.HealthReply(
+            status="SERVING", device_count=1, platform="cpu"
+        )  # proto3 default: field_cache=False
+
+    handlers = _grpc.method_handlers_generic_handler(
+        SERVICE,
+        {
+            "ScheduleBatch": _grpc.unary_unary_rpc_method_handler(
+                schedule_batch,
+                request_deserializer=pb.ScheduleRequest.FromString,
+                response_serializer=pb.ScheduleReply.SerializeToString,
+            ),
+            "Health": _grpc.unary_unary_rpc_method_handler(
+                health,
+                request_deserializer=pb.HealthRequest.FromString,
+                response_serializer=pb.HealthReply.SerializeToString,
+            ),
+        },
+    )
+    server = _grpc.server(
+        futures.ThreadPoolExecutor(max_workers=1),
+        options=[("grpc.max_receive_message_length", MAX_MESSAGE_BYTES)],
+    )
+    server.add_generic_rpc_handlers((handlers,))
+    assert server.add_insecure_port(address) != 0
+    server.start()
+    return server
+
+
+def test_remote_field_cache_downgrade_reprobe():
+    """ADVICE r5 (medium): the field-cache capability must not latch True
+    for the client's lifetime. When the sidecar behind the target is
+    replaced by an older build (no field_cache), the marker-bearing send
+    fails INVALID_ARGUMENT; the client must drop the capability back to
+    unknown, re-probe health on the next cycle, and settle into full
+    sends — NOT fail every other cycle forever."""
+    snap = gen_cluster(8, seed=0)
+    pods = gen_pods(4, seed=1)
+    server, port, _ = make_server("127.0.0.1:0")
+    server.start()
+    client = RemoteEngine(f"127.0.0.1:{port}", deadline_seconds=60.0)
+    old_server = None
+    try:
+        r1 = client.schedule_batch(snap, pods, assigner="greedy")
+        client.schedule_batch(snap, pods, assigner="greedy")  # markers engaged
+        assert client._field_cache_ok is True
+        assert client._wire_cache["batch:snapshot"]
+        # rollback: an old build takes over the same target
+        server.stop(grace=None)
+        server = None
+        old_server = _start_pre_field_cache_server(f"127.0.0.1:{port}")
+        # the in-flight capability is stale: ONE failed cycle is expected
+        with pytest.raises(EngineUnavailable, match="INVALID_ARGUMENT"):
+            client.schedule_batch(snap, pods, assigner="greedy")
+        assert client._field_cache_ok is None  # forced re-probe
+        # every later cycle succeeds: health resolves field_cache=False,
+        # full sends, no markers, decisions unchanged
+        for _ in range(3):
+            r = client.schedule_batch(snap, pods, assigner="greedy")
+            np.testing.assert_array_equal(
+                np.asarray(r1.node_idx), np.asarray(r.node_idx)
+            )
+        assert client._field_cache_ok is False
+        assert client._wire_cache == {}
+    finally:
+        client.close()
+        if server is not None:
+            server.stop(grace=None)
+        if old_server is not None:
+            old_server.stop(grace=None)
+
+
+class _FakeRpcError(grpc.RpcError):
+    def __init__(self, code, details):
+        self._code, self._details = code, details
+
+    def code(self):
+        return self._code
+
+    def details(self):
+        return self._details
+
+
+def test_remote_field_cache_failed_resend_clears_cache():
+    """ADVICE r5 (low): when the full resend after a field-cache-miss
+    itself fails, build_request() has just repopulated _wire_cache with
+    values the server never stored — the failure path must clear it (and
+    drop the capability latch), or the next cycle burns a guaranteed
+    FAILED_PRECONDITION round-trip on stale markers."""
+    snap = gen_cluster(8, seed=0)
+    pods = gen_pods(4, seed=1)
+    server, port, _ = make_server("127.0.0.1:0")
+    server.start()
+    client = RemoteEngine(f"127.0.0.1:{port}", deadline_seconds=10.0, retries=0)
+    try:
+        r1 = client.schedule_batch(snap, pods, assigner="greedy")
+        assert client._wire_cache["batch:snapshot"]
+        calls = []
+        real_schedule = client._schedule
+
+        def failing(request, timeout=None):
+            calls.append(request)
+            if len(calls) == 1:
+                raise _FakeRpcError(
+                    grpc.StatusCode.FAILED_PRECONDITION,
+                    "field-cache-miss: SnapshotArrays.disk_io",
+                )
+            raise _FakeRpcError(
+                grpc.StatusCode.UNAVAILABLE, "connection reset mid-resend"
+            )
+
+        client._schedule = failing
+        with pytest.raises(EngineUnavailable):
+            client.schedule_batch(snap, pods, assigner="greedy")
+        assert len(calls) == 2  # the miss, then the failed full resend
+        # the resend WAS full (markers cleared before rebuilding)
+        assert not any(
+            t.same_as_last for t in calls[1].snapshot.tensors.values()
+        )
+        # and its optimistically-repopulated cache was wiped again
+        assert client._wire_cache == {}
+        assert client._field_cache_ok is None
+        # recovery: real stub back, the next cycle resends full and the
+        # cache re-engages from scratch
+        client._schedule = real_schedule
+        r2 = client.schedule_batch(snap, pods, assigner="greedy")
+        np.testing.assert_array_equal(
+            np.asarray(r1.node_idx), np.asarray(r2.node_idx)
+        )
+        assert client._wire_cache["batch:snapshot"]
+    finally:
+        client.close()
+        server.stop(grace=None)
 
 
 def test_remote_field_cache_constraint_sweep_matches_local():

@@ -94,6 +94,58 @@ def test_native_queue_duplicate_push_survives_mark_scheduled():
     assert not q._pods and not q._by_uid and not q._outstanding
 
 
+def test_mark_scheduled_many_duplicate_pod_marks_twice():
+    """ADVICE r5 (low): a pod appearing twice in one batch must resolve
+    its handle twice — ONE native batch call carrying the handle twice
+    (harmless: the native mark is an idempotent attempts.erase), where
+    the pre-fix early drop lost the second lookup mid-batch — and the
+    bookkeeping still drains completely."""
+    q = NativeBackedQueue(clock=lambda: 0.0)
+    pod = make_pod("dup2")
+    q.push(pod)
+    q.push(pod)
+    popped = q.pop_window(2)
+    assert [p.name for p in popped] == ["dup2", "dup2"]
+    batches = []
+    real_batch = q._q.mark_scheduled_batch
+
+    def recording(arr):
+        batches.append(np.asarray(arr).tolist())
+        return real_batch(arr)
+
+    q._q.mark_scheduled_batch = recording
+    q.mark_scheduled_many(popped)
+    assert len(batches) == 1
+    assert len(batches[0]) == 2 and batches[0][0] == batches[0][1]
+    assert len(q) == 0
+    assert not q._pods and not q._by_uid and not q._outstanding
+
+
+def test_mark_scheduled_many_native_failure_keeps_bookkeeping():
+    """ADVICE r5 (low): mark-then-drop ordering — when the native batch
+    call raises, the Python maps must be intact so the binds can be
+    re-marked (the native retry counters were never cleared)."""
+    q = NativeBackedQueue(clock=lambda: 0.0)
+    pod = make_pod("boom")
+    q.push(pod)
+    popped = q.pop_window(1)
+    assert [p.name for p in popped] == ["boom"]
+    real_batch = q._q.mark_scheduled_batch
+
+    def raising(arr):
+        raise RuntimeError("native batch failed")
+
+    q._q.mark_scheduled_batch = raising
+    with pytest.raises(RuntimeError, match="native batch failed"):
+        q.mark_scheduled_many(popped)
+    # maps untouched: the pod's handle is still resolvable
+    assert q._by_uid and q._pods
+    # retry succeeds and only then drops the bookkeeping
+    q._q.mark_scheduled_batch = real_batch
+    q.mark_scheduled_many(popped)
+    assert not q._pods and not q._by_uid and not q._outstanding
+
+
 def test_make_queue_fallback():
     assert isinstance(make_queue(prefer_native=False), SchedulingQueue)
     assert isinstance(make_queue(prefer_native=True), NativeBackedQueue)

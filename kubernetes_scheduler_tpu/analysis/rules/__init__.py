@@ -5,6 +5,7 @@ from kubernetes_scheduler_tpu.analysis.rules import (
     host_sync,
     jit_purity,
     lock_discipline,
+    pallas_vmem,
     timeout_hygiene,
     wire_schema,
 )
@@ -16,4 +17,5 @@ RULES = {
     wire_schema.RULE: wire_schema.check,
     dtype_shape.RULE: dtype_shape.check,
     timeout_hygiene.RULE: timeout_hygiene.check,
+    pallas_vmem.RULE: pallas_vmem.check,
 }

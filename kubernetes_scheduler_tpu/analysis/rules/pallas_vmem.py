@@ -1,0 +1,274 @@
+"""pallas-vmem: Pallas kernel hygiene — tiling, VMEM budget, f32
+accumulators, no host callbacks in kernel bodies.
+
+A Pallas kernel runs inside one XLA custom call: the grid steps over
+(block)-shaped tiles resident in VMEM, so the static facts that make or
+break it are checkable from the AST:
+
+- tiling: every resolvable BlockSpec block shape must divide the padded
+  axes it tiles — on TPU the minor (lane) dimension must be a multiple
+  of 128; a non-dividing block forces a relayout on every grid step and
+  leaves ragged tail tiles the kernel body never sees (the host pads TO
+  the tile — `_pad_axis(x, axis, tile)` in ops/pallas_fused.py — so a
+  128-aligned tile divides by construction);
+- VMEM budget: the summed bytes of all resolvable blocks (in_specs +
+  out_specs, f32) must leave double-buffering headroom under the
+  ~16 MB/core VMEM — an over-budget block set fails at compile time on
+  hardware but silently "works" under the interpreter;
+- accumulators stay f32: a reduced-precision accumulator (bfloat16/
+  float16 dtype on zeros/full/sum/dot, or .astype inside the body)
+  loses mantissa on long reductions and diverges from the unfused
+  reference path the parity tests pin;
+- no host callbacks inside kernel bodies: jax.debug.print/callback,
+  io_callback, pure_callback, plain print — none can fire from inside a
+  TPU kernel (they fail late on hardware or silently no-op under
+  interpret mode, hiding the breakage until deployment).
+
+Unresolvable dimensions (runtime values like `n_res`) are skipped, not
+guessed — the rule only reports what the AST proves.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kubernetes_scheduler_tpu.analysis.core import (
+    Context,
+    Violation,
+    dotted_name,
+)
+
+RULE = "pallas-vmem"
+
+SCOPE = ("kubernetes_scheduler_tpu/ops/pallas_*.py",)
+
+LANE = 128                      # TPU minor-axis tiling (f32 lanes)
+VMEM_BUDGET_BYTES = 14 << 20    # ~16 MB/core minus double-buffer headroom
+
+_HOST_CALLBACKS = {
+    "print",
+    "breakpoint",
+    "jax.debug.print",
+    "jax.debug.callback",
+    "jax.pure_callback",
+    "jax.experimental.io_callback",
+    "io_callback",
+    "pure_callback",
+    "host_callback.call",
+    "hcb.call",
+}
+
+_LOW_PRECISION = {
+    "jnp.bfloat16", "jnp.float16", "jax.numpy.bfloat16",
+    "jax.numpy.float16", "np.float16", "numpy.float16",
+    "bfloat16", "float16",
+}
+
+# accumulation/materialization calls whose dtype defines an accumulator
+_ACC_FUNCS = (
+    "zeros", "zeros_like", "full", "ones", "empty", "sum", "cumsum",
+    "dot", "matmul", "einsum", "dot_general", "astype",
+)
+
+
+def _dtype_token(node: ast.AST) -> str | None:
+    """'jnp.bfloat16' for attribute chains, 'bfloat16' for strings."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return dotted_name(node)
+
+
+def _module_consts(tree: ast.AST) -> dict[str, int]:
+    out: dict[str, int] = {}
+    for node in tree.body if isinstance(tree, ast.Module) else []:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if (
+                isinstance(t, ast.Name)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, int)
+            ):
+                out[t.id] = node.value.value
+    return out
+
+
+def _fn_bindings(fn: ast.AST, consts: dict[str, int]) -> dict[str, int]:
+    """Parameter defaults + simple local int assigns, resolved against
+    the module constants (`tile_p: int = TILE_P` resolves through
+    TILE_P = 256)."""
+    out = dict(consts)
+    args = fn.args
+    named = args.posonlyargs + args.args + args.kwonlyargs
+    defaults = args.defaults + args.kw_defaults
+    for a, d in zip(named[len(named) - len(defaults):], defaults):
+        if d is None:
+            continue
+        if isinstance(d, ast.Constant) and isinstance(d.value, int):
+            out[a.arg] = d.value
+        elif isinstance(d, ast.Name) and d.id in consts:
+            out[a.arg] = consts[d.id]
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if (
+                isinstance(t, ast.Name)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, int)
+            ):
+                out[t.id] = node.value.value
+    return out
+
+
+def _resolve_dims(shape: ast.AST, env: dict[str, int]) -> list[int | None]:
+    if not isinstance(shape, ast.Tuple):
+        return []
+    dims: list[int | None] = []
+    for el in shape.elts:
+        if isinstance(el, ast.Constant) and isinstance(el.value, int):
+            dims.append(el.value)
+        elif isinstance(el, ast.Name):
+            dims.append(env.get(el.id))
+        else:
+            dims.append(None)
+    return dims
+
+
+def _block_specs(call: ast.Call):
+    """Every BlockSpec(...) Call under in_specs/out_specs."""
+    for kw in call.keywords:
+        if kw.arg not in ("in_specs", "out_specs"):
+            continue
+        roots = (
+            kw.value.elts
+            if isinstance(kw.value, (ast.List, ast.Tuple))
+            else [kw.value]
+        )
+        for node in roots:
+            if isinstance(node, ast.Call) and (
+                (dotted_name(node.func) or "").rsplit(".", 1)[-1]
+                == "BlockSpec"
+            ):
+                yield node
+
+
+def _spec_shape(spec: ast.Call) -> ast.AST | None:
+    if spec.args:
+        return spec.args[0]
+    for kw in spec.keywords:
+        if kw.arg == "block_shape":
+            return kw.value
+    return None
+
+
+def _kernel_names(call: ast.Call) -> list[str]:
+    """The kernel function name(s) a pallas_call dispatches, unwrapping
+    functools.partial."""
+    if not call.args:
+        return []
+    k = call.args[0]
+    if isinstance(k, ast.Call) and (
+        (dotted_name(k.func) or "").rsplit(".", 1)[-1] == "partial"
+    ):
+        k = k.args[0] if k.args else None
+    name = dotted_name(k) if k is not None else None
+    return [name.rsplit(".", 1)[-1]] if name else []
+
+
+def _check_kernel_body(fn: ast.AST, sf, out: list[Violation]) -> None:
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        if name in _HOST_CALLBACKS:
+            out.append(
+                Violation(
+                    RULE, sf.path, node.lineno,
+                    f"host callback `{name}(...)` inside kernel body "
+                    f"`{fn.name}` — cannot fire from a TPU kernel",
+                )
+            )
+            continue
+        tail = name.rsplit(".", 1)[-1] if name else (
+            node.func.attr if isinstance(node.func, ast.Attribute) else None
+        )
+        if tail not in _ACC_FUNCS:
+            continue
+        cands = [kw.value for kw in node.keywords if kw.arg == "dtype"]
+        if tail == "astype" and node.args:
+            cands.append(node.args[0])
+        for cand in cands:
+            tok = _dtype_token(cand)
+            if tok in _LOW_PRECISION:
+                out.append(
+                    Violation(
+                        RULE, sf.path, node.lineno,
+                        f"accumulator dtype `{tok}` inside kernel body "
+                        f"`{fn.name}` — accumulate in f32 (cast on the "
+                        "final store if a narrow output is wanted)",
+                    )
+                )
+
+
+def check(ctx: Context) -> list[Violation]:
+    out: list[Violation] = []
+    for sf in ctx.scoped(SCOPE):
+        consts = _module_consts(sf.tree)
+        fns = [
+            n for n in ast.walk(sf.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        checked_kernels: set[str] = set()
+        seen_calls: set[int] = set()
+        for fn in fns:
+            env = _fn_bindings(fn, consts)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                if (dotted_name(node.func) or "").rsplit(".", 1)[-1] != (
+                    "pallas_call"
+                ):
+                    continue
+                # a call inside a nested def is walked by both scopes;
+                # the inner (more local env) pass runs first in source
+                # order only by accident — dedupe on identity
+                if id(node) in seen_calls:
+                    continue
+                seen_calls.add(id(node))
+                total_bytes = 0
+                for spec in _block_specs(node):
+                    dims = _resolve_dims(_spec_shape(spec), env)
+                    if not dims:
+                        continue
+                    last = dims[-1]
+                    if last is not None and last % LANE:
+                        out.append(
+                            Violation(
+                                RULE, sf.path, spec.lineno,
+                                f"BlockSpec minor axis {last} is not a "
+                                f"multiple of {LANE}: the block cannot "
+                                "divide the lane-padded axis (ragged "
+                                "tail tiles + per-step relayout)",
+                            )
+                        )
+                    if all(d is not None for d in dims):
+                        size = 4
+                        for d in dims:
+                            size *= d
+                        total_bytes += size
+                if total_bytes > VMEM_BUDGET_BYTES:
+                    out.append(
+                        Violation(
+                            RULE, sf.path, node.lineno,
+                            f"resolvable blocks total "
+                            f"{total_bytes / (1 << 20):.1f} MB — over the "
+                            "~16 MB/core VMEM budget once double-buffered",
+                        )
+                    )
+                for kname in _kernel_names(node):
+                    if kname in checked_kernels:
+                        continue
+                    checked_kernels.add(kname)
+                    for kfn in fns:
+                        if kfn.name == kname:
+                            _check_kernel_body(kfn, sf, out)
+    return out

@@ -35,6 +35,21 @@ class EngineUnavailable(RuntimeError):
     """The sidecar could not serve the cycle (after retries)."""
 
 
+class _FutureSchedule:
+    """RemoteEngine's in-flight ScheduleBatch handle: the whole RPC
+    (pack, send, server compute, unpack) runs on the client's dedicated
+    worker thread so the pipelined host overlaps it with next-cycle
+    host work. Same one-method surface as engine.PendingSchedule."""
+
+    __slots__ = ("_future",)
+
+    def __init__(self, future):
+        self._future = future
+
+    def result(self):
+        return self._future.result()
+
+
 LocalEngine = engine.LocalEngine  # re-export; defined grpc-free in engine.py
 
 
@@ -87,6 +102,11 @@ class RemoteEngine:
         self._session_id = uuid.uuid4().hex
         self._wire_cache: dict[str, dict] = {}
         self._field_cache_ok: bool | None = None
+        # lazy single-worker pool for schedule_batch_async: ONE worker
+        # because the wire field cache and capability latch are mutated
+        # per call, and the pipelined host forces result() before the
+        # next dispatch — at most one RPC is ever in flight per client
+        self._async_pool = None
 
     def _field_cache_enabled(self) -> bool:
         """Resolve the sidecar's field-cache capability ONCE per client
@@ -195,6 +215,23 @@ class RemoteEngine:
             request.score_plugins.add(name=name, weight=float(weight))
         reply = self._call_cached(self._schedule, build_request)
         return self._unpack_result(reply, snapshot, pods)
+
+    def schedule_batch_async(self, snapshot, pods, **kw) -> _FutureSchedule:
+        """Concurrent in-flight ScheduleBatch (the pipelined host loop's
+        async surface): submits the full synchronous call — retries,
+        field-cache recovery and all — to the dedicated worker thread
+        and returns immediately. Errors (EngineUnavailable included)
+        surface from `handle.result()`, where the scheduler's existing
+        fallback handling catches them."""
+        if self._async_pool is None:
+            import concurrent.futures
+
+            self._async_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="yoda-bridge-async"
+            )
+        return _FutureSchedule(
+            self._async_pool.submit(self.schedule_batch, snapshot, pods, **kw)
+        )
 
     def schedule_windows(
         self,
@@ -314,4 +351,9 @@ class RemoteEngine:
             return None
 
     def close(self) -> None:
+        if self._async_pool is not None:
+            # wait=True: an in-flight RPC owns the channel — closing it
+            # under the worker would surface a spurious cycle failure
+            self._async_pool.shutdown(wait=True)
+            self._async_pool = None
         self._channel.close()

@@ -92,6 +92,13 @@ class EngineService:
         self._sharded_opts = sharded_opts or {}
         self.cycles_served = 0
         self._lock = threading.Lock()
+        # serializes DEVICE access explicitly (schedule/windows/preempt
+        # bodies), so the executor may run more than one worker without
+        # ever interleaving two device programs: with a pipelined host
+        # keeping a ScheduleBatch in flight most of the time, a
+        # single-worker executor would queue Health probes (liveness,
+        # field-cache capability re-probes) behind the cycle
+        self._device_lock = threading.Lock()
         # session id -> {"<rpc>:<map>": {field: ndarray}} (LRU-bounded)
         self._field_cache: "OrderedDict[str, dict]" = OrderedDict()
 
@@ -177,36 +184,38 @@ class EngineService:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         t0 = time.perf_counter()
         try:
-            if self._sharded_fn is not None:
-                # `fused` is a decision-identical optimization hint; this
-                # sidecar's sharded program is built once at startup
-                # (make_sharded_*_fn(fused=...) exists, but the choice is
-                # baked), so serve the built variant rather than degrade
-                # the deployment to the host's scalar fallback
-                fn = self._pick_sharded_fn(
-                    request, context, self._sharded_fn,
-                    self._sharded_fn_soft, "sharded engine",
-                )
-                res = fn(snapshot, pods, **_auction_kw(request))
-            else:
-                kw = _auction_kw(request)
-                sp = _score_plugins(request)
-                if sp is not None:
-                    kw["score_plugins"] = sp
-                res = self._engine.schedule_batch(
-                    snapshot,
-                    pods,
-                    policy=request.policy or "balanced_cpu_diskio",
-                    assigner=request.assigner or "greedy",
-                    normalizer=request.normalizer or "min_max",
-                    fused=request.fused,
-                    affinity_aware=request.affinity_aware,
-                    soft=request.soft,
-                    **kw,
-                )
+            with self._device_lock:
+                if self._sharded_fn is not None:
+                    # `fused` is a decision-identical optimization hint;
+                    # this sidecar's sharded program is built once at
+                    # startup (make_sharded_*_fn(fused=...) exists, but
+                    # the choice is baked), so serve the built variant
+                    # rather than degrade the deployment to the host's
+                    # scalar fallback
+                    fn = self._pick_sharded_fn(
+                        request, context, self._sharded_fn,
+                        self._sharded_fn_soft, "sharded engine",
+                    )
+                    res = fn(snapshot, pods, **_auction_kw(request))
+                else:
+                    kw = _auction_kw(request)
+                    sp = _score_plugins(request)
+                    if sp is not None:
+                        kw["score_plugins"] = sp
+                    res = self._engine.schedule_batch(
+                        snapshot,
+                        pods,
+                        policy=request.policy or "balanced_cpu_diskio",
+                        assigner=request.assigner or "greedy",
+                        normalizer=request.normalizer or "min_max",
+                        fused=request.fused,
+                        affinity_aware=request.affinity_aware,
+                        soft=request.soft,
+                        **kw,
+                    )
+                res = jax.tree_util.tree_map(np.asarray, res)
         except ValueError as e:  # unknown policy/assigner/normalizer
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
-        res = jax.tree_util.tree_map(np.asarray, res)
         dt = time.perf_counter() - t0
         with self._lock:
             self.cycles_served += 1
@@ -236,31 +245,33 @@ class EngineService:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         t0 = time.perf_counter()
         try:
-            if self._sharded_windows_fn is not None:
-                fn = self._pick_sharded_fn(
-                    request, context, self._sharded_windows_fn,
-                    self._sharded_windows_fn_soft, "sharded windows engine",
-                )
-                res = fn(snapshot, pods_w, **_auction_kw(request))
-            else:
-                kw = _auction_kw(request)
-                sp = _score_plugins(request)
-                if sp is not None:
-                    kw["score_plugins"] = sp
-                res = self._engine.schedule_windows(
-                    snapshot,
-                    pods_w,
-                    policy=request.policy or "balanced_cpu_diskio",
-                    assigner=request.assigner or "auction",
-                    normalizer=request.normalizer or "none",
-                    fused=request.fused,
-                    affinity_aware=request.affinity_aware,
-                    soft=request.soft,
-                    **kw,
-                )
+            with self._device_lock:
+                if self._sharded_windows_fn is not None:
+                    fn = self._pick_sharded_fn(
+                        request, context, self._sharded_windows_fn,
+                        self._sharded_windows_fn_soft,
+                        "sharded windows engine",
+                    )
+                    res = fn(snapshot, pods_w, **_auction_kw(request))
+                else:
+                    kw = _auction_kw(request)
+                    sp = _score_plugins(request)
+                    if sp is not None:
+                        kw["score_plugins"] = sp
+                    res = self._engine.schedule_windows(
+                        snapshot,
+                        pods_w,
+                        policy=request.policy or "balanced_cpu_diskio",
+                        assigner=request.assigner or "auction",
+                        normalizer=request.normalizer or "none",
+                        fused=request.fused,
+                        affinity_aware=request.affinity_aware,
+                        soft=request.soft,
+                        **kw,
+                    )
+                res = jax.tree_util.tree_map(np.asarray, res)
         except ValueError as e:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
-        res = jax.tree_util.tree_map(np.asarray, res)
         dt = time.perf_counter() - t0
         with self._lock:
             self.cycles_served += 1
@@ -289,8 +300,9 @@ class EngineService:
         except (ValueError, TypeError) as e:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         t0 = time.perf_counter()
-        res = engine.preempt_batch(snapshot, pods, victims, k_cap=k_cap)
-        res = jax.tree_util.tree_map(np.asarray, res)
+        with self._device_lock:
+            res = engine.preempt_batch(snapshot, pods, victims, k_cap=k_cap)
+            res = jax.tree_util.tree_map(np.asarray, res)
         dt = time.perf_counter() - t0
         with self._lock:
             self.cycles_served += 1
@@ -318,10 +330,13 @@ def make_server(
     sharded_fn_soft=None,
     sharded_windows_fn=None,
     sharded_windows_fn_soft=None,
-    max_workers: int = 1,
+    max_workers: int = 2,
 ) -> tuple[grpc.Server, int, EngineService]:
-    """Build (server, bound_port, service). max_workers=1 keeps device
-    access single-writer; raise it only for a CPU-only sidecar."""
+    """Build (server, bound_port, service). Device access stays
+    single-writer regardless of max_workers (EngineService._device_lock
+    serializes the compute sections); the default of 2 workers keeps
+    Health answering while a pipelined host's ScheduleBatch is in
+    flight — with 1 worker every probe queues behind the cycle."""
     service = EngineService(
         engine_override=engine_override,
         sharded_fn=sharded_fn,

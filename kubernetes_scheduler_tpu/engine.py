@@ -497,6 +497,25 @@ class _UniformDeviceCache:
         return type(nt)(*out)
 
 
+class PendingSchedule:
+    """Handle for an in-flight schedule_batch dispatch (the pipelined
+    host loop's async surface): `result()` returns the ScheduleResult
+    whose leaves force on first host read. For the local engine the
+    jitted call is already enqueued when the handle is constructed —
+    JAX async dispatch returns before the device finishes, so the ONLY
+    blocking point is the caller's eventual `np.asarray(res.node_idx)`.
+    Remote engines return a thread-backed equivalent
+    (bridge.client._FutureSchedule) with the same one-method surface."""
+
+    __slots__ = ("_result",)
+
+    def __init__(self, result: "ScheduleResult"):
+        self._result = result
+
+    def result(self) -> "ScheduleResult":
+        return self._result
+
+
 class LocalEngine:
     """In-process engine with the bridge's call surface, so the host
     scheduler swaps Local/Remote behind one attribute (grpc-free — the
@@ -509,6 +528,14 @@ class LocalEngine:
         return schedule_batch(
             self._consts.swap(snapshot), self._consts.swap(pods), **kw
         )
+
+    def schedule_batch_async(self, snapshot, pods, **kw) -> PendingSchedule:
+        """Dispatch without forcing: the jit call enqueues the program
+        and returns lazy device arrays (compilation, on a cold cache,
+        still blocks — that is a one-time cost per bucket shape). The
+        pipelined host loop does next-cycle host work between this call
+        and `handle.result()`'s first array read."""
+        return PendingSchedule(self.schedule_batch(snapshot, pods, **kw))
 
     def schedule_windows(self, snapshot, pods_windows, **kw) -> "WindowsResult":
         return schedule_windows(

@@ -70,6 +70,12 @@ def summarize(metrics, totals: dict | None = None) -> dict:
             "fallback_policy_mismatch": sum(
                 1 for m in cycles if getattr(m, "policy_mismatch", False)
             ),
+            "pipeline_flushes": sum(
+                getattr(m, "pipeline_flushes", 0) for m in cycles
+            ),
+            "host_overlap_seconds": sum(
+                getattr(m, "host_overlap_seconds", 0.0) for m in cycles
+            ),
         }
     return {
         "cycles_total": totals["cycles"],
@@ -83,6 +89,13 @@ def summarize(metrics, totals: dict | None = None) -> dict:
         "fallback_policy_mismatch_total": totals.get(
             "fallback_policy_mismatch", 0
         ),
+        # pipelined loop (config.pipeline_depth): flush count is the
+        # hazard-rate signal (speculative state discarded for informer
+        # churn / engine failure / non-device cycles); overlap seconds
+        # is the host work hidden under in-flight engine calls — the
+        # win the pipeline exists for, observable in production
+        "pipeline_flushes_total": totals.get("pipeline_flushes", 0),
+        "host_overlap_seconds_total": totals.get("host_overlap_seconds", 0.0),
         "scheduling_pods_per_sec": bound / total_s if total_s > 0 else 0.0,
         "bind_latency_p50_seconds": _quantile(lat, 0.50),
         "bind_latency_p99_seconds": _quantile(lat, 0.99),
@@ -104,6 +117,8 @@ _HELP = {
     "fallback_cycles_total": "Cycles served by the scalar fallback path",
     "fetch_failures_total": "Cycles aborted by a cluster-source/advisor fetch failure (window requeued)",
     "fallback_policy_mismatch_total": "Fallback cycles scored with the yoda formula because config.policy has no scalar mirror",
+    "pipeline_flushes_total": "Speculative pipeline state discarded (informer/layout churn, engine failure, non-device cycle)",
+    "host_overlap_seconds_total": "Host work overlapped with in-flight engine calls (pipelined loop)",
     "scheduling_pods_per_sec": "Bound pods per second of cycle time",
     "bind_latency_p50_seconds": "Median end-to-end cycle latency",
     "bind_latency_p99_seconds": "p99 end-to-end cycle latency",

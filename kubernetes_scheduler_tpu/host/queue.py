@@ -63,6 +63,9 @@ class SchedulingQueue:
         self._backoff: list[tuple[float, int, Pod]] = []  # (ready_at, seq, pod)
         self._attempts: dict[str, int] = {}
         self._seq = itertools.count()
+        # restore_window keys: strictly below every normal seq, so a
+        # returned window pops ahead of equal-priority pods queued since
+        self._front_floor = 0
         self.initial_backoff = initial_backoff
         self.max_backoff = max_backoff
         self._clock = clock
@@ -112,6 +115,23 @@ class SchedulingQueue:
             while self._active and len(out) < max_pods:
                 out.append(heapq.heappop(self._active).pod)
             return out
+
+    def restore_window(self, pods: list[Pod]) -> None:
+        """Return a popped-but-unscheduled window to the FRONT of the
+        queue: restored pods keep their relative order and precede every
+        pod currently queued at equal priority — re-popping immediately
+        yields the same window. Used by the pipelined scheduler
+        (Scheduler.drain_pipeline) to hand back a prefetched window.
+        Restoring several windows without popping in between re-merges
+        them newest-first; the drain path restores exactly one."""
+        with self._lock:
+            base = self._front_floor - len(pods)
+            for i, pod in enumerate(pods):
+                heapq.heappush(
+                    self._active,
+                    _Entry((-pod_priority(pod), base + i), pod),
+                )
+            self._front_floor = base
 
     def __len__(self) -> int:
         with self._lock:
@@ -235,6 +255,17 @@ class NativeBackedQueue:
                     out_d.pop(h, None)
                     if pods_d.pop(h, None) is not None:
                         uid_d.pop(uid, None)
+
+    def restore_window(self, pods: list[Pod]) -> None:
+        """Return a popped window to the queue. The native heap assigns
+        its own (monotone) sequence numbers, so restored pods re-enter
+        at the BACK of their priority class rather than the front —
+        priority order is exact, FIFO position among equals is not.
+        Only the drain path (Scheduler.drain_pipeline) restores, and it
+        is followed by a fresh pop or shutdown, so the approximation
+        never affects a live pipelined cycle."""
+        for pod in pods:
+            self.push(pod)
 
     def pop_window(self, max_pods: int) -> list[Pod]:
         with self._lock:

@@ -129,6 +129,39 @@ class CycleMetrics:
     # and used the yoda formula instead — a POLICY change under
     # degradation, distinct from benign same-policy fallback
     policy_mismatch: bool = False
+    # pipelined loop (config.pipeline_depth >= 1): host work done while
+    # the engine call was in flight (the overlap win — next-cycle pop,
+    # record warming, speculative pod-batch build), and speculative-state
+    # discards (informer/layout churn, engine failure, non-device paths)
+    host_overlap_seconds: float = 0.0
+    pipeline_flushes: int = 0
+
+
+@dataclass
+class _CycleStart:
+    """State the cycle front-end (_begin_cycle: pop/fetch/eligibility)
+    hands the path back-ends — one struct, so the serial and pipelined
+    drivers cannot diverge on what a cycle knows."""
+
+    window: list
+    nodes: list
+    running: list
+    utils: dict
+    eph_running: bool
+    scalar_eligible: bool
+    use_device: bool
+    backlog: bool
+    cells: int
+    t_path: float
+
+
+@dataclass
+class _InFlight:
+    """One dispatched-but-unforced engine call (the 1-deep pipeline)."""
+
+    handle: object       # .result() -> ScheduleResult (engine.PendingSchedule)
+    pods_batch: object   # the dispatched PodBatch (validation + deltas)
+    t_eng: float         # dispatch timestamp (engine wall time)
 
 
 class Scheduler:
@@ -267,7 +300,15 @@ class Scheduler:
             "fallback_cycles": 0,
             "fetch_failures": 0,
             "fallback_policy_mismatch": 0,
+            "pipeline_flushes": 0,
+            "host_overlap_seconds": 0.0,
         }
+        # pipelined loop state (config.pipeline_depth >= 1): the window
+        # prefetched while the previous cycle's engine call was in
+        # flight, and the speculative pod batch prebuilt for it (kept at
+        # dispatch time only if the layout fingerprint still matches)
+        self._prefetched: list[Pod] | None = None
+        self._spec_batch: tuple | None = None  # (window, fingerprint, batch)
         # appends/reads cross threads (scheduling loop vs /metrics scrape;
         # deque raises on mutation during iteration, unlike list)
         self._metrics_lock = threading.Lock()
@@ -284,6 +325,8 @@ class Scheduler:
             self.totals["fallback_cycles"] += int(m.used_fallback)
             self.totals["fetch_failures"] += int(m.fetch_failed)
             self.totals["fallback_policy_mismatch"] += int(m.policy_mismatch)
+            self.totals["pipeline_flushes"] += m.pipeline_flushes
+            self.totals["host_overlap_seconds"] += m.host_overlap_seconds
 
     def metrics_snapshot(self) -> tuple[list[CycleMetrics], dict]:
         """Point-in-time copy for exporters (safe against the scheduling
@@ -311,25 +354,53 @@ class Scheduler:
     # ---- one cycle -----------------------------------------------------
 
     def run_cycle(self) -> CycleMetrics:
+        """One scheduling cycle. With config.pipeline_depth >= 1 the
+        batched device path runs 1-deep pipelined — async engine
+        dispatch with next-cycle host work overlapped against the
+        in-flight call; depth 0 is the strictly alternating host/device
+        loop. Bindings are bit-identical between the two for the same
+        arrival order (PARITY.md)."""
+        if self.config.pipeline_depth > 0:
+            return self._run_cycle_pipelined()
+        return self._run_cycle_serial()
+
+    def _run_cycle_serial(self) -> CycleMetrics:
         m = CycleMetrics()
         t0 = time.perf_counter()
+        start = self._begin_cycle(m, t0)
+        if start is None:
+            return m
+        self._run_paths(start, m)
+        self._finish_cycle(start, m, t0)
+        return m
+
+    def _window_cap(self) -> int:
+        return self.config.batch_window * (
+            max(1, self.config.max_windows_per_cycle)
+            if self._engine_windows_ok
+            else 1
+        )
+
+    def _begin_cycle(
+        self, m: CycleMetrics, t0: float, window: list | None = None,
+    ) -> _CycleStart | None:
+        """Cycle front-end shared by the serial and pipelined drivers:
+        pop (or adopt a prefetched) window, fetch cluster state, apply
+        the ReadWriteOncePod filter and nomination reservations, and
+        decide the path. Returns None after finishing the cycle itself
+        on the terminal paths (empty window, fetch failure, everything
+        filtered)."""
         self._cycle_unsched = []
         self._cycle_bound = []
-        window = self.queue.pop_window(
-            self.config.batch_window
-            * (
-                max(1, self.config.max_windows_per_cycle)
-                if self._engine_windows_ok
-                else 1
-            )
-        )
+        if window is None:
+            window = self.queue.pop_window(self._window_cap())
         m.pods_in = len(window)
         if not window:
             # empty cycles (backoff waits, idle polls) are not recorded:
             # a serve-forever loop would otherwise grow self.metrics
             # without bound on pure idle time
             m.cycle_seconds = time.perf_counter() - t0
-            return m
+            return None
 
         try:
             nodes = self.list_nodes()
@@ -348,7 +419,7 @@ class Scheduler:
             m.fetch_failed = True
             m.cycle_seconds = time.perf_counter() - t0
             self._record(m)
-            return m
+            return None
 
         # VolumeRestrictions (ReadWriteOncePod): at most one pod
         # cluster-wide may use an exclusive claim. Enforced HERE, against
@@ -377,7 +448,7 @@ class Scheduler:
             if not window:
                 m.cycle_seconds = time.perf_counter() - t0
                 self._record(m)
-                return m
+                return None
 
         # nominated-capacity reservations (upstream nominatedNodeName):
         # a preemptor whose victims were evicted holds its nominated
@@ -422,6 +493,26 @@ class Scheduler:
         backlog = (
             len(window) > self.config.batch_window and self._engine_windows_ok
         )
+        return _CycleStart(
+            window=window, nodes=nodes, running=running, utils=utils,
+            eph_running=eph_running, scalar_eligible=scalar_eligible,
+            use_device=use_device, backlog=backlog, cells=cells,
+            t_path=t_path,
+        )
+
+    def _run_paths(self, start: _CycleStart, m: CycleMetrics) -> None:
+        """Serial path dispatch: device (single-window or backlog) with
+        scalar fallback, or the scalar path outright — plus the adaptive
+        crossover observations."""
+        window, nodes, running, utils = (
+            start.window, start.nodes, start.running, start.utils,
+        )
+        eph_running = start.eph_running
+        scalar_eligible = start.scalar_eligible
+        use_device = start.use_device
+        backlog = start.backlog
+        cells = start.cells
+        t_path = start.t_path
         if self.config.feature_gates.tpu_batch_score and nodes and use_device:
             try:
                 # deep backlog: schedule all popped windows in ONE engine
@@ -513,6 +604,9 @@ class Scheduler:
                     False, cells, time.perf_counter() - t_path
                 )
 
+    def _finish_cycle(
+        self, start: _CycleStart, m: CycleMetrics, t0: float
+    ) -> None:
         # successful binds clear their retry counters in ONE batch (the
         # native path pays one foreign call instead of one per bind);
         # the 404/409 drop path inside _bind still marks immediately
@@ -522,7 +616,10 @@ class Scheduler:
         # PostFilter parity: unschedulable pods may preempt strictly-
         # lower-priority running pods (ops/preempt.py). A failure here
         # must never lose the cycle's bindings — preemptors are already
-        # requeued and simply retry without preemption next cycle.
+        # requeued and simply retry without preemption next cycle. On
+        # the pipelined driver this runs in the COMPLETION stage, after
+        # the engine result was forced and this cycle's binds applied —
+        # preemption always sees real, never speculative, capacity.
         if (
             self._cycle_unsched
             and self.evictor is not None
@@ -530,15 +627,284 @@ class Scheduler:
         ):
             try:
                 self._run_preemption(
-                    self._cycle_unsched, nodes, running, utils, m,
-                    ephemeral=eph_running,
+                    self._cycle_unsched, start.nodes, start.running,
+                    start.utils, m, ephemeral=start.eph_running,
                 )
             except Exception:
                 log.exception("preemption pass failed; retrying next cycle")
 
         m.cycle_seconds = time.perf_counter() - t0
         self._record(m)
+
+    # ---- pipelined loop (config.pipeline_depth >= 1) -------------------
+
+    def _run_cycle_pipelined(self) -> CycleMetrics:
+        """One cycle of the 1-deep pipeline: dispatch this cycle's
+        engine call asynchronously, do next-cycle host work (window pop,
+        record warming, speculative pod-batch build) while it is in
+        flight, then force, validate, and bind. Non-device paths
+        (scalar, deep backlog, fetch failure) run the serial back-end
+        unchanged and flush any speculative state; an engine failure
+        mid-flight drains the pipeline and falls back to scalar for this
+        window exactly once; the preemption pass runs in the completion
+        stage against real — never speculative — capacity."""
+        m = CycleMetrics()
+        t0 = time.perf_counter()
+        start = self._begin_cycle(m, t0, window=self._take_prefetched())
+        if start is None:
+            return m
+        if not (
+            self.config.feature_gates.tpu_batch_score
+            and start.nodes
+            and start.use_device
+            and not start.backlog
+        ):
+            # scalar and multi-window backlog cycles keep their serial
+            # semantics; speculative state never survives into them
+            self._discard_speculative(m)
+            self._run_paths(start, m)
+            self._finish_cycle(start, m, t0)
+            return m
+        try:
+            infl = self._dispatch_window(
+                start.window, start.nodes, start.running, start.utils, m,
+                ephemeral=start.eph_running, use_async=True,
+            )
+        except Exception:
+            log.exception(
+                "engine dispatch failed; falling back to scalar path "
+                "(policy=%r; unsupported policies degrade to the yoda "
+                "formula and bump fallback_policy_mismatch)",
+                self.config.policy,
+            )
+            m.used_fallback = True
+            self._discard_speculative(m)
+            self._run_scalar(
+                start.window, start.nodes, start.running, start.utils, m
+            )
+            self._observe_dispatch(start, m)
+            self._finish_cycle(start, m, t0)
+            return m
+        # overlap: next-cycle host work while the engine runs — this is
+        # the serialized host time the strictly alternating loop paid
+        # on the critical path (BENCH_r05: ~65 ms of a 168 ms cycle)
+        t_prep = time.perf_counter()
+        self._prefetch_next()
+        m.host_overlap_seconds = time.perf_counter() - t_prep
+        try:
+            self._complete_window(
+                infl, start.window, start.nodes, m,
+                ephemeral=start.eph_running,
+            )
+            self._observe_dispatch(start, m)
+        except Exception:
+            log.exception(
+                "engine cycle failed; draining pipeline and falling back "
+                "to scalar path (policy=%r; unsupported policies degrade "
+                "to the yoda formula and bump fallback_policy_mismatch)",
+                self.config.policy,
+            )
+            m.used_fallback = True
+            self._discard_speculative(m)
+            self._run_scalar(
+                start.window, start.nodes, start.running, start.utils, m
+            )
+            # failed device cycle priced at FULL cost — same rationale
+            # as the serial fallback's observation
+            self._observe_dispatch(start, m)
+        self._finish_cycle(start, m, t0)
         return m
+
+    def _observe_dispatch(self, start: _CycleStart, m: CycleMetrics) -> None:
+        """Adaptive-crossover observation for a pipelined device cycle
+        (single-window by construction; the serial back-end keeps its
+        own inline observations)."""
+        if self._dispatch is not None and start.scalar_eligible:
+            self._dispatch.observe(
+                True, start.cells, time.perf_counter() - start.t_path
+            )
+
+    def _layout_fingerprint(self) -> tuple:
+        """Everything a prebuilt PodBatch depends on besides the window
+        itself: column layout, selector-table size, node set (target_node
+        indices), port mapping, image vocabulary. The speculative batch
+        built while the engine is in flight is used at dispatch time only
+        if this fingerprint still matches — an informer event in between
+        (node add/remove, selector-minting churn) discards it, forcing a
+        serial rebuild so a stale snapshot is never scored."""
+        b = self.builder
+        sc = b.__dict__.get("_node_static")
+        return (
+            b.resource_names_tuple(),
+            len(b.selectors),
+            sc["ids"] if sc is not None else None,
+            tuple(sorted(b._port_index.items())),
+            len(b.images),
+        )
+
+    def _take_prefetched(self) -> list[Pod] | None:
+        w = self._prefetched
+        self._prefetched = None
+        return w
+
+    def _discard_speculative(self, m: CycleMetrics) -> None:
+        """Flush the speculative pod batch (never the prefetched WINDOW
+        — those are real popped pods and dispatch next cycle on whatever
+        path then applies)."""
+        if self._spec_batch is not None:
+            self._spec_batch = None
+            m.pipeline_flushes += 1
+
+    def drain_pipeline(self) -> None:
+        """Hand a prefetched-but-undispatched window back to the queue
+        (front, exact order on the Python queue) and drop speculative
+        state. Call when abandoning the scheduler mid-backlog so
+        len(queue) reflects reality and a restart reschedules the pods;
+        run_cycle/run_until_empty drain naturally otherwise."""
+        self._spec_batch = None
+        w = self._prefetched
+        self._prefetched = None
+        if w:
+            self.queue.restore_window(w)
+
+    def _prefetch_next(self) -> None:
+        """Host work overlapped with the in-flight engine call: pop the
+        next window, warm its per-pod records/flags, and pre-build its
+        pod batch. The batch is speculative — kept at dispatch time only
+        if the layout fingerprint still matches.
+
+        Skipped entirely at zero backoff: a requeue from THIS cycle
+        could then legally re-enter the very next window, and a
+        prefetched pop would misorder it against serial mode (with the
+        default >= 1 s backoff, a requeued pod is never ready within one
+        cycle's flight time)."""
+        if self._prefetched is not None:
+            return
+        if self.config.initial_backoff_seconds <= 0:
+            return
+        window = self.queue.pop_window(self._window_cap())
+        if not window:
+            return
+        self._prefetched = window
+        if len(window) > self.config.batch_window:
+            return  # backlog windows take the serial multi-window path
+        try:
+            self._window_flags(window)  # warms records + the flag cache
+            batch = self.builder.build_pod_batch(
+                window, recs=self._window_recs(window)
+            )
+            fp = self._layout_fingerprint()
+        except Exception:
+            # e.g. a hostPort outside the table (build_snapshot has not
+            # seen this window yet): the serial build at dispatch time
+            # surfaces it inside the cycle's normal error handling
+            log.debug("speculative pod-batch build failed; will rebuild")
+            return
+        self._spec_batch = (window, fp, batch)
+
+    def _dispatch_window(
+        self, window, nodes, running, utils, m: CycleMetrics,
+        *, ephemeral: bool, use_async: bool,
+    ) -> _InFlight:
+        """Build the snapshot, adopt or rebuild the pod batch, dispatch
+        the engine — ONE implementation for the serial path (use_async=
+        False: synchronous call, forced in _complete_window right after)
+        and the pipelined path (use_async=True: the call goes out
+        unforced so host work can overlap it).
+
+        Snapshot FIRST: build_snapshot registers every selector the
+        cycle needs — the window's terms AND running pods' anti terms
+        (reverse anti-affinity) — so build_pod_batch computes
+        pod_matches against the complete table. Reversed, a selector
+        first introduced by a running avoider would be missing from
+        pod_matches and the reverse check would silently pass. (The
+        speculative prebuild respects this through the layout
+        fingerprint: a selector minted between prebuild and here
+        discards the prebuilt batch.)"""
+        snapshot = self.builder.build_snapshot(
+            nodes, utils, running, pending_pods=window,
+            ephemeral=ephemeral,
+            pending_all_plain=self._window_flags(window)[0],
+        )
+        pods_batch = None
+        spec = self._spec_batch
+        if spec is not None and spec[0] is window:
+            self._spec_batch = None
+            if spec[1] == self._layout_fingerprint():
+                pods_batch = spec[2]
+            else:
+                # informer/selector churn since the prebuild: the batch
+                # could carry stale selector ids, node indices, or port
+                # columns — never score it
+                m.pipeline_flushes += 1
+        if pods_batch is None:
+            pods_batch = self.builder.build_pod_batch(
+                window, recs=self._window_recs(window)
+            )
+        kw = self._engine_options(
+            window, nodes, running, pods_batch, snapshot,
+            record=not ephemeral,
+        )
+        t_eng = time.perf_counter()
+        submit = (
+            getattr(self.engine, "schedule_batch_async", None)
+            if use_async
+            else None
+        )
+        if submit is not None:
+            handle = submit(snapshot, pods_batch, **kw)
+        else:
+            # serial mode, and engines without the async surface:
+            # synchronous dispatch (the pipeline still interleaves
+            # correctly around it, with no overlap)
+            from kubernetes_scheduler_tpu.engine import PendingSchedule
+
+            handle = PendingSchedule(
+                self.engine.schedule_batch(snapshot, pods_batch, **kw)
+            )
+        return _InFlight(handle=handle, pods_batch=pods_batch, t_eng=t_eng)
+
+    def _complete_window(
+        self, infl: _InFlight, window, nodes, m: CycleMetrics,
+        *, ephemeral: bool,
+    ) -> None:
+        """Force the (possibly in-flight) result, validate (BEFORE any
+        bind, so the scalar fallback re-schedules the window exactly
+        once), apply assignments, and fold the binds into the snapshot
+        accumulator. Shared by the serial and pipelined paths — the
+        validation and bind semantics cannot drift between them."""
+        res = infl.handle.result()
+        idx = np.asarray(res.node_idx)
+        m.engine_seconds += time.perf_counter() - infl.t_eng
+        p_padded = int(np.asarray(infl.pods_batch.request).shape[0])
+        if (
+            idx.shape != (p_padded,)
+            or p_padded < len(window)
+            or (idx[: len(window)] >= len(nodes)).any()
+        ):
+            raise RuntimeError(
+                f"engine returned node_idx shape {idx.shape} (max "
+                f"{idx.max() if idx.size else 'n/a'}) for a {len(window)}-pod "
+                f"window padded to {p_padded} over {len(nodes)} nodes"
+            )
+        pre = len(self._cycle_bound)
+        self._apply_assignments(window, nodes, idx, m)
+        bound = self._cycle_bound[pre:]
+        if bound and not ephemeral:
+            # incremental snapshot carry: fold this cycle's binds into
+            # the builder's accumulated `requested` matrix now (one
+            # vectorized scatter-add), so the next dispatch's build
+            # skips re-walking them when the informer appends these pods
+            try:
+                pos = {id(pod): i for i, pod in enumerate(window)}
+                rows = [pos[id(pod)] for pod in bound]
+                self.builder.apply_assignment_deltas(
+                    bound, idx[rows], np.asarray(infl.pods_batch.request)[rows]
+                )
+            except Exception:
+                # the delta is an optimization: on any surprise the next
+                # build's suffix scan recomputes from scratch
+                log.exception("assignment-delta fold failed; next build rescans")
 
     def _pdb_expected_count(self, matching: list[Pod]) -> int | None:
         """The upstream disruption controller's expected count for
@@ -1109,41 +1475,15 @@ class Scheduler:
         self, window, nodes, running, utils, m: CycleMetrics,
         *, ephemeral: bool = False,
     ):
-        # snapshot FIRST: build_snapshot registers every selector the cycle
-        # needs — the window's terms AND running pods' anti terms (reverse
-        # anti-affinity) — so build_pod_batch computes pod_matches against
-        # the complete table. Reversed, a selector first introduced by a
-        # running avoider would be missing from pod_matches and the reverse
-        # check would silently pass.
-        snapshot = self.builder.build_snapshot(
-            nodes, utils, running, pending_pods=window, ephemeral=ephemeral,
-            pending_all_plain=self._window_flags(window)[0],
+        """Serial single-window device cycle: the same dispatch/complete
+        pair the pipelined driver uses, back to back — one
+        implementation of snapshot ordering, engine-result validation,
+        and bind application, so the two modes cannot drift."""
+        infl = self._dispatch_window(
+            window, nodes, running, utils, m,
+            ephemeral=ephemeral, use_async=False,
         )
-        pods_batch = self.builder.build_pod_batch(
-            window, recs=self._window_recs(window)
-        )
-        kw = self._engine_options(
-            window, nodes, running, pods_batch, snapshot,
-            record=not ephemeral,
-        )
-        t0 = time.perf_counter()
-        res = self.engine.schedule_batch(snapshot, pods_batch, **kw)
-        idx = np.asarray(res.node_idx)
-        m.engine_seconds += time.perf_counter() - t0
-        p_padded = int(np.asarray(pods_batch.request).shape[0])
-        if (
-            idx.shape != (p_padded,)
-            or p_padded < len(window)
-            or (idx[: len(window)] >= len(nodes)).any()
-        ):
-            # a version-skewed remote engine must fail BEFORE any bind, so
-            # the fallback re-schedules the window exactly once
-            raise RuntimeError(
-                f"engine returned node_idx shape {idx.shape} (max "
-                f"{idx.max() if idx.size else 'n/a'}) for a {len(window)}-pod "
-                f"window padded to {p_padded} over {len(nodes)} nodes"
-            )
-        self._apply_assignments(window, nodes, idx, m)
+        self._complete_window(infl, window, nodes, m, ephemeral=ephemeral)
 
     def _run_scalar(self, window, nodes, running, utils, m: CycleMetrics):
         from kubernetes_scheduler_tpu.host.plugins import SCALAR_POLICIES
@@ -1271,7 +1611,10 @@ class Scheduler:
     def run_until_empty(self, *, max_cycles: int = 1000) -> list[CycleMetrics]:
         out = []
         for _ in range(max_cycles):
-            if len(self.queue) == 0:
+            # a prefetched window lives outside the queue (popped while
+            # the previous engine call was in flight) — the drain is not
+            # done until it has been dispatched too
+            if len(self.queue) == 0 and self._prefetched is None:
                 break
             out.append(self.run_cycle())
         return out

@@ -631,6 +631,7 @@ class SnapshotBuilder:
         pods_col = names.index("pods")
         acc = self.__dict__.get("_acc_cache")
         start = 0
+        use_acc = False
         if (
             acc is not None
             and acc["names_t"] is names_t
@@ -641,7 +642,34 @@ class SnapshotBuilder:
             and acc["port_index"] == self._port_index
         ):
             start = suffix_start(acc["prefix"], running_pods)
-        if start:
+            use_acc = start > 0
+            pending = acc.get("pending")
+            if pending is not None:
+                # apply_assignment_deltas (pipelined loop) pre-summed
+                # these binds into the retained matrix; trust it ONLY if
+                # the informer appended exactly those pod objects right
+                # after the recorded prefix. Any other churn means the
+                # matrix holds contributions for pods not in the list —
+                # rebuild from zeros, never serve a stale delta.
+                k = len(pending)
+                prefix_valid = start > 0 or (
+                    acc["prefix"][1] == 0
+                    and acc["prefix"][0] is running_pods
+                )
+                if (
+                    prefix_valid
+                    and len(running_pods) >= start + k
+                    and all(
+                        running_pods[start + i] is pending[i]
+                        for i in range(k)
+                    )
+                ):
+                    start += k
+                    use_acc = True
+                else:
+                    start = 0
+                    use_acc = False
+        if use_acc:
             requested = acc["requested"].copy()
         else:
             requested = np.zeros((n, r), np.float32)
@@ -708,6 +736,59 @@ class SnapshotBuilder:
             pref_attract=pref_attract, pref_avoid=pref_avoid,
             image_scaled=image_scaled,
         )
+
+    def apply_assignment_deltas(
+        self, bound_pods: list[Pod], node_rows, request_rows
+    ) -> bool:
+        """Incremental snapshot carry for the pipelined host loop: fold
+        a cycle's successful binds into the retained accumulated
+        `requested` matrix in place — one vectorized scatter-add of the
+        dispatched PodBatch's dense request rows (which already carry
+        the pods column and the hostPort columns, exactly the suffix
+        scan's contribution) — so the NEXT build_snapshot skips
+        re-walking them when the informer appends exactly these pod
+        objects to the running list.
+
+        Returns False (accumulator untouched) when nothing is retained
+        or the layout moved underneath: column set, node set, or port
+        mapping changed, or a previous delta is still unconfirmed. The
+        next build then does the ordinary suffix scan. The anticipated
+        suffix is verified by identity at the next build (see the
+        `pending` check there): any informer event that breaks it —
+        node add/remove rebuilds node_index, running-set churn fails
+        the suffix identity check, an advisor refresh only touches the
+        per-cycle utilization series which are rebuilt every build
+        anyway — forces the full re-accumulation, so a stale delta is
+        never silently trusted."""
+        acc = self.__dict__.get("_acc_cache")
+        if acc is None or not bound_pods:
+            return False
+        # hostPort-bearing pods take the suffix scan: the dense batch
+        # SETS a port cell to 1 where the scan INCREMENTS per host_ports
+        # entry, so a duplicated port (TCP+UDP on one number) would
+        # diverge between the delta and a full rebuild — and these pods
+        # are rare enough that the rescan costs nothing
+        for pd in bound_pods:
+            fl = pd.__dict__.get("_flags_cache")
+            if (fl is None or not fl & FLAG_PLAIN) and pd.host_ports:
+                return False
+        req = acc["requested"]
+        rows = np.asarray(node_rows, np.int64).reshape(-1)
+        mat = np.asarray(request_rows, np.float32)
+        if (
+            acc["names_t"] is not self.resource_names_tuple()
+            or acc["node_index"] is not self._node_index
+            or acc["port_index"] != self._port_index
+            or acc.get("pending") is not None
+            or mat.shape != (len(bound_pods), req.shape[1])
+            or rows.shape != (len(bound_pods),)
+            or bool((rows < 0).any())
+            or bool((rows >= req.shape[0]).any())
+        ):
+            return False
+        np.add.at(req, rows, mat)
+        acc["pending"] = list(bound_pods)
+        return True
 
     def _selector_id(self, term) -> int:
         """Selector identity = (matchLabels, matchExpressions, topology

@@ -1003,7 +1003,14 @@ def run_kube_loop(
                     was_leader = False
                 # drain anything queued before leadership was lost and
                 # forget it (the feeder is gated while standby; promotion
-                # re-submits from the server's pending set)
+                # re-submits from the server's pending set). A pipelined
+                # scheduler may hold a prefetched window OUTSIDE the
+                # queue — restore it first so the same drain covers it
+                # (a stale window surviving standby would be scheduled
+                # on re-promotion, double-binding pods the new leader
+                # already placed)
+                if hasattr(sched, "drain_pipeline"):
+                    sched.drain_pipeline()
                 for pod in sched.queue.pop_window(1 << 20):
                     feeder.discard(pod_key(pod))
                 time.sleep(idle_sleep)
@@ -1011,7 +1018,13 @@ def run_kube_loop(
             if not was_leader:
                 log.info("leadership (re)gained; resuming scheduling")
                 was_leader = True
-            if len(sched.queue) == 0:
+            # a pipelined scheduler's prefetched window counts as queued
+            # work: parking on the feeder with it in hand would strand
+            # real popped pods until an unrelated arrival
+            if (
+                len(sched.queue) == 0
+                and getattr(sched, "_prefetched", None) is None
+            ):
                 if exit_when_idle and feeder.idle_rounds >= 1:
                     return cycles
                 feeder.wake.wait(timeout=idle_sleep)
@@ -1040,4 +1053,10 @@ def run_kube_loop(
                 feeder.wake.clear()
     finally:
         feeder.stop_evt.set()
+        # any exit (stop(), max_cycles) with a prefetched window in hand
+        # returns it to the queue, so len(queue) reflects reality and a
+        # restarted loop (or a promoted replica sharing the scheduler)
+        # reschedules the pods instead of stranding them
+        if hasattr(sched, "drain_pipeline"):
+            sched.drain_pipeline()
     return cycles

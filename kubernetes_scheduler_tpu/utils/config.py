@@ -127,6 +127,18 @@ class SchedulerConfig:
     # remote sidecars see the biggest gains, colocated engines pay ~ms
     # round-trips and gain little.
     max_windows_per_cycle: int = 8
+    # pipelined host loop (host/scheduler.py): with depth 1 the cycle
+    # dispatches the engine asynchronously and overlaps the wait with
+    # next-cycle host work (queue pop, pod-batch build, record warming),
+    # folding this cycle's binds into the snapshot accumulator with
+    # SnapshotBuilder.apply_assignment_deltas instead of a full rebuild.
+    # 0 restores the strictly alternating host/device loop. Bindings are
+    # bit-identical to serial mode for the same arrival order; pods that
+    # become ready mid-flight (backoff expiry, informer submits) join
+    # the NEXT dispatch instead of the prefetched window. Values > 1
+    # behave as 1 (the pipeline is one deep — a deeper pipeline would
+    # score stale capacity).
+    pipeline_depth: int = 0
     # preemption (upstream PostFilter parity, ops/preempt.py): when a pod
     # fits nowhere, evict <= preemption_max_victims strictly-lower-
     # priority pods from the least-disruptive node. Requires an evictor

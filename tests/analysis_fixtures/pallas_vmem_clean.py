@@ -1,0 +1,36 @@
+"""graftlint fixture: clean Pallas kernel (never imported, only parsed).
+
+Mirrors the real ops/pallas_fused.py shape: 128-aligned lane tiles,
+blocks well under the VMEM budget, f32 accumulation, no host effects;
+runtime-valued leading dims (n_res) are legitimately unresolvable and
+must not be flagged."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+TILE_P = 256
+TILE_N = 1024
+
+
+def _clean_kernel(x_ref, y_ref, out_ref, *, n_res: int):
+    acc = jnp.zeros((TILE_P, TILE_N), jnp.float32)
+    for i in range(n_res):
+        acc = acc + x_ref[i, :][:, None] * y_ref[i, :][None, :]
+    out_ref[...] = acc
+
+
+def clean_call(x, y, tile_p: int = TILE_P, tile_n: int = TILE_N):
+    n_res = x.shape[0]
+    return pl.pallas_call(
+        functools.partial(_clean_kernel, n_res=n_res),
+        out_shape=jax.ShapeDtypeStruct((x.shape[1], y.shape[1]), jnp.float32),
+        grid=(x.shape[1] // tile_p, y.shape[1] // tile_n),
+        in_specs=[
+            pl.BlockSpec((n_res, tile_p), lambda i, j: (0, i)),
+            pl.BlockSpec((n_res, tile_n), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((tile_p, tile_n), lambda i, j: (i, j)),
+    )(x, y)

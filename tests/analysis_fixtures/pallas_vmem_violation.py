@@ -1,0 +1,34 @@
+"""graftlint fixture: pallas-vmem violations (never imported, only parsed)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+TILE_P = 256
+BAD_TILE = 100      # not a multiple of 128
+HUGE_TILE = 4096
+
+
+def _bad_kernel(x_ref, y_ref, out_ref):
+    jax.debug.print("x = {}", x_ref[0, 0])  # LINE 16: host callback in body
+    print("tracing")                        # LINE 17: host callback in body
+    acc = jnp.zeros((8, 128), dtype=jnp.bfloat16)  # LINE 18: bf16 accumulator
+    acc = acc + x_ref[...].astype("bfloat16")      # LINE 19: bf16 accumulate
+    out_ref[...] = (acc + y_ref[...]).astype(jnp.float32)
+
+
+def bad_call(x, y):
+    return pl.pallas_call(
+        functools.partial(_bad_kernel),
+        out_shape=jax.ShapeDtypeStruct(x.shape, jnp.float32),
+        grid=(x.shape[0] // TILE_P, 1),
+        in_specs=[
+            # LINE 29: minor axis 100 does not divide the lane padding
+            pl.BlockSpec((TILE_P, BAD_TILE), lambda i, j: (i, j)),
+            # LINE 31: 4096 x 4096 x 4B = 64 MB >> VMEM
+            pl.BlockSpec((HUGE_TILE, HUGE_TILE), lambda i, j: (i, j)),
+        ],
+        out_specs=pl.BlockSpec((TILE_P, 128), lambda i, j: (i, j)),
+    )(x, y)

@@ -44,6 +44,12 @@ def active(violations):
         ),
         ("dtype-shape", "dtype_shape_violation.py", "dtype_shape_clean.py", 3),
         ("timeout-hygiene", "timeout_violation.py", "timeout_clean.py", 5),
+        (
+            "pallas-vmem",
+            "pallas_vmem_violation.py",
+            "pallas_vmem_clean.py",
+            4,
+        ),
     ],
 )
 def test_rule_fires_and_stays_quiet(rule, violating, clean, min_hits):
@@ -109,6 +115,27 @@ def test_dtype_shape_allows_static_shape_branching():
     assert any("float64 dtype" in m for m in msgs)
     assert any("astype" in m for m in msgs)
     assert any("any" in m for m in msgs)
+
+
+def test_pallas_vmem_covers_all_three_families():
+    """The rule family's three checks each fire — tiling (a block that
+    cannot divide the lane-padded axis), the VMEM budget, reduced-
+    precision accumulators, and host callbacks — and runtime-valued dims
+    (the clean fixture's n_res) are skipped, not guessed."""
+    msgs = [
+        v.message
+        for v in active(lint_fixture("pallas_vmem_violation.py", "pallas-vmem"))
+    ]
+    assert any("multiple of 128" in m for m in msgs)
+    assert any("VMEM budget" in m for m in msgs)
+    assert any("accumulate in f32" in m for m in msgs)
+    assert any("host callback" in m for m in msgs)
+    # the real fused kernel stays clean (what `make lint` enforces)
+    real = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "kubernetes_scheduler_tpu", "ops", "pallas_fused.py",
+    )
+    assert active(run_lint([real], rules=["pallas-vmem"])) == []
 
 
 def test_real_schedule_proto_parses():

@@ -1,0 +1,61 @@
+"""Bench-path smoke: bench.py end-to-end at toy sizes (slow-marked).
+
+The benchmark is the repo's round-over-round evidence artifact; nothing
+else imports it, so a refactor can silently rot it between rounds. This
+drives the FULL default flow — engine headline, deployed-default and
+weighted-multi-scorer measurements, the host loop including the
+pipelined variant — as one subprocess with tiny BENCH_* knobs (the
+`make bench-smoke` invocation), and asserts every expected metric line
+comes back as parseable JSON."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SMOKE_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "BENCH_NODES": "64",
+    "BENCH_PODS": "128",
+    "BENCH_WINDOW": "32",
+    "BENCH_REPS": "2",
+    "BENCH_BASELINE_PODS": "8",
+    "BENCH_LOOP_NODES": "32",
+    "BENCH_LOOP_PODS": "64",
+}
+
+
+def test_bench_smoke_e2e():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True,
+        text=True,
+        timeout=560,
+        cwd=REPO,
+        env={**os.environ, **SMOKE_ENV},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:] + proc.stdout[-500:]
+    records = [
+        json.loads(line)
+        for line in proc.stdout.splitlines()
+        if line.startswith("{")
+    ]
+    assert not any("diag" in r and "failed" in r["diag"] for r in records), records
+    metrics = {r["metric"]: r for r in records if "metric" in r}
+    for want in (
+        "scheduling_throughput_64nodes",
+        "scheduling_throughput_64nodes_deployed_default",
+        "scheduling_throughput_64nodes_weighted_multi_scorer",
+        "host_loop_32nodes",
+        "host_loop_32nodes_deep16w",
+        "host_loop_32nodes_pipelined",
+    ):
+        assert want in metrics, (want, sorted(metrics))
+    for name in ("host_loop_32nodes", "host_loop_32nodes_pipelined"):
+        assert metrics[name]["pods_bound"] > 0, metrics[name]
+        assert metrics[name]["cycle_p50_ms"] > 0, metrics[name]
+    # the pipelined loop reports its observability companions
+    assert "host_overlap_p50_ms" in metrics["host_loop_32nodes_pipelined"]
+    assert "pipeline_flushes" in metrics["host_loop_32nodes_pipelined"]

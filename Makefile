@@ -41,9 +41,10 @@ bench:
 	$(PY) bench.py
 
 # the bench path itself must not rot between rounds: the full bench.py
-# flow (engine headline, host loop incl. the pipelined variant, weighted
-# multi-scorer) at toy sizes on CPU — seconds of compute, all compiles.
-# Same invocation tests/test_bench_smoke.py wraps as a slow-marked test.
+# flow (engine headline, host loop incl. the pipelined and resident-
+# state/delta-upload variants, weighted multi-scorer) at toy sizes on
+# CPU — seconds of compute, all compiles. Same invocation
+# tests/test_bench_smoke.py wraps as a slow-marked test.
 bench-smoke:
 	env JAX_PLATFORMS=cpu BENCH_NODES=64 BENCH_PODS=128 BENCH_WINDOW=32 \
 	  BENCH_REPS=2 BENCH_BASELINE_PODS=8 BENCH_LOOP_NODES=32 \

@@ -327,12 +327,33 @@ def _pipelined_loop_rate() -> dict:
     )
 
 
+def _resident_loop_rate() -> dict:
+    """The resident-state host-loop metric (host_loop_*_resident): the
+    pipelined shape with config.resident_state on — after the first full
+    upload per bucket shape the engine retains the snapshot on device
+    and cycles ship SnapshotDeltas applied by the jitted donated-buffer
+    scatter. Reported beside host_loop_* / host_loop_*_pipelined with
+    the delta hit rate and the snapshot payload actually shipped, so the
+    upload win is measurable in-data (the acceptance gate: >= 15% more
+    pods/s or >= 20% lower cycle p50 than the serial metric, with
+    fallback_cycles 0 and PARITY-pinned identical bindings)."""
+    return loop_rate(
+        n_pods=int(os.environ.get("BENCH_LOOP_PODS", 1024 * DEFAULT_LOOP_WINDOWS)),
+        max_windows=1,
+        pipeline_depth=1,
+        force_device=True,
+        resident=True,
+        metric_suffix="_resident",
+    )
+
+
 def loop_rate(
     *,
     n_pods: int | None = None,
     max_windows: int = DEFAULT_LOOP_WINDOWS,
     pipeline_depth: int = 0,
     force_device: bool = False,
+    resident: bool = False,
     metric_suffix: str = "",
 ) -> dict:
     """END-TO-END host loop at the north-star scale: queue pop -> snapshot
@@ -387,6 +408,7 @@ def loop_rate(
             normalizer="none",
             max_windows_per_cycle=max_windows,
             pipeline_depth=pipeline_depth,
+            resident_state=resident,
             **(
                 {"adaptive_dispatch": False, "min_device_work": 1}
                 if force_device
@@ -433,7 +455,7 @@ def loop_rate(
         for c in cycles
         if c.cycle_seconds > 0
     ]
-    return {
+    out = {
         "metric": f"host_loop_{n_nodes}nodes{metric_suffix}",
         "cycles": len(cycles),
         "pods_bound": bound,
@@ -462,6 +484,29 @@ def loop_rate(
         ),
         "pipeline_flushes": int(sum(c.pipeline_flushes for c in cycles)),
     }
+    if resident:
+        # resident-state observability: delta hit rate and the snapshot
+        # payload actually shipped. snapshot_upload_bytes is the full
+        # per-cycle payload MINUS what the deltas avoided — measured
+        # against the same cycles, so the win is in-data, not inferred.
+        from kubernetes_scheduler_tpu.engine import snapshot_nbytes
+
+        deltas = int(sum(c.delta_uploads for c in cycles))
+        fulls = int(sum(c.full_uploads for c in cycles))
+        saved = int(sum(c.delta_bytes_saved for c in cycles))
+        snap_bytes = snapshot_nbytes(
+            sched.builder.build_snapshot(
+                nodes, sched.advisor.fetch(), running, ephemeral=True
+            )
+        )
+        out.update(
+            delta_uploads=deltas,
+            full_uploads=fulls,
+            delta_hit_rate=round(deltas / max(deltas + fulls, 1), 4),
+            delta_bytes_saved=saved,
+            snapshot_upload_bytes=(deltas + fulls) * snap_bytes - saved,
+        )
+    return out
 
 
 _PROBE_SRC = (
@@ -538,6 +583,7 @@ def main():
         print(json.dumps(loop_rate()))
         print(json.dumps(loop_rate(max_windows=16, metric_suffix="_deep16w")))
         print(json.dumps(_pipelined_loop_rate()))
+        print(json.dumps(_resident_loop_rate()))
         return
     if "--suite" in sys.argv:
         from kubernetes_scheduler_tpu.sim.cluster_gen import BENCH_CONFIGS
@@ -594,6 +640,9 @@ def main():
         # the double-buffered loop beside the serial one: BENCH_r06's
         # before/after for the pipelined host-loop change
         print(json.dumps(_pipelined_loop_rate()), flush=True)
+        # device-resident cluster state with epoch-validated delta
+        # uploads, measured against the same cluster/backlog shape
+        print(json.dumps(_resident_loop_rate()), flush=True)
     except Exception as e:  # pragma: no cover - diagnostic path
         print(json.dumps({"diag": "host_loop_failed", "error": str(e)[-200:]}),
               flush=True)

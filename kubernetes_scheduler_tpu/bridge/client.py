@@ -102,25 +102,64 @@ class RemoteEngine:
         self._session_id = uuid.uuid4().hex
         self._wire_cache: dict[str, dict] = {}
         self._field_cache_ok: bool | None = None
+        # resident-cluster-state capability (HealthReply.resident_state),
+        # latched like the field cache and INVALIDATED TOGETHER with it:
+        # a mid-stream downgrade (sidecar replaced by an older build)
+        # otherwise leaves one latch trusting a dead sidecar's
+        # advertisement while the other re-probes
+        self._resident_cap: bool | None = None
+        # did the LAST schedule_resident call apply a delta server-side?
+        # (mirrors LocalEngine.resident_used_delta for the host's
+        # delta/full upload metrics)
+        self.resident_used_delta = False
         # lazy single-worker pool for schedule_batch_async: ONE worker
         # because the wire field cache and capability latch are mutated
         # per call, and the pipelined host forces result() before the
         # next dispatch — at most one RPC is ever in flight per client
         self._async_pool = None
 
+    def _probe_capabilities(self) -> None:
+        """ONE Health RPC resolves BOTH capability latches (field cache
+        and resident state): they ride the same reply, and a down
+        sidecar must not pay the probe timeout once per latch per cycle
+        on the outage path. Only a positive reply resolves them; an
+        unreachable sidecar leaves both unknown to be probed again next
+        call."""
+        info = self.health_info()
+        if info is not None:
+            self._field_cache_ok = bool(info.field_cache)
+            self._resident_cap = bool(info.resident_state)
+
     def _field_cache_enabled(self) -> bool:
         """Resolve the sidecar's field-cache capability ONCE per client
         (older sidecars would read a marker as a malformed empty
         tensor). Called once per schedule call, never inside the
-        per-map packing — a down sidecar must not add health-probe
-        latency twice per cycle on the outage path."""
+        per-map packing."""
         if self._field_cache_ok is None:
-            info = self.health_info()
-            # only a positive health reply resolves it; an unreachable
-            # sidecar stays unknown and is probed again next call
-            if info is not None:
-                self._field_cache_ok = bool(info.field_cache)
+            self._probe_capabilities()
         return bool(self._field_cache_ok)
+
+    def supports_resident(self) -> bool:
+        """Resolve the sidecar's resident-cluster-state capability, once
+        per client (re-probed after any failure — see
+        _invalidate_session). Clients must never send delta uploads to a
+        sidecar that has not advertised HealthReply.resident_state."""
+        if self._resident_cap is None:
+            self._probe_capabilities()
+        return bool(self._resident_cap)
+
+    def _invalidate_session(self) -> None:
+        """Reset everything scoped to the sidecar behind this target: the
+        wire field cache AND both capability latches (field cache,
+        resident state) — always together. A failed send means the
+        sidecar may have been replaced (restart, rollback to an older
+        build): clearing only the field cache would leave the resident
+        latch trusting the dead sidecar's advertisement, so the client
+        would keep shipping deltas an older build cannot parse. The next
+        call re-probes Health and re-learns both capabilities."""
+        self._wire_cache.clear()
+        self._field_cache_ok = None
+        self._resident_cap = None
 
     def _cache_for(self, key: str, enabled: bool):
         if not enabled:
@@ -159,21 +198,16 @@ class RemoteEngine:
                 try:
                     return self._call_with_retry(method, build_request())
                 except Exception:
-                    self._wire_cache.clear()
-                    self._field_cache_ok = None
+                    self._invalidate_session()
                     raise
-            self._wire_cache.clear()
-            self._field_cache_ok = None
+            self._invalidate_session()
             raise
         except Exception:
-            self._wire_cache.clear()
-            self._field_cache_ok = None
+            self._invalidate_session()
             raise
 
-    def schedule_batch(
+    def _base_request(
         self,
-        snapshot,
-        pods,
         *,
         policy: str = "balanced_cpu_diskio",
         assigner: str = "greedy",
@@ -184,7 +218,10 @@ class RemoteEngine:
         auction_price_frac: float = 0.0,
         auction_rounds: int = 0,
         score_plugins: tuple | None = None,
-    ) -> engine.ScheduleResult:
+    ) -> pb.ScheduleRequest:
+        """The option skeleton shared by ScheduleBatch-shaped calls
+        (plain and resident), so the two cannot drift on how cycle
+        options ride the wire."""
         request = pb.ScheduleRequest(
             policy=policy,
             assigner=assigner,
@@ -199,6 +236,13 @@ class RemoteEngine:
             auction_price_frac=auction_price_frac,
             auction_rounds=auction_rounds,
         )
+        for name, weight in score_plugins or ():
+            request.score_plugins.add(name=name, weight=float(weight))
+        return request
+
+    def schedule_batch(self, snapshot, pods, **kw) -> engine.ScheduleResult:
+        request = self._base_request(**kw)
+
         def build_request():
             req = pb.ScheduleRequest()
             req.CopyFrom(request)
@@ -211,10 +255,89 @@ class RemoteEngine:
             codec.pack_fields(pods, req.pods, cache=pods_cache)
             return req
 
-        for name, weight in score_plugins or ():
-            request.score_plugins.add(name=name, weight=float(weight))
         reply = self._call_cached(self._schedule, build_request)
         return self._unpack_result(reply, snapshot, pods)
+
+    def schedule_resident(
+        self, snapshot, pods, *, delta=None, epoch: int = 0, **kw
+    ) -> engine.ScheduleResult:
+        """ScheduleBatch against sidecar-resident cluster state:
+        `snapshot` is always the full host build (the fallback payload);
+        when `delta` is given it ships INSTEAD of the snapshot map and
+        the sidecar applies it to the state retained under this client's
+        session. An inapplicable delta (sidecar restart, session
+        eviction, epoch desync, layout churn) aborts INVALID_ARGUMENT
+        "resident-epoch-mismatch" and this method transparently resends
+        the full snapshot — the cycle never pays a fallback for it. A
+        sidecar that does not advertise the capability is served a plain
+        ScheduleBatch."""
+        if not self.supports_resident():
+            self.resident_used_delta = False
+            return self.schedule_batch(snapshot, pods, **kw)
+        request = self._base_request(**kw)
+
+        def build_request(with_delta: bool):
+            req = pb.ScheduleRequest()
+            req.CopyFrom(request)
+            enabled = self._field_cache_enabled()
+            pods_cache = self._cache_for("batch:pods", enabled)
+            # resident state is session-keyed regardless of the field
+            # cache: the id always rides resident requests
+            req.session_id = self._session_id
+            req.resident_epoch = epoch
+            if with_delta:
+                # the snapshot map stays EMPTY — the sidecar resolves it
+                # from its retained state; only the delta crosses the wire
+                codec.pack_fields(delta, req.snapshot_delta)
+            else:
+                req.resident_full = True
+                snap_cache = self._cache_for("batch:snapshot", enabled)
+                codec.pack_fields(snapshot, req.snapshot, cache=snap_cache)
+            codec.pack_fields(pods, req.pods, cache=pods_cache)
+            return req
+
+        if delta is not None:
+            try:
+                reply = self._call_cached(
+                    self._schedule, lambda: build_request(True)
+                )
+                self.resident_used_delta = True
+                return self._unpack_result(reply, snapshot, pods)
+            except EngineUnavailable as e:
+                cause = e.__cause__
+                if not (
+                    isinstance(cause, grpc.RpcError)
+                    and cause.code() == grpc.StatusCode.INVALID_ARGUMENT
+                    and "resident-epoch-mismatch" in (cause.details() or "")
+                ):
+                    raise
+                log.warning(
+                    "sidecar %s cannot apply the resident delta "
+                    "(restart/eviction/churn); resending in full",
+                    self.target,
+                )
+        self.resident_used_delta = False
+        reply = self._call_cached(self._schedule, lambda: build_request(False))
+        return self._unpack_result(reply, snapshot, pods)
+
+    def schedule_resident_async(
+        self, snapshot, pods, *, delta=None, epoch: int = 0, **kw
+    ) -> _FutureSchedule:
+        """In-flight twin of schedule_resident on the dedicated worker
+        thread (see schedule_batch_async); errors surface from
+        handle.result()."""
+        if self._async_pool is None:
+            import concurrent.futures
+
+            self._async_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="yoda-bridge-async"
+            )
+        return _FutureSchedule(
+            self._async_pool.submit(
+                self.schedule_resident, snapshot, pods,
+                delta=delta, epoch=epoch, **kw,
+            )
+        )
 
     def schedule_batch_async(self, snapshot, pods, **kw) -> _FutureSchedule:
         """Concurrent in-flight ScheduleBatch (the pipelined host loop's

@@ -74,6 +74,8 @@ class EngineService:
         sharded_fn_soft=None,
         sharded_windows_fn=None,
         sharded_windows_fn_soft=None,
+        field_cache: bool = True,
+        resident_state: bool = True,
     ):
         # serve a custom engine (e.g. models.learned.LearnedEngine) on
         # the dense branch instead of the module-level heuristic engine;
@@ -91,6 +93,15 @@ class EngineService:
         # anything else must fail loud, not be silently overridden
         self._sharded_opts = sharded_opts or {}
         self.cycles_served = 0
+        # capability switches, read dynamically by the handlers (so a
+        # test — or a canary rollout — can downgrade a live server and
+        # exercise the client's invalidate-together recovery)
+        self.field_cache_enabled = field_cache
+        self.resident_enabled = resident_state
+        # resident-state observability (tests + ops): how many cycles
+        # were served from an applied delta vs. a full resident upload
+        self.resident_deltas_served = 0
+        self.resident_fulls_served = 0
         self._lock = threading.Lock()
         # serializes DEVICE access explicitly (schedule/windows/preempt
         # bodies), so the executor may run more than one worker without
@@ -102,12 +113,12 @@ class EngineService:
         # session id -> {"<rpc>:<map>": {field: ndarray}} (LRU-bounded)
         self._field_cache: "OrderedDict[str, dict]" = OrderedDict()
 
-    def _session_caches(self, request, which: str):
-        """(snapshot_cache, pods_cache) for this request's session, or
-        (None, None) when the client did not opt into the field cache."""
+    def _session(self, request) -> dict | None:
+        """The per-session state dict (field caches + resident state),
+        LRU-bounded; None when the request carries no session id."""
         sid = request.session_id
         if not sid:
-            return None, None
+            return None
         with self._lock:
             sess = self._field_cache.get(sid)
             if sess is None:
@@ -117,10 +128,85 @@ class EngineService:
                     self._field_cache.popitem(last=False)
             else:
                 self._field_cache.move_to_end(sid)
+        return sess
+
+    def _session_caches(self, request, which: str):
+        """(snapshot_cache, pods_cache) for this request's session, or
+        (None, None) when the client did not opt into the field cache
+        (or this server does not serve it)."""
+        sess = self._session(request) if self.field_cache_enabled else None
+        if sess is None:
+            return None, None
         return (
             sess.setdefault(f"{which}:snapshot", {}),
             sess.setdefault(f"{which}:pods", {}),
         )
+
+    def _resident_snapshot(self, request, context, snap_cache):
+        """Resolve the request's cluster state under the resident-state
+        protocol: a delta applies to the session's retained snapshot
+        (INVALID_ARGUMENT "resident-epoch-mismatch" when inapplicable —
+        the client resends in full), a resident_full upload replaces it,
+        and either path retags the session to request.resident_epoch.
+        Plain requests (no delta, no resident_full) pass through
+        untouched."""
+        delta_present = bool(request.snapshot_delta.tensors)
+        if not (delta_present or request.resident_full):
+            return codec.unpack_fields(
+                engine.SnapshotArrays, request.snapshot, cache=snap_cache
+            )
+        if not self.resident_enabled:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "resident-epoch-mismatch: this sidecar does not serve "
+                "resident cluster state",
+            )
+        sess = self._session(request)
+        if sess is None:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "resident cluster state requires a session_id",
+            )
+        if delta_present:
+            st = sess.get("resident")
+            if st is None or st["epoch"] != request.resident_epoch - 1:
+                context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    f"resident-epoch-mismatch: session holds epoch "
+                    f"{None if st is None else st['epoch']}, delta wants "
+                    f"{request.resident_epoch - 1}",
+                )
+            delta = codec.unpack_fields(
+                engine.SnapshotDelta, request.snapshot_delta
+            )
+            if (
+                delta.node_mask.shape != st["snapshot"].node_mask.shape
+                or delta.req_vals.shape[1:]
+                != st["snapshot"].requested.shape[1:]
+                or delta.dom_vals.shape[1]
+                != st["snapshot"].domain_counts.shape[1]
+            ):
+                context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    "resident-epoch-mismatch: delta shape does not match "
+                    "the retained snapshot (layout churn)",
+                )
+            # applied in numpy BY VALUE: bitwise the snapshot the client
+            # would have shipped in full, so delta cycles cannot diverge
+            # from full-upload cycles (PARITY.md)
+            snapshot = engine.apply_snapshot_delta_np(st["snapshot"], delta)
+            with self._lock:
+                self.resident_deltas_served += 1
+        else:
+            snapshot = codec.unpack_fields(
+                engine.SnapshotArrays, request.snapshot, cache=snap_cache
+            )
+            with self._lock:
+                self.resident_fulls_served += 1
+        sess["resident"] = {
+            "snapshot": snapshot, "epoch": int(request.resident_epoch),
+        }
+        return snapshot
 
     def _pick_sharded_fn(self, request, context, fn, fn_soft, what):
         """Validate the request against the options baked into the
@@ -170,9 +256,7 @@ class EngineService:
     def schedule_batch(self, request: pb.ScheduleRequest, context) -> pb.ScheduleReply:
         snap_cache, pods_cache = self._session_caches(request, "batch")
         try:
-            snapshot = codec.unpack_fields(
-                engine.SnapshotArrays, request.snapshot, cache=snap_cache
-            )
+            snapshot = self._resident_snapshot(request, context, snap_cache)
             pods = codec.unpack_fields(
                 engine.PodBatch, request.pods, cache=pods_cache
             )
@@ -317,7 +401,8 @@ class EngineService:
             device_count=len(devs),
             platform=devs[0].platform if devs else "none",
             cycles_served=self.cycles_served,
-            field_cache=True,
+            field_cache=self.field_cache_enabled,
+            resident_state=self.resident_enabled,
         )
 
 

@@ -434,6 +434,178 @@ class ScheduleResult(NamedTuple):
     n_assigned: jnp.ndarray   # [] int32
 
 
+class SnapshotDelta(NamedTuple):
+    """Cycle-over-cycle change to a retained SnapshotArrays: changed rows
+    BY VALUE (set, never add — re-applying the host's exact float32 row
+    contents keeps the resident matrices bitwise identical to a full
+    rebuild, which the PARITY.md delta/full guarantee depends on).
+
+    Row index arrays are bucket-padded with an out-of-range index (the
+    node-axis length), dropped by the device scatter (`mode="drop"`) and
+    filtered by the numpy applier — so delta shapes stay stable across
+    cycles and the jitted `apply_snapshot_delta` rarely recompiles.
+
+    Only the leaves that change in steady state are expressible:
+    `requested` rows (the engine's own assignments plus running-set
+    churn), the five utilization series, the four float domain-count
+    tables (binds of selector-matching pods move whole-domain rows —
+    `domain_id` itself is layout and never rides a delta), and the node
+    mask. Any change to the static block (allocatable, labels, taints,
+    cards, images, the selector axis) or any shape/layout churn makes
+    the host emit a full upload instead (host.snapshot.snapshot_delta
+    returns None)."""
+
+    req_rows: jnp.ndarray   # [k] int32 changed `requested` rows; pad = n
+    req_vals: jnp.ndarray   # [k, r] float32 full new row contents
+    util_rows: jnp.ndarray  # [j] int32 changed utilization rows; pad = n
+    # [j, 5] float32 columns: disk_io, cpu_pct, mem_pct, net_up, net_down
+    util_vals: jnp.ndarray
+    dom_rows: jnp.ndarray   # [d] int32 changed domain-table rows; pad = n
+    # [d, S, 4] float32 stacked columns: domain_counts, avoid_counts,
+    # pref_attract, pref_avoid
+    dom_vals: jnp.ndarray
+    node_mask: jnp.ndarray  # [n] bool (cheap; shipped whole every delta)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def apply_snapshot_delta(
+    snapshot: SnapshotArrays, delta: SnapshotDelta
+) -> SnapshotArrays:
+    """Fold a SnapshotDelta into the device-resident snapshot in place:
+    the snapshot tree is DONATED, so in the common case no [n, r] matrix
+    crosses the host<->device boundary and XLA reuses the resident
+    buffers for the output. Callers must drop every reference to the
+    donated tree and hold only the returned one (graftlint's dtype-shape
+    family flags a donated leaf that is re-read)."""
+    return snapshot._replace(
+        requested=snapshot.requested.at[delta.req_rows].set(
+            delta.req_vals, mode="drop"
+        ),
+        disk_io=snapshot.disk_io.at[delta.util_rows].set(
+            delta.util_vals[:, 0], mode="drop"
+        ),
+        cpu_pct=snapshot.cpu_pct.at[delta.util_rows].set(
+            delta.util_vals[:, 1], mode="drop"
+        ),
+        mem_pct=snapshot.mem_pct.at[delta.util_rows].set(
+            delta.util_vals[:, 2], mode="drop"
+        ),
+        net_up=snapshot.net_up.at[delta.util_rows].set(
+            delta.util_vals[:, 3], mode="drop"
+        ),
+        net_down=snapshot.net_down.at[delta.util_rows].set(
+            delta.util_vals[:, 4], mode="drop"
+        ),
+        domain_counts=snapshot.domain_counts.at[delta.dom_rows].set(
+            delta.dom_vals[:, :, 0], mode="drop"
+        ),
+        avoid_counts=snapshot.avoid_counts.at[delta.dom_rows].set(
+            delta.dom_vals[:, :, 1], mode="drop"
+        ),
+        pref_attract=snapshot.pref_attract.at[delta.dom_rows].set(
+            delta.dom_vals[:, :, 2], mode="drop"
+        ),
+        pref_avoid=snapshot.pref_avoid.at[delta.dom_rows].set(
+            delta.dom_vals[:, :, 3], mode="drop"
+        ),
+        node_mask=delta.node_mask,
+    )
+
+
+def apply_snapshot_delta_np(snapshot: SnapshotArrays, delta: SnapshotDelta):
+    """The numpy mirror of apply_snapshot_delta, for hosts that retain
+    the resident state off-device (the bridge server keys one per
+    session): row sets by value, so the result is BITWISE the snapshot
+    the client would have shipped in full. Returns a new SnapshotArrays;
+    the input's leaves are not mutated."""
+    import numpy as np
+
+    n = snapshot.node_mask.shape[0]
+    req = np.array(snapshot.requested, np.float32, copy=True)
+    rows = np.asarray(delta.req_rows)
+    keep = (rows >= 0) & (rows < n)
+    req[rows[keep]] = np.asarray(delta.req_vals, np.float32)[keep]
+    series = []
+    urows = np.asarray(delta.util_rows)
+    ukeep = (urows >= 0) & (urows < n)
+    uvals = np.asarray(delta.util_vals, np.float32)
+    for col, name in enumerate(
+        ("disk_io", "cpu_pct", "mem_pct", "net_up", "net_down")
+    ):
+        s = np.array(getattr(snapshot, name), np.float32, copy=True)
+        s[urows[ukeep]] = uvals[ukeep, col]
+        series.append(s)
+    domains = []
+    drows = np.asarray(delta.dom_rows)
+    dkeep = (drows >= 0) & (drows < n)
+    dvals = np.asarray(delta.dom_vals, np.float32)
+    for col, name in enumerate(
+        ("domain_counts", "avoid_counts", "pref_attract", "pref_avoid")
+    ):
+        t = np.array(getattr(snapshot, name), np.float32, copy=True)
+        t[drows[dkeep]] = dvals[dkeep, :, col]
+        domains.append(t)
+    return snapshot._replace(
+        requested=req,
+        disk_io=series[0],
+        cpu_pct=series[1],
+        mem_pct=series[2],
+        net_up=series[3],
+        net_down=series[4],
+        domain_counts=domains[0],
+        avoid_counts=domains[1],
+        pref_attract=domains[2],
+        pref_avoid=domains[3],
+        node_mask=np.asarray(delta.node_mask, bool),
+    )
+
+
+def snapshot_nbytes(nt) -> int:
+    """Total payload bytes of a NamedTuple of arrays (host-side shapes
+    and dtypes only — never forces a device sync)."""
+    import numpy as np
+
+    total = 0
+    for a in nt:
+        size = 1
+        for d in a.shape:
+            size *= int(d)
+        total += size * np.dtype(a.dtype).itemsize
+    return total
+
+
+class ResidentMismatch(RuntimeError):
+    """A SnapshotDelta arrived for resident state this engine does not
+    hold (wrong epoch, shape/layout churn, or no state at all); the
+    caller must re-upload in full."""
+
+
+class ResidentState:
+    """Device-owned steady-state cluster arrays: the retained snapshot
+    tree plus the epoch the host tags its deltas with. The snapshot
+    leaves are PRIVATE device buffers (never the shared uniform-constant
+    cache) because apply_snapshot_delta donates them."""
+
+    __slots__ = ("snapshot", "epoch")
+
+    def __init__(self, snapshot: SnapshotArrays, epoch: int):
+        self.snapshot = snapshot
+        self.epoch = epoch
+
+    def accepts(self, delta: SnapshotDelta, epoch: int) -> bool:
+        """Is `delta` (tagged to produce `epoch`) applicable to this
+        state? Epoch must be the immediate successor and the delta's
+        node/resource axes must match the retained shapes — anything
+        else is layout churn requiring a full upload."""
+        snap = self.snapshot
+        return (
+            epoch == self.epoch + 1
+            and delta.node_mask.shape == snap.node_mask.shape
+            and delta.req_vals.shape[1:] == snap.requested.shape[1:]
+            and delta.dom_vals.shape[1] == snap.domain_counts.shape[1]
+        )
+
+
 class _UniformDeviceCache:
     """Device-resident constants for uniform-valued tensor leaves.
 
@@ -523,10 +695,66 @@ class LocalEngine:
 
     def __init__(self):
         self._consts = _UniformDeviceCache()
+        # device-resident cluster state (config.resident_state): retained
+        # snapshot + epoch; None until the first full resident upload
+        self._resident: ResidentState | None = None
+        # did the LAST schedule_resident call apply a delta (True) or
+        # fall back to / receive a full upload (False)? The host reads
+        # this after forcing the result to attribute its metrics.
+        self.resident_used_delta = False
 
     def schedule_batch(self, snapshot, pods, **kw) -> "ScheduleResult":
         return schedule_batch(
             self._consts.swap(snapshot), self._consts.swap(pods), **kw
+        )
+
+    # ---- resident cluster state (delta uploads) -----------------------
+
+    def supports_resident(self) -> bool:
+        return True
+
+    def invalidate_resident(self) -> None:
+        """Drop the retained state; the next schedule_resident call does
+        a full upload regardless of what the host sends."""
+        self._resident = None
+
+    def schedule_resident(
+        self, snapshot, pods, *, delta=None, epoch=0, **kw
+    ) -> "ScheduleResult":
+        """Schedule against device-resident cluster state. `snapshot` is
+        ALWAYS the full host build (the fallback payload); when `delta`
+        is given and matches the retained epoch/shape it is applied by
+        the jitted donated-buffer apply_snapshot_delta instead — no
+        [n, r] matrix crosses the host<->device boundary. Any mismatch
+        (engine restart, epoch desync, layout churn) transparently
+        degrades to a full upload of `snapshot`; `resident_used_delta`
+        reports which path served the call."""
+        st = self._resident
+        if delta is not None and st is not None and st.accepts(delta, epoch):
+            new_snap = apply_snapshot_delta(st.snapshot, delta)
+            # the donated tree is dead: rebind before anything can read it
+            st.snapshot = new_snap
+            st.epoch = epoch
+            self.resident_used_delta = True
+        else:
+            # full upload into PRIVATE buffers — the uniform-constant
+            # cache's shared device arrays must never be donated
+            self._resident = ResidentState(jax.device_put(snapshot), epoch)
+            self.resident_used_delta = False
+        return schedule_batch(
+            self._resident.snapshot, self._consts.swap(pods), **kw
+        )
+
+    def schedule_resident_async(
+        self, snapshot, pods, *, delta=None, epoch=0, **kw
+    ) -> "PendingSchedule":
+        """Async-dispatch twin of schedule_resident (the delta apply and
+        the cycle program are enqueued without forcing; see
+        schedule_batch_async)."""
+        return PendingSchedule(
+            self.schedule_resident(
+                snapshot, pods, delta=delta, epoch=epoch, **kw
+            )
         )
 
     def schedule_batch_async(self, snapshot, pods, **kw) -> PendingSchedule:

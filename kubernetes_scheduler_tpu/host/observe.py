@@ -76,6 +76,15 @@ def summarize(metrics, totals: dict | None = None) -> dict:
             "host_overlap_seconds": sum(
                 getattr(m, "host_overlap_seconds", 0.0) for m in cycles
             ),
+            "delta_uploads": sum(
+                getattr(m, "delta_uploads", 0) for m in cycles
+            ),
+            "full_uploads": sum(
+                getattr(m, "full_uploads", 0) for m in cycles
+            ),
+            "delta_bytes_saved": sum(
+                getattr(m, "delta_bytes_saved", 0) for m in cycles
+            ),
         }
     return {
         "cycles_total": totals["cycles"],
@@ -96,6 +105,13 @@ def summarize(metrics, totals: dict | None = None) -> dict:
         # win the pipeline exists for, observable in production
         "pipeline_flushes_total": totals.get("pipeline_flushes", 0),
         "host_overlap_seconds_total": totals.get("host_overlap_seconds", 0.0),
+        # resident cluster state (config.resident_state): delta vs full
+        # uploads and the payload bytes the deltas avoided shipping —
+        # the delta hit rate IS the steady-state health signal (full
+        # uploads after warmup mean layout churn or engine flapping)
+        "delta_uploads_total": totals.get("delta_uploads", 0),
+        "full_uploads_total": totals.get("full_uploads", 0),
+        "delta_bytes_saved_total": totals.get("delta_bytes_saved", 0),
         "scheduling_pods_per_sec": bound / total_s if total_s > 0 else 0.0,
         "bind_latency_p50_seconds": _quantile(lat, 0.50),
         "bind_latency_p99_seconds": _quantile(lat, 0.99),
@@ -119,6 +135,9 @@ _HELP = {
     "fallback_policy_mismatch_total": "Fallback cycles scored with the yoda formula because config.policy has no scalar mirror",
     "pipeline_flushes_total": "Speculative pipeline state discarded (informer/layout churn, engine failure, non-device cycle)",
     "host_overlap_seconds_total": "Host work overlapped with in-flight engine calls (pipelined loop)",
+    "delta_uploads_total": "Resident-state cycles served by a SnapshotDelta applied on the engine",
+    "full_uploads_total": "Resident-state cycles that shipped the full snapshot (first upload, churn, or flush)",
+    "delta_bytes_saved_total": "Snapshot payload bytes delta uploads avoided shipping to the engine",
     "scheduling_pods_per_sec": "Bound pods per second of cycle time",
     "bind_latency_p50_seconds": "Median end-to-end cycle latency",
     "bind_latency_p99_seconds": "p99 end-to-end cycle latency",

@@ -135,6 +135,16 @@ class CycleMetrics:
     # discards (informer/layout churn, engine failure, non-device paths)
     host_overlap_seconds: float = 0.0
     pipeline_flushes: int = 0
+    # resident cluster state (config.resident_state): how this cycle's
+    # snapshot reached the engine — a SnapshotDelta applied to the
+    # device-retained state (delta_uploads) or a full upload
+    # (full_uploads; also counts resident cycles whose delta the engine
+    # had to reject — epoch/shape mismatch degrades to full
+    # transparently). delta_bytes_saved is the payload the delta avoided
+    # shipping vs. the full snapshot.
+    delta_uploads: int = 0
+    full_uploads: int = 0
+    delta_bytes_saved: int = 0
 
 
 @dataclass
@@ -162,6 +172,13 @@ class _InFlight:
     handle: object       # .result() -> ScheduleResult (engine.PendingSchedule)
     pods_batch: object   # the dispatched PodBatch (validation + deltas)
     t_eng: float         # dispatch timestamp (engine wall time)
+    # resident-state accounting: was this a resident dispatch, did the
+    # host send a delta, and how many bytes the delta saved vs. the full
+    # snapshot (attributed in _complete_window once the engine reports
+    # which path actually served the call)
+    resident: bool = False
+    delta_sent: bool = False
+    delta_bytes_saved: int = 0
 
 
 class Scheduler:
@@ -302,7 +319,19 @@ class Scheduler:
             "fallback_policy_mismatch": 0,
             "pipeline_flushes": 0,
             "host_overlap_seconds": 0.0,
+            "delta_uploads": 0,
+            "full_uploads": 0,
+            "delta_bytes_saved": 0,
         }
+        # resident cluster state (config.resident_state): the last full
+        # snapshot the engine confirmed retaining (the delta base), the
+        # epoch the next upload will be tagged with, and whether the
+        # engine-side state is trusted — flipped False on engine
+        # failure, epoch desync, or preemption so the next dispatch
+        # flushes to a full upload
+        self._resident_prev = None
+        self._resident_epoch = 0
+        self._resident_ok = False
         # pipelined loop state (config.pipeline_depth >= 1): the window
         # prefetched while the previous cycle's engine call was in
         # flight, and the speculative pod batch prebuilt for it (kept at
@@ -327,6 +356,9 @@ class Scheduler:
             self.totals["fallback_policy_mismatch"] += int(m.policy_mismatch)
             self.totals["pipeline_flushes"] += m.pipeline_flushes
             self.totals["host_overlap_seconds"] += m.host_overlap_seconds
+            self.totals["delta_uploads"] += m.delta_uploads
+            self.totals["full_uploads"] += m.full_uploads
+            self.totals["delta_bytes_saved"] += m.delta_bytes_saved
 
     def metrics_snapshot(self) -> tuple[list[CycleMetrics], dict]:
         """Point-in-time copy for exporters (safe against the scheduling
@@ -584,6 +616,7 @@ class Scheduler:
                     self.config.policy,
                 )
                 m.used_fallback = True
+                self._invalidate_resident()
                 self._run_scalar(window, nodes, running, utils, m)
                 # a failed device cycle is a device observation priced at
                 # its FULL cost: the failed attempt (timeout or fast
@@ -632,6 +665,12 @@ class Scheduler:
                 )
             except Exception:
                 log.exception("preemption pass failed; retrying next cycle")
+            if m.victims_evicted and self.config.resident_state:
+                # evictions change the running set out-of-band of the
+                # binding flow; flush the resident contract so the next
+                # dispatch re-uploads in full rather than trusting a
+                # delta base that predates the kills
+                self._invalidate_resident()
 
         m.cycle_seconds = time.perf_counter() - t0
         self._record(m)
@@ -678,6 +717,7 @@ class Scheduler:
                 self.config.policy,
             )
             m.used_fallback = True
+            self._invalidate_resident()
             self._discard_speculative(m)
             self._run_scalar(
                 start.window, start.nodes, start.running, start.utils, m
@@ -705,6 +745,7 @@ class Scheduler:
                 self.config.policy,
             )
             m.used_fallback = True
+            self._invalidate_resident()
             self._discard_speculative(m)
             self._run_scalar(
                 start.window, start.nodes, start.running, start.utils, m
@@ -845,6 +886,11 @@ class Scheduler:
             window, nodes, running, pods_batch, snapshot,
             record=not ephemeral,
         )
+        infl = self._dispatch_resident(
+            snapshot, pods_batch, kw, ephemeral=ephemeral, use_async=use_async,
+        )
+        if infl is not None:
+            return infl
         t_eng = time.perf_counter()
         submit = (
             getattr(self.engine, "schedule_batch_async", None)
@@ -864,6 +910,77 @@ class Scheduler:
             )
         return _InFlight(handle=handle, pods_batch=pods_batch, t_eng=t_eng)
 
+    def _dispatch_resident(
+        self, snapshot, pods_batch, kw, *, ephemeral: bool, use_async: bool,
+    ) -> "_InFlight | None":
+        """Resident-state dispatch (config.resident_state): ship a
+        SnapshotDelta against the engine-retained snapshot when the
+        cycle-over-cycle change is delta-expressible, a tagged full
+        upload otherwise. Returns None when the resident path does not
+        apply (knob off, engine without the surface, ephemeral builds —
+        a throwaway reservation-concatenated snapshot must never become
+        the delta base) and the caller runs the ordinary dispatch.
+
+        The full snapshot always accompanies a delta down the engine
+        surface, so an epoch/shape mismatch degrades to a full upload
+        INSIDE the call (local: transparently; remote: INVALID_ARGUMENT
+        resend) and never costs the cycle."""
+        if not self.config.resident_state or ephemeral:
+            return None
+        supports = getattr(self.engine, "supports_resident", None)
+        if supports is None or not supports():
+            return None
+        from kubernetes_scheduler_tpu.engine import snapshot_nbytes
+        from kubernetes_scheduler_tpu.host.snapshot import snapshot_delta
+
+        delta = None
+        if self._resident_ok and self._resident_prev is not None:
+            delta = snapshot_delta(self._resident_prev, snapshot)
+        epoch = self._resident_epoch + 1
+        saved = 0
+        if delta is not None:
+            saved = max(0, snapshot_nbytes(snapshot) - snapshot_nbytes(delta))
+        t_eng = time.perf_counter()
+        submit = (
+            getattr(self.engine, "schedule_resident_async", None)
+            if use_async
+            else None
+        )
+        if submit is not None:
+            handle = submit(snapshot, pods_batch, delta=delta, epoch=epoch, **kw)
+        else:
+            from kubernetes_scheduler_tpu.engine import PendingSchedule
+
+            handle = PendingSchedule(
+                self.engine.schedule_resident(
+                    snapshot, pods_batch, delta=delta, epoch=epoch, **kw
+                )
+            )
+        # optimistic commit: the dispatched snapshot is the next delta
+        # base. A failure before the result forces flips _resident_ok
+        # False (the completion/fallback paths call
+        # _invalidate_resident), flushing the next cycle to full.
+        self._resident_prev = snapshot
+        self._resident_epoch = epoch
+        self._resident_ok = True
+        return _InFlight(
+            handle=handle, pods_batch=pods_batch, t_eng=t_eng,
+            resident=True, delta_sent=delta is not None,
+            delta_bytes_saved=saved,
+        )
+
+    def _invalidate_resident(self) -> None:
+        """Flush the resident-state contract: the next resident dispatch
+        uploads in full (engine failure, preemption, epoch desync)."""
+        self._resident_ok = False
+        self._resident_prev = None
+        inval = getattr(self.engine, "invalidate_resident", None)
+        if inval is not None:
+            try:
+                inval()
+            except Exception:
+                log.debug("engine invalidate_resident failed", exc_info=True)
+
     def _complete_window(
         self, infl: _InFlight, window, nodes, m: CycleMetrics,
         *, ephemeral: bool,
@@ -876,6 +993,18 @@ class Scheduler:
         res = infl.handle.result()
         idx = np.asarray(res.node_idx)
         m.engine_seconds += time.perf_counter() - infl.t_eng
+        if infl.resident:
+            # attribute AFTER the force: the engine reports whether the
+            # delta actually applied or it degraded to a full upload
+            # (epoch/shape mismatch) inside the call
+            used_delta = infl.delta_sent and bool(
+                getattr(self.engine, "resident_used_delta", False)
+            )
+            if used_delta:
+                m.delta_uploads += 1
+                m.delta_bytes_saved += infl.delta_bytes_saved
+            else:
+                m.full_uploads += 1
         p_padded = int(np.asarray(infl.pods_batch.request).shape[0])
         if (
             idx.shape != (p_padded,)

@@ -15,7 +15,11 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-from kubernetes_scheduler_tpu.engine import PodBatch, SnapshotArrays
+from kubernetes_scheduler_tpu.engine import (
+    PodBatch,
+    SnapshotArrays,
+    SnapshotDelta,
+)
 from kubernetes_scheduler_tpu.host.advisor import NodeUtil
 from kubernetes_scheduler_tpu.host.queue import pod_priority
 from kubernetes_scheduler_tpu.host.types import Node, Pod
@@ -196,6 +200,130 @@ def suffix_record(lst: list) -> tuple:
     """The (list, length, boundary sentinel) record suffix_start checks."""
     n = len(lst)
     return (lst, n, lst[n - 1] if n else None)
+
+
+# SnapshotArrays leaves that are static per node SET: build_snapshot
+# serves them from the _node_static cache, so between two builds with the
+# same node set they are the SAME array objects — snapshot_delta checks
+# identity first and only falls back to a bytewise compare.
+_STATIC_LEAVES = (
+    "allocatable", "cards", "card_mask", "card_healthy", "taints",
+    "taint_mask", "node_labels", "node_label_mask", "image_scaled",
+)
+# the domain-membership encoding is LAYOUT (selector axis + topology
+# partition): any drift forces a full upload. The four float count
+# tables over that layout change with ordinary binds and ride deltas as
+# row sets, exactly like `requested`.
+_DOMAIN_LAYOUT_LEAVES = ("domain_id",)
+_DOMAIN_VALUE_LEAVES = (
+    "domain_counts", "avoid_counts", "pref_attract", "pref_avoid",
+)
+_UTIL_LEAVES = ("disk_io", "cpu_pct", "mem_pct", "net_up", "net_down")
+
+# every SnapshotArrays leaf MUST be classified: an unlisted leaf would be
+# neither compared (no full-upload flush when it changes) nor shipped in
+# the delta — the engine would silently score stale values, breaking the
+# PARITY.md delta/full guarantee with no error. Fails loudly at import
+# when a new leaf is added to the struct without a classification.
+assert (
+    set(_STATIC_LEAVES)
+    | set(_DOMAIN_LAYOUT_LEAVES)
+    | set(_DOMAIN_VALUE_LEAVES)
+    | set(_UTIL_LEAVES)
+    | {"requested", "node_mask"}
+) == set(SnapshotArrays._fields), (
+    "snapshot_delta's leaf classification no longer covers "
+    "SnapshotArrays — classify the new leaf (static / layout / "
+    "row-diffed) before deltas can be trusted"
+)
+
+
+def _rows_padded(rows: np.ndarray, n: int) -> np.ndarray:
+    """Bucket-pad a changed-row index vector with the out-of-range
+    sentinel `n` (dropped by both delta appliers), so delta shapes stay
+    stable and the jitted device apply rarely recompiles."""
+    k = bucket_size(max(len(rows), 1), floor=8, multiple=8)
+    out = np.full(k, n, np.int32)
+    out[: len(rows)] = rows
+    return out
+
+
+def snapshot_delta(
+    prev: SnapshotArrays, new: SnapshotArrays, *, max_byte_frac: float = 0.5
+) -> SnapshotDelta | None:
+    """The cycle-over-cycle change from `prev` (the snapshot the engine
+    retains on device) to `new` (this cycle's full host build), or None
+    when the change is not delta-expressible and the host must upload in
+    full: static-block churn (node add/remove, column-layout growth,
+    label/taint/card edits), selector-axis/domain-membership drift, any
+    shape change, or a delta payload exceeding `max_byte_frac` of the
+    full snapshot (bytes, not rows — a zone-topology bind legitimately
+    touches whole-domain row blocks that are still tiny next to the
+    static leaves a full upload re-ships).
+
+    Changed rows ride BY VALUE (the exact float32 contents of the new
+    build), so applying the delta reproduces `new` bitwise — the
+    PARITY.md delta/full bindings guarantee reduces to this function
+    never mis-classifying a changed leaf as clean, which the generic
+    row-diff below guarantees by construction (it diffs the full
+    matrices rather than trusting any cache's account of what moved)."""
+    if (
+        prev.requested.shape != new.requested.shape
+        or prev.domain_counts.shape != new.domain_counts.shape
+    ):
+        return None
+    for name in _STATIC_LEAVES + _DOMAIN_LAYOUT_LEAVES:
+        a, b = getattr(prev, name), getattr(new, name)
+        if a is b:
+            continue
+        if a.shape != b.shape or a.dtype != b.dtype or not np.array_equal(a, b):
+            return None
+    n = int(new.node_mask.shape[0])
+    req_changed = np.flatnonzero(
+        (np.asarray(prev.requested) != np.asarray(new.requested)).any(axis=1)
+    )
+    util_diff = np.zeros(n, bool)
+    for name in _UTIL_LEAVES:
+        util_diff |= np.asarray(getattr(prev, name)) != np.asarray(
+            getattr(new, name)
+        )
+    util_changed = np.flatnonzero(util_diff)
+    dom_diff = np.zeros(n, bool)
+    for name in _DOMAIN_VALUE_LEAVES:
+        a, b = getattr(prev, name), getattr(new, name)
+        if a is not b:
+            dom_diff |= (np.asarray(a) != np.asarray(b)).any(axis=1)
+    dom_changed = np.flatnonzero(dom_diff)
+    req_rows = _rows_padded(req_changed, n)
+    req_vals = np.zeros((len(req_rows), new.requested.shape[1]), np.float32)
+    req_vals[: len(req_changed)] = np.asarray(new.requested)[req_changed]
+    util_rows = _rows_padded(util_changed, n)
+    util_vals = np.zeros((len(util_rows), 5), np.float32)
+    for col, name in enumerate(_UTIL_LEAVES):
+        util_vals[: len(util_changed), col] = np.asarray(getattr(new, name))[
+            util_changed
+        ]
+    dom_rows = _rows_padded(dom_changed, n)
+    s = int(new.domain_counts.shape[1])
+    dom_vals = np.zeros((len(dom_rows), s, 4), np.float32)
+    for col, name in enumerate(_DOMAIN_VALUE_LEAVES):
+        dom_vals[: len(dom_changed), :, col] = np.asarray(getattr(new, name))[
+            dom_changed
+        ]
+    delta = SnapshotDelta(
+        req_rows=req_rows,
+        req_vals=req_vals,
+        util_rows=util_rows,
+        util_vals=util_vals,
+        dom_rows=dom_rows,
+        dom_vals=dom_vals,
+        node_mask=np.asarray(new.node_mask, bool),
+    )
+    from kubernetes_scheduler_tpu.engine import snapshot_nbytes
+
+    if snapshot_nbytes(delta) > max_byte_frac * snapshot_nbytes(new):
+        return None
+    return delta
 
 
 FLAG_PLAIN = 1   # no constraint family beyond score + resource fit

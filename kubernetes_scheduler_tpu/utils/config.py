@@ -139,6 +139,20 @@ class SchedulerConfig:
     # behave as 1 (the pipeline is one deep — a deeper pipeline would
     # score stale capacity).
     pipeline_depth: int = 0
+    # device-resident cluster state (engine.ResidentState): the engine
+    # retains the snapshot leaves on device after the first full upload
+    # in a bucket shape, and subsequent single-window cycles ship only a
+    # SnapshotDelta (changed requested/utilization/domain rows by value + the
+    # node mask), applied by a jitted donated-buffer scatter — no full
+    # [n, r] matrix crosses the host<->device boundary in the common
+    # case. Flushes to a full upload on epoch mismatch, layout/
+    # fingerprint churn, engine failure, or preemption. Off by default:
+    # the default-off path is bit-identical to the pre-resident loop,
+    # and delta mode itself is binding-parity-pinned against full-upload
+    # mode (PARITY.md; tests/test_resident.py). Multi-window backlog
+    # cycles (max_windows_per_cycle > 1 with a deep queue) always upload
+    # in full — only the schedule_batch surface is resident.
+    resident_state: bool = False
     # preemption (upstream PostFilter parity, ops/preempt.py): when a pod
     # fits nowhere, evict <= preemption_max_victims strictly-lower-
     # priority pods from the least-disruptive node. Requires an evictor

@@ -1,0 +1,32 @@
+"""graftlint fixture: disciplined donated-buffer use (never imported)."""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def apply_delta(state, rows, vals):
+    return state.at[rows].set(vals, mode="drop")
+
+
+def cycle(state, rows, vals):
+    # idiomatic donation: rebind the result to the donated name, so the
+    # only live reference is the output aliasing the donated storage
+    state = apply_delta(state, rows, vals)
+    return state * 2
+
+
+def cycle_reads_before(state, rows, vals):
+    total = state.sum()  # reads BEFORE the donation are fine
+    state = apply_delta(state, rows, vals)
+    return state + total
+
+
+def cycle_exclusive_arms(state, rows, vals, flag):
+    if flag:
+        out = apply_delta(state, rows, vals)
+        return out
+    # the other arm of the branch: the donation never executed on this
+    # control path, so this read is fine
+    return state.sum()

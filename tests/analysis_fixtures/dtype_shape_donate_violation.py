@@ -1,0 +1,23 @@
+"""graftlint fixture: donated-buffer re-read (never imported)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def apply_delta(state, rows, vals):
+    return state.at[rows].set(vals, mode="drop")
+
+
+def cycle(state, rows, vals):
+    new = apply_delta(state, rows, vals)
+    # LINE 17: `state` was donated — its buffer may already back `new`
+    return new + state.sum()
+
+
+def cycle_two_reads(state, rows, vals):
+    out = apply_delta(state, rows, vals)
+    total = jnp.sum(state)  # LINE 23: donated leaf re-read
+    return out, total

@@ -117,6 +117,23 @@ def test_dtype_shape_allows_static_shape_branching():
     assert any("any" in m for m in msgs)
 
 
+def test_dtype_shape_flags_donated_buffer_reread():
+    """The donate_argnums family (the resident-state apply_snapshot_delta
+    signature): a leaf read after being donated is a violation; the
+    idiomatic `x = f(x)` rebind — and reads before the donation — are
+    clean."""
+    hits = active(
+        lint_fixture("dtype_shape_donate_violation.py", "dtype-shape")
+    )
+    assert len(hits) >= 2, [v.format() for v in hits]
+    assert all("donated" in v.message for v in hits)
+    assert all("apply_delta" in v.message for v in hits)
+    quiet = active(
+        lint_fixture("dtype_shape_donate_clean.py", "dtype-shape")
+    )
+    assert quiet == [], [v.format() for v in quiet]
+
+
 def test_pallas_vmem_covers_all_three_families():
     """The rule family's three checks each fire — tiling (a block that
     cannot divide the lane-padded axis), the VMEM budget, reduced-

@@ -51,11 +51,24 @@ def test_bench_smoke_e2e():
         "host_loop_32nodes",
         "host_loop_32nodes_deep16w",
         "host_loop_32nodes_pipelined",
+        "host_loop_32nodes_resident",
     ):
         assert want in metrics, (want, sorted(metrics))
-    for name in ("host_loop_32nodes", "host_loop_32nodes_pipelined"):
+    for name in (
+        "host_loop_32nodes",
+        "host_loop_32nodes_pipelined",
+        "host_loop_32nodes_resident",
+    ):
         assert metrics[name]["pods_bound"] > 0, metrics[name]
         assert metrics[name]["cycle_p50_ms"] > 0, metrics[name]
     # the pipelined loop reports its observability companions
     assert "host_overlap_p50_ms" in metrics["host_loop_32nodes_pipelined"]
     assert "pipeline_flushes" in metrics["host_loop_32nodes_pipelined"]
+    # the resident loop actually exercised the delta path and reports
+    # the upload accounting the acceptance gate reads
+    res = metrics["host_loop_32nodes_resident"]
+    assert res["delta_uploads"] > 0, res
+    assert res["fallback_cycles"] == 0, res
+    assert 0.0 < res["delta_hit_rate"] <= 1.0, res
+    assert res["snapshot_upload_bytes"] > 0, res
+    assert res["delta_bytes_saved"] > 0, res

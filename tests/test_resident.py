@@ -1,0 +1,386 @@
+"""Device-resident cluster state (config.resident_state): delta/full
+parity and the flush paths.
+
+The guarantee under test (PARITY.md): for the same arrival order,
+resident-delta mode produces BIT-IDENTICAL bindings to full-upload mode
+— the SnapshotDelta machinery is a pure transfer optimization. Deltas
+ship changed rows BY VALUE, so an applied delta reproduces the full host
+build bitwise; these tests pin that across metric churn, node add/
+remove, preemption, engine-failure fallback, and the live sidecar
+(including sidecar restart and the mid-stream capability downgrade that
+must invalidate the wire field cache and the resident epoch together)."""
+
+import numpy as np
+import pytest
+
+from kubernetes_scheduler_tpu.engine import (
+    LocalEngine,
+    PendingSchedule,
+    apply_snapshot_delta,
+    apply_snapshot_delta_np,
+)
+from kubernetes_scheduler_tpu.host import NodeUtil, Scheduler, StaticAdvisor
+from kubernetes_scheduler_tpu.host.scheduler import RecordingEvictor
+from kubernetes_scheduler_tpu.host.snapshot import SnapshotBuilder, snapshot_delta
+from kubernetes_scheduler_tpu.sim.host_gen import gen_host_cluster, gen_host_pods
+from kubernetes_scheduler_tpu.utils.config import SchedulerConfig
+from tests.test_pipeline import drain, make_cfg, make_node, make_pod
+
+
+def make_sched(nodes, advisor, running, *, resident, engine=None, **kw):
+    kw.setdefault("pipeline_depth", 1)
+    return Scheduler(
+        make_cfg(resident_state=resident, **kw),
+        advisor=advisor,
+        list_nodes=lambda: nodes,
+        list_running_pods=lambda: running,
+        engine=engine,
+    )
+
+
+def run_workload(
+    resident, *, constraints=False, n_nodes=48, n_pods=130, engine=None,
+    mutate=None, depth=1,
+):
+    """Drain a backlog cycle by cycle; `mutate(cycle_no, nodes, advisor)`
+    injects deterministic churn at the same points in every run so
+    resident and plain runs stay comparable."""
+    nodes, advisor = gen_host_cluster(n_nodes, seed=0, constraints=constraints)
+    running: list = []
+    sched = make_sched(
+        nodes, advisor, running, resident=resident, engine=engine,
+        pipeline_depth=depth,
+    )
+    for pod in gen_host_pods(n_pods, seed=1, constraints=constraints):
+        sched.submit(pod)
+    seen = 0
+    cycle = 0
+    metrics = []
+    for _ in range(64):
+        if len(sched.queue) == 0 and sched._prefetched is None:
+            break
+        metrics.append(sched.run_cycle())
+        for b in sched.binder.bindings[seen:]:
+            running.append(b.pod)
+        seen = len(sched.binder.bindings)
+        cycle += 1
+        if mutate is not None:
+            mutate(cycle, nodes, advisor)
+    binds = [(b.pod.namespace, b.pod.name, b.node_name)
+             for b in sched.binder.bindings]
+    return binds, metrics, sched
+
+
+def test_resident_parity_bitidentical_plain():
+    b0, _, _ = run_workload(False)
+    b1, m1, s1 = run_workload(True)
+    assert b1 == b0 and len(b0) > 0
+    # the delta path actually engaged: one full upload establishes the
+    # resident state, every later device cycle ships a delta
+    assert s1.totals["full_uploads"] == 1
+    assert s1.totals["delta_uploads"] >= 1
+    assert s1.totals["delta_bytes_saved"] > 0
+    assert s1.totals["fallback_cycles"] == 0
+
+
+def test_resident_parity_serial_mode():
+    """resident_state composes with pipeline_depth=0 too (the serial
+    driver shares _dispatch_window)."""
+    b0, _, _ = run_workload(False, depth=0)
+    b1, _, s1 = run_workload(True, depth=0)
+    assert b1 == b0 and len(b0) > 0
+    assert s1.totals["delta_uploads"] >= 1
+
+
+def test_resident_parity_constraint_churn():
+    """Constraint workloads: binds move whole-domain rows of the [n, S]
+    count tables — those ride the delta as row sets (domain_id drift
+    would force a full), so the delta path engages here too."""
+    b0, _, _ = run_workload(False, constraints=True)
+    b1, _, s1 = run_workload(True, constraints=True)
+    assert b1 == b0 and len(b0) > 0
+    assert s1.totals["fallback_cycles"] == 0
+    assert s1.totals["delta_uploads"] >= 1
+
+
+def test_resident_parity_metric_churn():
+    """Advisor series changing every cycle: changed rows ride the delta
+    by value, bindings stay bit-identical, and the delta path keeps
+    engaging (metric churn alone must not force full uploads)."""
+
+    def churn(cycle, nodes, advisor):
+        rng = np.random.default_rng(1000 + cycle)
+        for nd in nodes[:: 3]:
+            advisor.utils[nd.name] = NodeUtil(
+                cpu_pct=float(rng.uniform(0, 100)),
+                disk_io=float(rng.uniform(0, 50)),
+                mem_pct=float(rng.uniform(0, 100)),
+            )
+
+    b0, _, _ = run_workload(False, mutate=churn)
+    b1, _, s1 = run_workload(True, mutate=churn)
+    assert b1 == b0 and len(b0) > 0
+    assert s1.totals["delta_uploads"] >= 1
+    assert s1.totals["full_uploads"] == 1
+    assert s1.totals["fallback_cycles"] == 0
+
+
+def test_resident_parity_node_add_remove():
+    """Node add (and remove) mid-drain: layout churn flushes to a full
+    upload — never a stale delta — and bindings match full-upload mode
+    with the same events."""
+
+    def events(cycle, nodes, advisor):
+        if cycle == 1:
+            nodes.append(make_node("n-late"))
+            advisor.utils["n-late"] = NodeUtil(cpu_pct=5.0)
+        if cycle == 2:
+            gone = nodes.pop(0)
+            advisor.utils.pop(gone.name, None)
+
+    b0, _, _ = run_workload(False, mutate=events)
+    b1, _, s1 = run_workload(True, mutate=events)
+    assert b1 == b0 and len(b0) > 0
+    # the node events forced fresh full uploads (bucket/static churn)
+    assert s1.totals["full_uploads"] >= 2
+    assert s1.totals["fallback_cycles"] == 0
+
+
+class ResidentMidflightFailEngine(LocalEngine):
+    """LocalEngine whose in-flight resident handle dies on force for one
+    call — the remote-outage shape against the resident surface."""
+
+    def __init__(self, fail_call: int):
+        super().__init__()
+        self.calls = 0
+        self.fail_call = fail_call
+
+    def schedule_resident_async(self, snapshot, pods, **kw):
+        self.calls += 1
+        if self.calls == self.fail_call:
+            class _Dead:
+                def result(self):
+                    raise RuntimeError("injected mid-flight engine failure")
+
+            return _Dead()
+        return PendingSchedule(self.schedule_resident(snapshot, pods, **kw))
+
+
+def test_resident_engine_failure_flushes_to_full():
+    """An engine failure mid-flight falls back to scalar exactly once,
+    invalidates the resident contract, and the NEXT device cycle is a
+    full upload — with bindings still matching the no-resident run."""
+    engine = ResidentMidflightFailEngine(fail_call=2)
+    b1, m1, s1 = run_workload(True, engine=engine)
+    fallbacks = [m for m in m1 if m.used_fallback]
+    assert len(fallbacks) == 1
+    # first cycle full, failed cycle flushed, recovery cycle full again
+    assert s1.totals["full_uploads"] >= 2
+    names = [b[1] for b in b1]
+    assert len(names) == len(set(names))
+    b0, _, _ = run_workload(False, engine=ResidentMidflightFailEngine(2))
+    assert len(b1) == len(b0)
+    later = m1[m1.index(fallbacks[0]) + 1:]
+    assert later and not any(m.used_fallback for m in later)
+
+
+def run_preemption(resident):
+    nodes = [make_node("n0", cpu=2000.0), make_node("n1", cpu=2000.0)]
+    advisor = StaticAdvisor({n.name: NodeUtil(cpu_pct=10.0) for n in nodes})
+    running = []
+    for i, node in enumerate(nodes):
+        victim = make_pod(f"victim-{i}", cpu=1800.0, priority=0)
+        victim.node_name = node.name
+        victim.start_time = 100.0 + i
+        running.append(victim)
+    evictor = RecordingEvictor()
+    sched = Scheduler(
+        make_cfg(pipeline_depth=1, batch_window=4, resident_state=resident),
+        advisor=advisor,
+        evictor=evictor,
+        list_nodes=lambda: nodes,
+        list_running_pods=lambda: running,
+    )
+    sched.submit(make_pod("preemptor", cpu=1800.0, priority=100))
+    sched.submit(make_pod("small", cpu=100.0, priority=0))
+    drain(sched, running)
+    return (
+        [(e.victim.name, e.preemptor.name) for e in evictor.evictions],
+        sched,
+    )
+
+
+def test_resident_preemption_parity_and_flush():
+    """Preemption selects the same victims under resident mode, and an
+    eviction flushes the resident contract (the next resident dispatch
+    re-uploads in full rather than trusting a pre-kill delta base)."""
+    ev0, _ = run_preemption(False)
+    ev1, sched = run_preemption(True)
+    assert ev1 == ev0 and len(ev0) >= 1
+    assert sched._resident_ok is False  # flushed after the evictions
+
+
+def test_snapshot_delta_reproduces_full_build_bitwise():
+    """The delta IS the full build, row-compressed: applying it (numpy
+    and device paths) to the previous snapshot reproduces the next full
+    build bitwise on every leaf."""
+    nodes = [make_node(f"n{i}") for i in range(24)]
+    utils = {n.name: NodeUtil(cpu_pct=10.0, disk_io=5.0) for n in nodes}
+    running = []
+    b = SnapshotBuilder()
+    window = [make_pod("w0", cpu=300.0), make_pod("w1", cpu=400.0)]
+    prev = b.build_snapshot(nodes, utils, running, pending_pods=window)
+    for i, pod in enumerate(window):
+        pod.node_name = f"n{i}"
+        running.append(pod)
+    utils["n2"] = NodeUtil(cpu_pct=77.0, net_up=3.0)
+    new = b.build_snapshot(nodes, utils, running)
+    delta = snapshot_delta(prev, new)
+    assert delta is not None
+    applied = apply_snapshot_delta_np(prev, delta)
+    for name, a, c in zip(new._fields, applied, new):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(c), err_msg=name)
+    import jax
+
+    dev = apply_snapshot_delta(jax.device_put(prev), delta)
+    for name, a, c in zip(new._fields, dev, new):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(c), err_msg=name)
+
+
+def test_snapshot_delta_refuses_static_and_layout_churn():
+    nodes = [make_node(f"n{i}") for i in range(3)]
+    utils = {n.name: NodeUtil(cpu_pct=10.0) for n in nodes}
+    b = SnapshotBuilder()
+    prev = b.build_snapshot(nodes, utils, [])
+    # node-set churn: static block rebuilt -> no delta
+    nodes2 = nodes + [make_node("n3")]
+    new = b.build_snapshot(nodes2, utils, [])
+    if prev.requested.shape == new.requested.shape:
+        assert snapshot_delta(prev, new) is None
+    # shape churn (bucket growth) -> no delta
+    nodes3 = [make_node(f"m{i}") for i in range(20)]
+    utils3 = {n.name: NodeUtil() for n in nodes3}
+    new3 = SnapshotBuilder().build_snapshot(nodes3, utils3, [])
+    assert snapshot_delta(prev, new3) is None
+
+
+def test_resident_default_off_never_engages():
+    """The default-off path is bit-identical PR-2 behavior: no resident
+    counters move and the engine never sees the resident surface."""
+    b0, _, s0 = run_workload(False)
+    assert s0.totals["delta_uploads"] == 0
+    assert s0.totals["full_uploads"] == 0
+    assert s0.totals["delta_bytes_saved"] == 0
+
+
+def test_resident_counters_exported():
+    from kubernetes_scheduler_tpu.host.observe import render_prometheus
+
+    _, _, sched = run_workload(True, n_pods=40)
+    window, totals = sched.metrics_snapshot()
+    assert totals["delta_uploads"] > 0
+    assert totals["full_uploads"] > 0
+    text = render_prometheus(window, totals)
+    assert "yoda_tpu_delta_uploads_total" in text
+    assert "yoda_tpu_full_uploads_total" in text
+    assert "yoda_tpu_delta_bytes_saved_total" in text
+    # pre-totals callers (older exporters) still render
+    text2 = render_prometheus(window, None)
+    assert "yoda_tpu_delta_uploads_total" in text2
+
+
+# ---- live sidecar variants ------------------------------------------------
+
+
+def _with_sidecar(fn):
+    grpc = pytest.importorskip("grpc")  # noqa: F841
+    from kubernetes_scheduler_tpu.bridge.client import RemoteEngine
+    from kubernetes_scheduler_tpu.bridge.server import make_server
+
+    server, port, service = make_server("127.0.0.1:0")
+    server.start()
+    client = RemoteEngine(f"127.0.0.1:{port}", deadline_seconds=60.0)
+    try:
+        return fn(client, service)
+    finally:
+        client.close()
+        server.stop(grace=None)
+
+
+def test_resident_over_sidecar_parity():
+    """The bridge path: deltas ride the wire, the sidecar applies them to
+    its session-resident state, bindings match the local full-upload
+    run, and the server's own counters confirm deltas were served."""
+
+    def body(client, service):
+        return run_workload(True, n_pods=96, engine=client), service
+
+    (b_remote, m_remote, s_remote), service = _with_sidecar(body)
+    b_local, _, _ = run_workload(False, n_pods=96)
+    assert b_remote == b_local
+    assert not any(m.used_fallback for m in m_remote)
+    assert s_remote.totals["delta_uploads"] >= 1
+    assert service.resident_deltas_served >= 1
+    assert service.resident_fulls_served >= 1
+
+
+def test_resident_sidecar_restart_transparent_full_resend():
+    """Sidecar restart (session state gone) mid-stream: the delta's
+    INVALID_ARGUMENT resident-epoch-mismatch triggers a transparent full
+    resend inside the client — the cycle never falls back to scalar."""
+
+    def body(client, service):
+        nodes, advisor = gen_host_cluster(32, seed=0)
+        running: list = []
+        sched = make_sched(nodes, advisor, running, resident=True, engine=client)
+        for pod in gen_host_pods(96, seed=1):
+            sched.submit(pod)
+        metrics = drain(sched, running)
+        assert sched.totals["delta_uploads"] >= 1
+        # "restart": evict every session (resident state + field caches)
+        service._field_cache.clear()
+        for pod in gen_host_pods(48, seed=2):
+            sched.submit(pod)
+        metrics += drain(sched, running)
+        return sched, metrics
+
+    sched, metrics = _with_sidecar(body)
+    assert not any(m.used_fallback for m in metrics)
+    # post-restart cycles recovered: at least one full resend, then deltas
+    assert sched.totals["full_uploads"] >= 2
+    assert sum(m.pods_bound for m in metrics) == 96 + 48
+
+
+def test_resident_capability_downgrade_invalidates_together():
+    """The satellite bugfix: a mid-stream capability downgrade (sidecar
+    replaced by a build without field_cache/resident_state) must
+    invalidate the wire field cache AND the resident capability latch
+    together — the client re-probes both and degrades to plain full
+    sends instead of looping on rejected deltas."""
+
+    def body(client, service):
+        nodes, advisor = gen_host_cluster(32, seed=0)
+        running: list = []
+        sched = make_sched(nodes, advisor, running, resident=True, engine=client)
+        for pod in gen_host_pods(64, seed=1):
+            sched.submit(pod)
+        metrics = drain(sched, running)
+        assert sched.totals["delta_uploads"] >= 1
+        assert client._field_cache_ok is True and client._resident_cap is True
+        # the downgrade: the same target now serves neither capability
+        service.field_cache_enabled = False
+        service.resident_enabled = False
+        for pod in gen_host_pods(64, seed=2):
+            sched.submit(pod)
+        metrics += drain(sched, running)
+        return sched, metrics, client
+
+    sched, metrics, client = _with_sidecar(body)
+    # both latches re-probed to the downgraded answers — never one stale
+    assert client._field_cache_ok is False
+    assert client._resident_cap is False
+    # at most one cycle paid a fallback while the latches re-learned;
+    # everything recovered and every pod bound
+    assert sum(1 for m in metrics if m.used_fallback) <= 1
+    assert sum(m.pods_bound for m in metrics) == 128
+    assert not metrics[-1].used_fallback

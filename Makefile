@@ -5,7 +5,7 @@ PY ?= python
 IMAGE_REPO ?= registry.example.com/yoda-tpu
 TAG ?= latest
 
-.PHONY: local test test-fast bench lint native native-asan native-tsan proto clean build push
+.PHONY: local test test-fast bench trace-smoke lint native native-asan native-tsan proto clean build push
 
 # "make local" in the reference = fmt + vet + compile. Here: byte-compile
 # the package, build the native library, lint, run the fast tests.
@@ -49,6 +49,27 @@ bench-smoke:
 	env JAX_PLATFORMS=cpu BENCH_NODES=64 BENCH_PODS=128 BENCH_WINDOW=32 \
 	  BENCH_REPS=2 BENCH_BASELINE_PODS=8 BENCH_LOOP_NODES=32 \
 	  BENCH_LOOP_PODS=64 $(PY) bench.py
+
+# flight-recorder round trip on CPU: record a short sim-driven run (the
+# config pins the device path — tiny cycles would otherwise route to
+# the scalar fallback, which records decisions but is not replayable),
+# replay the journal, and diff the recorded vs replayed journals —
+# `trace replay` exits non-zero on ANY binding diff, `trace diff` on
+# any decision difference. tests/test_bench_smoke.py wraps the same
+# flow as a slow-marked test.
+TRACE_SMOKE_DIR ?= /tmp/yoda-trace-smoke
+trace-smoke:
+	rm -rf $(TRACE_SMOKE_DIR)
+	mkdir -p $(TRACE_SMOKE_DIR)
+	printf '{"batch_window": 64, "min_device_work": 1, "adaptive_dispatch": false}' \
+	  > $(TRACE_SMOKE_DIR)/config.json
+	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_scheduler_tpu scheduler \
+	  --nodes 48 --pods 192 --config $(TRACE_SMOKE_DIR)/config.json \
+	  --trace $(TRACE_SMOKE_DIR)/journal
+	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_scheduler_tpu trace replay \
+	  $(TRACE_SMOKE_DIR)/journal --out $(TRACE_SMOKE_DIR)/replayed
+	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_scheduler_tpu trace diff \
+	  $(TRACE_SMOKE_DIR)/journal $(TRACE_SMOKE_DIR)/replayed
 
 native:
 	$(MAKE) -C native

@@ -1,10 +1,12 @@
-"""wire-schema: schedule_pb2 field usage must exist in schedule.proto.
+"""wire-schema: wire contracts must match their declared schemas.
 
-The bridge's hand-written stubs mean no compiler checks that the Python
-side's field names still exist in the .proto — a renamed field would
-silently serialize nothing (proto3 default) instead of failing. This
-rule parses the .proto's message blocks and checks, in every file that
-imports a `*_pb2` module:
+Two wire surfaces, one rule family:
+
+gRPC bridge — the hand-written stubs mean no compiler checks that the
+Python side's field names still exist in the .proto; a renamed field
+would silently serialize nothing (proto3 default) instead of failing.
+This rule parses the .proto's message blocks and checks, in every file
+that imports a `*_pb2` module:
 
 - keyword arguments of `pb.<Message>(...)` constructors;
 - first-level attribute access on variables whose Message type is known
@@ -12,6 +14,17 @@ imports a `*_pb2` module:
   assignments).
 
 Protobuf runtime API names (CopyFrom, SerializeToString, ...) pass.
+
+Trace journal (trace/schema.py) — the flight recorder's record layout
+is declared as a JOURNAL_FIELDS tag table plus a TENSOR_DTYPES pinning
+map, and the same schema-drift failure modes apply: a reused tag makes
+old journals decode into the wrong field, an unpinned or drifted dtype
+makes "bitwise replay parity" silently meaningless. In any file that
+declares those tables the rule checks: field tags are unique integer
+LITERALS (a computed tag has no stable wire identity), field names are
+unique, kinds come from the declared set, every tensor dtype is a
+literal from the pinned dtype set (float64 is deliberately absent), and
+every dtype key's field prefix is a declared `tensors`-kind field.
 """
 
 from __future__ import annotations
@@ -30,6 +43,12 @@ from kubernetes_scheduler_tpu.analysis.core import (
 RULE = "wire-schema"
 
 SCOPE = ("kubernetes_scheduler_tpu/bridge/*.py",)
+TRACE_SCOPE = ("kubernetes_scheduler_tpu/trace/*.py",)
+
+# the journal's pinned dtype vocabulary — float64 deliberately absent
+# (device parity is float32; a silent f64 leaf would diff every replay)
+_PINNED_DTYPES = {"float32", "int32", "int64", "bool", "uint8"}
+_JOURNAL_KINDS = {"u64", "f64", "str", "json", "tensors"}
 
 _DEFAULT_PROTO = os.path.join(
     "kubernetes_scheduler_tpu", "bridge", "schedule.proto"
@@ -119,8 +138,158 @@ def _message_of(node: ast.AST, aliases: set) -> str | None:
     return None
 
 
+def _const(node) -> object:
+    return node.value if isinstance(node, ast.Constant) else _NOT_CONST
+
+
+_NOT_CONST = object()
+
+
+def _journal_tables(tree: ast.AST):
+    """Top-level JOURNAL_FIELDS / TENSOR_DTYPES assignments, or Nones."""
+    fields_node = dtypes_node = None
+    for node in getattr(tree, "body", ()):
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+        ):
+            if node.targets[0].id == "JOURNAL_FIELDS":
+                fields_node = node.value
+            elif node.targets[0].id == "TENSOR_DTYPES":
+                dtypes_node = node.value
+    return fields_node, dtypes_node
+
+
+def _check_journal_schema(sf: SourceFile) -> list[Violation]:
+    out: list[Violation] = []
+    fields_node, dtypes_node = _journal_tables(sf.tree)
+    if fields_node is None and dtypes_node is None:
+        return out
+    tensor_fields: set[str] = set()
+    have_fields = fields_node is not None
+    if have_fields:
+        seen_tags: dict[int, str] = {}
+        seen_names: set[str] = set()
+        elts = (
+            fields_node.elts
+            if isinstance(fields_node, (ast.Tuple, ast.List))
+            else ()
+        )
+        for e in elts:
+            if not (
+                isinstance(e, ast.Call)
+                and dotted_name(e.func) in ("Field",)
+            ):
+                continue
+            slots = {"tag": None, "name": None, "kind": None}
+            for pos, arg in zip(("tag", "name", "kind"), e.args):
+                slots[pos] = arg
+            for kw in e.keywords:
+                if kw.arg in slots:
+                    slots[kw.arg] = kw.value
+            tag = _const(slots["tag"]) if slots["tag"] is not None else _NOT_CONST
+            name = _const(slots["name"]) if slots["name"] is not None else _NOT_CONST
+            kind = _const(slots["kind"]) if slots["kind"] is not None else _NOT_CONST
+            if not isinstance(tag, int) or isinstance(tag, bool) or tag <= 0:
+                out.append(
+                    Violation(
+                        RULE, sf.path, e.lineno,
+                        "journal field tag must be a positive integer "
+                        "LITERAL — tags are wire identity and a computed "
+                        "tag has no stable value to keep",
+                    )
+                )
+            elif tag in seen_tags:
+                out.append(
+                    Violation(
+                        RULE, sf.path, e.lineno,
+                        f"journal field tag {tag} reused (already "
+                        f"`{seen_tags[tag]}`) — reuse makes old journals "
+                        "decode into the wrong field",
+                    )
+                )
+            else:
+                seen_tags[tag] = name if isinstance(name, str) else "?"
+            if isinstance(name, str):
+                if name in seen_names:
+                    out.append(
+                        Violation(
+                            RULE, sf.path, e.lineno,
+                            f"journal field name `{name}` declared twice",
+                        )
+                    )
+                seen_names.add(name)
+                if kind == "tensors":
+                    tensor_fields.add(name)
+            if not isinstance(kind, str):
+                # a computed or missing kind has no stable wire identity
+                # — the same drift class as a computed tag
+                out.append(
+                    Violation(
+                        RULE, sf.path, e.lineno,
+                        "journal field kind must be a string LITERAL "
+                        f"from {sorted(_JOURNAL_KINDS)}",
+                    )
+                )
+            elif kind not in _JOURNAL_KINDS:
+                out.append(
+                    Violation(
+                        RULE, sf.path, e.lineno,
+                        f"unknown journal field kind {kind!r}; expected "
+                        f"one of {sorted(_JOURNAL_KINDS)}",
+                    )
+                )
+    if dtypes_node is not None and isinstance(dtypes_node, ast.Dict):
+        seen_keys: set[str] = set()
+        for k, v in zip(dtypes_node.keys, dtypes_node.values):
+            key = _const(k) if k is not None else _NOT_CONST
+            val = _const(v)
+            line = (k or v).lineno
+            if not isinstance(key, str):
+                out.append(
+                    Violation(
+                        RULE, sf.path, line,
+                        "TENSOR_DTYPES keys must be string literals "
+                        "(`<field>.<leaf>`)",
+                    )
+                )
+                continue
+            if key in seen_keys:
+                out.append(
+                    Violation(
+                        RULE, sf.path, line,
+                        f"TENSOR_DTYPES key `{key}` declared twice",
+                    )
+                )
+            seen_keys.add(key)
+            prefix = key.split(".", 1)[0]
+            if have_fields and prefix not in tensor_fields:
+                out.append(
+                    Violation(
+                        RULE, sf.path, line,
+                        f"TENSOR_DTYPES key `{key}`: `{prefix}` is not a "
+                        "declared `tensors`-kind journal field",
+                    )
+                )
+            if not isinstance(val, str) or val not in _PINNED_DTYPES:
+                shown = val if val is not _NOT_CONST else "<non-literal>"
+                out.append(
+                    Violation(
+                        RULE, sf.path, v.lineno,
+                        f"tensor dtype for `{key}` must be a literal from "
+                        f"{sorted(_PINNED_DTYPES)}; got {shown!r} — an "
+                        "unpinned dtype makes bitwise replay parity "
+                        "unverifiable",
+                    )
+                )
+    return out
+
+
 def check(ctx: Context) -> list[Violation]:
     out: list[Violation] = []
+    for sf in ctx.scoped(TRACE_SCOPE):
+        out.extend(_check_journal_schema(sf))
     for sf in ctx.scoped(SCOPE):
         aliases = _pb_aliases(sf.tree)
         if not aliases:

@@ -108,6 +108,10 @@ class RemoteEngine:
         # otherwise leaves one latch trusting a dead sidecar's
         # advertisement while the other re-probes
         self._resident_cap: bool | None = None
+        # windows-resident capability (HealthReply.windows_resident):
+        # resident deltas on the ScheduleWindows RPC — probed, latched,
+        # and invalidated together with the other two
+        self._windows_resident_cap: bool | None = None
         # did the LAST schedule_resident call apply a delta server-side?
         # (mirrors LocalEngine.resident_used_delta for the host's
         # delta/full upload metrics)
@@ -129,6 +133,9 @@ class RemoteEngine:
         if info is not None:
             self._field_cache_ok = bool(info.field_cache)
             self._resident_cap = bool(info.resident_state)
+            self._windows_resident_cap = bool(
+                getattr(info, "windows_resident", False)
+            )
 
     def _field_cache_enabled(self) -> bool:
         """Resolve the sidecar's field-cache capability ONCE per client
@@ -148,6 +155,14 @@ class RemoteEngine:
             self._probe_capabilities()
         return bool(self._resident_cap)
 
+    def supports_windows_resident(self) -> bool:
+        """Resolve the sidecar's windows-resident capability (resident
+        deltas on the ScheduleWindows backlog RPC) — same latch
+        discipline as supports_resident."""
+        if self._windows_resident_cap is None:
+            self._probe_capabilities()
+        return bool(self._windows_resident_cap)
+
     def _invalidate_session(self) -> None:
         """Reset everything scoped to the sidecar behind this target: the
         wire field cache AND both capability latches (field cache,
@@ -160,6 +175,7 @@ class RemoteEngine:
         self._wire_cache.clear()
         self._field_cache_ok = None
         self._resident_cap = None
+        self._windows_resident_cap = None
 
     def _cache_for(self, key: str, enabled: bool):
         if not enabled:
@@ -296,13 +312,27 @@ class RemoteEngine:
             codec.pack_fields(pods, req.pods, cache=pods_cache)
             return req
 
+        reply = self._resident_call(
+            self._schedule, build_request, delta, "resident"
+        )
+        return self._unpack_result(reply, snapshot, pods)
+
+    def _resident_call(self, method, build_request, delta, what: str):
+        """Delta-first resident send with the transparent full resend on
+        INVALID_ARGUMENT "resident-epoch-mismatch" (sidecar restart,
+        session eviction, epoch desync, layout churn) — ONE
+        implementation of the recovery protocol for both resident
+        surfaces (ScheduleBatch and ScheduleWindows), so the
+        string-matched detail contract cannot drift between them.
+        Leaves `resident_used_delta` reporting which path served the
+        call."""
         if delta is not None:
             try:
                 reply = self._call_cached(
-                    self._schedule, lambda: build_request(True)
+                    method, lambda: build_request(True)
                 )
                 self.resident_used_delta = True
-                return self._unpack_result(reply, snapshot, pods)
+                return reply
             except EngineUnavailable as e:
                 cause = e.__cause__
                 if not (
@@ -312,13 +342,12 @@ class RemoteEngine:
                 ):
                     raise
                 log.warning(
-                    "sidecar %s cannot apply the resident delta "
+                    "sidecar %s cannot apply the %s delta "
                     "(restart/eviction/churn); resending in full",
-                    self.target,
+                    self.target, what,
                 )
         self.resident_used_delta = False
-        reply = self._call_cached(self._schedule, lambda: build_request(False))
-        return self._unpack_result(reply, snapshot, pods)
+        return self._call_cached(method, lambda: build_request(False))
 
     def schedule_resident_async(
         self, snapshot, pods, *, delta=None, epoch: int = 0, **kw
@@ -400,6 +429,43 @@ class RemoteEngine:
         for name, weight in score_plugins or ():
             request.score_plugins.add(name=name, weight=float(weight))
         reply = self._call_cached(self._schedule_windows, build_request)
+        return codec.unpack_fields(engine.WindowsResult, reply.result)
+
+    def schedule_windows_resident(
+        self, snapshot, pods_windows, *, delta=None, epoch: int = 0, **kw
+    ) -> "engine.WindowsResult":
+        """ScheduleWindows against sidecar-resident cluster state (the
+        backlog twin of schedule_resident, same session-retained
+        snapshot and epoch sequence). `snapshot` is always the full host
+        build; a given `delta` ships instead of the snapshot map, and an
+        inapplicable delta (restart, eviction, epoch desync, churn)
+        aborts INVALID_ARGUMENT "resident-epoch-mismatch" — this method
+        transparently resends the full snapshot. A sidecar without the
+        windows_resident capability is served a plain ScheduleWindows."""
+        if not self.supports_windows_resident():
+            self.resident_used_delta = False
+            return self.schedule_windows(snapshot, pods_windows, **kw)
+        request = self._base_request(**kw)
+
+        def build_request(with_delta: bool):
+            req = pb.ScheduleRequest()
+            req.CopyFrom(request)
+            enabled = self._field_cache_enabled()
+            pods_cache = self._cache_for("windows:pods", enabled)
+            req.session_id = self._session_id
+            req.resident_epoch = epoch
+            if with_delta:
+                codec.pack_fields(delta, req.snapshot_delta)
+            else:
+                req.resident_full = True
+                snap_cache = self._cache_for("windows:snapshot", enabled)
+                codec.pack_fields(snapshot, req.snapshot, cache=snap_cache)
+            codec.pack_fields(pods_windows, req.pods, cache=pods_cache)
+            return req
+
+        reply = self._resident_call(
+            self._schedule_windows, build_request, delta, "windows-resident"
+        )
         return codec.unpack_fields(engine.WindowsResult, reply.result)
 
     def preempt(self, snapshot, pods, victims, *, k_cap: int):

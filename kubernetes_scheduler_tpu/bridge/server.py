@@ -98,6 +98,10 @@ class EngineService:
         # exercise the client's invalidate-together recovery)
         self.field_cache_enabled = field_cache
         self.resident_enabled = resident_state
+        # resident deltas on the ScheduleWindows RPC (multi-window
+        # backlog path; HealthReply.windows_resident) — its own switch
+        # so a canary can downgrade it independently of batch-resident
+        self.windows_resident_enabled = resident_state
         # resident-state observability (tests + ops): how many cycles
         # were served from an applied delta vs. a full resident upload
         self.resident_deltas_served = 0
@@ -316,10 +320,19 @@ class EngineService:
         dispatch schedules every window with capacity + (anti)affinity
         carries threaded between them."""
         snap_cache, pods_cache = self._session_caches(request, "windows")
-        try:
-            snapshot = codec.unpack_fields(
-                engine.SnapshotArrays, request.snapshot, cache=snap_cache
+        if (
+            bool(request.snapshot_delta.tensors) or request.resident_full
+        ) and not self.windows_resident_enabled:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "resident-epoch-mismatch: this sidecar does not serve "
+                "resident cluster state on ScheduleWindows",
             )
+        try:
+            # the resident protocol is shared with ScheduleBatch — same
+            # session-retained snapshot, same epoch sequence (backlog
+            # and single-window cycles interleave on one counter)
+            snapshot = self._resident_snapshot(request, context, snap_cache)
             pods_w = codec.unpack_fields(
                 engine.PodBatch, request.pods, cache=pods_cache
             )
@@ -403,6 +416,7 @@ class EngineService:
             cycles_served=self.cycles_served,
             field_cache=self.field_cache_enabled,
             resident_state=self.resident_enabled,
+            windows_resident=self.windows_resident_enabled,
         )
 
 

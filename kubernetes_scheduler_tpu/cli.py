@@ -38,7 +38,8 @@ def _load_config(args) -> SchedulerConfig:
         else SchedulerConfig()
     )
     for key in (
-        "policy", "assigner", "normalizer", "batch_window", "learned_checkpoint"
+        "policy", "assigner", "normalizer", "batch_window",
+        "learned_checkpoint", "trace_path",
     ):
         v = getattr(args, key, None)
         if v is not None:
@@ -63,6 +64,12 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
         "--no-tpu",
         action="store_true",
         help="feature-gate TPUBatchScore=false: scalar fallback path only",
+    )
+    p.add_argument(
+        "--trace",
+        dest="trace_path",
+        help="cycle flight recorder: journal every cycle under this "
+        "directory (trace/; replay with `yoda-tpu trace replay`)",
     )
 
 
@@ -187,6 +194,8 @@ def cmd_scheduler_kube(args, cfg) -> int:
         cycles = sched.totals["cycles"]
     finally:
         cache.stop()
+        if sched.recorder is not None:
+            sched.recorder.close()
         if hasattr(advisor, "close"):
             advisor.close()  # stop the background refresh thread
         if elector is not None:
@@ -258,11 +267,14 @@ def cmd_scheduler(args) -> int:
         cycles = sched.run_until_empty(max_cycles=args.max_cycles)
     finally:
         # SIGTERM (SystemExit via _terminate) must still release the
-        # lease — an unreleased lease stalls standby failover — and
-        # close the exporter; on the normal path these are no-ops for
-        # the exporter in serve-forever mode, handled below
+        # lease — an unreleased lease stalls standby failover — close
+        # the flight-recorder journal, and close the exporter; on the
+        # normal path these are no-ops for the exporter in serve-forever
+        # mode, handled below
         if elector is not None:
             elector.release()
+        if sched.recorder is not None:
+            sched.recorder.close()
     dt = time.perf_counter() - t0
     for binding in sched.binder.bindings:
         running.append(binding.pod)
@@ -324,6 +336,52 @@ def cmd_bench(args) -> int:
     bench = importlib.import_module("bench")
     bench.main()
     return 0
+
+
+def cmd_trace(args) -> int:
+    """Flight-recorder journal tooling: stats/dump read a journal
+    without an engine; diff compares two journals on decision content;
+    replay re-executes one and exits non-zero on any binding diff."""
+    from kubernetes_scheduler_tpu.trace import inspect as tinspect
+
+    if args.trace_cmd == "stats":
+        print(json.dumps(tinspect.stats(args.journal)))
+        return 0
+    if args.trace_cmd == "dump":
+        for line in tinspect.dump(args.journal, limit=args.limit):
+            print(json.dumps(line))
+        return 0
+    if args.trace_cmd == "diff":
+        report = tinspect.diff(args.journal, args.other)
+        print(json.dumps(report))
+        clean = (
+            report["differences"] == 0
+            and report["extra_records_a"] == 0
+            and report["extra_records_b"] == 0
+            and not report.get("truncated")
+        )
+        return 0 if clean else 1
+    # replay
+    from kubernetes_scheduler_tpu.trace.replay import replay_journal
+
+    engine = None
+    if args.engine and args.engine != "local":
+        from kubernetes_scheduler_tpu.bridge.client import RemoteEngine
+
+        engine = RemoteEngine(args.engine)
+    try:
+        report = replay_journal(
+            args.journal,
+            engine=engine,
+            mode=args.mode,
+            resident=args.resident,
+            record_path=args.out,
+        )
+    finally:
+        if engine is not None:
+            engine.close()
+    print(json.dumps(report.to_dict()))
+    return 1 if report.binding_diffs else 0
 
 
 def cmd_config(args) -> int:
@@ -416,6 +474,46 @@ def build_parser() -> argparse.ArgumentParser:
 
     pb = sub.add_parser("bench", help="run the throughput benchmark")
     pb.set_defaults(fn=cmd_bench)
+
+    pt = sub.add_parser(
+        "trace", help="flight-recorder journals: dump/stats/diff/replay"
+    )
+    tsub = pt.add_subparsers(dest="trace_cmd", required=True)
+    td = tsub.add_parser("dump", help="per-record summaries as JSON lines")
+    td.add_argument("journal", help="journal directory")
+    td.add_argument("--limit", type=int, default=None)
+    ts = tsub.add_parser("stats", help="whole-journal aggregates")
+    ts.add_argument("journal")
+    tf = tsub.add_parser(
+        "diff",
+        help="record-by-record decision diff of two journals "
+        "(exit 1 on any difference)",
+    )
+    tf.add_argument("journal")
+    tf.add_argument("other")
+    tr = tsub.add_parser(
+        "replay",
+        help="re-execute a journal and diff bindings bitwise "
+        "(exit 1 on any diff)",
+    )
+    tr.add_argument("journal")
+    tr.add_argument(
+        "--engine",
+        default="local",
+        help='"local" or a gRPC sidecar target like "localhost:50051"',
+    )
+    tr.add_argument("--mode", choices=("serial", "pipelined"), default="serial")
+    tr.add_argument(
+        "--resident",
+        action="store_true",
+        help="drive the resident-state delta-upload surface",
+    )
+    tr.add_argument(
+        "--out",
+        default=None,
+        help="re-record the replayed cycles as a new journal here",
+    )
+    pt.set_defaults(fn=cmd_trace)
 
     pf = sub.add_parser("config", help="print effective config")
     _add_config_flags(pf)

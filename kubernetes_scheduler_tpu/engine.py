@@ -770,6 +770,32 @@ class LocalEngine:
             self._consts.swap(snapshot), self._consts.swap(pods_windows), **kw
         )
 
+    def supports_windows_resident(self) -> bool:
+        return True
+
+    def schedule_windows_resident(
+        self, snapshot, pods_windows, *, delta=None, epoch=0, **kw
+    ) -> "WindowsResult":
+        """schedule_windows against device-resident cluster state — the
+        multi-window twin of schedule_resident, sharing the SAME
+        retained snapshot/epoch (backlog and single-window cycles
+        interleave on one epoch sequence). The scan's cross-window
+        capacity/affinity carries stay internal to the call; the
+        retained state remains the PRE-backlog snapshot, exactly as the
+        host's delta base accounting assumes."""
+        st = self._resident
+        if delta is not None and st is not None and st.accepts(delta, epoch):
+            new_snap = apply_snapshot_delta(st.snapshot, delta)
+            st.snapshot = new_snap
+            st.epoch = epoch
+            self.resident_used_delta = True
+        else:
+            self._resident = ResidentState(jax.device_put(snapshot), epoch)
+            self.resident_used_delta = False
+        return schedule_windows(
+            self._resident.snapshot, self._consts.swap(pods_windows), **kw
+        )
+
     def preempt(self, snapshot, pods, victims, *, k_cap: int):
         return preempt_batch(snapshot, pods, victims, k_cap=k_cap)
 

@@ -148,6 +148,13 @@ _HELP = {
         "Cycles served a utilization snapshot older than twice the "
         "advisor refresh interval (BackgroundAdvisor brown-out signal)"
     ),
+    # cycle flight recorder (config.trace_path; trace/recorder.py)
+    "cycles_recorded_total": "Scheduling cycles journaled by the flight recorder",
+    "trace_bytes_total": "Journal bytes written by the flight recorder",
+    "trace_records_dropped_total": (
+        "Cycle records the flight recorder failed to journal "
+        "(encode/IO error — the scheduling loop never pays for these)"
+    ),
 }
 
 
@@ -189,11 +196,17 @@ class MetricsExporter:
                     stale = getattr(
                         getattr(sched, "advisor", None), "stale_served", None
                     )
-                    extra = (
-                        {"advisor_stale_served_total": stale}
-                        if stale is not None
-                        else None
-                    )
+                    extra = {}
+                    if stale is not None:
+                        extra["advisor_stale_served_total"] = stale
+                    rec = getattr(sched, "recorder", None)
+                    if rec is not None:
+                        extra.update(
+                            cycles_recorded_total=rec.cycles_recorded,
+                            trace_bytes_total=rec.bytes_written,
+                            trace_records_dropped_total=rec.records_dropped,
+                        )
+                    extra = extra or None
                     body = render_prometheus(window, totals, extra).encode()
                     ctype = "text/plain; version=0.0.4"
                 elif self.path == "/healthz":

@@ -1033,6 +1033,90 @@ class SnapshotBuilder:
         if not ephemeral:
             self.__dict__["_dc_prefix"] = suffix_record(running)
         s = self._selector_slots()
+        if not self.selectors:
+            counts = np.zeros((n, s), np.float32)
+            domain_id = np.tile(
+                np.arange(n, dtype=np.int32)[:, None], (1, s)
+            )
+            return counts, domain_id, counts.copy(), counts.copy(), counts.copy()
+        # Incremental raw tables (ROADMAP follow-up: skip the rebuild of
+        # provably-unchanged snapshot sections). The O(running x
+        # selectors) matching scan is the dominant cost here, and the
+        # host loop passes the SAME append-only running list cycle after
+        # cycle — so the per-node raw tables are carried across builds
+        # and only pods appended since the last build are matched.
+        # Invalidation is exact: any node-set change (object identities),
+        # any selector minted since (prefix pods were never matched
+        # against it), or a rebuilt/shrunk running list falls back to the
+        # full scan. Accumulation order is prefix-then-suffix, the same
+        # order the full scan sums in — bitwise identical outputs.
+        n_real = len(nodes)
+        node_ids = tuple(map(id, nodes))
+        rc = self.__dict__.get("_dc_raw")
+        start = 0
+        if (
+            rc is not None
+            and rc["node_ids"] == node_ids
+            and rc["n_sel"] == len(self.selectors)
+            and rc["s"] == s
+        ):
+            start = suffix_start(rc["prefix"], running)
+        if start:
+            raw, raw_avoid, raw_attract_w, raw_avoid_w = rc["tables"]
+            if ephemeral:
+                # a throwaway build must never mutate the retained tables
+                raw = raw.copy()
+                raw_avoid = raw_avoid.copy()
+                raw_attract_w = raw_attract_w.copy()
+                raw_avoid_w = raw_avoid_w.copy()
+        else:
+            rc = None
+            raw = np.zeros((n_real, s), np.float32)
+            raw_avoid = np.zeros((n_real, s), np.float32)
+            raw_attract_w = np.zeros((n_real, s), np.float32)
+            raw_avoid_w = np.zeros((n_real, s), np.float32)
+        suffix = running[start:] if start else running
+        if suffix:
+            node_index = {nd.name: i for i, nd in enumerate(nodes)}
+            for pod in suffix:
+                i = node_index.get(pod.node_name)
+                if i is None:
+                    continue
+                for key, sid in self.selectors.items():
+                    if self._key_matches(pod, key):
+                        raw[i, sid] += 1
+                for term in pod.pod_affinity:
+                    # intern ONLY the term kinds the pre-intern loop above
+                    # registered (preferred/anti): a required attract term
+                    # of a running pod would otherwise mint a fresh
+                    # selector id AFTER the arrays were sized to s — an
+                    # index crash
+                    if term.preferred:
+                        sid = self._selector_id(term)
+                        (raw_avoid_w if term.anti else raw_attract_w)[i, sid] += term.weight
+                    elif term.anti:
+                        raw_avoid[i, self._selector_id(term)] += 1
+        if not ephemeral:
+            unchanged = rc is not None and not suffix
+            if not unchanged:
+                rc = {
+                    "node_ids": node_ids,
+                    # pin the node OBJECTS so their ids cannot be
+                    # recycled under the cache (same rule as the
+                    # _node_static cache's nodes_ref)
+                    "nodes_ref": list(nodes),
+                    "n_sel": len(self.selectors),
+                    "s": s,
+                    "tables": (raw, raw_avoid, raw_attract_w, raw_avoid_w),
+                    "out": None,
+                }
+                self.__dict__["_dc_raw"] = rc
+            rc["prefix"] = suffix_record(running)
+            if unchanged and rc["out"] is not None:
+                # nothing moved since the last build: serve the SAME
+                # output arrays, so snapshot_delta's identity fast path
+                # skips diffing the four [n, S] tables entirely
+                return rc["out"]
         counts = np.zeros((n, s), np.float32)
         avoid = np.zeros((n, s), np.float32)
         attract_w = np.zeros((n, s), np.float32)
@@ -1041,31 +1125,6 @@ class SnapshotBuilder:
         domain_id = np.tile(
             np.arange(n, dtype=np.int32)[:, None], (1, s)
         )
-        if not self.selectors:
-            return counts, domain_id, avoid, attract_w, avoid_w
-        node_index = {nd.name: i for i, nd in enumerate(nodes)}
-        # per-node raw counts
-        raw = np.zeros((len(nodes), s), np.float32)
-        raw_avoid = np.zeros((len(nodes), s), np.float32)
-        raw_attract_w = np.zeros((len(nodes), s), np.float32)
-        raw_avoid_w = np.zeros((len(nodes), s), np.float32)
-        for pod in running:
-            i = node_index.get(pod.node_name)
-            if i is None:
-                continue
-            for key, sid in self.selectors.items():
-                if self._key_matches(pod, key):
-                    raw[i, sid] += 1
-            for term in pod.pod_affinity:
-                # intern ONLY the term kinds the pre-intern loop above
-                # registered (preferred/anti): a required attract term of
-                # a running pod would otherwise mint a fresh selector id
-                # AFTER the arrays were sized to s — an index crash
-                if term.preferred:
-                    sid = self._selector_id(term)
-                    (raw_avoid_w if term.anti else raw_attract_w)[i, sid] += term.weight
-                elif term.anti:
-                    raw_avoid[i, self._selector_id(term)] += 1
         # aggregate over topology domains
         for (_items, _exprs, topo, _ns), sid in self.selectors.items():
             sums: dict[str, list[float]] = {}
@@ -1082,7 +1141,10 @@ class SnapshotBuilder:
                 d = nd.name if topo == "kubernetes.io/hostname" else nd.labels.get(topo, "")
                 counts[i, sid], avoid[i, sid], attract_w[i, sid], avoid_w[i, sid] = sums[d]
                 domain_id[i, sid] = first[d]
-        return counts, domain_id, avoid, attract_w, avoid_w
+        out = (counts, domain_id, avoid, attract_w, avoid_w)
+        if not ephemeral:
+            self.__dict__["_dc_raw"]["out"] = out
+        return out
 
     # ---- pod side ------------------------------------------------------
 

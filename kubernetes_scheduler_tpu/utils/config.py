@@ -158,6 +158,18 @@ class SchedulerConfig:
     # priority pods from the least-disruptive node. Requires an evictor
     # wired into the Scheduler (RecordingEvictor for sims, kube.
     # KubeEvictor live); without one the pass is inert.
+    # cycle flight recorder (trace/): when set, every scheduling cycle
+    # appends one length-prefixed, CRC-guarded record (window pod
+    # identity, the snapshot arrays or the SnapshotDelta actually
+    # shipped, engine options, resident epoch, path taken, bindings,
+    # CycleMetrics) to a rotating journal under this DIRECTORY, bounded
+    # by trace_max_bytes total (oldest files dropped; every file opens
+    # with a full snapshot so a head-rotated journal still replays).
+    # trace/replay.py re-executes a journal through any engine mode
+    # combination and diffs bindings bitwise. None = off (zero cost).
+    trace_path: str | None = None
+    trace_file_bytes: int = 32 * 1024 * 1024
+    trace_max_bytes: int = 256 * 1024 * 1024
     preemption: bool = True
     preemption_max_victims: int = 8
     # preemptors evaluated per pass, highest priority first: the

@@ -1,0 +1,23 @@
+"""wire-schema journal fixture: a well-formed schema table stays quiet."""
+
+
+class Field:
+    def __init__(self, tag, name, kind):
+        self.tag, self.name, self.kind = tag, name, kind
+
+
+JOURNAL_FIELDS = (
+    Field(1, "seq", "u64"),
+    Field(2, "path", "str"),
+    Field(3, "metrics", "json"),
+    Field(4, "wall_time", "f64"),
+    Field(5, "snapshot", "tensors"),
+    Field(6, "assign", "tensors"),
+)
+
+TENSOR_DTYPES = {
+    "snapshot.allocatable": "float32",
+    "snapshot.node_mask": "bool",
+    "snapshot.labels": "int32",
+    "assign.node_idx": "int32",
+}

@@ -1,0 +1,29 @@
+"""wire-schema journal fixture: every schema-drift failure mode fires."""
+
+BASE_TAG = 40
+
+
+class Field:
+    def __init__(self, tag, name, kind):
+        self.tag, self.name, self.kind = tag, name, kind
+
+
+SOME_KIND = "u64"
+
+JOURNAL_FIELDS = (
+    Field(1, "seq", "u64"),
+    Field(1, "path", "str"),            # tag 1 reused -> violation
+    Field(2, "seq", "json"),            # name reused -> violation
+    Field(BASE_TAG + 1, "extra", "str"),  # computed tag -> violation
+    Field(0, "zero", "u64"),            # non-positive tag -> violation
+    Field(3, "blob", "bytes_v2"),       # unknown kind -> violation
+    Field(5, "computed", SOME_KIND),    # non-literal kind -> violation
+    Field(4, "snapshot", "tensors"),
+)
+
+TENSOR_DTYPES = {
+    "snapshot.allocatable": "float64",   # unpinned dtype -> violation
+    "snapshot.requested": "float32",
+    "pods.request": "float32",           # `pods` not tensors-kind -> violation
+    "snapshot.mask": "bool",
+}

@@ -42,6 +42,12 @@ def active(violations):
             "wire_schema_clean.py",
             4,
         ),
+        (
+            "wire-schema",
+            "journal_schema_violation.py",
+            "journal_schema_clean.py",
+            6,
+        ),
         ("dtype-shape", "dtype_shape_violation.py", "dtype_shape_clean.py", 3),
         ("timeout-hygiene", "timeout_violation.py", "timeout_clean.py", 5),
         (
@@ -153,6 +159,30 @@ def test_pallas_vmem_covers_all_three_families():
         "kubernetes_scheduler_tpu", "ops", "pallas_fused.py",
     )
     assert active(run_lint([real], rules=["pallas-vmem"])) == []
+
+
+def test_journal_schema_messages_name_the_drift():
+    """Each journal-schema failure mode fires with a message naming the
+    drift — and the REAL trace/schema.py lints clean (what `make lint`
+    enforces for the journal contract)."""
+    msgs = [
+        v.message
+        for v in active(
+            lint_fixture("journal_schema_violation.py", "wire-schema")
+        )
+    ]
+    assert any("tag 1 reused" in m for m in msgs)
+    assert any("`seq` declared twice" in m for m in msgs)
+    assert any("positive integer LITERAL" in m for m in msgs)
+    assert any("unknown journal field kind" in m for m in msgs)
+    assert any("kind must be a string LITERAL" in m for m in msgs)
+    assert any("float64" in m for m in msgs)
+    assert any("not a declared `tensors`-kind" in m for m in msgs)
+    real = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "kubernetes_scheduler_tpu", "trace", "schema.py",
+    )
+    assert active(run_lint([real], rules=["wire-schema"])) == []
 
 
 def test_real_schedule_proto_parses():

@@ -52,6 +52,7 @@ def test_bench_smoke_e2e():
         "host_loop_32nodes_deep16w",
         "host_loop_32nodes_pipelined",
         "host_loop_32nodes_resident",
+        "host_loop_32nodes_replay",
     ):
         assert want in metrics, (want, sorted(metrics))
     for name in (
@@ -72,3 +73,52 @@ def test_bench_smoke_e2e():
     assert 0.0 < res["delta_hit_rate"] <= 1.0, res
     assert res["snapshot_upload_bytes"] > 0, res
     assert res["delta_bytes_saved"] > 0, res
+    # the flight-recorder metric: replay reproduced the recorded
+    # bindings bitwise (the acceptance gate) on a recorded workload
+    rep = metrics["host_loop_32nodes_replay"]
+    assert rep["binding_diffs"] == 0, rep
+    assert rep["cycles_replayed"] > 0, rep
+    assert rep["pods_replayed"] > 0, rep
+    assert rep["traced_pods_per_sec"] > 0, rep
+    # the recorder's own wall time is reported (the <5% overhead gate's
+    # evidence; not asserted at smoke sizes where cycles are ~ms)
+    assert "trace_overhead_pct" in rep, rep
+    assert rep["trace_bytes"] > 0, rep
+
+
+def test_trace_smoke_e2e(tmp_path):
+    """The `make trace-smoke` flow as a test: record a sim-driven run
+    on the device path, replay the journal (exit 1 on ANY binding
+    diff), and diff the recorded vs replayed journals (exit 1 on any
+    decision difference)."""
+    cfg = tmp_path / "config.json"
+    cfg.write_text(
+        '{"batch_window": 64, "min_device_work": 1, '
+        '"adaptive_dispatch": false}'
+    )
+    journal = str(tmp_path / "journal")
+    replayed = str(tmp_path / "replayed")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+
+    def run(*argv):
+        return subprocess.run(
+            [sys.executable, "-m", "kubernetes_scheduler_tpu", *argv],
+            capture_output=True, text=True, timeout=300, cwd=REPO, env=env,
+        )
+
+    rec = run(
+        "scheduler", "--nodes", "48", "--pods", "192",
+        "--config", str(cfg), "--trace", journal,
+    )
+    assert rec.returncode == 0, rec.stderr[-2000:]
+    summary = json.loads(rec.stdout.splitlines()[-1])
+    assert summary["pods_bound"] == 192 and summary["fallback_cycles"] == 0
+
+    rep = run("trace", "replay", journal, "--out", replayed)
+    assert rep.returncode == 0, rep.stderr[-2000:] + rep.stdout[-500:]
+    report = json.loads(rep.stdout.splitlines()[-1])
+    assert report["binding_diffs"] == 0 and report["replayed"] > 0
+
+    dif = run("trace", "diff", journal, replayed)
+    assert dif.returncode == 0, dif.stderr[-2000:] + dif.stdout[-500:]
+    assert json.loads(dif.stdout.splitlines()[-1])["differences"] == 0

@@ -40,7 +40,7 @@ def make_sched(nodes, advisor, running, *, resident, engine=None, **kw):
 
 def run_workload(
     resident, *, constraints=False, n_nodes=48, n_pods=130, engine=None,
-    mutate=None, depth=1,
+    mutate=None, depth=1, **cfg_kw,
 ):
     """Drain a backlog cycle by cycle; `mutate(cycle_no, nodes, advisor)`
     injects deterministic churn at the same points in every run so
@@ -49,7 +49,7 @@ def run_workload(
     running: list = []
     sched = make_sched(
         nodes, advisor, running, resident=resident, engine=engine,
-        pipeline_depth=depth,
+        pipeline_depth=depth, **cfg_kw,
     )
     for pod in gen_host_pods(n_pods, seed=1, constraints=constraints):
         sched.submit(pod)
@@ -218,6 +218,149 @@ def test_resident_preemption_parity_and_flush():
     ev1, sched = run_preemption(True)
     assert ev1 == ev0 and len(ev0) >= 1
     assert sched._resident_ok is False  # flushed after the evictions
+
+
+def test_resident_backlog_windows_parity():
+    """The multi-window backlog path (schedule_windows) ships deltas
+    too (ROADMAP follow-up): bindings bit-identical to the no-resident
+    run, with the delta path engaging after the first full upload."""
+    b0, _, _ = run_workload(False, n_pods=160, max_windows_per_cycle=4)
+    b1, m1, s1 = run_workload(True, n_pods=160, max_windows_per_cycle=4)
+    assert b1 == b0 and len(b0) > 0
+    assert s1.totals["delta_uploads"] >= 1
+    assert s1.totals["full_uploads"] >= 1
+    assert s1.totals["fallback_cycles"] == 0
+
+
+def test_resident_backlog_flushes_on_node_churn():
+    """Cross-window layout churn (node add) mid-drain flushes the
+    backlog path to a full upload — never a stale delta — and bindings
+    still match the no-resident run with the same events."""
+
+    def events(cycle, nodes, advisor):
+        if cycle == 1:
+            nodes.append(make_node("n-late"))
+            advisor.utils["n-late"] = NodeUtil(cpu_pct=5.0)
+
+    b0, _, _ = run_workload(
+        False, n_pods=160, max_windows_per_cycle=4, mutate=events
+    )
+    b1, _, s1 = run_workload(
+        True, n_pods=160, max_windows_per_cycle=4, mutate=events
+    )
+    assert b1 == b0 and len(b0) > 0
+    assert s1.totals["full_uploads"] >= 2
+    assert s1.totals["fallback_cycles"] == 0
+
+
+def test_domain_count_incremental_bitwise_and_identity():
+    """The incremental domain-count build (ROADMAP follow-up: skip the
+    rebuild of provably-unchanged sections): appended running pods fold
+    into cached raw tables with outputs BITWISE equal to a fresh
+    builder's full scan — and when nothing changed, the SAME arrays
+    come back (identity), so snapshot_delta skips diffing them."""
+    from kubernetes_scheduler_tpu.host.types import PodAffinityTerm
+
+    def mk_nodes():
+        nodes = []
+        for i in range(12):
+            nd = make_node(f"n{i}")
+            nd.labels = {"topology.kubernetes.io/zone": f"z{i % 3}"}
+            nodes.append(nd)
+        return nodes
+
+    def mk_pod(name, node=None, anti=False):
+        pod = make_pod(name, cpu=100.0)
+        pod.labels = {"app": "svc"}
+        pod.pod_affinity = [
+            PodAffinityTerm(
+                match_labels={"app": "svc"},
+                topology_key="topology.kubernetes.io/zone",
+                anti=anti,
+            )
+        ]
+        pod.node_name = node
+        return pod
+
+    nodes = mk_nodes()
+    utils = {nd.name: NodeUtil(cpu_pct=10.0) for nd in nodes}
+    running = [mk_pod(f"r{i}", node=f"n{i % 12}", anti=(i % 2 == 0))
+               for i in range(6)]
+    window = [mk_pod("w0"), mk_pod("w1", anti=True)]
+    inc = SnapshotBuilder()
+    s1 = inc.build_snapshot(nodes, utils, running, pending_pods=window)
+    # appended suffix (the live informer's shape)
+    running.append(mk_pod("r-new", node="n3"))
+    s2 = inc.build_snapshot(nodes, utils, running, pending_pods=window)
+    fresh = SnapshotBuilder()
+    f2 = fresh.build_snapshot(nodes, utils, running, pending_pods=window)
+    for name in ("domain_counts", "avoid_counts", "pref_attract",
+                 "pref_avoid", "domain_id"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(s2, name)), np.asarray(getattr(f2, name)),
+            err_msg=name,
+        )
+    # no change since the last build -> identical OBJECTS (the
+    # snapshot_delta identity fast path)
+    s3 = inc.build_snapshot(nodes, utils, running, pending_pods=window)
+    assert s3.domain_counts is s2.domain_counts
+    assert s3.avoid_counts is s2.avoid_counts
+    # an ephemeral build must not poison the cache
+    s_eph = inc.build_snapshot(
+        nodes, utils, running + [mk_pod("tmp", node="n1")], ephemeral=True,
+        pending_pods=window,
+    )
+    assert np.asarray(s_eph.domain_counts).sum() > np.asarray(
+        s3.domain_counts
+    ).sum()
+    s4 = inc.build_snapshot(nodes, utils, running, pending_pods=window)
+    np.testing.assert_array_equal(
+        np.asarray(s4.domain_counts), np.asarray(s3.domain_counts)
+    )
+
+
+def test_resident_backlog_over_sidecar_parity():
+    """Satellite over the wire: backlog cycles ship deltas through the
+    ScheduleWindows RPC when the sidecar advertises the
+    windows_resident capability bit; bindings match the local
+    no-resident run and the server's counters confirm deltas served."""
+
+    def body(client, service):
+        assert client.supports_windows_resident() is True
+        return (
+            run_workload(
+                True, n_pods=160, engine=client, max_windows_per_cycle=4,
+            ),
+            service,
+        )
+
+    (b_remote, m_remote, s_remote), service = _with_sidecar(body)
+    b_local, _, _ = run_workload(False, n_pods=160, max_windows_per_cycle=4)
+    assert b_remote == b_local and len(b_local) > 0
+    assert not any(m.used_fallback for m in m_remote)
+    assert s_remote.totals["delta_uploads"] >= 1
+    assert service.resident_deltas_served >= 1
+
+
+def test_resident_backlog_sidecar_capability_downgrade():
+    """A sidecar without the windows_resident bit (older build) serves
+    backlog cycles as plain full ScheduleWindows — no deltas on that
+    RPC, no errors, bindings unchanged."""
+
+    def body(client, service):
+        service.windows_resident_enabled = False
+        assert client.supports_windows_resident() is False
+        return run_workload(
+            True, n_pods=160, engine=client, max_windows_per_cycle=4,
+        )
+
+    b_remote, m_remote, s_remote = _with_sidecar(body)
+    b_local, _, _ = run_workload(False, n_pods=160, max_windows_per_cycle=4)
+    assert b_remote == b_local and len(b_local) > 0
+    assert not any(m.used_fallback for m in m_remote)
+    # backlog cycles stayed full-upload (the single-window path may
+    # still delta through ScheduleBatch, which remains advertised)
+    assert s_remote.totals["delta_uploads"] == 0
 
 
 def test_snapshot_delta_reproduces_full_build_bitwise():

@@ -5,7 +5,7 @@ PY ?= python
 IMAGE_REPO ?= registry.example.com/yoda-tpu
 TAG ?= latest
 
-.PHONY: local test test-fast bench trace-smoke lint native native-asan native-tsan proto clean build push
+.PHONY: local test test-fast bench trace-smoke obs-smoke lint native native-asan native-tsan proto clean build push
 
 # "make local" in the reference = fmt + vet + compile. Here: byte-compile
 # the package, build the native library, lint, run the fast tests.
@@ -70,6 +70,39 @@ trace-smoke:
 	  $(TRACE_SMOKE_DIR)/journal --out $(TRACE_SMOKE_DIR)/replayed
 	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_scheduler_tpu trace diff \
 	  $(TRACE_SMOKE_DIR)/journal $(TRACE_SMOKE_DIR)/replayed
+
+# end-to-end telemetry round trip on CPU: a sidecar with its own
+# /metrics + span files, a short sim-driven host run with spans + the
+# host exporter on, a sidecar-metrics scrape (device-step histograms
+# must be there), and the `spans merge` join — which exits non-zero
+# when host and sidecar span files share no trace ids (broken metadata
+# propagation). tests/test_bench_smoke.py wraps the same flow as a
+# slow-marked test.
+OBS_SMOKE_DIR ?= /tmp/yoda-obs-smoke
+OBS_SMOKE_PORT ?= 50161
+OBS_SMOKE_METRICS_PORT ?= 9161
+OBS_SMOKE_HOST_METRICS_PORT ?= 9162
+obs-smoke:
+	rm -rf $(OBS_SMOKE_DIR)
+	mkdir -p $(OBS_SMOKE_DIR)
+	printf '{"batch_window": 64, "min_device_work": 1, "adaptive_dispatch": false}' \
+	  > $(OBS_SMOKE_DIR)/config.json
+	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_scheduler_tpu sidecar \
+	  --port $(OBS_SMOKE_PORT) --metrics-port $(OBS_SMOKE_METRICS_PORT) \
+	  --metrics-host 127.0.0.1 --span-path $(OBS_SMOKE_DIR)/sidecar-spans \
+	  > $(OBS_SMOKE_DIR)/sidecar.log 2>&1 & echo $$! > $(OBS_SMOKE_DIR)/sidecar.pid
+	sleep 8
+	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_scheduler_tpu scheduler \
+	  --nodes 48 --pods 192 --config $(OBS_SMOKE_DIR)/config.json \
+	  --engine 127.0.0.1:$(OBS_SMOKE_PORT) --spans $(OBS_SMOKE_DIR)/host-spans \
+	  --metrics-port $(OBS_SMOKE_HOST_METRICS_PORT) \
+	  || { kill `cat $(OBS_SMOKE_DIR)/sidecar.pid`; exit 1; }
+	$(PY) -c "import urllib.request; body = urllib.request.urlopen('http://127.0.0.1:$(OBS_SMOKE_METRICS_PORT)/metrics', timeout=10).read().decode(); assert 'device_step_duration_seconds_bucket' in body, body" \
+	  || { kill `cat $(OBS_SMOKE_DIR)/sidecar.pid`; exit 1; }
+	kill `cat $(OBS_SMOKE_DIR)/sidecar.pid`
+	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_scheduler_tpu spans merge \
+	  $(OBS_SMOKE_DIR)/host-spans $(OBS_SMOKE_DIR)/sidecar-spans \
+	  --out $(OBS_SMOKE_DIR)/merged.trace.json
 
 native:
 	$(MAKE) -C native

@@ -5,6 +5,7 @@ from kubernetes_scheduler_tpu.analysis.rules import (
     host_sync,
     jit_purity,
     lock_discipline,
+    metric_hygiene,
     pallas_vmem,
     timeout_hygiene,
     wire_schema,
@@ -18,4 +19,5 @@ RULES = {
     dtype_shape.RULE: dtype_shape.check,
     timeout_hygiene.RULE: timeout_hygiene.check,
     pallas_vmem.RULE: pallas_vmem.check,
+    metric_hygiene.RULE: metric_hygiene.check,
 }

@@ -121,6 +121,44 @@ class RemoteEngine:
         # per call, and the pipelined host forces result() before the
         # next dispatch — at most one RPC is ever in flight per client
         self._async_pool = None
+        # span/profile context, shipped as gRPC METADATA (no message
+        # changes): the host cycle's trace id + flight-recorder seq ride
+        # every schedule call so the sidecar's spans join the host
+        # timeline; a /debug/profile arm forwards on the next call (the
+        # sidecar owns the device, so the dump lands on its side)
+        self._trace_md: list | None = None
+        self._profile_ask = 0
+
+    def set_trace_id(self, trace_id: int, seq: int = -1) -> None:
+        """Span context for subsequent calls (mirrors
+        LocalEngine.set_trace_id): attached to the wire as metadata keys
+        `yoda-trace-id` / `yoda-trace-seq` (bridge/schedule.proto)."""
+        self._trace_md = [
+            ("yoda-trace-id", str(int(trace_id))),
+            ("yoda-trace-seq", str(int(seq))),
+        ]
+
+    def arm_profile(self, cycles: int, out_dir: str | None = None) -> dict:
+        """Forward a /debug/profile arm to the sidecar over metadata on
+        the next schedule call (best effort: a call that never reaches
+        the server drops the ask). The dump lands under the sidecar's
+        --profile-path — the device lives there."""
+        self._profile_ask = int(cycles)
+        return {
+            "armed": self._profile_ask,
+            "forwarded_to": self.target,
+            "note": "dump lands under the sidecar's --profile-path",
+        }
+
+    def _call_metadata(self, *, profile_ok: bool = True) -> list | None:
+        md = list(self._trace_md or ())
+        # the ask rides only schedule calls: the Preempt handler never
+        # reads the key, and consuming the arm there would lose it
+        # silently after /debug/profile already reported it armed
+        if profile_ok and self._profile_ask > 0:
+            md.append(("yoda-profile-cycles", str(self._profile_ask)))
+            self._profile_ask = 0
+        return md or None
 
     def _probe_capabilities(self) -> None:
         """ONE Health RPC resolves BOTH capability latches (field cache
@@ -480,14 +518,19 @@ class RemoteEngine:
         codec.pack_fields(snapshot, request.snapshot)
         codec.pack_fields(pods, request.pods)
         codec.pack_fields(victims, request.victims)
-        reply = self._call_with_retry(self._preempt, request)
+        reply = self._call_with_retry(self._preempt, request, profile_ok=False)
         return codec.unpack_fields(PreemptResult, reply.result)
 
-    def _call_with_retry(self, method, request):
+    def _call_with_retry(self, method, request, *, profile_ok: bool = True):
         last_err = None
+        metadata = self._call_metadata(profile_ok=profile_ok)
+        # the kwarg is attached only when telemetry context exists:
+        # metadata-free calls keep the bare (request, timeout) surface
+        # (injectable test doubles and old stubs depend on it)
+        kw = {"metadata": metadata} if metadata else {}
         for attempt in range(self.retries + 1):
             try:
-                reply = method(request, timeout=self.deadline_seconds)
+                reply = method(request, timeout=self.deadline_seconds, **kw)
                 self.last_engine_seconds = reply.engine_seconds
                 return reply
             except grpc.RpcError as e:

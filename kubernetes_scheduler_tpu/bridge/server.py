@@ -76,6 +76,8 @@ class EngineService:
         sharded_windows_fn_soft=None,
         field_cache: bool = True,
         resident_state: bool = True,
+        span_path: str | None = None,
+        profile_path: str | None = None,
     ):
         # serve a custom engine (e.g. models.learned.LearnedEngine) on
         # the dense branch instead of the module-level heuristic engine;
@@ -116,6 +118,41 @@ class EngineService:
         self._device_lock = threading.Lock()
         # session id -> {"<rpc>:<map>": {field: ndarray}} (LRU-bounded)
         self._field_cache: "OrderedDict[str, dict]" = OrderedDict()
+        # sidecar telemetry (host/observe primitives — the sidecar was
+        # Health-only before; SURVEY.md §5's blindness, now on its own
+        # /metrics): labeled device-step histogram, per-RPC counters,
+        # resident delta-vs-full applies, live resident session count
+        from kubernetes_scheduler_tpu.host import observe
+
+        self.metrics_step = observe.Histogram(
+            "device_step_duration_seconds",
+            "Device (engine) step time by RPC",
+            labels=("rpc",),
+        )
+        self.metrics_rpcs = observe.Counter(
+            "rpcs_served_total", "RPCs served by the sidecar", labels=("rpc",)
+        )
+        self.metrics_resident = observe.Counter(
+            "resident_applies_total",
+            "Resident-state cluster uploads applied (delta vs full)",
+            labels=("upload",),
+        )
+        self.metrics_sessions = observe.Gauge(
+            "resident_sessions_count",
+            "Sessions currently holding resident device state",
+        )
+        # server-side spans (trace/spans.py): opened under the trace id
+        # the host shipped as gRPC metadata, so `spans merge` joins the
+        # two timelines; requests without an id are not spanned (a
+        # sidecar-assigned id could collide with a host id and fake a
+        # join)
+        self.spans = None
+        if span_path:
+            self.spans = observe.SpanRecorder(span_path, process="sidecar")
+        # on-demand jax.profiler capture (/debug/profile, or forwarded
+        # from the host over the yoda-profile-cycles metadata key)
+        self._profile_left = 0
+        self._profile_dir = profile_path
 
     def _session(self, request) -> dict | None:
         """The per-session state dict (field caches + resident state),
@@ -146,7 +183,102 @@ class EngineService:
             sess.setdefault(f"{which}:pods", {}),
         )
 
-    def _resident_snapshot(self, request, context, snap_cache):
+    # ---- telemetry ----------------------------------------------------
+
+    def _request_telemetry(self, context):
+        """(trace_id, seq, span_set) from the call's gRPC metadata
+        (bridge/schedule.proto documents the keys). Also arms the
+        profiler when the host forwarded a /debug/profile ask."""
+        md = {}
+        try:
+            md = {k: v for k, v in (context.invocation_metadata() or ())}
+        except Exception:
+            pass
+        try:
+            tid = int(md.get("yoda-trace-id", 0))
+        except (TypeError, ValueError):
+            tid = 0
+        try:
+            seq = int(md.get("yoda-trace-seq", -1))
+        except (TypeError, ValueError):
+            seq = -1
+        ask = md.get("yoda-profile-cycles")
+        if ask:
+            try:
+                self.arm_profile(int(ask))
+            except (TypeError, ValueError):
+                pass
+        ss = (
+            self.spans.begin(tid)
+            if self.spans is not None and tid > 0
+            else None
+        )
+        return tid, seq, ss
+
+    def arm_profile(self, cycles: int, out_dir: str | None = None) -> dict:
+        """Capture the next `cycles` device steps under jax.profiler;
+        each dump is named after the trace id it covers (step-<id>) so
+        a profile pairs with its spans and flight-recorder record."""
+        if out_dir is None:
+            out_dir = self._profile_dir
+        if out_dir is None:
+            import tempfile
+
+            out_dir = tempfile.mkdtemp(prefix="yoda-sidecar-profile-")
+        with self._lock:
+            self._profile_dir = out_dir
+            self._profile_left = int(cycles)
+        return {"armed": int(cycles), "out_dir": out_dir}
+
+    def _maybe_profile(self, call, trace_id: int):
+        """One device dispatch, under jax.profiler when an arm is
+        outstanding (zero cost otherwise). Runs inside _device_lock —
+        the profiler session must never interleave two programs."""
+        with self._lock:
+            armed = self._profile_left > 0
+            if armed:
+                self._profile_left -= 1
+            out_dir = self._profile_dir
+        if not armed:
+            return call()
+        import os
+
+        from kubernetes_scheduler_tpu.host.observe import profile_device_step
+
+        tag = "step-%08d" % trace_id if trace_id > 0 else "step-unlabeled"
+        return profile_device_step(call, os.path.join(out_dir, tag))
+
+    def render_metrics(self) -> str:
+        """Prometheus exposition for the sidecar's own /metrics."""
+        with self._lock:
+            sessions = sum(
+                1 for s in self._field_cache.values() if "resident" in s
+            )
+        self.metrics_sessions.set(sessions)
+        collectors = [
+            self.metrics_rpcs,
+            self.metrics_step,
+            self.metrics_resident,
+            self.metrics_sessions,
+        ]
+        out = []
+        for c in collectors:
+            out.extend(c.render())
+        return "\n".join(out) + "\n"
+
+    def _finish_call(self, rpc: str, dt: float, seq: int, ss, marks) -> None:
+        """Per-RPC telemetry epilogue, OFF the device section: histogram
+        + counter feeds and the span flush (deserialize, device step,
+        serialize — plus delta_apply when _resident_snapshot recorded
+        one into `ss` mid-call)."""
+        self.metrics_step.observe(dt, rpc=rpc)
+        self.metrics_rpcs.inc(rpc=rpc)
+        if ss is not None:
+            for name, t0, t1 in marks:
+                ss.add(name, t0, t1, rpc=rpc)
+            self.spans.flush(ss, seq=seq if seq >= 0 else None)
+
+    def _resident_snapshot(self, request, context, snap_cache, ss=None):
         """Resolve the request's cluster state under the resident-state
         protocol: a delta applies to the session's retained snapshot
         (INVALID_ARGUMENT "resident-epoch-mismatch" when inapplicable —
@@ -198,15 +330,20 @@ class EngineService:
             # applied in numpy BY VALUE: bitwise the snapshot the client
             # would have shipped in full, so delta cycles cannot diverge
             # from full-upload cycles (PARITY.md)
+            t_apply = time.perf_counter()
             snapshot = engine.apply_snapshot_delta_np(st["snapshot"], delta)
+            if ss is not None:
+                ss.add("delta_apply", t_apply, time.perf_counter())
             with self._lock:
                 self.resident_deltas_served += 1
+            self.metrics_resident.inc(upload="delta")
         else:
             snapshot = codec.unpack_fields(
                 engine.SnapshotArrays, request.snapshot, cache=snap_cache
             )
             with self._lock:
                 self.resident_fulls_served += 1
+            self.metrics_resident.inc(upload="full")
         sess["resident"] = {
             "snapshot": snapshot, "epoch": int(request.resident_epoch),
         }
@@ -258,9 +395,13 @@ class EngineService:
         return fn
 
     def schedule_batch(self, request: pb.ScheduleRequest, context) -> pb.ScheduleReply:
+        tid, seq, ss = self._request_telemetry(context)
         snap_cache, pods_cache = self._session_caches(request, "batch")
+        t_des = time.perf_counter()
         try:
-            snapshot = self._resident_snapshot(request, context, snap_cache)
+            snapshot = self._resident_snapshot(
+                request, context, snap_cache, ss
+            )
             pods = codec.unpack_fields(
                 engine.PodBatch, request.pods, cache=pods_cache
             )
@@ -284,32 +425,47 @@ class EngineService:
                         request, context, self._sharded_fn,
                         self._sharded_fn_soft, "sharded engine",
                     )
-                    res = fn(snapshot, pods, **_auction_kw(request))
+                    res = self._maybe_profile(
+                        lambda: fn(snapshot, pods, **_auction_kw(request)),
+                        tid,
+                    )
                 else:
                     kw = _auction_kw(request)
                     sp = _score_plugins(request)
                     if sp is not None:
                         kw["score_plugins"] = sp
-                    res = self._engine.schedule_batch(
-                        snapshot,
-                        pods,
-                        policy=request.policy or "balanced_cpu_diskio",
-                        assigner=request.assigner or "greedy",
-                        normalizer=request.normalizer or "min_max",
-                        fused=request.fused,
-                        affinity_aware=request.affinity_aware,
-                        soft=request.soft,
-                        **kw,
+                    res = self._maybe_profile(
+                        lambda: self._engine.schedule_batch(
+                            snapshot,
+                            pods,
+                            policy=request.policy or "balanced_cpu_diskio",
+                            assigner=request.assigner or "greedy",
+                            normalizer=request.normalizer or "min_max",
+                            fused=request.fused,
+                            affinity_aware=request.affinity_aware,
+                            soft=request.soft,
+                            **kw,
+                        ),
+                        tid,
                     )
                 res = jax.tree_util.tree_map(np.asarray, res)
         except ValueError as e:  # unknown policy/assigner/normalizer
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
-        dt = time.perf_counter() - t0
+        t1 = time.perf_counter()
+        dt = t1 - t0
         with self._lock:
             self.cycles_served += 1
         reply = pb.ScheduleReply(engine_seconds=dt)
         only = set(_DECISION_FIELDS) if request.decisions_only else None
         codec.pack_fields(res, reply.result, only=only)
+        self._finish_call(
+            "schedule_batch", dt, seq, ss,
+            (
+                ("deserialize", t_des, t0),
+                ("device_step", t0, t1),
+                ("serialize", t1, time.perf_counter()),
+            ),
+        )
         return reply
 
     def schedule_windows(
@@ -319,6 +475,7 @@ class EngineService:
         axis; the reply holds engine.WindowsResult fields. One device
         dispatch schedules every window with capacity + (anti)affinity
         carries threaded between them."""
+        tid, seq, ss = self._request_telemetry(context)
         snap_cache, pods_cache = self._session_caches(request, "windows")
         if (
             bool(request.snapshot_delta.tensors) or request.resident_full
@@ -328,11 +485,14 @@ class EngineService:
                 "resident-epoch-mismatch: this sidecar does not serve "
                 "resident cluster state on ScheduleWindows",
             )
+        t_des = time.perf_counter()
         try:
             # the resident protocol is shared with ScheduleBatch — same
             # session-retained snapshot, same epoch sequence (backlog
             # and single-window cycles interleave on one counter)
-            snapshot = self._resident_snapshot(request, context, snap_cache)
+            snapshot = self._resident_snapshot(
+                request, context, snap_cache, ss
+            )
             pods_w = codec.unpack_fields(
                 engine.PodBatch, request.pods, cache=pods_cache
             )
@@ -349,31 +509,48 @@ class EngineService:
                         self._sharded_windows_fn_soft,
                         "sharded windows engine",
                     )
-                    res = fn(snapshot, pods_w, **_auction_kw(request))
+                    res = self._maybe_profile(
+                        lambda: fn(
+                            snapshot, pods_w, **_auction_kw(request)
+                        ),
+                        tid,
+                    )
                 else:
                     kw = _auction_kw(request)
                     sp = _score_plugins(request)
                     if sp is not None:
                         kw["score_plugins"] = sp
-                    res = self._engine.schedule_windows(
-                        snapshot,
-                        pods_w,
-                        policy=request.policy or "balanced_cpu_diskio",
-                        assigner=request.assigner or "auction",
-                        normalizer=request.normalizer or "none",
-                        fused=request.fused,
-                        affinity_aware=request.affinity_aware,
-                        soft=request.soft,
-                        **kw,
+                    res = self._maybe_profile(
+                        lambda: self._engine.schedule_windows(
+                            snapshot,
+                            pods_w,
+                            policy=request.policy or "balanced_cpu_diskio",
+                            assigner=request.assigner or "auction",
+                            normalizer=request.normalizer or "none",
+                            fused=request.fused,
+                            affinity_aware=request.affinity_aware,
+                            soft=request.soft,
+                            **kw,
+                        ),
+                        tid,
                     )
                 res = jax.tree_util.tree_map(np.asarray, res)
         except ValueError as e:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
-        dt = time.perf_counter() - t0
+        t1 = time.perf_counter()
+        dt = t1 - t0
         with self._lock:
             self.cycles_served += 1
         reply = pb.ScheduleReply(engine_seconds=dt)
         codec.pack_fields(res, reply.result)
+        self._finish_call(
+            "schedule_windows", dt, seq, ss,
+            (
+                ("deserialize", t_des, t0),
+                ("device_step", t0, t1),
+                ("serialize", t1, time.perf_counter()),
+            ),
+        )
         return reply
 
     def preempt(self, request: pb.ScheduleRequest, context) -> pb.ScheduleReply:
@@ -405,10 +582,13 @@ class EngineService:
             self.cycles_served += 1
         reply = pb.ScheduleReply(engine_seconds=dt)
         codec.pack_fields(res, reply.result)
+        self.metrics_step.observe(dt, rpc="preempt")
+        self.metrics_rpcs.inc(rpc="preempt")
         return reply
 
     def health(self, request: pb.HealthRequest, context) -> pb.HealthReply:
         devs = jax.devices()
+        self.metrics_rpcs.inc(rpc="health")
         return pb.HealthReply(
             status="SERVING",
             device_count=len(devs),
@@ -430,6 +610,8 @@ def make_server(
     sharded_windows_fn=None,
     sharded_windows_fn_soft=None,
     max_workers: int = 2,
+    span_path: str | None = None,
+    profile_path: str | None = None,
 ) -> tuple[grpc.Server, int, EngineService]:
     """Build (server, bound_port, service). Device access stays
     single-writer regardless of max_workers (EngineService._device_lock
@@ -443,6 +625,8 @@ def make_server(
         sharded_fn_soft=sharded_fn_soft,
         sharded_windows_fn=sharded_windows_fn,
         sharded_windows_fn_soft=sharded_windows_fn_soft,
+        span_path=span_path,
+        profile_path=profile_path,
     )
     handlers = grpc.method_handlers_generic_handler(
         SERVICE,
@@ -544,6 +728,26 @@ def main(argv=None):
         help="serve the learned two-tower policy restored from this orbax "
         "checkpoint (policy name becomes 'learned'; shards over the mesh "
         "when --mesh-devices is set)",
+    )
+    parser.add_argument(
+        "--metrics-port", type=int, default=0,
+        help="serve the sidecar's own /metrics + /healthz + "
+        "/debug/profile on this HTTP port (0 = disabled)",
+    )
+    parser.add_argument(
+        "--metrics-host", default="0.0.0.0",
+        help="bind host for --metrics-port",
+    )
+    parser.add_argument(
+        "--span-path", default=None,
+        help="write server-side Chrome-trace spans (deserialize, device "
+        "step, delta apply, serialize) under this directory, joined to "
+        "host spans by the trace id on gRPC metadata",
+    )
+    parser.add_argument(
+        "--profile-path", default=None,
+        help="where on-demand /debug/profile jax.profiler dumps land "
+        "(default: a tempdir)",
     )
     args = parser.parse_args(argv)
 
@@ -686,7 +890,7 @@ def main(argv=None):
         sharded_windows_fn_soft = None
         sharded_opts = None
 
-    server, port, _ = make_server(
+    server, port, service = make_server(
         f"{args.host}:{args.port}",
         engine_override=engine_override,
         sharded_fn=sharded_fn,
@@ -694,7 +898,18 @@ def main(argv=None):
         sharded_fn_soft=sharded_fn_soft,
         sharded_windows_fn=sharded_windows_fn,
         sharded_windows_fn_soft=sharded_windows_fn_soft,
+        span_path=args.span_path,
+        profile_path=args.profile_path,
     )
+    exporter = None
+    if args.metrics_port:
+        from kubernetes_scheduler_tpu.host.observe import HttpMetricsServer
+
+        exporter = HttpMetricsServer(
+            service.render_metrics, profile=service.arm_profile
+        )
+        mport = exporter.serve(args.metrics_port, host=args.metrics_host)
+        log.info("sidecar metrics on %s:%d", args.metrics_host, mport)
     server.start()
     log.info(
         "engine sidecar serving on %s:%d (devices=%s)",
@@ -712,6 +927,11 @@ def main(argv=None):
         # and the belt-and-braces timeout keeps shutdown finite even if
         # the grpc core wedges
         server.stop(grace=10).wait(timeout=15)
+    finally:
+        if exporter is not None:
+            exporter.close()
+        if service.spans is not None:
+            service.spans.close()
 
 
 if __name__ == "__main__":

@@ -39,7 +39,7 @@ def _load_config(args) -> SchedulerConfig:
     )
     for key in (
         "policy", "assigner", "normalizer", "batch_window",
-        "learned_checkpoint", "trace_path",
+        "learned_checkpoint", "trace_path", "span_path",
     ):
         v = getattr(args, key, None)
         if v is not None:
@@ -70,6 +70,13 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
         dest="trace_path",
         help="cycle flight recorder: journal every cycle under this "
         "directory (trace/; replay with `yoda-tpu trace replay`)",
+    )
+    p.add_argument(
+        "--spans",
+        dest="span_path",
+        help="per-cycle span telemetry: Chrome-trace-event JSON under "
+        "this directory (join with the sidecar's via "
+        "`yoda-tpu spans merge`; open in Perfetto)",
     )
 
 
@@ -164,7 +171,7 @@ def cmd_scheduler_kube(args, cfg) -> int:
         from kubernetes_scheduler_tpu.host.observe import MetricsExporter
 
         exporter = MetricsExporter(sched)
-        exporter.serve(args.metrics_port)
+        exporter.serve(args.metrics_port, host=cfg.metrics_bind_host)
     elector = None
     if args.lease_kube or args.lease:
         if args.lease_kube:
@@ -196,6 +203,8 @@ def cmd_scheduler_kube(args, cfg) -> int:
         cache.stop()
         if sched.recorder is not None:
             sched.recorder.close()
+        if sched.spans is not None:
+            sched.spans.close()
         if hasattr(advisor, "close"):
             advisor.close()  # stop the background refresh thread
         if elector is not None:
@@ -256,7 +265,7 @@ def cmd_scheduler(args) -> int:
         from kubernetes_scheduler_tpu.host.observe import MetricsExporter
 
         exporter = MetricsExporter(sched)
-        exporter.serve(args.metrics_port)
+        exporter.serve(args.metrics_port, host=cfg.metrics_bind_host)
 
     for pod in pods:
         sched.submit(pod)
@@ -275,6 +284,8 @@ def cmd_scheduler(args) -> int:
             elector.release()
         if sched.recorder is not None:
             sched.recorder.close()
+        if sched.spans is not None:
+            sched.spans.close()
     dt = time.perf_counter() - t0
     for binding in sched.binder.bindings:
         running.append(binding.pod)
@@ -316,6 +327,15 @@ def cmd_sidecar(args) -> int:
     from kubernetes_scheduler_tpu.bridge import server
 
     argv = ["--port", str(args.port)]
+    if args.metrics_port:
+        argv += [
+            "--metrics-port", str(args.metrics_port),
+            "--metrics-host", args.metrics_host,
+        ]
+    if args.span_path:
+        argv += ["--span-path", args.span_path]
+    if args.profile_path:
+        argv += ["--profile-path", args.profile_path]
     if args.mesh_devices:
         argv += ["--mesh-devices", str(args.mesh_devices)]
         argv += ["--assigner", args.assigner]
@@ -382,6 +402,31 @@ def cmd_trace(args) -> int:
             engine.close()
     print(json.dumps(report.to_dict()))
     return 1 if report.binding_diffs else 0
+
+
+def cmd_spans(args) -> int:
+    """Span-timeline tooling: merge joins a host span directory and a
+    sidecar span directory on the shared trace ids into ONE
+    Perfetto-loadable Chrome trace; non-zero exit when the two sides
+    share no ids (broken metadata propagation — the join is the point)."""
+    from kubernetes_scheduler_tpu.trace import spans as tspans
+
+    report = tspans.merge_spans(args.host, args.sidecar, args.out)
+    print(json.dumps(report))
+    if report["merged_events"] == 0:
+        return 1
+    # a side with NO files was never configured (e.g. a local-engine
+    # run has no sidecar spans) — tolerated. A side whose writer ran
+    # (files exist: SpanWriter opens its first file eagerly) but
+    # contributed no joinable trace ids while the other side has them
+    # is the broken-propagation signal this exit code exists for.
+    if report["host_trace_ids"] and report["sidecar_files"]:
+        if report["joined_trace_ids"] == 0:
+            return 1
+    if report["sidecar_trace_ids"] and report["host_files"]:
+        if report["joined_trace_ids"] == 0:
+            return 1
+    return 0
 
 
 def cmd_config(args) -> int:
@@ -453,6 +498,20 @@ def build_parser() -> argparse.ArgumentParser:
 
     pc = sub.add_parser("sidecar", help="run the gRPC engine server")
     pc.add_argument("--port", type=int, default=50051)
+    pc.add_argument(
+        "--metrics-port", type=int, default=0,
+        help="sidecar /metrics + /healthz + /debug/profile HTTP port "
+        "(0 = disabled)",
+    )
+    pc.add_argument("--metrics-host", default="0.0.0.0")
+    pc.add_argument(
+        "--span-path", dest="span_path", default=None,
+        help="server-side Chrome-trace spans under this directory",
+    )
+    pc.add_argument(
+        "--profile-path", dest="profile_path", default=None,
+        help="where /debug/profile jax.profiler dumps land",
+    )
     pc.add_argument("--mesh-devices", type=int, default=0)
     pc.add_argument(
         "--assigner", default="greedy", choices=["greedy", "auction"],
@@ -514,6 +573,21 @@ def build_parser() -> argparse.ArgumentParser:
         help="re-record the replayed cycles as a new journal here",
     )
     pt.set_defaults(fn=cmd_trace)
+
+    pn = sub.add_parser(
+        "spans", help="span timelines: merge host + sidecar span files"
+    )
+    nsub = pn.add_subparsers(dest="spans_cmd", required=True)
+    nm = nsub.add_parser(
+        "merge",
+        help="join host and sidecar span directories on trace id into "
+        "one Perfetto-loadable Chrome trace (exit 1 when non-empty "
+        "sides share no trace ids)",
+    )
+    nm.add_argument("host", help="host span directory (--spans)")
+    nm.add_argument("sidecar", help="sidecar span directory (--span-path)")
+    nm.add_argument("--out", required=True, help="merged trace JSON path")
+    pn.set_defaults(fn=cmd_spans)
 
     pf = sub.add_parser("config", help="print effective config")
     _add_config_flags(pf)

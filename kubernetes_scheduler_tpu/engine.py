@@ -702,10 +702,59 @@ class LocalEngine:
         # fall back to / receive a full upload (False)? The host reads
         # this after forcing the result to attribute its metrics.
         self.resident_used_delta = False
+        # span/profile context (host/observe): the host's per-cycle
+        # trace id, and the outstanding /debug/profile arm (capture the
+        # next N schedule calls under jax.profiler)
+        self._trace_id = 0
+        self._profile_left = 0
+        self._profile_dir: str | None = None
+
+    # ---- telemetry context --------------------------------------------
+
+    def set_trace_id(self, trace_id: int, seq: int = -1) -> None:
+        """Span context for the NEXT schedule call (the host cycle's
+        trace id). The local engine only uses it to name on-demand
+        profile dumps; RemoteEngine's twin propagates it to the sidecar
+        as gRPC metadata so server-side spans join the host timeline."""
+        self._trace_id = int(trace_id)
+
+    def arm_profile(self, cycles: int, out_dir: str | None = None) -> dict:
+        """Capture the next `cycles` schedule calls under jax.profiler
+        (/debug/profile?cycles=N). Each captured call dumps under
+        <out_dir>/step-<trace_id> — named after the trace id it covers,
+        so a profile pairs with its spans and flight-recorder record."""
+        if out_dir is None:
+            import tempfile
+
+            out_dir = tempfile.mkdtemp(prefix="yoda-profile-")
+        self._profile_dir = out_dir
+        self._profile_left = int(cycles)
+        return {"armed": self._profile_left, "out_dir": out_dir}
+
+    def _maybe_profile(self, call):
+        """Run one engine dispatch under jax.profiler when an arm is
+        outstanding; otherwise dispatch untouched (zero cost)."""
+        if self._profile_left <= 0:
+            return call()
+        import os
+
+        from kubernetes_scheduler_tpu.host.observe import profile_device_step
+
+        self._profile_left -= 1
+        tag = (
+            "step-%08d" % self._trace_id
+            if self._trace_id
+            else "step-unlabeled"
+        )
+        return profile_device_step(
+            call, os.path.join(self._profile_dir, tag)
+        )
 
     def schedule_batch(self, snapshot, pods, **kw) -> "ScheduleResult":
-        return schedule_batch(
-            self._consts.swap(snapshot), self._consts.swap(pods), **kw
+        return self._maybe_profile(
+            lambda: schedule_batch(
+                self._consts.swap(snapshot), self._consts.swap(pods), **kw
+            )
         )
 
     # ---- resident cluster state (delta uploads) -----------------------
@@ -741,8 +790,10 @@ class LocalEngine:
             # cache's shared device arrays must never be donated
             self._resident = ResidentState(jax.device_put(snapshot), epoch)
             self.resident_used_delta = False
-        return schedule_batch(
-            self._resident.snapshot, self._consts.swap(pods), **kw
+        return self._maybe_profile(
+            lambda: schedule_batch(
+                self._resident.snapshot, self._consts.swap(pods), **kw
+            )
         )
 
     def schedule_resident_async(
@@ -766,8 +817,12 @@ class LocalEngine:
         return PendingSchedule(self.schedule_batch(snapshot, pods, **kw))
 
     def schedule_windows(self, snapshot, pods_windows, **kw) -> "WindowsResult":
-        return schedule_windows(
-            self._consts.swap(snapshot), self._consts.swap(pods_windows), **kw
+        return self._maybe_profile(
+            lambda: schedule_windows(
+                self._consts.swap(snapshot),
+                self._consts.swap(pods_windows),
+                **kw,
+            )
         )
 
     def supports_windows_resident(self) -> bool:
@@ -792,8 +847,12 @@ class LocalEngine:
         else:
             self._resident = ResidentState(jax.device_put(snapshot), epoch)
             self.resident_used_delta = False
-        return schedule_windows(
-            self._resident.snapshot, self._consts.swap(pods_windows), **kw
+        return self._maybe_profile(
+            lambda: schedule_windows(
+                self._resident.snapshot,
+                self._consts.swap(pods_windows),
+                **kw,
+            )
         )
 
     def preempt(self, snapshot, pods, victims, *, k_cap: int):

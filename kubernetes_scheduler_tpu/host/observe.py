@@ -1,4 +1,4 @@
-"""Observability: metrics export, cycle timing spans, device profiling.
+"""Observability: metrics export, per-cycle spans, device profiling.
 
 The reference *consumes* metrics but exports none — its own metrics
 endpoint is disabled (MetricsBindAddress: "", scheduler.go:64) and its
@@ -10,14 +10,28 @@ BASELINE.json:
   latency p50/p99, batch sizes, engine (device) step time, fallback
   count, in Prometheus text exposition format on /metrics — so the same
   Prometheus the advisor scrapes from can scrape the scheduler back.
-- `CycleTracer`: structured per-cycle spans (host snapshot build, device
-  step, bind fan-out) logged as JSON lines.
+- `Histogram`/`Counter`/`Gauge`: real labeled Prometheus series beside
+  the legacy window-quantile gauges (`path=serial|pipelined|fallback`,
+  `upload=delta|full`, `rpc=schedule_batch|...`) — shared by the host
+  exporter and the sidecar's own exporter (bridge/server.py).
+- `SpanRecorder`: per-cycle structured spans with a monotonically-
+  assigned trace id, emitted as Chrome-trace-event JSON to a rotating,
+  disk-budgeted directory (trace/spans.py); the same id rides gRPC
+  metadata so sidecar-side spans join the host timeline
+  (`yoda-tpu spans merge`).
 - `profile_device_step`: wraps one engine call in a jax.profiler trace
-  for XLA-level inspection (op time on the MXU/VPU, transfer time).
+  for XLA-level inspection (op time on the MXU/VPU, transfer time) —
+  armed on demand through /debug/profile?cycles=N.
+
+Metric-name contract (enforced by graftlint's `metric-hygiene` family):
+every exported name carries a HELP entry, ends in a unit (or `_total`)
+suffix, and is pinned in SHIPPED_METRICS — dashboards and alerts
+reference metrics by name, so a shipped name is never removed.
 """
 
 from __future__ import annotations
 
+import bisect
 import contextlib
 import http.server
 import json
@@ -155,7 +169,59 @@ _HELP = {
         "Cycle records the flight recorder failed to journal "
         "(encode/IO error — the scheduling loop never pays for these)"
     ),
+    # per-cycle span telemetry (config.span_path; trace/spans.py)
+    "spans_written_total": "Span events written to the Chrome-trace files",
+    "span_bytes_total": "Bytes written to the Chrome-trace span files",
+    "spans_dropped_total": (
+        "Cycle span sets the recorder failed to encode/write "
+        "(the scheduling loop never pays for these)"
+    ),
 }
+
+
+# every metric name this process has EVER exported, pinned: dashboards
+# and alerts reference metrics by name, so a shipped name is never
+# removed — graftlint's metric-hygiene family checks this registry
+# against the declared surfaces (this file's _HELP keys plus every
+# Histogram/Counter/Gauge construction in the package) both ways.
+SHIPPED_METRICS = (
+    "cycles_total",
+    "pods_bound_total",
+    "pods_unschedulable_total",
+    "pods_dropped_total",
+    "pods_preempted_total",
+    "victims_evicted_total",
+    "fallback_cycles_total",
+    "fetch_failures_total",
+    "fallback_policy_mismatch_total",
+    "pipeline_flushes_total",
+    "host_overlap_seconds_total",
+    "delta_uploads_total",
+    "full_uploads_total",
+    "delta_bytes_saved_total",
+    "scheduling_pods_per_sec",
+    "bind_latency_p50_seconds",
+    "bind_latency_p99_seconds",
+    "engine_step_p50_seconds",
+    "engine_step_p99_seconds",
+    "batch_size_mean",
+    "advisor_stale_served_total",
+    "cycles_recorded_total",
+    "trace_bytes_total",
+    "trace_records_dropped_total",
+    "spans_written_total",
+    "span_bytes_total",
+    "spans_dropped_total",
+    # labeled histogram layer (host, fed by Scheduler._record)
+    "cycle_duration_seconds",
+    "engine_step_duration_seconds",
+    "snapshot_uploads_total",
+    # sidecar exporter (bridge/server.EngineService)
+    "device_step_duration_seconds",
+    "rpcs_served_total",
+    "resident_applies_total",
+    "resident_sessions_count",
+)
 
 
 def render_prometheus(
@@ -168,49 +234,314 @@ def render_prometheus(
     for key, value in rows.items():
         name = f"{PREFIX}_{key}"
         kind = "counter" if key.endswith("_total") else "gauge"
-        out.append(f"# HELP {name} {_HELP[key]}")
+        # an extra key without a registered HELP entry still renders (an
+        # empty HELP line) — a metrics endpoint must never 500 over one
+        # undocumented sample (the KeyError regression)
+        out.append(f"# HELP {name} {_HELP.get(key, '')}".rstrip())
         out.append(f"# TYPE {name} {kind}")
         out.append(f"{name} {value}")
     return "\n".join(out) + "\n"
 
 
-class MetricsExporter:
-    """Serves /metrics (Prometheus text format) and /healthz for a live
-    Scheduler, on a daemon thread."""
+# ---- labeled Prometheus series (histograms / counters / gauges) -----------
 
-    def __init__(self, scheduler):
-        self.scheduler = scheduler
+# sub-second-to-seconds ladder covering everything from a colocated
+# sidecar's ~1ms device step to a tunneled dev chip's multi-second tail
+DURATION_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0,
+)
+
+
+def _fmt_labels(names: tuple, values: tuple, extra: str = "") -> str:
+    parts = [
+        '%s="%s"' % (n, str(v).replace("\\", "\\\\").replace('"', '\\"'))
+        for n, v in zip(names, values)
+    ]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class Histogram:
+    """Thread-safe labeled Prometheus histogram (cumulative buckets in
+    the exposition, per-bucket counts internally). Appends/observes come
+    from the scheduling (or RPC worker) thread while /metrics scrapes
+    render concurrently — every touch of the series map holds the
+    lock."""
+
+    def __init__(
+        self,
+        name: str,
+        help: str,
+        *,
+        labels: tuple = (),
+        buckets: tuple = DURATION_BUCKETS,
+    ):
+        self.name = name
+        self.help = help
+        self.labels = tuple(labels)
+        self.buckets = tuple(sorted(buckets))
+        self._lock = threading.Lock()
+        # label values -> [per-bucket counts..., +Inf count], sum
+        self._series: dict[tuple, list] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        key = tuple(str(labels[name]) for name in self.labels)
+        i = bisect.bisect_left(self.buckets, value)
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                s = [[0] * (len(self.buckets) + 1), 0.0]
+                self._series[key] = s
+            s[0][i] += 1
+            s[1] += value
+
+    def render(self, prefix: str = PREFIX) -> list[str]:
+        name = f"{prefix}_{self.name}"
+        out = [f"# HELP {name} {self.help}", f"# TYPE {name} histogram"]
+        with self._lock:
+            series = {k: (list(v[0]), v[1]) for k, v in self._series.items()}
+        for key in sorted(series):
+            counts, total = series[key]
+            running = 0
+            for bound, c in zip(self.buckets, counts):
+                running += c
+                lbl = _fmt_labels(self.labels, key, 'le="%g"' % bound)
+                out.append(f"{name}_bucket{lbl} {running}")
+            running += counts[-1]
+            lbl = _fmt_labels(self.labels, key, 'le="+Inf"')
+            out.append(f"{name}_bucket{lbl} {running}")
+            plain = _fmt_labels(self.labels, key)
+            out.append(f"{name}_sum{plain} {total}")
+            out.append(f"{name}_count{plain} {running}")
+        return out
+
+
+class Counter:
+    """Thread-safe labeled monotonic counter (name must end `_total`)."""
+
+    def __init__(self, name: str, help: str, *, labels: tuple = ()):
+        self.name = name
+        self.help = help
+        self.labels = tuple(labels)
+        self._lock = threading.Lock()
+        self._series: dict[tuple, float] = {}
+
+    def inc(self, n: float = 1, **labels) -> None:
+        key = tuple(str(labels[name]) for name in self.labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0) + n
+
+    def render(self, prefix: str = PREFIX) -> list[str]:
+        name = f"{prefix}_{self.name}"
+        out = [f"# HELP {name} {self.help}", f"# TYPE {name} counter"]
+        with self._lock:
+            series = dict(self._series)
+        for key in sorted(series):
+            out.append(
+                f"{name}{_fmt_labels(self.labels, key)} {series[key]}"
+            )
+        return out
+
+
+class Gauge:
+    """Set-at-render scalar sample (the sidecar sets it from live state
+    inside its render callback)."""
+
+    def __init__(self, name: str, help: str):
+        self.name = name
+        self.help = help
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = value
+
+    def render(self, prefix: str = PREFIX) -> list[str]:
+        name = f"{prefix}_{self.name}"
+        with self._lock:
+            value = self._value
+        return [
+            f"# HELP {name} {self.help}",
+            f"# TYPE {name} gauge",
+            f"{name} {value}",
+        ]
+
+
+# ---- per-cycle spans (Chrome trace events, merged across the bridge) ------
+
+
+class SpanSet:
+    """One cycle's spans: (name, start, end, args) perf_counter pairs
+    plus the cycle's trace id. Collection appends two floats per span —
+    cheap enough for the dispatch path; Chrome-event encoding happens in
+    SpanRecorder.flush, from the cycle's completion stage (the flight-
+    recorder discipline: telemetry never costs the device dispatch)."""
+
+    __slots__ = ("trace_id", "spans")
+
+    def __init__(self, trace_id: int):
+        self.trace_id = trace_id
+        self.spans: list[tuple] = []
+
+    def add(self, name: str, t0: float, t1: float, **args) -> None:
+        self.spans.append((name, t0, t1, args))
+
+    @contextlib.contextmanager
+    def span(self, name: str, **args):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add(name, t0, time.perf_counter(), **args)
+
+
+class SpanRecorder:
+    """Monotonic trace ids + Chrome-event encoding over the rotating
+    span files (trace/spans.py).
+
+    The host assigns ids (`begin()`); the sidecar opens its SpanSets
+    under the id it received over gRPC metadata (`begin(trace_id=...)`),
+    which is what makes `spans merge` able to join the two timelines.
+    Timestamps are mapped to epoch microseconds through one wall/perf
+    anchor pair taken at construction, so both processes share the wall
+    clock domain without per-span time.time() calls."""
+
+    def __init__(
+        self,
+        path: str,
+        *,
+        file_bytes: int = 32 << 20,
+        max_bytes: int = 128 << 20,
+        process: str = "host",
+    ):
+        from kubernetes_scheduler_tpu.trace.spans import SpanWriter
+
+        self._writer = SpanWriter(
+            path,
+            file_bytes=file_bytes,
+            max_bytes=max_bytes,
+            process_name=process,
+        )
+        self.path = path
+        self.process = process
+        self._wall0 = time.time()
+        self._perf0 = time.perf_counter()
+        self._next_id = 1
+        self._id_lock = threading.Lock()
+        self.spans_dropped = 0
+
+    @property
+    def spans_written(self) -> int:
+        return self._writer.events_written
+
+    @property
+    def bytes_written(self) -> int:
+        return self._writer.bytes_written
+
+    def begin(self, trace_id: int | None = None) -> SpanSet:
+        if trace_id is None:
+            with self._id_lock:
+                trace_id = self._next_id
+                self._next_id += 1
+        return SpanSet(trace_id)
+
+    def _ts_us(self, t_perf: float) -> float:
+        return (self._wall0 + (t_perf - self._perf0)) * 1e6
+
+    def flush(self, ss: SpanSet, *, seq: int | None = None, tid: int = 0) -> None:
+        """Encode and write one cycle's spans. Every event carries the
+        trace id; `seq` cross-links the cycle to its flight-recorder
+        record so a replayed cycle can be found in the timeline. Never
+        raises into the scheduling loop — a failed write logs, counts,
+        and drops the set."""
+        try:
+            events = []
+            for name, t0, t1, args in ss.spans:
+                a = {"trace_id": ss.trace_id}
+                if seq is not None:
+                    a["seq"] = seq
+                if args:
+                    a.update(args)
+                events.append(
+                    {
+                        "name": name,
+                        "ph": "X",
+                        "cat": self.process,
+                        "ts": round(self._ts_us(t0), 3),
+                        "dur": round((t1 - t0) * 1e6, 3),
+                        "pid": self._writer.pid,
+                        "tid": tid,
+                        "args": a,
+                    }
+                )
+            self._writer.append(events)
+        except Exception:
+            log.exception("spans: cycle flush failed; dropping span set")
+            self.spans_dropped += 1
+
+    def close(self) -> None:
+        self._writer.close()
+
+
+# ---- HTTP exporters -------------------------------------------------------
+
+
+class HttpMetricsServer:
+    """Minimal threaded HTTP exporter: /metrics from a render callable,
+    /healthz, and (when armed with a profile callable) the on-demand
+    /debug/profile?cycles=N endpoint. The host's MetricsExporter and
+    the sidecar's exporter (bridge/server.py) are both this class with
+    different render sources."""
+
+    def __init__(self, render, *, profile=None):
+        self._render = render      # () -> str (Prometheus exposition)
+        self._profile = profile    # (cycles: int) -> dict, or None
         self._server: http.server.ThreadingHTTPServer | None = None
 
-    def serve(self, port: int) -> int:
+    def serve(self, port: int, host: str = "0.0.0.0") -> int:
+        """Bind `host`:`port` (0 = ephemeral) and serve on a daemon
+        thread; returns the bound port. The bind host is configurable
+        (SchedulerConfig.metrics_bind_host) — tests bind loopback, the
+        deploy manifests bind all interfaces for the scrape."""
         exporter = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):
-                if self.path == "/metrics":
-                    sched = exporter.scheduler
-                    if hasattr(sched, "metrics_snapshot"):
-                        window, totals = sched.metrics_snapshot()
-                    else:
-                        window, totals = list(sched.metrics), None
-                    stale = getattr(
-                        getattr(sched, "advisor", None), "stale_served", None
-                    )
-                    extra = {}
-                    if stale is not None:
-                        extra["advisor_stale_served_total"] = stale
-                    rec = getattr(sched, "recorder", None)
-                    if rec is not None:
-                        extra.update(
-                            cycles_recorded_total=rec.cycles_recorded,
-                            trace_bytes_total=rec.bytes_written,
-                            trace_records_dropped_total=rec.records_dropped,
-                        )
-                    extra = extra or None
-                    body = render_prometheus(window, totals, extra).encode()
+                path, _, query = self.path.partition("?")
+                if path == "/metrics":
+                    try:
+                        body = exporter._render().encode()
+                    except Exception:
+                        log.exception("metrics render failed")
+                        self.send_error(500)
+                        return
                     ctype = "text/plain; version=0.0.4"
-                elif self.path == "/healthz":
+                elif path == "/healthz":
                     body, ctype = b"ok\n", "text/plain"
+                elif path == "/debug/profile":
+                    if exporter._profile is None:
+                        self.send_error(404)
+                        return
+                    from urllib.parse import parse_qs
+
+                    try:
+                        cycles = int(
+                            parse_qs(query).get("cycles", ["1"])[0]
+                        )
+                    except ValueError:
+                        self.send_error(400, "cycles must be an integer")
+                        return
+                    cycles = max(1, min(cycles, 1000))
+                    try:
+                        report = exporter._profile(cycles)
+                    except Exception as e:
+                        log.exception("profile arm failed")
+                        report = {"armed": 0, "error": str(e)}
+                    body = (json.dumps(report) + "\n").encode()
+                    ctype = "application/json"
                 else:
                     self.send_error(404)
                     return
@@ -223,7 +554,7 @@ class MetricsExporter:
             def log_message(self, fmt, *args):
                 log.debug("metrics http: " + fmt, *args)
 
-        self._server = http.server.ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        self._server = http.server.ThreadingHTTPServer((host, port), Handler)
         threading.Thread(target=self._server.serve_forever, daemon=True).start()
         return self._server.server_address[1]
 
@@ -234,31 +565,53 @@ class MetricsExporter:
             self._server = None
 
 
-class CycleTracer:
-    """Structured timing spans for one scheduling cycle, emitted as one
-    JSON line (the replacement for the reference's klog.V(4) spam)."""
+class MetricsExporter(HttpMetricsServer):
+    """Serves /metrics (Prometheus text format), /healthz, and
+    /debug/profile for a live Scheduler, on a daemon thread. The
+    exposition is the legacy summarize() gauges plus the scheduler's
+    labeled collectors (prom_collectors) and the recorder/span-writer
+    running totals."""
 
-    def __init__(self, sink=None):
-        self.sink = sink or (lambda line: log.info("%s", line))
-        self._spans: dict[str, float] = {}
+    def __init__(self, scheduler):
+        super().__init__(self._render_scheduler, profile=self._arm_profile)
+        self.scheduler = scheduler
 
-    @contextlib.contextmanager
-    def span(self, name: str):
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            self._spans[name] = self._spans.get(name, 0.0) + (
-                time.perf_counter() - t0
-            )
+    def _arm_profile(self, cycles: int) -> dict:
+        armer = getattr(self.scheduler, "arm_profile", None)
+        if armer is None:
+            return {"armed": 0, "error": "scheduler has no profile surface"}
+        return armer(cycles)
 
-    def emit(self, **fields) -> None:
-        record = {"ts": time.time(), **fields}
-        record.update(
-            {f"span_{k}_seconds": round(v, 6) for k, v in self._spans.items()}
+    def _render_scheduler(self) -> str:
+        sched = self.scheduler
+        if hasattr(sched, "metrics_snapshot"):
+            window, totals = sched.metrics_snapshot()
+        else:
+            window, totals = list(sched.metrics), None
+        stale = getattr(
+            getattr(sched, "advisor", None), "stale_served", None
         )
-        self.sink(json.dumps(record))
-        self._spans.clear()
+        extra = {}
+        if stale is not None:
+            extra["advisor_stale_served_total"] = stale
+        rec = getattr(sched, "recorder", None)
+        if rec is not None:
+            extra.update(
+                cycles_recorded_total=rec.cycles_recorded,
+                trace_bytes_total=rec.bytes_written,
+                trace_records_dropped_total=rec.records_dropped,
+            )
+        spans = getattr(sched, "spans", None)
+        if spans is not None:
+            extra.update(
+                spans_written_total=spans.spans_written,
+                span_bytes_total=spans.bytes_written,
+                spans_dropped_total=spans.spans_dropped,
+            )
+        body = render_prometheus(window, totals, extra or None)
+        for collector in getattr(sched, "prom_collectors", ()):
+            body += "\n".join(collector.render()) + "\n"
+        return body
 
 
 def profile_device_step(engine_call, out_dir: str):

@@ -359,8 +359,87 @@ class Scheduler:
             )
         # per-cycle dispatch contexts the recorder reads in _finish_cycle
         self._trace_cycle: list[dict] = []
+        # per-cycle span telemetry (config.span_path; observe.SpanRecorder
+        # over trace/spans.py): collection appends perf_counter pairs on
+        # the cycle path; Chrome-event encoding and the file write happen
+        # in _finish_cycle AFTER the cycle's bookkeeping — the same
+        # off-the-critical-path discipline as the flight recorder. The
+        # cycle's trace id also rides gRPC metadata (engine.set_trace_id)
+        # so sidecar-side spans join the host timeline.
+        self.spans = None
+        if config.span_path:
+            from kubernetes_scheduler_tpu.host.observe import SpanRecorder
+
+            self.spans = SpanRecorder(
+                config.span_path,
+                file_bytes=config.span_file_bytes,
+                max_bytes=config.span_max_bytes,
+                process="host",
+            )
+        self._cycle_span = None
+        # labeled Prometheus collectors, rendered by MetricsExporter
+        # beside the legacy quantile gauges: real histograms (bucketed,
+        # labeled by driver path) instead of window quantiles, and the
+        # upload counter the resident-state dashboards key on
+        from kubernetes_scheduler_tpu.host.observe import Counter, Histogram
+
+        self.hist_cycle = Histogram(
+            "cycle_duration_seconds",
+            "End-to-end cycle latency by driver path",
+            labels=("path",),
+        )
+        self.hist_engine = Histogram(
+            "engine_step_duration_seconds",
+            "Device (engine) step time by driver path",
+            labels=("path",),
+        )
+        self.ctr_uploads = Counter(
+            "snapshot_uploads_total",
+            "Snapshot uploads to the engine (resident delta vs full)",
+            labels=("upload",),
+        )
+        self.prom_collectors = (
+            self.hist_cycle, self.hist_engine, self.ctr_uploads,
+        )
+
+    def _cycle_path(self, m: CycleMetrics) -> str:
+        """The histogram `path` label: which driver served the cycle."""
+        if m.used_fallback or m.fetch_failed:
+            return "fallback"
+        return "pipelined" if self.config.pipeline_depth > 0 else "serial"
+
+    def _span(self, name: str, t0: float, t1: float | None = None, **args):
+        """Record one span on the current cycle's SpanSet (no-op with
+        spans off — one attribute read on the hot path)."""
+        sp = self._cycle_span
+        if sp is not None:
+            sp.add(name, t0, time.perf_counter() if t1 is None else t1, **args)
+
+    def arm_profile(self, cycles: int) -> dict:
+        """Arm jax.profiler capture of the next `cycles` engine calls
+        (the /debug/profile?cycles=N endpoint). A local engine dumps
+        under config.profile_path (default <span_path>/profiles, else a
+        tempdir), one dump per call named after the trace id it covers;
+        a RemoteEngine forwards the arm to the sidecar over metadata."""
+        armer = getattr(self.engine, "arm_profile", None)
+        if armer is None:
+            return {"armed": 0, "error": "engine has no profile surface"}
+        out_dir = self.config.profile_path
+        if out_dir is None and self.config.span_path:
+            import os
+
+            out_dir = os.path.join(self.config.span_path, "profiles")
+        return armer(int(cycles), out_dir)
 
     def _record(self, m: CycleMetrics) -> None:
+        path = self._cycle_path(m)
+        self.hist_cycle.observe(m.cycle_seconds, path=path)
+        if m.engine_seconds > 0:
+            self.hist_engine.observe(m.engine_seconds, path=path)
+        if m.delta_uploads:
+            self.ctr_uploads.inc(m.delta_uploads, upload="delta")
+        if m.full_uploads:
+            self.ctr_uploads.inc(m.full_uploads, upload="full")
         with self._metrics_lock:
             self.metrics.append(m)
             self.totals["cycles"] += 1
@@ -443,16 +522,24 @@ class Scheduler:
         self._cycle_unsched = []
         self._cycle_bound = []
         self._trace_cycle = []
+        self._cycle_span = (
+            self.spans.begin() if self.spans is not None else None
+        )
+        t_pop = time.perf_counter()
         if window is None:
             window = self.queue.pop_window(self._window_cap())
         m.pods_in = len(window)
         if not window:
             # empty cycles (backoff waits, idle polls) are not recorded:
             # a serve-forever loop would otherwise grow self.metrics
-            # without bound on pure idle time
+            # without bound on pure idle time — and not spanned (the
+            # same unbounded-idle concern applies to span files)
+            self._cycle_span = None
             m.cycle_seconds = time.perf_counter() - t0
             return None
+        self._span("queue_pop", t_pop)
 
+        t_fetch = time.perf_counter()
         try:
             nodes = self.list_nodes()
             running = self.list_running_pods()
@@ -470,7 +557,9 @@ class Scheduler:
             m.fetch_failed = True
             m.cycle_seconds = time.perf_counter() - t0
             self._record(m)
+            self._flush_spans(t0, m)
             return None
+        self._span("state_fetch", t_fetch)
 
         # VolumeRestrictions (ReadWriteOncePod): at most one pod
         # cluster-wide may use an exclusive claim. Enforced HERE, against
@@ -499,6 +588,7 @@ class Scheduler:
             if not window:
                 m.cycle_seconds = time.perf_counter() - t0
                 self._record(m)
+                self._flush_spans(t0, m)
                 return None
 
         # nominated-capacity reservations (upstream nominatedNodeName):
@@ -693,11 +783,47 @@ class Scheduler:
 
         m.cycle_seconds = time.perf_counter() - t0
         self._record(m)
+        seq = None
         if self.recorder is not None:
             # AFTER the cycle's own bookkeeping: journal serialization
             # time never inflates cycle_seconds, and the record carries
-            # the final metrics
+            # the final metrics. The seq is read BEFORE the append — the
+            # value this cycle's record is journaled under, and the same
+            # value the dispatch propagated to the sidecar.
+            seq = self.recorder._seq
+            dropped_before = self.recorder.records_dropped
+            t_rec = time.perf_counter()
             self._record_trace(start, m)
+            self._span("recorder_write", t_rec)
+            if self.recorder.records_dropped != dropped_before:
+                # the record was NOT journaled under the predicted seq —
+                # the next cycle's record will own it. Omit the
+                # cross-link rather than point at the wrong record (the
+                # sidecar's copy of the prediction cannot be retracted).
+                seq = None
+        self._flush_spans(t0, m, seq=seq)
+
+    def _flush_spans(
+        self, t0: float, m: CycleMetrics, seq: int | None = None
+    ) -> None:
+        """Close out the cycle's span set: add the whole-cycle span and
+        hand it to the recorder for encoding + write (completion stage —
+        the device dispatch never pays for serialization). `seq`
+        cross-links every span to the cycle's flight-recorder record so
+        a replayed cycle can be found in the timeline."""
+        sp = self._cycle_span
+        if sp is None:
+            return
+        self._cycle_span = None
+        sp.add(
+            "cycle",
+            t0,
+            time.perf_counter(),
+            path=self._cycle_path(m),
+            pods_in=m.pods_in,
+            pods_bound=m.pods_bound,
+        )
+        self.spans.flush(sp, seq=seq)
 
     def _trace_fingerprint(self, start: _CycleStart) -> dict:
         """Config + layout identity summary riding every full record —
@@ -820,6 +946,7 @@ class Scheduler:
         t_prep = time.perf_counter()
         self._prefetch_next()
         m.host_overlap_seconds = time.perf_counter() - t_prep
+        self._span("host_overlap", t_prep, t_prep + m.host_overlap_seconds)
         try:
             self._complete_window(
                 infl, start.window, start.nodes, m,
@@ -951,6 +1078,7 @@ class Scheduler:
         speculative prebuild respects this through the layout
         fingerprint: a selector minted between prebuild and here
         discards the prebuilt batch.)"""
+        t_build = time.perf_counter()
         snapshot = self.builder.build_snapshot(
             nodes, utils, running, pending_pods=window,
             ephemeral=ephemeral,
@@ -975,6 +1103,8 @@ class Scheduler:
             window, nodes, running, pods_batch, snapshot,
             record=not ephemeral,
         )
+        self._span("snapshot_build", t_build)
+        self._set_engine_trace_id()
         tctx = None
         if self.recorder is not None:
             # references only — serialization happens in _finish_cycle,
@@ -1011,6 +1141,22 @@ class Scheduler:
         return _InFlight(
             handle=handle, pods_batch=pods_batch, t_eng=t_eng, trace_ctx=tctx,
         )
+
+    def _set_engine_trace_id(self) -> None:
+        """Hand the cycle's trace id + predicted flight-recorder seq to
+        the engine before dispatch: RemoteEngine ships them as gRPC
+        metadata (sidecar spans join the host timeline on the id), a
+        local engine names on-demand profile dumps with them. One
+        getattr when spans are off."""
+        sp = self._cycle_span
+        if sp is None:
+            return
+        setter = getattr(self.engine, "set_trace_id", None)
+        if setter is not None:
+            setter(
+                sp.trace_id,
+                self.recorder._seq if self.recorder is not None else -1,
+            )
 
     def _dispatch_resident(
         self, snapshot, pods_batch, kw, *, ephemeral: bool, use_async: bool,
@@ -1084,7 +1230,12 @@ class Scheduler:
         validation and bind semantics cannot drift between them."""
         res = infl.handle.result()
         idx = np.asarray(res.node_idx)
-        m.engine_seconds += time.perf_counter() - infl.t_eng
+        t_done = time.perf_counter()
+        m.engine_seconds += t_done - infl.t_eng
+        self._span(
+            "engine_step", infl.t_eng, t_done,
+            resident=infl.resident, delta=infl.delta_sent,
+        )
         if infl.resident:
             # attribute AFTER the force: the engine reports whether the
             # delta actually applied or it degraded to a full upload
@@ -1108,7 +1259,9 @@ class Scheduler:
                 idx[: len(window)], np.int32
             )
         pre = len(self._cycle_bound)
+        t_bind = time.perf_counter()
         self._apply_assignments(window, nodes, idx, m)
+        self._span("bind", t_bind)
         bound = self._cycle_bound[pre:]
         if bound and not ephemeral:
             # incremental snapshot carry: fold this cycle's binds into
@@ -1620,6 +1773,7 @@ class Scheduler:
         from kubernetes_scheduler_tpu.utils.padding import pad_pod_batch
 
         bw = self.config.batch_window
+        t_build = time.perf_counter()
         snapshot = self.builder.build_snapshot(
             nodes, utils, running, pending_pods=window, ephemeral=ephemeral,
             pending_all_plain=self._window_flags(window)[0],
@@ -1627,6 +1781,7 @@ class Scheduler:
         pods_batch = self.builder.build_pod_batch(
             window, recs=self._window_recs(window)
         )
+        self._span("snapshot_build", t_build)
         n_padded = -(-len(window) // bw) * bw
         p_have = int(np.asarray(pods_batch.request).shape[0])
         if p_have < n_padded:
@@ -1643,6 +1798,7 @@ class Scheduler:
             window, nodes, running, pods_batch, snapshot,
             record=not ephemeral,
         )
+        self._set_engine_trace_id()
         tctx = None
         if self.recorder is not None:
             tctx = {
@@ -1654,7 +1810,9 @@ class Scheduler:
             snapshot, windows, kw, m, ephemeral=ephemeral, tctx=tctx,
         )
         idx = np.asarray(res.node_idx).reshape(-1)
-        m.engine_seconds += time.perf_counter() - t_eng
+        t_done = time.perf_counter()
+        m.engine_seconds += t_done - t_eng
+        self._span("engine_step", t_eng, t_done, backlog=True)
         if (
             idx.shape[0] < len(window)
             or (idx[: len(window)] >= len(nodes)).any()
@@ -1665,7 +1823,9 @@ class Scheduler:
             )
         if tctx is not None:
             tctx["node_idx"] = np.array(idx[: len(window)], np.int32)
+        t_bind = time.perf_counter()
         self._apply_assignments(window, nodes, idx, m)
+        self._span("bind", t_bind)
 
     def _dispatch_windows(
         self, snapshot, windows, kw, m: CycleMetrics,
@@ -1715,9 +1875,11 @@ class Scheduler:
         from kubernetes_scheduler_tpu.engine import snapshot_nbytes
         from kubernetes_scheduler_tpu.host.snapshot import snapshot_delta
 
+        t_d = time.perf_counter()
         delta = None
         if self._resident_ok and self._resident_prev is not None:
             delta = snapshot_delta(self._resident_prev, snapshot)
+        self._span("delta_derive", t_d, sent=delta is not None)
         epoch = self._resident_epoch + 1
         saved = 0
         if delta is not None:
@@ -1805,6 +1967,15 @@ class Scheduler:
         self._complete_window(infl, window, nodes, m, ephemeral=ephemeral)
 
     def _run_scalar(self, window, nodes, running, utils, m: CycleMetrics):
+        t_s = time.perf_counter()
+        try:
+            self._run_scalar_inner(window, nodes, running, utils, m)
+        finally:
+            self._span("scalar_cycle", t_s)
+
+    def _run_scalar_inner(
+        self, window, nodes, running, utils, m: CycleMetrics
+    ):
         from kubernetes_scheduler_tpu.host.plugins import SCALAR_POLICIES
 
         policy = self.config.policy

@@ -216,6 +216,33 @@ def journal_files(path: str) -> list[str]:
     ]
 
 
+def enforce_disk_budget(
+    files: list[str], max_bytes: int, *, keep: str | None = None
+) -> None:
+    """Drop the OLDEST of `files` (given oldest first) until the total
+    size fits `max_bytes`; `keep` (the file being written) is never
+    dropped. Shared by the journal writer and the span writer
+    (trace/spans.py) — one disk-budget policy for every telemetry
+    artifact the scheduler rotates."""
+    total = 0
+    sizes = {}
+    for fp in files:
+        try:
+            sizes[fp] = os.path.getsize(fp)
+        except OSError:
+            sizes[fp] = 0
+        total += sizes[fp]
+    for fp in files:
+        if total <= max_bytes or fp == keep:
+            break
+        total -= sizes[fp]
+        try:
+            os.remove(fp)
+            log.info("trace: dropped %s (disk budget)", fp)
+        except OSError:
+            log.warning("trace: could not drop %s", fp, exc_info=True)
+
+
 def read_journal_file(fp: str, *, strict_version: bool = True):
     """Yield decoded records from ONE journal file, with truncated-tail
     recovery: a short final frame, a failing CRC, or a payload cut
@@ -342,19 +369,12 @@ class JournalWriter:
         self._enforce_budget()
 
     def _enforce_budget(self) -> None:
-        files = journal_files(self.path)
-        total = sum(os.path.getsize(fp) for fp in files)
         # never drop the file being written
-        current = self._f.name if self._f is not None else None
-        for fp in files:
-            if total <= self.max_bytes or fp == current:
-                break
-            total -= os.path.getsize(fp)
-            try:
-                os.remove(fp)
-                log.info("trace: dropped %s (disk budget)", fp)
-            except OSError:
-                log.warning("trace: could not drop %s", fp, exc_info=True)
+        enforce_disk_budget(
+            journal_files(self.path),
+            self.max_bytes,
+            keep=self._f.name if self._f is not None else None,
+        )
 
     def needs_rotation(self, payload_len: int) -> bool:
         return (

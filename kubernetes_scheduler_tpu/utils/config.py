@@ -170,6 +170,26 @@ class SchedulerConfig:
     trace_path: str | None = None
     trace_file_bytes: int = 32 * 1024 * 1024
     trace_max_bytes: int = 256 * 1024 * 1024
+    # per-cycle span telemetry (host/observe.SpanRecorder): when set,
+    # every completed cycle appends Chrome-trace-event JSON spans
+    # (queue pop, state fetch, snapshot build, delta derivation, engine
+    # step, bind fan-out, recorder write) under a monotonically-assigned
+    # trace id to a rotating journal-style directory (trace/spans.py).
+    # The id rides gRPC metadata to the sidecar so `yoda-tpu spans
+    # merge` joins host and sidecar spans into one Perfetto-loadable
+    # timeline; each span also carries the cycle's flight-recorder seq
+    # when trace_path is set. None = off (zero cost); encoding happens
+    # in the completion stage, off the device-dispatch critical path.
+    span_path: str | None = None
+    span_file_bytes: int = 32 * 1024 * 1024
+    span_max_bytes: int = 128 * 1024 * 1024
+    # on-demand device profiling (/debug/profile?cycles=N): where the
+    # jax.profiler dumps land. None = derive (<span_path>/profiles when
+    # spans are on, else a tempdir)
+    profile_path: str | None = None
+    # /metrics bind host (host/observe exporters): the deploy manifests
+    # bind all interfaces for the Prometheus scrape; tests bind loopback
+    metrics_bind_host: str = "0.0.0.0"
     preemption: bool = True
     preemption_max_victims: int = 8
     # preemptors evaluated per pass, highest priority first: the

@@ -1,0 +1,47 @@
+"""Fixture: a well-formed metric surface the rule stays quiet on."""
+
+
+class Histogram:  # stand-in for observe.Histogram
+    def __init__(self, name, help="", labels=()):
+        pass
+
+
+class Counter(Histogram):
+    pass
+
+
+class Gauge(Histogram):
+    pass
+
+
+_HELP = {
+    "queue_depth_count": "Pods waiting in the scheduling queue",
+    "flushes_total": "Speculative state discards",
+    "binds_total": "Pods bound",
+    "drain_rate_per_sec": "Pods drained per second",
+    "window_size_mean": "Mean pods per scheduling window",
+}
+
+requests = Counter("requests_total", "RPCs served", labels=("rpc",))
+steps = Histogram(
+    "step_duration_seconds", "Device step time", labels=("rpc",)
+)
+sessions = Gauge("session_bytes", help="Bytes held by live sessions")
+
+
+def render(extra):
+    extra.update(flushes_total=1)
+    extra["binds_total"] = 2
+    return extra
+
+
+SHIPPED_METRICS = (
+    "queue_depth_count",
+    "flushes_total",
+    "binds_total",
+    "drain_rate_per_sec",
+    "window_size_mean",
+    "requests_total",
+    "step_duration_seconds",
+    "session_bytes",
+)

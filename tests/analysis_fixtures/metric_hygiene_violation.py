@@ -1,0 +1,56 @@
+"""Fixture: every metric-hygiene failure mode, one surface each."""
+
+
+class Histogram:  # stand-in for observe.Histogram
+    def __init__(self, name, help="", labels=()):
+        pass
+
+
+class Counter(Histogram):
+    pass
+
+
+class Gauge(Histogram):
+    pass
+
+
+_HELP = {
+    # no unit suffix
+    "queue_depth": "Pods waiting in the scheduling queue",
+    # empty HELP string
+    "flushes_total": "",
+    # declared twice
+    "binds_total": "Pods bound",
+    "binds_total": "Pods bound (again)",  # noqa: F601
+    # fine on its own, but missing from SHIPPED_METRICS below
+    "orphan_metric_total": "Declared but never registered",
+}
+
+# Counter without the _total suffix
+requests = Counter("requests_seconds", "RPC count mislabeled as seconds")
+
+# Histogram with a bad suffix
+steps = Histogram("step_time", "Device step time", labels=("rpc",))
+
+# Histogram with no help text at all
+waits = Histogram("wait_duration_seconds")
+
+
+def render(extra):
+    # emitted through the side channel with no HELP entry anywhere
+    extra.update(mystery_metric_total=1)
+    extra["surprise_sample_bytes"] = 2
+    return extra
+
+
+SHIPPED_METRICS = (
+    "queue_depth",
+    "flushes_total",
+    "binds_total",
+    "requests_seconds",
+    "step_time",
+    "wait_duration_seconds",
+    # shipped once, no longer declared anywhere — the removal the rule
+    # exists to catch
+    "removed_metric_total",
+)

@@ -56,6 +56,12 @@ def active(violations):
             "pallas_vmem_clean.py",
             4,
         ),
+        (
+            "metric-hygiene",
+            "metric_hygiene_violation.py",
+            "metric_hygiene_clean.py",
+            8,
+        ),
     ],
 )
 def test_rule_fires_and_stays_quiet(rule, violating, clean, min_hits):
@@ -183,6 +189,37 @@ def test_journal_schema_messages_name_the_drift():
         "kubernetes_scheduler_tpu", "trace", "schema.py",
     )
     assert active(run_lint([real], rules=["wire-schema"])) == []
+
+
+def test_metric_hygiene_covers_every_failure_mode():
+    """Each metric-hygiene failure mode fires with a message naming the
+    metric — and the REAL metric surfaces (host/observe.py's _HELP +
+    SHIPPED_METRICS registry, the scheduler's and sidecar's labeled
+    collectors) lint clean across the package (what `make lint`
+    enforces)."""
+    msgs = [
+        v.message
+        for v in active(
+            lint_fixture("metric_hygiene_violation.py", "metric-hygiene")
+        )
+    ]
+    assert any("`queue_depth` has no unit suffix" in m for m in msgs)
+    assert any("empty HELP string" in m for m in msgs)
+    assert any("`binds_total` declared twice" in m for m in msgs)
+    assert any("must end in `_total`" in m for m in msgs)
+    assert any("no (or an empty) help string" in m for m in msgs)
+    assert any("no HELP entry in any *_HELP table" in m for m in msgs)
+    assert any("no longer declared anywhere" in m for m in msgs)
+    assert any("not registered in SHIPPED_METRICS" in m for m in msgs)
+    assert active(run_lint(rules=["metric-hygiene"])) == []
+
+
+def test_shipped_registry_matches_help_table():
+    """The live registry covers every _HELP key (the lint checks the
+    static surfaces; this pins the runtime tables to each other)."""
+    from kubernetes_scheduler_tpu.host.observe import _HELP, SHIPPED_METRICS
+
+    assert set(_HELP) <= set(SHIPPED_METRICS)
 
 
 def test_real_schedule_proto_parses():

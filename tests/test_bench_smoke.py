@@ -53,6 +53,7 @@ def test_bench_smoke_e2e():
         "host_loop_32nodes_pipelined",
         "host_loop_32nodes_resident",
         "host_loop_32nodes_replay",
+        "host_loop_32nodes_telemetry",
     ):
         assert want in metrics, (want, sorted(metrics))
     for name in (
@@ -84,6 +85,126 @@ def test_bench_smoke_e2e():
     # evidence; not asserted at smoke sizes where cycles are ~ms)
     assert "trace_overhead_pct" in rep, rep
     assert rep["trace_bytes"] > 0, rep
+    # full-telemetry metric: spans were actually written during the
+    # drain, the concurrent scraper got real responses, and the
+    # vs-pipelined ratio (the <5% gate's evidence at real sizes) is
+    # reported — not asserted at smoke sizes where cycles are ~ms
+    tel = metrics["host_loop_32nodes_telemetry"]
+    assert tel["pods_bound"] > 0, tel
+    assert tel["spans_written"] > 0, tel
+    assert tel["span_bytes"] > 0, tel
+    assert tel["spans_dropped"] == 0, tel
+    assert tel["metrics_scrapes"] > 0, tel
+    assert "telemetry_overhead_pct" in tel, tel
+
+
+def test_obs_smoke_e2e(tmp_path):
+    """The `make obs-smoke` flow as a test: a sidecar with its own
+    /metrics + span files, a sim-driven host run (spans + exporter on)
+    against it, a scrape of BOTH exporters, and the `spans merge` join —
+    non-empty and ID-joined is the acceptance shape."""
+    import socket
+    import time
+    import urllib.request
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    cfg = tmp_path / "config.json"
+    cfg.write_text(
+        '{"batch_window": 64, "min_device_work": 1, '
+        '"adaptive_dispatch": false, "metrics_bind_host": "127.0.0.1"}'
+    )
+    grpc_port, side_mport, host_mport = free_port(), free_port(), free_port()
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    sidecar = subprocess.Popen(
+        [
+            sys.executable, "-m", "kubernetes_scheduler_tpu", "sidecar",
+            "--port", str(grpc_port), "--metrics-port", str(side_mport),
+            "--metrics-host", "127.0.0.1",
+            "--span-path", str(tmp_path / "sidecar-spans"),
+        ],
+        cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{side_mport}/healthz", timeout=2
+                )
+                break
+            except Exception:
+                assert sidecar.poll() is None, sidecar.stdout.read()[-2000:]
+                time.sleep(0.5)
+        else:
+            raise AssertionError("sidecar metrics endpoint never came up")
+
+        host = subprocess.Popen(
+            [
+                sys.executable, "-m", "kubernetes_scheduler_tpu",
+                "scheduler", "--nodes", "48", "--pods", "192",
+                "--config", str(cfg),
+                "--engine", f"127.0.0.1:{grpc_port}",
+                "--spans", str(tmp_path / "host-spans"),
+                "--metrics-port", str(host_mport),
+            ],
+            cwd=REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        # scrape the HOST exporter while the run is live (it serves from
+        # process start; the first cycle's compile leaves ample time)
+        host_bodies = []
+        while host.poll() is None:
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{host_mport}/metrics", timeout=2
+                ) as r:
+                    host_bodies.append(r.read().decode())
+            except Exception:
+                pass
+            time.sleep(0.3)
+        out, err = host.communicate(timeout=60)
+        assert host.returncode == 0, err[-2000:]
+        summary = json.loads(out.splitlines()[-1])
+        assert summary["pods_bound"] == 192
+        assert summary["fallback_cycles"] == 0
+        assert host_bodies, "host /metrics was never scraped successfully"
+        assert any("yoda_tpu_cycles_total" in b for b in host_bodies)
+
+        # the sidecar's own exporter serves device-step histograms
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{side_mport}/metrics", timeout=10
+        ) as r:
+            side_body = r.read().decode()
+        assert "yoda_tpu_device_step_duration_seconds_bucket" in side_body
+        assert "yoda_tpu_rpcs_served_total" in side_body
+    finally:
+        sidecar.terminate()
+        try:
+            sidecar.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            sidecar.kill()
+
+    # merge joins the two sides on shared trace ids (exit 1 otherwise)
+    merged = str(tmp_path / "merged.trace.json")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "kubernetes_scheduler_tpu", "spans",
+            "merge", str(tmp_path / "host-spans"),
+            str(tmp_path / "sidecar-spans"), "--out", merged,
+        ],
+        capture_output=True, text=True, timeout=120, cwd=REPO, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:] + proc.stdout[-500:]
+    report = json.loads(proc.stdout.splitlines()[-1])
+    assert report["joined_trace_ids"] > 0, report
+    assert report["host_events"] > 0 and report["sidecar_events"] > 0
+    trace = json.load(open(merged))
+    assert trace["traceEvents"], "merged timeline is empty"
 
 
 def test_trace_smoke_e2e(tmp_path):

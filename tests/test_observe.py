@@ -1,11 +1,19 @@
-"""Observability: metric aggregation, Prometheus exposition, spans."""
+"""Observability: metric aggregation, Prometheus exposition, labeled
+histograms, per-cycle spans (Chrome trace events + merge), concurrent
+scrapes against a live scheduler, and telemetry-on/off binding parity."""
 
 import json
+import threading
 import urllib.request
 
+import pytest
+
 from kubernetes_scheduler_tpu.host.observe import (
-    CycleTracer,
+    Counter,
+    Gauge,
+    Histogram,
     MetricsExporter,
+    SpanRecorder,
     render_prometheus,
     summarize,
 )
@@ -46,33 +54,451 @@ def test_render_prometheus_format():
             float(value)
 
 
+def test_render_prometheus_unknown_extra_does_not_crash():
+    """Regression: an `extra` key with no _HELP entry used to KeyError
+    the whole /metrics render; it now falls back to an empty HELP line
+    and still emits the sample."""
+    text = render_prometheus(
+        make_metrics(), extra={"mystery_metric_total": 3}
+    )
+    assert "# HELP yoda_tpu_mystery_metric_total" in text
+    assert "yoda_tpu_mystery_metric_total 3" in text
+    for line in text.strip().splitlines():
+        if not line.startswith("#"):
+            name, value = line.split()
+            float(value)
+
+
 def test_metrics_exporter_http():
     class FakeScheduler:
         metrics = make_metrics()
 
     exporter = MetricsExporter(FakeScheduler())
-    port = exporter.serve(0)
+    # loopback bind (the configurable-host satellite): tests must not
+    # open 0.0.0.0 listeners
+    port = exporter.serve(0, host="127.0.0.1")
     try:
-        with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics") as r:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
             body = r.read().decode()
         assert "yoda_tpu_pods_bound_total 29" in body
-        with urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz") as r:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz", timeout=10) as r:
             assert r.read() == b"ok\n"
     finally:
         exporter.close()
 
 
-def test_cycle_tracer_spans():
-    lines = []
-    tracer = CycleTracer(sink=lines.append)
-    with tracer.span("snapshot"):
+# ---- labeled collectors ---------------------------------------------------
+
+
+def test_histogram_render_cumulative_buckets():
+    h = Histogram(
+        "step_duration_seconds", "step time", labels=("rpc",),
+        buckets=(0.01, 0.1, 1.0),
+    )
+    h.observe(0.005, rpc="a")
+    h.observe(0.05, rpc="a")
+    h.observe(0.05, rpc="a")
+    h.observe(5.0, rpc="a")   # over the top bucket -> +Inf only
+    h.observe(0.1, rpc="b")   # == bound lands in that bucket (le)
+    lines = h.render()
+    text = "\n".join(lines)
+    assert "# TYPE yoda_tpu_step_duration_seconds histogram" in text
+    assert 'yoda_tpu_step_duration_seconds_bucket{rpc="a",le="0.01"} 1' in text
+    assert 'yoda_tpu_step_duration_seconds_bucket{rpc="a",le="0.1"} 3' in text
+    assert 'yoda_tpu_step_duration_seconds_bucket{rpc="a",le="1"} 3' in text
+    assert 'yoda_tpu_step_duration_seconds_bucket{rpc="a",le="+Inf"} 4' in text
+    assert 'yoda_tpu_step_duration_seconds_count{rpc="a"} 4' in text
+    assert 'yoda_tpu_step_duration_seconds_bucket{rpc="b",le="0.1"} 1' in text
+    # sums are per-series
+    assert 'yoda_tpu_step_duration_seconds_sum{rpc="b"} 0.1' in text
+
+
+def test_counter_and_gauge_render():
+    c = Counter("rpcs_served_total", "rpcs", labels=("rpc",))
+    c.inc(rpc="health")
+    c.inc(3, rpc="schedule_batch")
+    text = "\n".join(c.render())
+    assert 'yoda_tpu_rpcs_served_total{rpc="health"} 1' in text
+    assert 'yoda_tpu_rpcs_served_total{rpc="schedule_batch"} 3' in text
+    g = Gauge("resident_sessions_count", "sessions")
+    g.set(2)
+    text = "\n".join(g.render())
+    assert "# TYPE yoda_tpu_resident_sessions_count gauge" in text
+    assert "yoda_tpu_resident_sessions_count 2" in text
+
+
+def test_histogram_concurrent_observe_and_render():
+    """The buckets are mutated from the scheduling thread while scrapes
+    render: no torn series, final counts exact."""
+    h = Histogram("cycle_duration_seconds", "cycles", labels=("path",))
+    stop = threading.Event()
+    rendered = []
+
+    def scrape():
+        while not stop.is_set():
+            rendered.append(h.render())
+
+    t = threading.Thread(target=scrape)
+    t.start()
+    for i in range(2000):
+        h.observe(0.001 * (i % 7), path="serial")
+    stop.set()
+    t.join(timeout=10)
+    text = "\n".join(h.render())
+    assert 'yoda_tpu_cycle_duration_seconds_count{path="serial"} 2000' in text
+    assert rendered  # scrapes actually interleaved
+
+
+# ---- span layer -----------------------------------------------------------
+
+
+def test_span_recorder_chrome_events(tmp_path):
+    from kubernetes_scheduler_tpu.trace.spans import read_spans
+
+    rec = SpanRecorder(str(tmp_path), process="host")
+    ss = rec.begin()
+    assert ss.trace_id == 1
+    with ss.span("snapshot_build"):
         pass
-    with tracer.span("engine"):
-        pass
-    tracer.emit(cycle=1, pods=5)
-    rec = json.loads(lines[0])
-    assert rec["cycle"] == 1
-    assert "span_snapshot_seconds" in rec and "span_engine_seconds" in rec
-    # spans reset between cycles
-    tracer.emit(cycle=2)
-    assert "span_engine_seconds" not in json.loads(lines[1])
+    ss.add("engine_step", 1.0, 1.5, resident=False)
+    rec.flush(ss, seq=7)
+    ss2 = rec.begin()
+    assert ss2.trace_id == 2  # monotonic
+    rec.close()
+
+    events = [ev for ev in read_spans(str(tmp_path)) if ev["ph"] == "X"]
+    assert len(events) == 2
+    for ev in events:
+        assert ev["args"]["trace_id"] == 1
+        assert ev["args"]["seq"] == 7  # flight-recorder cross-link
+        assert ev["dur"] >= 0
+    names = {ev["name"] for ev in events}
+    assert names == {"snapshot_build", "engine_step"}
+    assert rec.spans_written == 2
+    assert rec.bytes_written > 0
+
+
+def test_span_writer_rotation_and_budget(tmp_path):
+    from kubernetes_scheduler_tpu.trace.spans import (
+        SpanWriter,
+        read_spans,
+        span_files,
+    )
+
+    w = SpanWriter(str(tmp_path), file_bytes=600, max_bytes=2000)
+    for i in range(40):
+        w.append([{"name": "s", "ph": "X", "ts": i, "dur": 1,
+                   "pid": 1, "tid": 0, "args": {"trace_id": i}}])
+    w.close()
+    files = span_files(str(tmp_path))
+    assert len(files) > 1  # rotated
+    import os
+
+    assert sum(os.path.getsize(f) for f in files) <= 2600  # budget held
+    # surviving files all parse
+    events = [ev for ev in read_spans(str(tmp_path)) if ev["ph"] == "X"]
+    assert events and all(ev["name"] == "s" for ev in events)
+
+
+def test_span_file_torn_tail_recovers(tmp_path):
+    from kubernetes_scheduler_tpu.trace.spans import (
+        SpanWriter,
+        read_span_file,
+        span_files,
+    )
+
+    w = SpanWriter(str(tmp_path))
+    w.append([{"name": "good", "ph": "X", "ts": 1, "dur": 1, "pid": 1,
+               "tid": 0, "args": {"trace_id": 1}}])
+    w.close()
+    fp = span_files(str(tmp_path))[0]
+    with open(fp, "a") as f:
+        f.write('{"name": "torn", "ph"')
+    events = [ev for ev in read_span_file(fp) if ev["ph"] == "X"]
+    assert [ev["name"] for ev in events] == ["good"]
+
+
+def test_spans_merge_joins_on_trace_id(tmp_path):
+    from kubernetes_scheduler_tpu.trace.spans import merge_spans
+
+    host = SpanRecorder(str(tmp_path / "host"), process="host")
+    side = SpanRecorder(str(tmp_path / "side"), process="sidecar")
+    for tid in (1, 2, 3):
+        ss = host.begin()
+        ss.add("cycle", 0.0, 1.0)
+        host.flush(ss)
+    for tid in (2, 3, 9):  # 9 only on the sidecar side
+        ss = side.begin(tid)
+        ss.add("device_step", 0.2, 0.8, rpc="schedule_batch")
+        side.flush(ss, seq=tid)
+    host.close()
+    side.close()
+    out = tmp_path / "merged.json"
+    report = merge_spans(
+        str(tmp_path / "host"), str(tmp_path / "side"), str(out)
+    )
+    assert report["joined_trace_ids"] == 2
+    assert report["host_trace_ids"] == 3
+    assert report["sidecar_trace_ids"] == 3
+    merged = json.loads(out.read_text())
+    assert len(merged["traceEvents"]) == report["merged_events"]
+    # both process_name metadata tracks survive the merge
+    names = {
+        ev["args"]["name"]
+        for ev in merged["traceEvents"]
+        if ev.get("ph") == "M"
+    }
+    assert names == {"host", "sidecar"}
+
+
+def test_spans_merge_cli(tmp_path):
+    from kubernetes_scheduler_tpu.cli import main
+
+    host = SpanRecorder(str(tmp_path / "host"), process="host")
+    ss = host.begin()
+    ss.add("cycle", 0.0, 1.0)
+    host.flush(ss)
+    host.close()
+    side = SpanRecorder(str(tmp_path / "side"), process="sidecar")
+    ss = side.begin(1)
+    ss.add("device_step", 0.2, 0.8)
+    side.flush(ss)
+    side.close()
+    out = str(tmp_path / "merged.json")
+    rc = main([
+        "spans", "merge", str(tmp_path / "host"), str(tmp_path / "side"),
+        "--out", out,
+    ])
+    assert rc == 0
+    assert json.load(open(out))["traceEvents"]
+    # disjoint ids on non-empty sides -> non-zero exit (broken join)
+    side2 = SpanRecorder(str(tmp_path / "side2"), process="sidecar")
+    ss = side2.begin(999)
+    ss.add("device_step", 0.2, 0.8)
+    side2.flush(ss)
+    side2.close()
+    rc = main([
+        "spans", "merge", str(tmp_path / "host"), str(tmp_path / "side2"),
+        "--out", str(tmp_path / "merged2.json"),
+    ])
+    assert rc == 1
+
+
+# ---- live scheduler: spans wired into both drivers, scrape concurrency ----
+
+
+def _make_sched(tmp_path, *, pipeline_depth=0, span=True, trace=False):
+    from kubernetes_scheduler_tpu.host.scheduler import Scheduler
+    from kubernetes_scheduler_tpu.sim.host_gen import (
+        gen_host_cluster,
+        gen_host_pods,
+    )
+    from kubernetes_scheduler_tpu.utils.config import SchedulerConfig
+
+    nodes, advisor = gen_host_cluster(12, seed=0)
+    running: list = []
+    cfg = SchedulerConfig(
+        batch_window=16,
+        max_windows_per_cycle=1,
+        min_device_work=1,
+        adaptive_dispatch=False,
+        pipeline_depth=pipeline_depth,
+        initial_backoff_seconds=3600.0,
+        max_backoff_seconds=3600.0,
+        span_path=str(tmp_path / f"spans{pipeline_depth}") if span else None,
+        trace_path=str(tmp_path / f"journal{pipeline_depth}") if trace else None,
+    )
+    sched = Scheduler(
+        cfg,
+        advisor=advisor,
+        list_nodes=lambda: nodes,
+        list_running_pods=lambda: running,
+    )
+    for pod in gen_host_pods(48, seed=1):
+        sched.submit(pod)
+    return sched, running
+
+
+@pytest.mark.parametrize("depth", [0, 1])
+def test_cycle_spans_written_by_both_drivers(tmp_path, depth):
+    from kubernetes_scheduler_tpu.trace.spans import read_spans
+
+    sched, running = _make_sched(tmp_path, pipeline_depth=depth, trace=True)
+    sched.run_until_empty(max_cycles=16)
+    sched.spans.close()
+    sched.recorder.close()
+    events = [
+        ev
+        for ev in read_spans(str(tmp_path / f"spans{depth}"))
+        if ev["ph"] == "X"
+    ]
+    assert events
+    by_name = {}
+    for ev in events:
+        by_name.setdefault(ev["name"], []).append(ev)
+    for want in ("queue_pop", "state_fetch", "snapshot_build",
+                 "engine_step", "bind", "cycle", "recorder_write"):
+        assert want in by_name, (want, sorted(by_name))
+    if depth == 1:
+        assert "host_overlap" in by_name
+    # trace ids are monotonic and shared across one cycle's spans
+    ids = sorted({ev["args"]["trace_id"] for ev in events})
+    assert ids == list(range(1, len(ids) + 1))
+    # every span carries the cycle's flight-recorder seq, and the seqs
+    # pair with the journal records (the replay cross-link)
+    from kubernetes_scheduler_tpu.trace.recorder import read_journal
+
+    rec_seqs = {
+        r["seq"] for r in read_journal(str(tmp_path / f"journal{depth}"))
+    }
+    for ev in events:
+        assert ev["args"]["seq"] in rec_seqs
+    # device-step spans specifically carry the seq (the acceptance gate)
+    assert all("seq" in ev["args"] for ev in by_name["engine_step"])
+
+
+@pytest.mark.parametrize("depth", [0, 1])
+def test_concurrent_scrapes_mid_cycle(tmp_path, depth):
+    """Hammer /metrics from several threads while the scheduler drains:
+    every response parses, no torn histogram series, and the final
+    scrape agrees with the scheduler's totals (metrics_snapshot and the
+    histogram buckets are thread-safe in both drivers)."""
+    sched, running = _make_sched(tmp_path, pipeline_depth=depth)
+    exporter = MetricsExporter(sched)
+    port = exporter.serve(0, host="127.0.0.1")
+    bodies, errors = [], []
+    stop = threading.Event()
+
+    def scrape():
+        while not stop.is_set():
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=10
+                ) as r:
+                    bodies.append(r.read().decode())
+            except Exception as e:  # pragma: no cover - the failure signal
+                errors.append(repr(e))
+                return
+
+    threads = [threading.Thread(target=scrape) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        sched.run_until_empty(max_cycles=16)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        exporter.close()
+        if sched.spans is not None:
+            sched.spans.close()
+    assert not errors, errors
+    assert bodies
+    for body in bodies:
+        for line in body.strip().splitlines():
+            if not line.startswith("#"):
+                name, value = line.rsplit(" ", 1)
+                float(value)
+    # final state: histogram totals equal the recorded cycles
+    final = "\n".join(
+        c
+        for collector in sched.prom_collectors
+        for c in collector.render()
+    )
+    path = "pipelined" if depth else "serial"
+    want = sum(1 for m in sched.metrics)
+    assert (
+        f'yoda_tpu_cycle_duration_seconds_count{{path="{path}"}} {want}'
+        in final
+    )
+
+
+def test_telemetry_parity_bindings_bitidentical(tmp_path):
+    """PARITY.md: telemetry-on (spans + exporter scraping mid-drain)
+    vs telemetry-off bindings are bit-identical — spans only read
+    clocks."""
+
+    def run(span, depth):
+        sched, running = _make_sched(
+            tmp_path / f"p{int(span)}{depth}", pipeline_depth=depth,
+            span=span,
+        )
+        exporter = None
+        if span:
+            exporter = MetricsExporter(sched)
+            exporter.serve(0, host="127.0.0.1")
+        sched.run_until_empty(max_cycles=16)
+        if exporter is not None:
+            exporter.close()
+        if sched.spans is not None:
+            sched.spans.close()
+        return [
+            (b.pod.namespace, b.pod.name, b.node_name)
+            for b in sched.binder.bindings
+        ]
+
+    for depth in (0, 1):
+        (tmp_path / f"p0{depth}").mkdir()
+        (tmp_path / f"p1{depth}").mkdir()
+        assert run(True, depth) == run(False, depth)
+
+
+def test_live_sidecar_exporter_concurrent_scrape():
+    """The sidecar's own /metrics under concurrent scrapes while RPCs
+    are in flight: rpc counters + device-step histograms appear and
+    every response parses (the live-sidecar half of the thread-safety
+    satellite)."""
+    from kubernetes_scheduler_tpu.bridge.client import RemoteEngine
+    from kubernetes_scheduler_tpu.bridge.server import make_server
+    from kubernetes_scheduler_tpu.host.observe import HttpMetricsServer
+    from kubernetes_scheduler_tpu.sim import gen_cluster, gen_pods
+
+    server, port, service = make_server("127.0.0.1:0")
+    server.start()
+    exporter = HttpMetricsServer(
+        service.render_metrics, profile=service.arm_profile
+    )
+    mport = exporter.serve(0, host="127.0.0.1")
+    engine = RemoteEngine(f"127.0.0.1:{port}")
+    bodies, errors = [], []
+    stop = threading.Event()
+
+    def scrape():
+        while not stop.is_set():
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{mport}/metrics", timeout=10
+                ) as r:
+                    bodies.append(r.read().decode())
+            except Exception as e:  # pragma: no cover
+                errors.append(repr(e))
+                return
+
+    threads = [threading.Thread(target=scrape) for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        snapshot = gen_cluster(8, seed=0)
+        pods = gen_pods(8, seed=1)
+        engine.set_trace_id(41, 5)
+        for _ in range(3):
+            engine.schedule_batch(snapshot, pods)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        engine.close()
+        exporter.close()
+        server.stop(grace=None)
+    assert not errors, errors
+    final = service.render_metrics()
+    assert 'yoda_tpu_rpcs_served_total{rpc="schedule_batch"} 3' in final
+    assert (
+        'yoda_tpu_device_step_duration_seconds_count{rpc="schedule_batch"} 3'
+        in final
+    )
+    for body in bodies:
+        for line in body.strip().splitlines():
+            if not line.startswith("#"):
+                name, value = line.rsplit(" ", 1)
+                float(value)

@@ -5,7 +5,7 @@ PY ?= python
 IMAGE_REPO ?= registry.example.com/yoda-tpu
 TAG ?= latest
 
-.PHONY: local test test-fast bench trace-smoke obs-smoke lint native native-asan native-tsan proto clean build push
+.PHONY: local test test-fast bench trace-smoke obs-smoke scenario-smoke lint native native-asan native-tsan proto clean build push
 
 # "make local" in the reference = fmt + vet + compile. Here: byte-compile
 # the package, build the native library, lint, run the fast tests.
@@ -70,6 +70,25 @@ trace-smoke:
 	  $(TRACE_SMOKE_DIR)/journal --out $(TRACE_SMOKE_DIR)/replayed
 	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_scheduler_tpu trace diff \
 	  $(TRACE_SMOKE_DIR)/journal $(TRACE_SMOKE_DIR)/replayed
+
+# scenario harness round trip on CPU: the two fastest registered
+# scenarios (burst, gang-mix) at small scale, each emitting a flight-
+# recorder journal that is then replayed — `trace replay` exits
+# non-zero on ANY binding diff, which is the replay-pinning gate every
+# scenario ships under. tests/test_bench_smoke.py wraps the same flow
+# as a slow-marked test.
+SCENARIO_SMOKE_DIR ?= /tmp/yoda-scenario-smoke
+scenario-smoke:
+	rm -rf $(SCENARIO_SMOKE_DIR)
+	mkdir -p $(SCENARIO_SMOKE_DIR)
+	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_scheduler_tpu scenario run \
+	  burst --nodes 32 --trace $(SCENARIO_SMOKE_DIR)/burst
+	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_scheduler_tpu trace replay \
+	  $(SCENARIO_SMOKE_DIR)/burst
+	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_scheduler_tpu scenario run \
+	  gang-mix --nodes 32 --trace $(SCENARIO_SMOKE_DIR)/gang-mix
+	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_scheduler_tpu trace replay \
+	  $(SCENARIO_SMOKE_DIR)/gang-mix
 
 # end-to-end telemetry round trip on CPU: a sidecar with its own
 # /metrics + span files, a short sim-driven host run with spans + the

@@ -438,6 +438,47 @@ def _replay_loop_rate() -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _scenario_rate(name: str, short: str) -> dict:
+    """Scenario-harness metrics (sim/scenarios): one adversarial traffic
+    program driven end to end through the host loop at the bench scale,
+    reported beside the pipelined host-loop baseline. The drain rate is
+    NOT comparable to host_loop_* (scenario traffic arrives over virtual
+    ticks, not as one pre-queued backlog) — it is the round-over-round
+    anchor for the scenario itself; the gang metric adds the admit rate
+    (admitted / (admitted + deferred)), the all-or-nothing health
+    signal."""
+    from kubernetes_scheduler_tpu.sim import scenarios
+
+    n_nodes = int(os.environ.get("BENCH_LOOP_NODES", 4000))
+    intensity = float(os.environ.get("BENCH_SCENARIO_INTENSITY", "1.0"))
+    summary = scenarios.run(
+        name, n_nodes=n_nodes, intensity=intensity, seed=0
+    )
+    out = {
+        "metric": f"scenario_{short}_{n_nodes}nodes",
+        "scenario": name,
+        "cycles": summary["cycles"],
+        "pods_submitted": summary["pods_submitted"],
+        "pods_bound": summary["pods_bound"],
+        "pods_unschedulable": summary["pods_unschedulable"],
+        "fallback_cycles": summary["fallback_cycles"],
+        "pods_per_sec": summary["pods_per_sec"],
+        "seconds": summary["seconds"],
+    }
+    admitted = summary["gangs_admitted"]
+    deferred = summary["gangs_deferred"]
+    if admitted or deferred:
+        out.update(
+            gangs_admitted=admitted,
+            gangs_deferred=deferred,
+            gang_pods_masked=summary["gang_pods_masked"],
+            gang_admit_rate=round(
+                admitted / max(admitted + deferred, 1), 4
+            ),
+        )
+    return out
+
+
 def loop_rate(
     *,
     n_pods: int | None = None,
@@ -740,6 +781,8 @@ def main():
         print(json.dumps(_resident_loop_rate()))
         print(json.dumps(_replay_loop_rate()))
         print(json.dumps(_telemetry_loop_rate(pipe)))
+        print(json.dumps(_scenario_rate("burst", "burst")))
+        print(json.dumps(_scenario_rate("gang-mix", "gang")))
         return
     if "--suite" in sys.argv:
         from kubernetes_scheduler_tpu.sim.cluster_gen import BENCH_CONFIGS
@@ -806,6 +849,11 @@ def main():
         # full telemetry on (spans + scraped exporter) beside the
         # pipelined baseline: the <5%-overhead observability gate
         print(json.dumps(_telemetry_loop_rate(pipe)), flush=True)
+        # scenario harness (sim/scenarios) beside the pipelined
+        # baseline: the burst program (time-varying arrivals) and the
+        # gang-heavy mix (all-or-nothing admit rate)
+        print(json.dumps(_scenario_rate("burst", "burst")), flush=True)
+        print(json.dumps(_scenario_rate("gang-mix", "gang")), flush=True)
     except Exception as e:  # pragma: no cover - diagnostic path
         print(json.dumps({"diag": "host_loop_failed", "error": str(e)[-200:]}),
               flush=True)

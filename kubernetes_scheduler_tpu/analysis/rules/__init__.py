@@ -7,6 +7,7 @@ from kubernetes_scheduler_tpu.analysis.rules import (
     lock_discipline,
     metric_hygiene,
     pallas_vmem,
+    sim_determinism,
     timeout_hygiene,
     wire_schema,
 )
@@ -20,4 +21,5 @@ RULES = {
     timeout_hygiene.RULE: timeout_hygiene.check,
     pallas_vmem.RULE: pallas_vmem.check,
     metric_hygiene.RULE: metric_hygiene.check,
+    sim_determinism.RULE: sim_determinism.check,
 }

@@ -35,6 +35,15 @@ class EngineUnavailable(RuntimeError):
     """The sidecar could not serve the cycle (after retries)."""
 
 
+# gang co-scheduling tensors (ops/gang.py), stripped off the wire when
+# the sidecar does not advertise HealthReply.gang_scheduling: an old
+# build's strict unpack rejects unknown PodBatch fields, so sending them
+# would error every cycle into the scalar fallback. The host's
+# _resolve_gangs backstop then enforces all-or-nothing host-side.
+_GANG_FIELDS = ("gang_id", "gang_size")
+_PODS_SANS_GANGS = frozenset(engine.PodBatch._fields) - set(_GANG_FIELDS)
+
+
 class _FutureSchedule:
     """RemoteEngine's in-flight ScheduleBatch handle: the whole RPC
     (pack, send, server compute, unpack) runs on the client's dedicated
@@ -112,6 +121,10 @@ class RemoteEngine:
         # resident deltas on the ScheduleWindows RPC — probed, latched,
         # and invalidated together with the other two
         self._windows_resident_cap: bool | None = None
+        # gang-scheduling capability (HealthReply.gang_scheduling):
+        # whether the sidecar's PodBatch knows the gang tensors — same
+        # latch/invalidate discipline as the other capability bits
+        self._gang_cap: bool | None = None
         # did the LAST schedule_resident call apply a delta server-side?
         # (mirrors LocalEngine.resident_used_delta for the host's
         # delta/full upload metrics)
@@ -169,11 +182,21 @@ class RemoteEngine:
         call."""
         info = self.health_info()
         if info is not None:
-            self._field_cache_ok = bool(info.field_cache)
-            self._resident_cap = bool(info.resident_state)
-            self._windows_resident_cap = bool(
-                getattr(info, "windows_resident", False)
-            )
+            # fill only UNRESOLVED latches: a latch someone already
+            # resolved (or pinned) stays put until _invalidate_session
+            # drops the whole set back to unknown together
+            if self._field_cache_ok is None:
+                self._field_cache_ok = bool(info.field_cache)
+            if self._resident_cap is None:
+                self._resident_cap = bool(info.resident_state)
+            if self._windows_resident_cap is None:
+                self._windows_resident_cap = bool(
+                    getattr(info, "windows_resident", False)
+                )
+            if self._gang_cap is None:
+                self._gang_cap = bool(
+                    getattr(info, "gang_scheduling", False)
+                )
 
     def _field_cache_enabled(self) -> bool:
         """Resolve the sidecar's field-cache capability ONCE per client
@@ -201,6 +224,22 @@ class RemoteEngine:
             self._probe_capabilities()
         return bool(self._windows_resident_cap)
 
+    def supports_gangs(self) -> bool:
+        """Resolve the sidecar's gang-scheduling capability
+        (HealthReply.gang_scheduling) — same latch discipline. False
+        flips every schedule call into degraded mode: the gang tensors
+        are stripped off the wire (_PODS_SANS_GANGS) and the host's
+        _resolve_gangs backstop enforces all-or-nothing instead of the
+        device op, with identical bindings."""
+        if self._gang_cap is None:
+            self._probe_capabilities()
+        return bool(self._gang_cap)
+
+    def _pods_wire_fields(self) -> frozenset | None:
+        """The PodBatch fields to put on the wire: everything, or
+        everything minus the gang tensors against a gang-blind sidecar."""
+        return None if self.supports_gangs() else _PODS_SANS_GANGS
+
     def _invalidate_session(self) -> None:
         """Reset everything scoped to the sidecar behind this target: the
         wire field cache AND both capability latches (field cache,
@@ -214,6 +253,7 @@ class RemoteEngine:
         self._field_cache_ok = None
         self._resident_cap = None
         self._windows_resident_cap = None
+        self._gang_cap = None
 
     def _cache_for(self, key: str, enabled: bool):
         if not enabled:
@@ -306,7 +346,10 @@ class RemoteEngine:
             if enabled:
                 req.session_id = self._session_id
             codec.pack_fields(snapshot, req.snapshot, cache=snap_cache)
-            codec.pack_fields(pods, req.pods, cache=pods_cache)
+            codec.pack_fields(
+                pods, req.pods, cache=pods_cache,
+                only=self._pods_wire_fields(),
+            )
             return req
 
         reply = self._call_cached(self._schedule, build_request)
@@ -347,7 +390,10 @@ class RemoteEngine:
                 req.resident_full = True
                 snap_cache = self._cache_for("batch:snapshot", enabled)
                 codec.pack_fields(snapshot, req.snapshot, cache=snap_cache)
-            codec.pack_fields(pods, req.pods, cache=pods_cache)
+            codec.pack_fields(
+                pods, req.pods, cache=pods_cache,
+                only=self._pods_wire_fields(),
+            )
             return req
 
         reply = self._resident_call(
@@ -461,7 +507,10 @@ class RemoteEngine:
             if enabled:
                 req.session_id = self._session_id
             codec.pack_fields(snapshot, req.snapshot, cache=snap_cache)
-            codec.pack_fields(pods_windows, req.pods, cache=pods_cache)
+            codec.pack_fields(
+                pods_windows, req.pods, cache=pods_cache,
+                only=self._pods_wire_fields(),
+            )
             return req
 
         for name, weight in score_plugins or ():
@@ -498,7 +547,10 @@ class RemoteEngine:
                 req.resident_full = True
                 snap_cache = self._cache_for("windows:snapshot", enabled)
                 codec.pack_fields(snapshot, req.snapshot, cache=snap_cache)
-            codec.pack_fields(pods_windows, req.pods, cache=pods_cache)
+            codec.pack_fields(
+                pods_windows, req.pods, cache=pods_cache,
+                only=self._pods_wire_fields(),
+            )
             return req
 
         reply = self._resident_call(

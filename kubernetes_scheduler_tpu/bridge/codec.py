@@ -89,7 +89,11 @@ def unpack_fields(
     """Rebuild NamedTuple `cls` from a NamedTensors map.
 
     Missing fields fall back to `defaults` (used for decisions_only
-    replies); unknown wire fields are rejected so schema drift fails loud.
+    replies, and for struct leaves newer than the sending client — e.g.
+    the gang tensors an old host never ships); a CALLABLE default is
+    invoked with the kwargs decoded so far, so it can shape itself from
+    earlier fields. Unknown wire fields are rejected so schema drift
+    fails loud.
 
     With `cache` (the server side of the wire field cache), a
     `same_as_last` tensor resolves to the session's previously received
@@ -117,7 +121,8 @@ def unpack_fields(
                     cache[name] = arr
                 kwargs[name] = arr
         elif defaults is not None and name in defaults:
-            kwargs[name] = defaults[name]
+            d = defaults[name]
+            kwargs[name] = d(kwargs) if callable(d) else d
         else:
             raise ValueError(f"missing {cls.__name__} field {name!r} on the wire")
     return cls(**kwargs)

@@ -26,6 +26,7 @@ import numpy as np
 from kubernetes_scheduler_tpu import engine
 from kubernetes_scheduler_tpu.bridge import codec
 from kubernetes_scheduler_tpu.bridge import schedule_pb2 as pb
+from kubernetes_scheduler_tpu.ops.gang import GANG_MASKED_BASE
 
 log = logging.getLogger("yoda_tpu.bridge.server")
 
@@ -55,6 +56,17 @@ def _score_plugins(request: pb.ScheduleRequest) -> tuple | None:
     return tuple(
         (e.name, e.weight if e.weight else 1.0) for e in request.score_plugins
     )
+
+
+# PodBatch leaves newer than an old client's wire schema, backfilled
+# with their neutral defaults (codec.unpack_fields callable defaults):
+# gang_id=-1 / gang_size=0 is exactly "no gangs", and the gang mask is
+# bitwise the identity then. Shapes derive from the request tensor so
+# one table serves both [p, r] batch and [w, p, r] windows requests.
+_POD_WIRE_DEFAULTS = {
+    "gang_id": lambda kw: np.full(kw["request"].shape[:-1], -1, np.int32),
+    "gang_size": lambda kw: np.zeros(kw["request"].shape[:-1], np.int32),
+}
 
 # Matrices are ~P*N*4 bytes; 10k nodes x 4k pods of f32 scores is ~160 MB.
 MAX_MESSAGE_BYTES = 512 * 1024 * 1024
@@ -104,6 +116,12 @@ class EngineService:
         # backlog path; HealthReply.windows_resident) — its own switch
         # so a canary can downgrade it independently of batch-resident
         self.windows_resident_enabled = resident_state
+        # gang co-scheduling (HealthReply.gang_scheduling): this build's
+        # PodBatch knows the gang tensors and finish_cycle rescinds
+        # partial gangs on device. The switch exists so a test/canary
+        # can impersonate an OLD sidecar and exercise the client's
+        # strip-and-degrade path (host-side all-or-nothing backstop).
+        self.gang_enabled = True
         # resident-state observability (tests + ops): how many cycles
         # were served from an applied delta vs. a full resident upload
         self.resident_deltas_served = 0
@@ -140,6 +158,14 @@ class EngineService:
         self.metrics_sessions = observe.Gauge(
             "resident_sessions_count",
             "Sessions currently holding resident device state",
+        )
+        # the sidecar-side half of the gang counters (the host exports
+        # admit/defer totals; the device is where placements are
+        # rescinded, so the masked count is surfaced HERE too)
+        self.metrics_gang_masked = observe.Counter(
+            "gang_pods_masked_total",
+            "Tentative placements rescinded on device by the gang "
+            "all-or-nothing rule (ops/gang.py)",
         )
         # server-side spans (trace/spans.py): opened under the trace id
         # the host shipped as gRPC metadata, so `spans merge` joins the
@@ -260,6 +286,7 @@ class EngineService:
             self.metrics_step,
             self.metrics_resident,
             self.metrics_sessions,
+            self.metrics_gang_masked,
         ]
         out = []
         for c in collectors:
@@ -403,7 +430,8 @@ class EngineService:
                 request, context, snap_cache, ss
             )
             pods = codec.unpack_fields(
-                engine.PodBatch, request.pods, cache=pods_cache
+                engine.PodBatch, request.pods, cache=pods_cache,
+                defaults=_POD_WIRE_DEFAULTS,
             )
         except codec.FieldCacheMiss as e:
             # sidecar restarted or the session was evicted: the client
@@ -455,6 +483,15 @@ class EngineService:
         dt = t1 - t0
         with self._lock:
             self.cycles_served += 1
+        # gang sentinels (<= GANG_MASKED_BASE, ops/gang.py) are
+        # placements the device rescinded under the all-or-nothing rule
+        # — surfaced on the sidecar's own /metrics beside the host's
+        # admit/defer totals
+        masked = int(
+            (np.asarray(res.node_idx) <= GANG_MASKED_BASE).sum()
+        )
+        if masked:
+            self.metrics_gang_masked.inc(masked)
         reply = pb.ScheduleReply(engine_seconds=dt)
         only = set(_DECISION_FIELDS) if request.decisions_only else None
         codec.pack_fields(res, reply.result, only=only)
@@ -494,7 +531,8 @@ class EngineService:
                 request, context, snap_cache, ss
             )
             pods_w = codec.unpack_fields(
-                engine.PodBatch, request.pods, cache=pods_cache
+                engine.PodBatch, request.pods, cache=pods_cache,
+                defaults=_POD_WIRE_DEFAULTS,
             )
         except codec.FieldCacheMiss as e:
             context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
@@ -541,6 +579,11 @@ class EngineService:
         dt = t1 - t0
         with self._lock:
             self.cycles_served += 1
+        masked = int(
+            (np.asarray(res.node_idx) <= GANG_MASKED_BASE).sum()
+        )
+        if masked:
+            self.metrics_gang_masked.inc(masked)
         reply = pb.ScheduleReply(engine_seconds=dt)
         codec.pack_fields(res, reply.result)
         self._finish_call(
@@ -562,7 +605,9 @@ class EngineService:
 
         try:
             snapshot = codec.unpack_fields(engine.SnapshotArrays, request.snapshot)
-            pods = codec.unpack_fields(engine.PodBatch, request.pods)
+            pods = codec.unpack_fields(
+                engine.PodBatch, request.pods, defaults=_POD_WIRE_DEFAULTS
+            )
             victims = codec.unpack_fields(VictimArrays, request.victims)
             k_cap = int(request.preempt_k_cap)
             if k_cap <= 0:
@@ -597,6 +642,7 @@ class EngineService:
             field_cache=self.field_cache_enabled,
             resident_state=self.resident_enabled,
             windows_resident=self.windows_resident_enabled,
+            gang_scheduling=self.gang_enabled,
         )
 
 

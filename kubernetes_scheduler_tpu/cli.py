@@ -404,6 +404,42 @@ def cmd_trace(args) -> int:
     return 1 if report.binding_diffs else 0
 
 
+def cmd_scenario(args) -> int:
+    """Scenario harness (sim/scenarios): seeded adversarial traffic
+    programs over the host loop. `list` names them; `run` drives one and
+    prints its summary JSON line — with --trace, the run emits a
+    flight-recorder journal that `trace replay` must reproduce with zero
+    binding diffs (every scenario is replay-pinned)."""
+    from kubernetes_scheduler_tpu.sim import scenarios
+
+    if args.scenario_cmd == "list":
+        for name in sorted(scenarios.SCENARIOS):
+            cls = scenarios.SCENARIOS[name]
+            smoke = " [smoke]" if cls.smoke else ""
+            print(f"{name:20s} {cls.description}{smoke}")
+        return 0
+    # run
+    overrides: dict = {}
+    if args.pipeline:
+        overrides["pipeline_depth"] = 1
+    if args.resident:
+        overrides["resident_state"] = True
+        overrides["pipeline_depth"] = 1
+    if args.gang_off:
+        overrides["gang_scheduling"] = False
+    cfg = scenarios.scenario_config(overrides)
+    summary = scenarios.run(
+        args.name,
+        n_nodes=args.nodes,
+        intensity=args.intensity,
+        seed=args.seed,
+        trace_path=args.trace_path,
+        config=cfg,
+    )
+    print(json.dumps(summary))
+    return 0
+
+
 def cmd_spans(args) -> int:
     """Span-timeline tooling: merge joins a host span directory and a
     sidecar span directory on the shared trace ids into ONE
@@ -573,6 +609,43 @@ def build_parser() -> argparse.ArgumentParser:
         help="re-record the replayed cycles as a new journal here",
     )
     pt.set_defaults(fn=cmd_trace)
+
+    pz = sub.add_parser(
+        "scenario",
+        help="scenario harness: seeded adversarial traffic programs "
+        "(sim/scenarios), replay-pinned via the flight recorder",
+    )
+    zsub = pz.add_subparsers(dest="scenario_cmd", required=True)
+    zl = zsub.add_parser("list", help="list registered scenarios")
+    zl.set_defaults(fn=cmd_scenario)
+    zr = zsub.add_parser(
+        "run", help="run one scenario; prints a summary JSON line"
+    )
+    zr.add_argument("name", help="a registered scenario (see `list`)")
+    zr.add_argument("--nodes", type=int, default=64)
+    zr.add_argument(
+        "--intensity", type=float, default=1.0,
+        help="traffic scale factor relative to the node count",
+    )
+    zr.add_argument("--seed", type=int, default=0)
+    zr.add_argument(
+        "--trace", dest="trace_path", default=None,
+        help="emit a flight-recorder journal under this directory "
+        "(replay-pin with `yoda-tpu trace replay`)",
+    )
+    zr.add_argument(
+        "--pipeline", action="store_true",
+        help="drive the pipelined host loop (pipeline_depth=1)",
+    )
+    zr.add_argument(
+        "--resident", action="store_true",
+        help="device-resident cluster state (implies --pipeline)",
+    )
+    zr.add_argument(
+        "--gang-off", action="store_true",
+        help="disable gang co-scheduling (gang labels ignored)",
+    )
+    zr.set_defaults(fn=cmd_scenario)
 
     pn = sub.add_parser(
         "spans", help="span timelines: merge host + sidecar span files"

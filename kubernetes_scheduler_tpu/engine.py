@@ -182,6 +182,11 @@ class PodBatch(NamedTuple):
     # count scaling the upstream 23MB..1000MB-per-container ramp
     image_ids: jnp.ndarray           # [p, Ki] int32 image ids, -1 pad
     n_containers: jnp.ndarray        # [p] int32
+    # gang co-scheduling (ops/gang.py): window-local gang slot (-1 = not
+    # in a gang) and the gang's declared member count — finish_cycle
+    # rescinds every placement of a gang that did not fully fit
+    gang_id: jnp.ndarray             # [p] int32, -1 = no gang
+    gang_size: jnp.ndarray           # [p] int32
 
 
 def make_snapshot(
@@ -314,6 +319,8 @@ def make_pod_batch(
     soft_spread_sel=None,
     image_ids=None,
     n_containers=None,
+    gang_id=None,
+    gang_size=None,
 ) -> PodBatch:
     """PodBatch with no-op defaults (no GPU demand, no tolerations, no
     affinity requirements, no preferences)."""
@@ -421,6 +428,16 @@ def make_pod_batch(
             jnp.ones((p,), jnp.int32)
             if n_containers is None
             else jnp.asarray(n_containers, jnp.int32)
+        ),
+        gang_id=(
+            jnp.full((p,), -1, jnp.int32)
+            if gang_id is None
+            else jnp.asarray(gang_id, jnp.int32)
+        ),
+        gang_size=(
+            jnp.zeros((p,), jnp.int32)
+            if gang_size is None
+            else jnp.asarray(gang_size, jnp.int32)
         ),
     )
 
@@ -858,6 +875,13 @@ class LocalEngine:
     def preempt(self, snapshot, pods, victims, *, k_cap: int):
         return preempt_batch(snapshot, pods, victims, k_cap=k_cap)
 
+    def supports_gangs(self) -> bool:
+        """Gang co-scheduling capability (ops/gang.py): the in-process
+        engine always applies the all-or-nothing mask in finish_cycle.
+        RemoteEngine's twin latches the sidecar's advertised bit and
+        strips the gang tensors off the wire when it is absent."""
+        return True
+
     def healthy(self) -> bool:
         return True
 
@@ -1286,13 +1310,23 @@ def finish_cycle(
             rounds=auction_rounds, price_frac=auction_price_frac,
             affinity=affinity,
         )
+    # gang co-scheduling (ops/gang.py): rescind every placement of a
+    # gang that did not fully fit, BEFORE the result leaves the engine —
+    # the windows scan's capacity/affinity carries must never see a
+    # phantom partial gang. Bitwise identity on gang-free windows.
+    from kubernetes_scheduler_tpu.ops.gang import gang_mask_assign
+
+    node_idx, free_after, n_assigned = gang_mask_assign(
+        pods.gang_id, pods.gang_size, pods.pod_mask,
+        res.node_idx, pods.request, res.free_after, res.n_assigned,
+    )
     return ScheduleResult(
-        node_idx=res.node_idx,
+        node_idx=node_idx,
         scores=norm,
         raw_scores=raw,
         feasible=feasible,
-        free_after=res.free_after,
-        n_assigned=res.n_assigned,
+        free_after=free_after,
+        n_assigned=n_assigned,
     )
 
 

@@ -99,6 +99,15 @@ def summarize(metrics, totals: dict | None = None) -> dict:
             "delta_bytes_saved": sum(
                 getattr(m, "delta_bytes_saved", 0) for m in cycles
             ),
+            "gangs_admitted": sum(
+                getattr(m, "gangs_admitted", 0) for m in cycles
+            ),
+            "gangs_deferred": sum(
+                getattr(m, "gangs_deferred", 0) for m in cycles
+            ),
+            "gang_pods_masked": sum(
+                getattr(m, "gang_pods_masked", 0) for m in cycles
+            ),
         }
     return {
         "cycles_total": totals["cycles"],
@@ -126,6 +135,13 @@ def summarize(metrics, totals: dict | None = None) -> dict:
         "delta_uploads_total": totals.get("delta_uploads", 0),
         "full_uploads_total": totals.get("full_uploads", 0),
         "delta_bytes_saved_total": totals.get("delta_bytes_saved", 0),
+        # gang co-scheduling (config.gang_scheduling; ops/gang.py):
+        # all-or-nothing admissions, unit deferrals, and the tentative
+        # placements the rule rescinded — deferred/admitted is the
+        # gang-health ratio, masked is the capacity the rule protected
+        "gangs_admitted_total": totals.get("gangs_admitted", 0),
+        "gangs_deferred_total": totals.get("gangs_deferred", 0),
+        "gang_pods_masked_total": totals.get("gang_pods_masked", 0),
         "scheduling_pods_per_sec": bound / total_s if total_s > 0 else 0.0,
         "bind_latency_p50_seconds": _quantile(lat, 0.50),
         "bind_latency_p99_seconds": _quantile(lat, 0.99),
@@ -152,6 +168,9 @@ _HELP = {
     "delta_uploads_total": "Resident-state cycles served by a SnapshotDelta applied on the engine",
     "full_uploads_total": "Resident-state cycles that shipped the full snapshot (first upload, churn, or flush)",
     "delta_bytes_saved_total": "Snapshot payload bytes delta uploads avoided shipping to the engine",
+    "gangs_admitted_total": "Gangs whose every member bound in one cycle (all-or-nothing admission)",
+    "gangs_deferred_total": "Gangs requeued as a unit (members missing, partial device fit, or a scalar-fallback cycle)",
+    "gang_pods_masked_total": "Tentative placements rescinded by the gang all-or-nothing rule",
     "scheduling_pods_per_sec": "Bound pods per second of cycle time",
     "bind_latency_p50_seconds": "Median end-to-end cycle latency",
     "bind_latency_p99_seconds": "p99 end-to-end cycle latency",
@@ -199,6 +218,9 @@ SHIPPED_METRICS = (
     "delta_uploads_total",
     "full_uploads_total",
     "delta_bytes_saved_total",
+    "gangs_admitted_total",
+    "gangs_deferred_total",
+    "gang_pods_masked_total",
     "scheduling_pods_per_sec",
     "bind_latency_p50_seconds",
     "bind_latency_p99_seconds",

@@ -40,6 +40,38 @@ def pod_priority(pod: Pod) -> int:
     return v
 
 
+_GANG_UNSET = object()
+
+
+def pod_gang(pod: Pod) -> tuple[str, int] | None:
+    """Gang identity (all-or-nothing co-scheduling, ops/gang.py) from
+    the `scv/gang` + `scv/gang-size` labels, memoized on the pod object
+    like pod_priority: ("<namespace>/<gang name>", declared size), or
+    None for ordinary pods (absent/garbage labels, or size < 2 — a
+    one-pod "gang" is just a pod). The scheduler clears the memo to None
+    when a gang exhausts its defer budget under the "split" policy
+    (break_gang) — its members then schedule as individuals."""
+    v = pod.__dict__.get("_gang_cache", _GANG_UNSET)
+    if v is _GANG_UNSET:
+        v = None
+        name = pod.labels.get("scv/gang")
+        if name:
+            try:
+                size = int(pod.labels.get("scv/gang-size", 0))
+            except (TypeError, ValueError):
+                size = 0
+            if size >= 2:
+                v = (f"{pod.namespace}/{name}", size)
+        pod.__dict__["_gang_cache"] = v
+    return v
+
+
+def break_gang(pod: Pod) -> None:
+    """Drop a pod's gang identity (the "split" defer policy): it
+    schedules as an individual from the next cycle on."""
+    pod.__dict__["_gang_cache"] = None
+
+
 @dataclass(order=True)
 class _Entry:
     sort_key: tuple
@@ -51,6 +83,14 @@ class SchedulingQueue:
     feeds submissions from a watch thread while the scheduling thread
     pops windows — the same producer/consumer split as the upstream
     scheduling queue."""
+
+    # restore_window returns pods to the FRONT of their priority class
+    # (exact re-pop position). Gang deferral branches on this: a
+    # front-restoring queue needs the pipelined driver's prefetched
+    # window handed back BEHIND the deferred gang to match serial pop
+    # order; a back-restoring queue (the native heap) must instead KEEP
+    # the prefetch — see Scheduler._defer_gang.
+    RESTORES_TO_FRONT = True
 
     def __init__(
         self,
@@ -121,9 +161,12 @@ class SchedulingQueue:
         queue: restored pods keep their relative order and precede every
         pod currently queued at equal priority — re-popping immediately
         yields the same window. Used by the pipelined scheduler
-        (Scheduler.drain_pipeline) to hand back a prefetched window.
-        Restoring several windows without popping in between re-merges
-        them newest-first; the drain path restores exactly one."""
+        (Scheduler.drain_pipeline) to hand back a prefetched window and
+        by gang deferral (Scheduler._defer_gang) to requeue a gang
+        atomically ahead of its equals. Restoring several windows
+        without popping in between re-merges them newest-first — which
+        is exactly what _defer_gang relies on: prefetched window first,
+        deferred gang second, so the gang leads the next pop."""
         with self._lock:
             base = self._front_floor - len(pods)
             for i, pod in enumerate(pods):
@@ -146,6 +189,10 @@ class NativeBackedQueue:
     construction when the native library is unavailable — callers (the
     Scheduler) then keep the pure-Python queue.
     """
+
+    # the native heap re-pushes restored pods with fresh sequence
+    # numbers: BACK of their priority class (see restore_window)
+    RESTORES_TO_FRONT = False
 
     def __init__(
         self,
@@ -261,9 +308,15 @@ class NativeBackedQueue:
         its own (monotone) sequence numbers, so restored pods re-enter
         at the BACK of their priority class rather than the front —
         priority order is exact, FIFO position among equals is not.
-        Only the drain path (Scheduler.drain_pipeline) restores, and it
-        is followed by a fresh pop or shutdown, so the approximation
-        never affects a live pipelined cycle."""
+        Callers are the drain path (Scheduler.drain_pipeline, followed
+        by a fresh pop or shutdown) and gang deferral
+        (Scheduler._defer_gang): a deferred gang re-enters behind
+        same-priority arrivals instead of ahead of them, which delays
+        its retry but never its correctness. _defer_gang reads
+        RESTORES_TO_FRONT and KEEPS the pipelined driver's prefetched
+        window on this queue (re-pushing it would put it behind pods
+        the serial driver pops later), so serial/pipelined binding
+        parity holds on either queue implementation."""
         for pod in pods:
             self.push(pod)
 

@@ -27,7 +27,12 @@ import numpy as np
 from kubernetes_scheduler_tpu.engine import LocalEngine
 from kubernetes_scheduler_tpu.host.advisor import NodeUtil
 from kubernetes_scheduler_tpu.host.plugins import ScalarYodaPlugin, scalar_schedule_one
-from kubernetes_scheduler_tpu.host.queue import make_queue, pod_priority
+from kubernetes_scheduler_tpu.host.queue import (
+    break_gang,
+    make_queue,
+    pod_gang,
+    pod_priority,
+)
 from kubernetes_scheduler_tpu.ops.constraints import (
     PREFER_NO_SCHEDULE as _PREFER_NO_SCHEDULE,
 )
@@ -145,6 +150,14 @@ class CycleMetrics:
     delta_uploads: int = 0
     full_uploads: int = 0
     delta_bytes_saved: int = 0
+    # gang co-scheduling (config.gang_scheduling; ops/gang.py): gangs
+    # whose every member bound this cycle, gangs deferred as a unit
+    # (short of members in the window, partial device fit, or a scalar-
+    # fallback cycle — gangs never bind through the scalar path), and
+    # the tentative placements the all-or-nothing rule rescinded
+    gangs_admitted: int = 0
+    gangs_deferred: int = 0
+    gang_pods_masked: int = 0
 
 
 @dataclass
@@ -198,6 +211,7 @@ class Scheduler:
         list_pdbs: Callable[[], list] | None = None,
         controller_replicas: Callable[[str, str, str], int | None] | None = None,
         engine=None,
+        queue_clock: Callable[[], float] | None = None,
     ):
         self.config = config
         self.advisor = advisor
@@ -289,13 +303,18 @@ class Scheduler:
                 )
         else:
             self._native_ok = False
+        # queue_clock: injectable retry-backoff clock (default wall
+        # monotonic) — the scenario harness passes a virtual clock so
+        # backoffs resolve in simulated ticks, deterministically
         self.queue = make_queue(
             initial_backoff=config.initial_backoff_seconds,
             max_backoff=config.max_backoff_seconds,
             prefer_native=self._native_ok,
+            **({"clock": queue_clock} if queue_clock is not None else {}),
         )
         self.builder = SnapshotBuilder(
-            extended_resources=list(config.extended_resources)
+            extended_resources=list(config.extended_resources),
+            gang_scheduling=config.gang_scheduling,
         )
         if config.adaptive_dispatch:
             from kubernetes_scheduler_tpu.utils.adaptive import AdaptiveDispatch
@@ -304,6 +323,15 @@ class Scheduler:
         else:
             self._dispatch = None
         self._scalar_cycler = None
+        # gang co-scheduling (config.gang_scheduling): gang key ->
+        # consecutive front-of-queue deferrals; cleared on admission,
+        # resolved per config.gang_defer_policy when the budget runs out
+        if config.gang_defer_policy not in ("split", "drop"):
+            raise ValueError(
+                f"unknown gang_defer_policy {config.gang_defer_policy!r}; "
+                "expected 'split' or 'drop'"
+            )
+        self._gang_defers: dict[str, int] = {}
         # bounded: a long-lived process keeps the last window of cycle
         # metrics (latency quantiles), while monotonic run totals live in
         # self.totals — Prometheus counters must never decrease, and the
@@ -326,6 +354,9 @@ class Scheduler:
             "delta_uploads": 0,
             "full_uploads": 0,
             "delta_bytes_saved": 0,
+            "gangs_admitted": 0,
+            "gangs_deferred": 0,
+            "gang_pods_masked": 0,
         }
         # resident cluster state (config.resident_state): the last full
         # snapshot the engine confirmed retaining (the delta base), the
@@ -456,6 +487,9 @@ class Scheduler:
             self.totals["delta_uploads"] += m.delta_uploads
             self.totals["full_uploads"] += m.full_uploads
             self.totals["delta_bytes_saved"] += m.delta_bytes_saved
+            self.totals["gangs_admitted"] += m.gangs_admitted
+            self.totals["gangs_deferred"] += m.gangs_deferred
+            self.totals["gang_pods_masked"] += m.gang_pods_masked
 
     def metrics_snapshot(self) -> tuple[list[CycleMetrics], dict]:
         """Point-in-time copy for exporters (safe against the scheduling
@@ -538,6 +572,18 @@ class Scheduler:
             m.cycle_seconds = time.perf_counter() - t0
             return None
         self._span("queue_pop", t_pop)
+
+        # gang admission control BEFORE any state fetch: gangs short of
+        # members (or too big to ever fit a window) defer as a unit —
+        # scheduling a knowingly-partial gang would only burn a device
+        # dispatch to mask it out again
+        if self.config.gang_scheduling:
+            window = self._gang_screen(window, m)
+            if not window:
+                m.cycle_seconds = time.perf_counter() - t0
+                self._record(m)
+                self._flush_spans(t0, m)
+                return None
 
         t_fetch = time.perf_counter()
         try:
@@ -1255,8 +1301,8 @@ class Scheduler:
         if infl.trace_ctx is not None:
             # the replay comparison target: engine decisions over the
             # real window rows (copy — idx may view an engine buffer)
-            infl.trace_ctx["node_idx"] = np.array(
-                idx[: len(window)], np.int32
+            infl.trace_ctx["node_idx"] = self._trace_node_idx(
+                infl.pods_batch, idx, len(window)
             )
         pre = len(self._cycle_bound)
         t_bind = time.perf_counter()
@@ -1278,6 +1324,30 @@ class Scheduler:
                 # the delta is an optimization: on any surprise the next
                 # build's suffix scan recomputes from scratch
                 log.exception("assignment-delta fold failed; next build rescans")
+
+    def _trace_node_idx(self, pods_batch, idx, n: int) -> np.ndarray:
+        """The journaled node_idx over the real window rows, with the
+        gang mask applied: against a gang-capable engine this is the
+        identity (sentinels already present), but a gang-blind engine
+        (capability-downgraded sidecar, mesh-sharded path) replies with
+        RAW placements — recording those would make the journal
+        unreplayable (local replay re-masks and diffs). The np mirror
+        is test-pinned bitwise-equal to the device op, so the recorded
+        vector is exactly what any gang-capable replay produces."""
+        out = np.array(np.asarray(idx).reshape(-1)[:n], np.int32)
+        if self.config.gang_scheduling:
+            from kubernetes_scheduler_tpu.ops.gang import (
+                mask_partial_gangs_np,
+            )
+
+            gid = np.asarray(pods_batch.gang_id).reshape(-1)[:n]
+            if (gid >= 0).any():
+                out, _ = mask_partial_gangs_np(
+                    gid,
+                    np.asarray(pods_batch.gang_size).reshape(-1)[:n],
+                    out,
+                )
+        return out
 
     def _pdb_expected_count(self, matching: list[Pod]) -> int | None:
         """The upstream disruption controller's expected count for
@@ -1537,6 +1607,201 @@ class Scheduler:
                     "preempting %d pod(s) on %s for %s",
                     n_evicted, nodes[j].name, pods[i].name,
                 )
+
+    # ---- gang co-scheduling (config.gang_scheduling; ops/gang.py) ------
+
+    def _window_gang_groups(self, window) -> dict:
+        """gang key -> [declared size, member row indices] over a
+        window. Empty for gang-free traffic (one memoized label probe
+        per pod — the cost profile of the existing flag scans).
+        Members declaring inconsistent sizes (malformed labels) take
+        the MAX: the conservative all-or-nothing reading."""
+        groups: dict[str, list] = {}
+        for i, pod in enumerate(window):
+            g = pod_gang(pod)
+            if g is not None:
+                ent = groups.get(g[0])
+                if ent is None:
+                    groups[g[0]] = ent = [g[1], []]
+                elif g[1] > ent[0]:
+                    ent[0] = g[1]
+                ent[1].append(i)
+        return groups
+
+    def _gang_screen(self, window: list, m: CycleMetrics) -> list:
+        """Pre-dispatch gang admission control: defer gangs that cannot
+        possibly bind this cycle (members missing from the window, or a
+        declared size no window can hold), and keep gangs from
+        STRADDLING a stacked-window stride (each scan step checks
+        completeness against its own window, so a boundary-crossing
+        gang would always read as partial) — stride-aligned gangs ride
+        the deep multi-window dispatch untouched. Returns the window to
+        dispatch."""
+        groups = self._window_gang_groups(window)
+        if not groups:
+            return window
+        drop: set[int] = set()
+        for key, (size, rows) in groups.items():
+            if len(rows) >= size and size <= self.config.batch_window:
+                continue
+            drop.update(rows)
+            self._defer_gang(key, size, [window[i] for i in rows], m)
+        if drop:
+            window = [pd for i, pd in enumerate(window) if i not in drop]
+        bw = self.config.batch_window
+        if len(window) > bw:
+            # deep pop: a gang fully inside ONE stacked-window stride is
+            # fine (each scan step applies its own all-or-nothing mask),
+            # but a gang STRADDLING a stride boundary would always read
+            # as partial in both strides. Cut the pop at the first
+            # straddling gang's first member (pulling in any gang a
+            # naive cut would itself split) and hand the suffix back —
+            # gang-free deep backlogs and stride-aligned gangs keep the
+            # full multi-window dispatch.
+            groups = self._window_gang_groups(window)
+            straddle = [
+                rows[0]
+                for _, rows in groups.values()
+                if rows[0] // bw != rows[-1] // bw
+            ]
+            if straddle:
+                cut = min(straddle)
+                while True:
+                    new_cut = min(
+                        (
+                            rows[0]
+                            for _, rows in groups.values()
+                            if rows[-1] >= cut
+                        ),
+                        default=cut,
+                    )
+                    if new_cut == cut:
+                        break
+                    cut = new_cut
+                if cut > 0:
+                    self.queue.restore_window(window[cut:])
+                    window = window[:cut]
+                else:
+                    # the straddling gang starts at row 0: a prefix cut
+                    # cannot make progress. Trim to one stride instead,
+                    # moving any stride-crossing gang out whole — the
+                    # head gangs then schedule in a single window and
+                    # the tail leads the next pop.
+                    move = {
+                        key
+                        for key, (_, rows) in groups.items()
+                        if rows[-1] >= bw
+                    }
+                    kept, restored = [], []
+                    for i, pd in enumerate(window):
+                        g = pod_gang(pd)
+                        if i >= bw or (g is not None and g[0] in move):
+                            restored.append(pd)
+                        else:
+                            kept.append(pd)
+                    self.queue.restore_window(restored)
+                    window = kept
+        return window
+
+    def _defer_gang(
+        self, key: str, size: int, members: list, m: CycleMetrics,
+        *, masked: int = 0,
+    ) -> None:
+        """All-or-nothing deferral: the whole gang returns to the queue
+        as a unit. Within the defer budget it goes back to the FRONT
+        (queue.restore_window — order preserved, re-pops next cycle,
+        picking up members that arrive in between). A gang that exhausts
+        config.gang_max_defers — or could never fit a window — resolves
+        per config.gang_defer_policy: "split" drops the gang identity
+        (members schedule as individuals), "drop" keeps it and retries
+        all-or-nothing at ordinary backoff cadence."""
+        m.gangs_deferred += 1
+        m.gang_pods_masked += masked
+        n = self._gang_defers.get(key, 0) + 1
+        oversize = size > self.config.batch_window
+        if oversize or n > self.config.gang_max_defers:
+            self._gang_defers.pop(key, None)
+            split = oversize or self.config.gang_defer_policy == "split"
+            if split:
+                for pod in members:
+                    break_gang(pod)
+            log.warning(
+                "gang %s (%d/%d members) %s after %d deferral(s)%s",
+                key, len(members), size,
+                "split into individuals" if split else "dropped to backoff",
+                n,
+                " (gang larger than any window)" if oversize else "",
+            )
+            for pod in members:
+                self.queue.requeue_unschedulable(pod)
+            m.pods_unschedulable += len(members)
+            return
+        self._gang_defers[key] = n
+        # atomic requeue, matched to the queue's restore semantics so
+        # serial and pipelined pop orders stay identical per queue type:
+        # - front-restoring queue (pure Python): hand the prefetched
+        #   window back FIRST, then the gang — the next pop yields
+        #   gang + prefetched pods exactly as serial would have popped
+        #   them (newest restore wins the front);
+        # - back-restoring queue (native heap): KEEP the prefetch — the
+        #   gang goes behind the waiting pods on both drivers, and
+        #   flushing the prefetch would re-push it behind pods the
+        #   serial driver pops later.
+        if getattr(self.queue, "RESTORES_TO_FRONT", False):
+            pf = self._take_prefetched()
+            if pf is not None:
+                self._discard_speculative(m)
+                self.queue.restore_window(pf)
+        self.queue.restore_window(members)
+
+    def _resolve_gangs(self, window, idx, m: CycleMetrics):
+        """Post-result gang resolution: bind fully-placed gangs, defer
+        the rest as units. The host-side all-or-nothing BACKSTOP is
+        ops.gang.mask_partial_gangs_np — the numpy mirror test-pinned
+        bitwise-equal to the device op — applied to EVERY reply:
+        against a gang-capable engine it is the identity (the device
+        already rescinded partial placements, sentinels <= -2); against
+        a gang-blind one (old sidecar after a capability downgrade, the
+        mesh-sharded fast path) it produces the same masked vector, so
+        no partial gang can ever reach mark_scheduled on ANY path.
+        Admission mirrors the device rule exactly: assigned-member
+        count >= declared size (an over-submitted gang's surplus
+        members fall through to the ordinary requeue loop).
+        Returns the (window, idx) remainder for the ordinary bind loop."""
+        from kubernetes_scheduler_tpu.ops.gang import (
+            GANG_MASKED_BASE,
+            mask_partial_gangs_np,
+        )
+
+        groups = self._window_gang_groups(window)
+        if not groups:
+            return window, idx
+        n_win = len(window)
+        gang_id = np.full(n_win, -1, np.int32)
+        gang_size = np.zeros(n_win, np.int32)
+        for slot, (size, rows) in enumerate(groups.values()):
+            gang_id[rows] = slot
+            gang_size[rows] = size
+        idx, _ = mask_partial_gangs_np(
+            gang_id, gang_size, np.asarray(idx)[:n_win]
+        )
+        drop: set[int] = set()
+        for key, (size, rows) in groups.items():
+            got = idx[rows]
+            if int((got >= 0).sum()) >= size > 0:
+                m.gangs_admitted += 1
+                self._gang_defers.pop(key, None)
+                continue
+            drop.update(rows)
+            self._defer_gang(
+                key, size, [window[i] for i in rows], m,
+                masked=int((got <= GANG_MASKED_BASE).sum()),
+            )
+        if drop:
+            keep = [i for i in range(n_win) if i not in drop]
+            window = [window[i] for i in keep]
+            idx = idx[keep]
+        return window, idx
 
     def _nomination_reservations(self, window) -> list[Pod]:
         """Virtual running pods holding nominated capacity (see
@@ -1822,7 +2087,9 @@ class Scheduler:
                 f"for a {len(window)}-pod backlog over {len(nodes)} nodes"
             )
         if tctx is not None:
-            tctx["node_idx"] = np.array(idx[: len(window)], np.int32)
+            tctx["node_idx"] = self._trace_node_idx(
+                pods_batch, idx, len(window)
+            )
         t_bind = time.perf_counter()
         self._apply_assignments(window, nodes, idx, m)
         self._span("bind", t_bind)
@@ -1926,6 +2193,10 @@ class Scheduler:
         semantics), all assigned pods go through ONE call — the per-pod
         _bind dispatch (try/except + counters) measured ~4.5us x 8k pods
         per cycle, a visible slice of the host loop."""
+        if self.config.gang_scheduling:
+            window, idx = self._resolve_gangs(window, idx, m)
+            if not window:
+                return
         p_real = len(window)
         bind_many = getattr(self.binder, "bind_many", None)
         if bind_many is None or p_real < 256:
@@ -1967,6 +2238,24 @@ class Scheduler:
         self._complete_window(infl, window, nodes, m, ephemeral=ephemeral)
 
     def _run_scalar(self, window, nodes, running, utils, m: CycleMetrics):
+        if self.config.gang_scheduling:
+            groups = self._window_gang_groups(window)
+            if groups:
+                # gangs never bind through the scalar path: all-or-
+                # nothing needs the batched view (the per-pod loop binds
+                # as it goes). Defer each gang as a unit; the rest of
+                # the window scalar-schedules normally.
+                drop: set[int] = set()
+                for key, (size, rows) in groups.items():
+                    drop.update(rows)
+                    self._defer_gang(
+                        key, size, [window[i] for i in rows], m
+                    )
+                window = [
+                    pd for i, pd in enumerate(window) if i not in drop
+                ]
+                if not window:
+                    return
         t_s = time.perf_counter()
         try:
             self._run_scalar_inner(window, nodes, running, utils, m)

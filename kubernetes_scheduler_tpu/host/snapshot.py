@@ -21,7 +21,7 @@ from kubernetes_scheduler_tpu.engine import (
     SnapshotDelta,
 )
 from kubernetes_scheduler_tpu.host.advisor import NodeUtil
-from kubernetes_scheduler_tpu.host.queue import pod_priority
+from kubernetes_scheduler_tpu.host.queue import pod_gang, pod_priority
 from kubernetes_scheduler_tpu.host.types import Node, Pod
 from kubernetes_scheduler_tpu.ops import constraints as C
 from kubernetes_scheduler_tpu.ops.resources import (
@@ -404,6 +404,11 @@ class SnapshotBuilder:
     """
 
     extended_resources: list[str] = field(default_factory=list)
+    # gang co-scheduling (config.gang_scheduling): False leaves the
+    # PodBatch gang tensors at their no-gang defaults, so the engine's
+    # gang mask is bitwise the identity — gang labels are IGNORED, the
+    # config contract for gang-off
+    gang_scheduling: bool = True
     label_keys: Interner = field(default_factory=Interner)
     label_values: Interner = field(default_factory=Interner)
     # container-image vocabulary for ImageLocality (ops/score.py): ids
@@ -1279,6 +1284,22 @@ class SnapshotBuilder:
         ki_max = bucket_size(m_cont, floor=1, multiple=1)
         image_ids = np.full((p, ki_max), -1, np.int32)
 
+        # gang co-scheduling (ops/gang.py): dense window-local slot ids
+        # by first appearance + the declared size. Gang pods carry an
+        # scv/ label, so they are always in `constrained` — plain
+        # windows never pay this pass. With the knob off the tensors
+        # stay at their no-gang defaults (the engine mask is then the
+        # identity): gang labels are ignored entirely.
+        gang_id = np.full(p, -1, np.int32)
+        gang_size = np.zeros(p, np.int32)
+        if self.gang_scheduling:
+            gang_slots: dict[str, int] = {}
+            for i in constrained:
+                g = pod_gang(pods[i])
+                if g is not None:
+                    gang_id[i] = gang_slots.setdefault(g[0], len(gang_slots))
+                    gang_size[i] = g[1]
+
         n_port0 = len(names) - self._port_slots
         has_image_vocab = len(self.images) > 0
         if has_image_vocab:
@@ -1404,4 +1425,5 @@ class SnapshotBuilder:
             spread_sel=spread_sel, spread_max=spread_max,
             soft_spread_sel=soft_spread_sel,
             image_ids=image_ids, n_containers=n_containers,
+            gang_id=gang_id, gang_size=gang_size,
         )

@@ -1,0 +1,121 @@
+"""Gang co-scheduling: all-or-nothing pod groups (arXiv:2511.08373's
+constraint-based packing, the upstream coscheduling plugin's PodGroup).
+
+A gang is a set of pods that must start together (distributed training
+workers, MPI ranks): binding a strict subset wastes capacity on members
+that will spin waiting for the rest. Pods declare membership with the
+`scv/gang` + `scv/gang-size` labels (host.queue.pod_gang); the snapshot
+builder threads them into PodBatch as
+
+    gang_id    [p] int32  window-local gang slot, -1 = not in a gang
+    gang_size  [p] int32  the gang's declared total member count
+
+and `gang_mask_assign` post-processes an assigner's node_idx ON DEVICE:
+a gang whose assigned-member count falls short of gang_size has every
+assigned member's placement rescinded before the result leaves the
+engine (engine.finish_cycle), so a partial gang can never reach the
+host's bind loop — and the windows scan's capacity/affinity carries
+never see phantom placements.
+
+Masked entries use the sentinel encoding
+
+    node_idx' = GANG_MASKED_BASE - node_idx      (<= -2)
+
+instead of the plain -1 so the would-have node stays decodable: the op
+gives the rescinded members' capacity back to free_after, and the host
+counts rescinded placements (CycleMetrics.gang_pods_masked) without a
+second result surface. Any consumer that only asks `idx >= 0` keeps
+treating masked rows as unassigned.
+
+The op is BITWISE the identity when the window carries no gang pods
+(every select keeps the original lane), which is what pins the
+gang-off <-> no-gangs-in-traffic parity in PARITY.md.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# masked placements encode as GANG_MASKED_BASE - node_idx; -1 stays the
+# plain "no node found" value, so decode is its own inverse
+GANG_MASKED_BASE = -2
+
+
+def decode_masked(idx):
+    """The node a masked (<= -2) entry would have been assigned to.
+    Works on numpy and jnp arrays alike (pure arithmetic)."""
+    return GANG_MASKED_BASE - idx
+
+
+def gang_mask_assign(
+    gang_id,
+    gang_size,
+    pod_mask,
+    node_idx,
+    request,
+    free_after,
+    n_assigned,
+):
+    """All-or-nothing post-pass over an assigner's result.
+
+    Returns (node_idx', free_after', n_assigned'): members of gangs with
+    fewer than gang_size assigned members have their placements rescinded
+    (sentinel-encoded), their request rows handed back to free_after,
+    and n_assigned recomputed. Everything is a lane-wise select, so a
+    gang-free window passes through bit-identical.
+    """
+    p = node_idx.shape[0]
+    has = (gang_id >= 0) & pod_mask
+    assigned = node_idx >= 0
+    # assigned members per gang slot (slot space = window rows; pad slot
+    # p absorbs non-members)
+    slot = jnp.where(has & assigned, jnp.clip(gang_id, 0, p - 1), p)
+    cnt = jnp.zeros(p + 1, jnp.int32).at[slot].add(1)
+    complete = cnt[jnp.clip(gang_id, 0, max(p - 1, 0))] >= gang_size
+    mask_out = has & assigned & ~complete
+    new_idx = jnp.where(mask_out, GANG_MASKED_BASE - node_idx, node_idx)
+    any_masked = mask_out.any()
+    # capacity give-back: the assigner consumed the masked members'
+    # requests; the next window (windows-scan carry) must not
+    rows = jnp.where(mask_out, node_idx, free_after.shape[0])
+    freed = jnp.zeros_like(free_after).at[rows].add(
+        jnp.where(mask_out[:, None], request, 0.0), mode="drop"
+    )
+    free_after = jnp.where(any_masked, free_after + freed, free_after)
+    n_assigned = jnp.where(
+        any_masked,
+        ((new_idx >= 0) & pod_mask).sum().astype(jnp.int32),
+        n_assigned,
+    )
+    return new_idx, free_after, n_assigned
+
+
+def mask_partial_gangs_np(gang_id, gang_size, node_idx):
+    """Host (numpy) mirror of the all-or-nothing rule, applied as the
+    unconditional backstop in host.scheduler._resolve_gangs: against a
+    gang-capable engine it is the identity (the device op already
+    rescinded the placements), against an old sidecar that never saw the
+    gang tensors (bridge capability downgrade) it produces the same
+    masked vector the device op would have — bitwise, so degraded mode
+    keeps binding parity. Returns (node_idx', newly_masked_count)."""
+    import numpy as np
+
+    idx = np.asarray(node_idx).copy()
+    gid = np.asarray(gang_id)
+    gsz = np.asarray(gang_size)
+    n = min(idx.shape[0], gid.shape[0])
+    newly = 0
+    for g in np.unique(gid[:n]):
+        if g < 0:
+            continue
+        rows = np.flatnonzero(gid[:n] == g)
+        got = idx[rows]
+        cnt = int((got >= 0).sum())
+        # PER-LANE size check, exactly like the device op's
+        # `cnt[gang] >= gang_size` select: members declaring
+        # inconsistent sizes (malformed labels) mask lane-wise, so the
+        # mirror stays bitwise-equal on any input
+        bad = rows[(got >= 0) & (cnt < gsz[rows])]
+        idx[bad] = GANG_MASKED_BASE - idx[bad]
+        newly += int(bad.size)
+    return idx, newly

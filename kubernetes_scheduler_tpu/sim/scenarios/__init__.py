@@ -1,0 +1,37 @@
+"""Scenario harness: registry + runner (see base.py for the contract).
+
+    from kubernetes_scheduler_tpu.sim.scenarios import SCENARIOS, run
+    summary = run("burst", n_nodes=64, seed=0, trace_path="/tmp/j")
+"""
+
+from kubernetes_scheduler_tpu.sim.scenarios.base import (
+    Scenario,
+    ScenarioWorld,
+    SimClock,
+    run_scenario,
+    scenario_config,
+)
+from kubernetes_scheduler_tpu.sim.scenarios.library import SCENARIOS
+
+
+def run(
+    name: str,
+    *,
+    n_nodes: int = 64,
+    intensity: float = 1.0,
+    seed: int = 0,
+    trace_path: str | None = None,
+    config=None,
+) -> dict:
+    """Instantiate and run a registered scenario by name."""
+    cls = SCENARIOS.get(name)
+    if cls is None:
+        raise ValueError(
+            f"unknown scenario {name!r}; expected one of {sorted(SCENARIOS)}"
+        )
+    return run_scenario(
+        cls(n_nodes=n_nodes, intensity=intensity),
+        seed=seed,
+        trace_path=trace_path,
+        config=config,
+    )

@@ -1,0 +1,253 @@
+"""Scenario harness: seeded, event-driven traffic programs over the host
+loop.
+
+A Scenario is a small deterministic program: the runner builds a
+simulated cluster, then advances virtual time tick by tick — each tick
+the scenario injects events (pod arrivals, node failures/returns,
+utilization shifts) into the ScenarioWorld and the runner drains the
+scheduler until it stops making progress. Everything downstream of the
+seed is deterministic: the RNG is a single `np.random.default_rng(seed)`
+stream, the queue runs on a virtual clock the runner advances one second
+per tick (retry backoffs resolve in ticks, not wall time), and the
+scheduler itself is single-threaded — so the same (scenario, seed,
+scale) always produces the same journal, which is what lets every
+scenario be REPLAY-PINNED: run it with `trace_path` set and
+`trace replay` over the emitted journal must report zero binding diffs
+(the scenario-smoke gate, and the diverse-traffic generator the
+learned-policy ROADMAP item trains from).
+
+Scenarios register by name in sim.scenarios.SCENARIOS (library.py) and
+run via `yoda-tpu scenario run <name>` or run_scenario() directly.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from kubernetes_scheduler_tpu.host.advisor import NodeUtil, StaticAdvisor
+from kubernetes_scheduler_tpu.host.scheduler import RecordingBinder, Scheduler
+from kubernetes_scheduler_tpu.host.types import Node, Pod
+from kubernetes_scheduler_tpu.utils.config import SchedulerConfig
+
+
+class SimClock:
+    """Deterministic stand-in for time.monotonic on the scheduling
+    queue: the runner advances it one second per tick, so retry backoffs
+    (initial 1s) resolve on the NEXT tick regardless of how fast the
+    host machine drained the previous one."""
+
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, dt: float = 1.0) -> None:
+        self.now += dt
+
+
+@dataclass
+class ScenarioWorld:
+    """The mutable cluster a scenario program acts on. All state changes
+    go through these methods so the summary counters stay truthful."""
+
+    nodes: list
+    utils: dict
+    scheduler: Scheduler
+    running: list = field(default_factory=list)
+    downed: dict = field(default_factory=dict)   # name -> Node
+    submitted: int = 0
+    resubmitted: int = 0
+    node_failures: int = 0
+    node_restores: int = 0
+    _seen_bindings: int = 0
+
+    def submit(self, pod: Pod) -> None:
+        self.submitted += 1
+        self.scheduler.submit(pod)
+
+    def fail_node(self, name: str) -> int:
+        """Remove a node mid-run; its running pods are killed and
+        resubmitted (the informer would deliver exactly this as a node
+        delete + pod deletes + controller re-creates). Returns how many
+        pods went back to the queue."""
+        nd = next((n for n in self.nodes if n.name == name), None)
+        if nd is None:
+            return 0
+        self.nodes.remove(nd)
+        self.downed[name] = nd
+        self.node_failures += 1
+        displaced = [p for p in self.running if p.node_name == name]
+        for pod in displaced:
+            self.running.remove(pod)
+            pod.node_name = None
+            self.resubmitted += 1
+            self.scheduler.submit(pod)
+        return len(displaced)
+
+    def restore_node(self, name: str) -> bool:
+        nd = self.downed.pop(name, None)
+        if nd is None:
+            return False
+        self.nodes.append(nd)
+        self.node_restores += 1
+        return True
+
+    def absorb_bindings(self) -> None:
+        """Fold this drain's binds into the running set (what the
+        informer's pod cache would reflect next cycle)."""
+        binder = self.scheduler.binder
+        for b in binder.bindings[self._seen_bindings:]:
+            self.running.append(b.pod)
+        self._seen_bindings = len(binder.bindings)
+
+
+class Scenario:
+    """One registered traffic program. Subclasses set `name`,
+    `description`, optionally `smoke` (cheap enough for the
+    scenario-smoke gate) and override build_cluster()/tick()."""
+
+    name = "?"
+    description = ""
+    ticks = 12
+    smoke = False
+
+    def __init__(self, *, n_nodes: int = 64, intensity: float = 1.0):
+        self.n_nodes = int(n_nodes)
+        self.intensity = float(intensity)
+
+    # -- cluster -------------------------------------------------------
+
+    def build_cluster(self, rng) -> tuple[list, dict]:
+        """(nodes, utils) — zone-labeled by default so zone/affinity
+        scenarios work against any cluster this base builds."""
+        from kubernetes_scheduler_tpu.sim.scenarios.library import ZONES
+
+        nodes, utils = [], {}
+        for i in range(self.n_nodes):
+            name = f"node-{i}"
+            nodes.append(
+                Node(
+                    name=name,
+                    labels={
+                        "topology.kubernetes.io/zone": ZONES[i % len(ZONES)]
+                    },
+                    allocatable={
+                        "cpu": float(rng.choice([4000, 8000, 16000])),
+                        "memory": float(rng.choice([8, 16, 32])) * 2**30,
+                        "pods": 110.0,
+                    },
+                )
+            )
+            utils[name] = NodeUtil(
+                cpu_pct=float(rng.uniform(5, 70)),
+                mem_pct=float(rng.uniform(5, 70)),
+                disk_io=float(min(rng.gamma(2.0, 8.0), 50.0)),
+                net_up=float(rng.gamma(2.0, 2.0)),
+                net_down=float(rng.gamma(2.0, 2.0)),
+            )
+        return nodes, utils
+
+    # -- per-tick program ----------------------------------------------
+
+    def tick(self, t: int, world: ScenarioWorld, rng) -> None:
+        raise NotImplementedError
+
+
+def scenario_config(overrides: dict | None = None) -> SchedulerConfig:
+    """The harness's SchedulerConfig: the device path pinned (tiny
+    simulated cycles must not route to the scalar fallback — scalar
+    cycles record decisions but are not replayable, and the whole point
+    of a scenario is a replayable journal)."""
+    base = dict(
+        batch_window=256,
+        normalizer="none",
+        min_device_work=1,
+        adaptive_dispatch=False,
+    )
+    base.update(overrides or {})
+    return SchedulerConfig(**base)
+
+
+def run_scenario(
+    scenario: Scenario,
+    *,
+    seed: int = 0,
+    trace_path: str | None = None,
+    config: SchedulerConfig | None = None,
+    max_cycles_per_tick: int = 64,
+) -> dict:
+    """Drive `scenario` through the host loop; returns the summary dict
+    (one JSON-able line). With `trace_path`, every cycle lands in a
+    flight-recorder journal replay-pinnable via `trace replay`."""
+    rng = np.random.default_rng(seed)
+    nodes, utils = scenario.build_cluster(rng)
+    cfg = config if config is not None else scenario_config()
+    if trace_path is not None and cfg.trace_path is None:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, trace_path=trace_path)
+    clock = SimClock()
+    world = ScenarioWorld(nodes=nodes, utils=utils, scheduler=None)
+    sched = Scheduler(
+        cfg,
+        advisor=StaticAdvisor(utils),
+        binder=RecordingBinder(),
+        list_nodes=lambda: world.nodes,
+        list_running_pods=lambda: world.running,
+        queue_clock=clock,
+    )
+    world.scheduler = sched
+
+    t0 = time.perf_counter()
+    cycles = 0
+    try:
+        for t in range(scenario.ticks):
+            scenario.tick(t, world, rng)
+            clock.advance(1.0)
+            for _ in range(max_cycles_per_tick):
+                if len(sched.queue) == 0 and sched._prefetched is None:
+                    break
+                m = sched.run_cycle()
+                cycles += 1
+                world.absorb_bindings()
+                if m.pods_bound == 0:
+                    # no progress: everything left is backoff-parked or
+                    # a deferred gang waiting for members — both need
+                    # the clock to advance, i.e. the next tick
+                    break
+        sched.drain_pipeline()
+    finally:
+        if sched.recorder is not None:
+            sched.recorder.close()
+        if sched.spans is not None:
+            sched.spans.close()
+    dt = time.perf_counter() - t0
+    totals = sched.totals
+    out = {
+        "scenario": scenario.name,
+        "seed": seed,
+        "n_nodes": scenario.n_nodes,
+        "ticks": scenario.ticks,
+        "cycles": cycles,
+        "pods_submitted": world.submitted,
+        "pods_resubmitted": world.resubmitted,
+        "pods_bound": totals["pods_bound"],
+        "pods_unschedulable": totals["pods_unschedulable"],
+        "node_failures": world.node_failures,
+        "node_restores": world.node_restores,
+        "fallback_cycles": totals["fallback_cycles"],
+        "gangs_admitted": totals["gangs_admitted"],
+        "gangs_deferred": totals["gangs_deferred"],
+        "gang_pods_masked": totals["gang_pods_masked"],
+        "delta_uploads": totals["delta_uploads"],
+        "full_uploads": totals["full_uploads"],
+        "seconds": round(dt, 3),
+        "pods_per_sec": round(totals["pods_bound"] / max(dt, 1e-9), 1),
+    }
+    if trace_path is not None:
+        out["journal"] = trace_path
+    return out

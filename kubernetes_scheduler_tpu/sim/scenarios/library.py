@@ -1,0 +1,247 @@
+"""The shipped scenario programs (sim/scenarios/base.py is the harness).
+
+Each scenario is a seeded adversarial traffic shape the static
+generators (sim/cluster_gen, sim/host_gen) cannot express: load that
+CHANGES over time, nodes that vanish mid-run, gangs whose members
+straggle in. Every one is registered by name in SCENARIOS and runnable
+via `yoda-tpu scenario run <name>`; all randomness flows from the single
+rng the runner seeds, so a (name, seed, scale) triple pins the journal.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from kubernetes_scheduler_tpu.host.types import Container, Pod, PodAffinityTerm
+from kubernetes_scheduler_tpu.sim.scenarios.base import Scenario, ScenarioWorld
+
+ZONES = ("zone-a", "zone-b", "zone-c", "zone-d")
+_ZONE_KEY = "topology.kubernetes.io/zone"
+
+
+def _mk_pod(
+    rng,
+    name: str,
+    *,
+    labels: dict | None = None,
+    cpu: float | None = None,
+    anti_group: str | None = None,
+) -> Pod:
+    """One simulated pod, everything drawn from the scenario rng."""
+    lab = {"scv/priority": str(int(rng.integers(0, 10)))}
+    if labels:
+        lab.update(labels)
+    kw: dict = {}
+    if anti_group is not None:
+        lab["app"] = anti_group
+        kw["pod_affinity"] = [
+            PodAffinityTerm(
+                match_labels={"app": anti_group},
+                topology_key=_ZONE_KEY,
+                anti=True,
+            )
+        ]
+    return Pod(
+        name=name,
+        labels=lab,
+        annotations={
+            "diskIO": f"{min(max(rng.gamma(2.0, 5.0), 0.1), 45.0):.1f}"
+        },
+        containers=[
+            Container(
+                requests={
+                    "cpu": float(
+                        cpu
+                        if cpu is not None
+                        else rng.choice([100, 250, 500, 1000])
+                    ),
+                    "memory": float(rng.choice([1, 2, 4])) * 2**28,
+                }
+            )
+        ],
+        **kw,
+    )
+
+
+class DiurnalScenario(Scenario):
+    """A day compressed into `ticks`: arrivals follow a sinusoidal load
+    curve (trough ~20% of peak), the steady-state shape a production
+    scheduler actually sees. The baseline every adversarial scenario is
+    judged against."""
+
+    name = "diurnal"
+    description = "sinusoidal arrival curve: compressed day/night load"
+    ticks = 12
+    smoke = True
+
+    def tick(self, t: int, world: ScenarioWorld, rng) -> None:
+        base = max(2, int(self.n_nodes * self.intensity))
+        phase = 2.0 * math.pi * t / self.ticks
+        n = max(1, int(base * (0.6 - 0.4 * math.cos(phase))))
+        for i in range(n):
+            world.submit(_mk_pod(rng, f"diurnal-t{t}-{i}"))
+
+
+class BurstScenario(Scenario):
+    """A quiet trickle, then one tick delivers a backlog ~8x the
+    steady state (a controller rollout, a namespace un-pause): the
+    deep-window pop, bucket-padding recompiles, and queue ordering all
+    get exercised at once."""
+
+    name = "burst"
+    description = "arrival burst: ~8x backlog lands in one tick"
+    ticks = 10
+    smoke = True
+
+    def tick(self, t: int, world: ScenarioWorld, rng) -> None:
+        base = max(2, int(self.n_nodes * self.intensity / 4))
+        n = base * 8 if t == self.ticks // 2 else base
+        for i in range(n):
+            world.submit(_mk_pod(rng, f"burst-t{t}-{i}"))
+
+
+class NodeFlapScenario(Scenario):
+    """Nodes vanish and return mid-run: each flap kills the node's
+    running pods (resubmitted by their controllers) and churns the
+    snapshot layout — with resident state on, every flap forces the
+    delta chain to flush to a full upload; the pipelined driver's
+    speculative batches discard on the fingerprint change."""
+
+    name = "node-flap"
+    description = "nodes vanish/return mid-run; resident state flushes"
+    ticks = 14
+
+    def tick(self, t: int, world: ScenarioWorld, rng) -> None:
+        n = max(2, int(self.n_nodes * self.intensity / 2))
+        for i in range(n):
+            world.submit(_mk_pod(rng, f"flap-t{t}-{i}"))
+        if t >= 2 and t % 3 == 2 and world.nodes:
+            k = max(1, len(world.nodes) // 16)
+            names = [
+                world.nodes[int(j)].name
+                for j in rng.choice(
+                    len(world.nodes), size=min(k, len(world.nodes)),
+                    replace=False,
+                )
+            ]
+            for name in names:
+                world.fail_node(name)
+        if t % 3 == 1:
+            for name in list(world.downed):
+                world.restore_node(name)
+
+
+class ZoneFailureScenario(Scenario):
+    """A whole zone dies at once: every node in it is gone in one tick
+    and every pod that ran there floods back into the queue — the mass-
+    rescheduling spike. The zone returns (empty) near the end."""
+
+    name = "zone-failure"
+    description = "whole-zone outage -> mass rescheduling flood"
+    ticks = 12
+
+    def tick(self, t: int, world: ScenarioWorld, rng) -> None:
+        n = max(2, int(self.n_nodes * self.intensity))
+        for i in range(n):
+            world.submit(_mk_pod(rng, f"zone-t{t}-{i}"))
+        if t == self.ticks // 2:
+            zone = ZONES[int(rng.integers(0, len(ZONES)))]
+            for name in [
+                nd.name
+                for nd in world.nodes
+                if nd.labels.get(_ZONE_KEY) == zone
+            ]:
+                world.fail_node(name)
+        if t == self.ticks - 2:
+            for name in list(world.downed):
+                world.restore_node(name)
+
+
+class AntiAffinityPackScenario(Scenario):
+    """Adversarial packing: waves of pods whose REQUIRED zone-level
+    anti-affinity admits at most one per zone per group — more members
+    than zones, so every wave leaves a deterministic unschedulable
+    remainder churning through retry backoff while plain filler traffic
+    must keep flowing around it."""
+
+    name = "anti-affinity-pack"
+    description = "zone anti-affinity groups larger than the zone count"
+    ticks = 10
+
+    def tick(self, t: int, world: ScenarioWorld, rng) -> None:
+        groups = max(1, int(self.n_nodes * self.intensity / 16))
+        for g in range(groups):
+            size = len(ZONES) + 2  # two can never place per wave
+            for i in range(size):
+                world.submit(
+                    _mk_pod(
+                        rng,
+                        f"anti-t{t}-g{g}-{i}",
+                        anti_group=f"spread-{t}-{g}",
+                    )
+                )
+        for i in range(max(2, int(self.n_nodes * self.intensity / 4))):
+            world.submit(_mk_pod(rng, f"anti-fill-t{t}-{i}"))
+
+
+class GangMixScenario(Scenario):
+    """Gang-heavy traffic (ops/gang.py): complete gangs of mixed sizes,
+    straggler gangs whose last member arrives a tick late (deferral +
+    reunite via restore_window), one oversize gang that must resolve by
+    policy, and plain filler — the all-or-nothing machinery end to end."""
+
+    name = "gang-mix"
+    description = "gangs of mixed sizes, stragglers, one oversize gang"
+    ticks = 10
+    smoke = True
+
+    def _gang_pod(self, rng, gang: str, size: int, i: int) -> Pod:
+        return _mk_pod(
+            rng,
+            f"{gang}-m{i}",
+            labels={"scv/gang": gang, "scv/gang-size": str(size)},
+            cpu=float(rng.choice([100, 250, 500])),
+        )
+
+    def tick(self, t: int, world: ScenarioWorld, rng) -> None:
+        scale = max(1, int(self.n_nodes * self.intensity / 32))
+        for g in range(scale):
+            size = int(rng.choice([2, 3, 4, 8]))
+            gang = f"gang-t{t}-{g}"
+            for i in range(size):
+                world.submit(self._gang_pod(rng, gang, size, i))
+        # straggler: all but one member now, the last one next tick
+        if t % 2 == 0:
+            size = int(rng.choice([3, 4]))
+            gang = f"straggler-t{t}"
+            for i in range(size - 1):
+                world.submit(self._gang_pod(rng, gang, size, i))
+            self._pending = (gang, size)
+        elif getattr(self, "_pending", None) is not None:
+            gang, size = self._pending
+            self._pending = None
+            world.submit(self._gang_pod(rng, gang, size, size - 1))
+        # one gang no window can hold: exercises the oversize policy
+        if t == 1:
+            size = 2048 + 2
+            # only a handful of members actually submitted — the
+            # declared size alone makes it unschedulable as a gang
+            for i in range(4):
+                world.submit(self._gang_pod(rng, f"oversize-t{t}", size, i))
+        for i in range(max(2, int(self.n_nodes * self.intensity / 8))):
+            world.submit(_mk_pod(rng, f"gangfill-t{t}-{i}"))
+
+
+SCENARIOS = {
+    s.name: s
+    for s in (
+        DiurnalScenario,
+        BurstScenario,
+        NodeFlapScenario,
+        ZoneFailureScenario,
+        AntiAffinityPackScenario,
+        GangMixScenario,
+    )
+}

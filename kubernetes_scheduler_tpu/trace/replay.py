@@ -74,6 +74,29 @@ class ReplayReport:
         }
 
 
+def pod_batch_from_record(tensors: dict) -> PodBatch:
+    """PodBatch from a record's `pods` tensors, backfilling leaves added
+    to the struct AFTER the journal was written (schema tags are
+    append-only, so old records simply lack them). Only leaves with a
+    semantically-neutral default may be backfilled — currently the gang
+    fields (gang_id=-1 / gang_size=0 is exactly "no gangs", and the gang
+    mask is bitwise the identity then); any other absence is drift."""
+    missing = set(PodBatch._fields) - set(tensors)
+    if missing - {"gang_id", "gang_size"}:
+        raise TraceError(
+            f"record's pods tensors lack {sorted(missing)} and no neutral "
+            "default exists — journal/struct drift"
+        )
+    if missing:
+        tensors = dict(tensors)
+        shape = np.asarray(tensors["request"]).shape[:-1]
+        if "gang_id" in missing:
+            tensors["gang_id"] = np.full(shape, -1, np.int32)
+        if "gang_size" in missing:
+            tensors["gang_size"] = np.zeros(shape, np.int32)
+    return PodBatch(**tensors)
+
+
 def engine_kw_from_record(rec: dict) -> dict:
     """The cycle options as the engine call expects them (JSON round-
     trips tuples to lists; score_plugins is static under jit and must be
@@ -241,7 +264,7 @@ def replay_journal(
                         seq=rec.get("seq"),
                     )
                 continue
-            pods = PodBatch(**rec["pods"])
+            pods = pod_batch_from_record(rec["pods"])
             kw = engine_kw_from_record(rec)
             if rec["path"] == "backlog":
                 bw = int(rec.get("batch_window") or 0)

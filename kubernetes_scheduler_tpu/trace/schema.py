@@ -141,6 +141,9 @@ TENSOR_DTYPES = {
     "pods.soft_spread_sel": "int32",
     "pods.image_ids": "int32",
     "pods.n_containers": "int32",
+    # gang co-scheduling (ops/gang.py): window-local gang slot + size
+    "pods.gang_id": "int32",
+    "pods.gang_size": "int32",
     # replay comparison target: the engine's node_idx over the real
     # (unpadded) window rows — "bitwise binding parity" reduces to an
     # array_equal on this

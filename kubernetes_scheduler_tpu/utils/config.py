@@ -153,6 +153,23 @@ class SchedulerConfig:
     # cycles (max_windows_per_cycle > 1 with a deep queue) always upload
     # in full — only the schedule_batch surface is resident.
     resident_state: bool = False
+    # gang co-scheduling (ops/gang.py, arXiv:2511.08373): pods labeled
+    # scv/gang + scv/gang-size bind all-or-nothing — the engine rescinds
+    # every placement of a gang that did not fully fit, and the host
+    # requeues the whole gang atomically to the FRONT of the queue
+    # (queue.restore_window: order preserved, re-pops next cycle).
+    # gang_max_defers bounds the front-of-queue retries; a gang that
+    # exhausts them is resolved per gang_defer_policy:
+    #   "split"  members lose their gang identity and schedule as
+    #            individuals with ordinary retry backoff (the default —
+    #            capacity eventually flows)
+    #   "drop"   members requeue with ordinary backoff but KEEP the gang,
+    #            retrying all-or-nothing at backoff cadence
+    # Off: gang labels are ignored entirely — bit-identical to the
+    # pre-gang scheduler (PARITY.md pins gang-off == no-gangs-in-traffic)
+    gang_scheduling: bool = True
+    gang_max_defers: int = 4
+    gang_defer_policy: str = "split"
     # preemption (upstream PostFilter parity, ops/preempt.py): when a pod
     # fits nowhere, evict <= preemption_max_victims strictly-lower-
     # priority pods from the least-disruptive node. Requires an evictor

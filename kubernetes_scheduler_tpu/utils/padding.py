@@ -52,6 +52,7 @@ def pad_to_bucket(arr: np.ndarray, axis: int = 0, *, floor: int = 8, fill=0):
 _NEG_SENTINEL_FIELDS = frozenset({
     "affinity_sel", "anti_affinity_sel", "spread_sel", "target_node",
     "pref_affinity_sel", "pref_anti_sel", "want_memory", "want_clock",
+    "gang_id",
 })
 
 

@@ -1,0 +1,15 @@
+"""Fixture: seeded generator flow the sim-determinism family accepts."""
+
+import numpy as np
+from numpy.random import default_rng
+
+
+def gen_cluster(n, seed=0):
+    rng = np.random.default_rng(seed)       # seeded: clean
+    rng2 = default_rng(seed + 1)            # seeded, bare form: clean
+    util = rng.random(n)                    # generator draw: clean
+    jitter = rng2.uniform(0, 1, n)
+    pick = rng.choice([1, 2, 3])
+    # an object that happens to be named like the stdlib module's
+    # sibling (rng.random above) is a generator method, not a global
+    return util, jitter, pick

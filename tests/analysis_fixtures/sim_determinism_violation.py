@@ -1,0 +1,17 @@
+"""Fixture: every unseeded-randomness shape the sim-determinism family
+flags."""
+
+import random
+
+import numpy as np
+from numpy.random import default_rng
+
+
+def gen_cluster(n):
+    util = np.random.random(n)              # global numpy RNG
+    np.random.seed(0)                       # seeding the global is still global
+    jitter = np.random.uniform(0, 1, n)     # global numpy RNG again
+    rng = default_rng()                     # unseeded: fresh OS entropy
+    rng2 = np.random.default_rng()          # unseeded, dotted form
+    pick = random.choice([1, 2, 3])         # stdlib global RNG
+    return util, jitter, rng, rng2, pick

@@ -62,6 +62,12 @@ def active(violations):
             "metric_hygiene_clean.py",
             8,
         ),
+        (
+            "sim-determinism",
+            "sim_determinism_violation.py",
+            "sim_determinism_clean.py",
+            6,
+        ),
     ],
 )
 def test_rule_fires_and_stays_quiet(rule, violating, clean, min_hits):
@@ -278,8 +284,33 @@ def test_unknown_rule_rejected():
 def test_registry_has_all_six_families():
     assert {
         "jit-purity", "host-sync", "lock-discipline", "wire-schema",
-        "dtype-shape", "timeout-hygiene",
+        "dtype-shape", "timeout-hygiene", "sim-determinism",
     } <= set(RULES)
+
+
+def test_sim_determinism_messages_name_the_fix():
+    msgs = [
+        v.message
+        for v in active(
+            lint_fixture("sim_determinism_violation.py", "sim-determinism")
+        )
+    ]
+    assert any("default_rng(seed)" in m for m in msgs)
+    assert any("GLOBAL RNG" in m for m in msgs)
+    # unseeded default_rng gets its own targeted message
+    assert any("unseeded default_rng()" in m for m in msgs)
+
+
+def test_sim_determinism_real_simulators_clean():
+    import glob
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    real = glob.glob(
+        os.path.join(repo_root, "kubernetes_scheduler_tpu", "sim", "**", "*.py"),
+        recursive=True,
+    )
+    assert real, "sim/ sources not found"
+    assert active(run_lint(real, rules=["sim-determinism"])) == []
 
 
 def test_lint_main_exit_codes(capsys):

@@ -54,6 +54,8 @@ def test_bench_smoke_e2e():
         "host_loop_32nodes_resident",
         "host_loop_32nodes_replay",
         "host_loop_32nodes_telemetry",
+        "scenario_burst_32nodes",
+        "scenario_gang_32nodes",
     ):
         assert want in metrics, (want, sorted(metrics))
     for name in (
@@ -96,6 +98,14 @@ def test_bench_smoke_e2e():
     assert tel["spans_dropped"] == 0, tel
     assert tel["metrics_scrapes"] > 0, tel
     assert "telemetry_overhead_pct" in tel, tel
+    # scenario-harness metrics: the burst program drained on the device
+    # path; the gang mix reports the all-or-nothing admit rate
+    for name in ("scenario_burst_32nodes", "scenario_gang_32nodes"):
+        assert metrics[name]["pods_bound"] > 0, metrics[name]
+        assert metrics[name]["fallback_cycles"] == 0, metrics[name]
+    gang = metrics["scenario_gang_32nodes"]
+    assert gang["gangs_admitted"] > 0, gang
+    assert 0.0 < gang["gang_admit_rate"] <= 1.0, gang
 
 
 def test_obs_smoke_e2e(tmp_path):
@@ -205,6 +215,39 @@ def test_obs_smoke_e2e(tmp_path):
     assert report["host_events"] > 0 and report["sidecar_events"] > 0
     trace = json.load(open(merged))
     assert trace["traceEvents"], "merged timeline is empty"
+
+
+def test_scenario_smoke_e2e(tmp_path):
+    """The `make scenario-smoke` flow as a test: the two fastest
+    registered scenarios at small scale, each emitting a journal that
+    `trace replay` (exit 1 on ANY binding diff) must reproduce — the
+    replay-pinning gate every scenario ships under."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+
+    def run(*argv):
+        return subprocess.run(
+            [sys.executable, "-m", "kubernetes_scheduler_tpu", *argv],
+            capture_output=True, text=True, timeout=420, cwd=REPO, env=env,
+        )
+
+    for name, checks in (
+        ("burst", {}),
+        ("gang-mix", {"gangs_admitted": lambda v: v > 0}),
+    ):
+        journal = str(tmp_path / name)
+        rec = run(
+            "scenario", "run", name, "--nodes", "32", "--trace", journal
+        )
+        assert rec.returncode == 0, rec.stderr[-2000:]
+        summary = json.loads(rec.stdout.splitlines()[-1])
+        assert summary["pods_bound"] > 0, summary
+        assert summary["fallback_cycles"] == 0, summary
+        for key, ok in checks.items():
+            assert ok(summary[key]), summary
+        rep = run("trace", "replay", journal)
+        assert rep.returncode == 0, rep.stderr[-2000:] + rep.stdout[-500:]
+        report = json.loads(rep.stdout.splitlines()[-1])
+        assert report["binding_diffs"] == 0 and report["replayed"] > 0
 
 
 def test_trace_smoke_e2e(tmp_path):

@@ -611,7 +611,15 @@ def _start_pre_field_cache_server(address):
                         f"unsupported dtype '' for tensor {name!r}",
                     )
         snapshot = codec.unpack_fields(engine.SnapshotArrays, request.snapshot)
-        pods = codec.unpack_fields(engine.PodBatch, request.pods)
+        # this fake predates gang scheduling too (health advertises
+        # neither bit), so the client rightly strips the gang tensors —
+        # but the fake runs on TODAY'S PodBatch struct, hence the
+        # backfill defaults a real old build would not need
+        from kubernetes_scheduler_tpu.bridge.server import _POD_WIRE_DEFAULTS
+
+        pods = codec.unpack_fields(
+            engine.PodBatch, request.pods, defaults=_POD_WIRE_DEFAULTS
+        )
         res = jax.tree_util.tree_map(
             np.asarray, local.schedule_batch(snapshot, pods)
         )

@@ -1,0 +1,691 @@
+"""Gang co-scheduling (ops/gang.py + the host/bridge plumbing): the
+all-or-nothing guarantee across every path.
+
+The pinned contracts (PARITY.md):
+- no binding for a partial gang ever reaches mark_scheduled — serial,
+  pipelined, scalar-fallback, and bridge (capability-downgraded) paths;
+- gang-off <-> no-gangs-in-traffic bindings are bit-identical (the gang
+  machinery is invisible to ordinary traffic);
+- serial <-> pipelined bindings are bit-identical under gang traffic,
+  on either queue implementation;
+- a deferred gang requeues atomically via restore_window (front of its
+  priority class on the Python queue, back on the native heap) and
+  re-pops as a unit;
+- journals replay clean even when recorded against a gang-blind engine
+  (the journaled node_idx is the backstop-masked vector).
+"""
+
+import numpy as np
+import pytest
+
+from kubernetes_scheduler_tpu.engine import (
+    LocalEngine,
+    make_pod_batch,
+    make_snapshot,
+    schedule_batch,
+)
+from kubernetes_scheduler_tpu.host.queue import (
+    SchedulingQueue,
+    break_gang,
+    pod_gang,
+)
+from kubernetes_scheduler_tpu.host.scheduler import Scheduler
+from kubernetes_scheduler_tpu.host.types import Container, Pod
+from kubernetes_scheduler_tpu.ops.gang import (
+    GANG_MASKED_BASE,
+    decode_masked,
+    gang_mask_assign,
+    mask_partial_gangs_np,
+)
+from kubernetes_scheduler_tpu.sim.host_gen import gen_host_cluster
+from kubernetes_scheduler_tpu.utils.config import FeatureGates, SchedulerConfig
+
+
+def _cfg(**kw):
+    base = dict(
+        batch_window=64, min_device_work=1, adaptive_dispatch=False,
+        normalizer="none",
+    )
+    base.update(kw)
+    return SchedulerConfig(**base)
+
+
+def _gang_pod(name, gang, size, *, cpu=100.0, ns="default"):
+    return Pod(
+        name=name,
+        namespace=ns,
+        labels={"scv/gang": gang, "scv/gang-size": str(size)},
+        containers=[Container(requests={"cpu": cpu, "memory": 2**28})],
+    )
+
+
+def _plain_pod(name, *, cpu=100.0):
+    return Pod(
+        name=name,
+        containers=[Container(requests={"cpu": cpu, "memory": 2**28})],
+    )
+
+
+def _scheduler(nodes, advisor, running, **cfg_kw):
+    return Scheduler(
+        _cfg(**cfg_kw),
+        advisor=advisor,
+        list_nodes=lambda: nodes,
+        list_running_pods=lambda: running,
+    )
+
+
+def _bindings(sched):
+    return [(b.pod.name, b.node_name) for b in sched.binder.bindings]
+
+
+# ---- pod_gang / labels ----------------------------------------------------
+
+
+def test_pod_gang_parses_and_memoizes():
+    pod = _gang_pod("a", "train", 3)
+    assert pod_gang(pod) == ("default/train", 3)
+    assert pod_gang(pod) == ("default/train", 3)  # memo hit
+    break_gang(pod)
+    assert pod_gang(pod) is None
+
+
+def test_pod_gang_rejects_garbage_and_singletons():
+    assert pod_gang(Pod(name="x", labels={"scv/gang": "g"})) is None
+    assert pod_gang(
+        Pod(name="y", labels={"scv/gang": "g", "scv/gang-size": "banana"})
+    ) is None
+    assert pod_gang(
+        Pod(name="z", labels={"scv/gang": "g", "scv/gang-size": "1"})
+    ) is None
+    assert pod_gang(Pod(name="w")) is None
+
+
+# ---- the device op --------------------------------------------------------
+
+
+def test_gang_mask_assign_rescinds_partial_and_returns_capacity():
+    alloc = np.array([[8.0, 100.0], [8.0, 100.0]], np.float32)
+    snap = make_snapshot(
+        alloc, np.zeros((2, 2), np.float32),
+        np.zeros(2), np.zeros(2), np.zeros(2),
+    )
+    pods = make_pod_batch(
+        request=np.full((3, 2), [8.0, 1.0], np.float32),
+        gang_id=np.zeros(3, np.int32),
+        gang_size=np.full(3, 3, np.int32),
+    )
+    res = schedule_batch(snap, pods, normalizer="none")
+    idx = np.asarray(res.node_idx)
+    # two members fit, the third cannot: ALL placements rescinded
+    assert (idx >= 0).sum() == 0
+    assert (idx <= GANG_MASKED_BASE).sum() == 2
+    # sentinels decode to the would-have nodes
+    assert sorted(decode_masked(idx[idx <= GANG_MASKED_BASE]).tolist()) == [0, 1]
+    assert int(res.n_assigned) == 0
+    # the rescinded members' capacity came back
+    assert np.allclose(np.asarray(res.free_after)[:, 0], 8.0)
+
+
+def test_gang_mask_assign_identity_without_gangs():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    node_idx = jnp.asarray(
+        rng.integers(-1, 4, 16).astype(np.int32)
+    )
+    req = jnp.asarray(rng.random((16, 3), np.float32))
+    free = jnp.asarray(rng.random((4, 3), np.float32))
+    out_idx, out_free, out_n = gang_mask_assign(
+        jnp.full(16, -1, jnp.int32), jnp.zeros(16, jnp.int32),
+        jnp.ones(16, bool), node_idx, req, free, jnp.asarray(7, jnp.int32),
+    )
+    assert np.array_equal(np.asarray(out_idx), np.asarray(node_idx))
+    assert np.array_equal(np.asarray(out_free), np.asarray(free))
+    assert int(out_n) == 7
+
+
+def test_np_mirror_matches_device_op():
+    rng = np.random.default_rng(3)
+    p = 32
+    gang_id = np.where(
+        rng.random(p) < 0.6, rng.integers(0, 5, p), -1
+    ).astype(np.int32)
+    sizes = rng.integers(2, 6, 5)
+    gang_size = np.where(
+        gang_id >= 0, sizes[np.clip(gang_id, 0, 4)], 0
+    ).astype(np.int32)
+    node_idx = rng.integers(-1, 8, p).astype(np.int32)
+
+    import jax.numpy as jnp
+
+    dev_idx, _, _ = gang_mask_assign(
+        jnp.asarray(gang_id), jnp.asarray(gang_size), jnp.ones(p, bool),
+        jnp.asarray(node_idx), jnp.zeros((p, 2), jnp.float32),
+        jnp.zeros((8, 2), jnp.float32), jnp.asarray(0, jnp.int32),
+    )
+    np_idx, newly = mask_partial_gangs_np(gang_id, gang_size, node_idx)
+    assert np.array_equal(np.asarray(dev_idx), np_idx)
+    assert newly == int((np_idx <= GANG_MASKED_BASE).sum())
+    # idempotent: masking a masked vector changes nothing
+    again, newly2 = mask_partial_gangs_np(gang_id, gang_size, np_idx)
+    assert np.array_equal(again, np_idx) and newly2 == 0
+
+
+# ---- host loop: all-or-nothing + deferral ---------------------------------
+
+
+def test_complete_gang_binds_incomplete_defers_then_splits():
+    nodes, advisor = gen_host_cluster(16, seed=0)
+    running: list = []
+    s = _scheduler(nodes, advisor, running, gang_max_defers=2)
+    for i in range(3):
+        s.submit(_gang_pod(f"g1-{i}", "a", 3))
+    for i in range(2):
+        s.submit(_gang_pod(f"g2-{i}", "b", 4))  # 2 of 4: never complete
+    s.run_until_empty(max_cycles=16)
+    names = [n for n, _ in _bindings(s)]
+    assert sorted(n for n in names if n.startswith("g1-")) == [
+        "g1-0", "g1-1", "g1-2",
+    ]
+    assert not any(n.startswith("g2-") for n in names)
+    assert s.totals["gangs_admitted"] == 1
+    # deferred twice, then the budget-exhausted resolution (also counted)
+    assert s.totals["gangs_deferred"] == 3
+
+
+def test_straggler_member_reunites_gang_within_defer_budget():
+    nodes, advisor = gen_host_cluster(16, seed=0)
+    running: list = []
+    s = _scheduler(nodes, advisor, running, gang_max_defers=4)
+    for i in range(2):
+        s.submit(_gang_pod(f"m-{i}", "late", 3))
+    m = s.run_cycle()
+    assert m.gangs_deferred == 1 and m.pods_bound == 0
+    # the straggler arrives; the gang re-pops as a unit and binds whole
+    s.submit(_gang_pod("m-2", "late", 3))
+    m2 = s.run_cycle()
+    assert m2.gangs_admitted == 1 and m2.pods_bound == 3
+    assert s.totals["pods_bound"] == 3
+
+
+def test_drop_policy_keeps_gang_identity():
+    nodes, advisor = gen_host_cluster(8, seed=0)
+    running: list = []
+    s = _scheduler(
+        nodes, advisor, running,
+        gang_max_defers=1, gang_defer_policy="drop",
+    )
+    pods = [_gang_pod(f"d-{i}", "keep", 3) for i in range(2)]
+    for p in pods:
+        s.submit(p)
+    s.run_cycle()
+    s.run_cycle()
+    # budget exhausted -> backoff requeue, gang identity intact
+    assert all(pod_gang(p) == ("default/keep", 3) for p in pods)
+    assert s.totals["pods_bound"] == 0
+
+
+def test_oversize_gang_splits_immediately():
+    nodes, advisor = gen_host_cluster(8, seed=0)
+    running: list = []
+    s = _scheduler(nodes, advisor, running, batch_window=8)
+    pods = [_gang_pod(f"o-{i}", "huge", 100) for i in range(4)]
+    for p in pods:
+        s.submit(p)
+    m = s.run_cycle()
+    assert m.gangs_deferred == 1
+    assert all(pod_gang(p) is None for p in pods)
+
+
+def test_unknown_gang_defer_policy_rejected():
+    nodes, advisor = gen_host_cluster(4, seed=0)
+    with pytest.raises(ValueError, match="gang_defer_policy"):
+        _scheduler(nodes, advisor, [], gang_defer_policy="explode")
+
+
+# ---- deferred-gang requeue ordering (restore_window) ----------------------
+
+
+def test_deferred_gang_requeues_to_front_in_order():
+    nodes, advisor = gen_host_cluster(16, seed=0)
+    running: list = []
+    s = _scheduler(nodes, advisor, running)
+    assert isinstance(s.queue, SchedulingQueue) or True
+    # incomplete gang first, then plain pods at the same priority
+    gang = [_gang_pod(f"fg-{i}", "front", 3) for i in range(2)]
+    for p in gang:
+        s.submit(p)
+    plain = [_plain_pod(f"fp-{i}") for i in range(3)]
+    for p in plain:
+        s.submit(p)
+    m = s.run_cycle()
+    # the gang deferred; the plain pods bound
+    assert m.gangs_deferred == 1 and m.pods_bound == 3
+    # restore_window contract: the gang re-pops FIRST, original order
+    nxt = s.queue.pop_window(8)
+    assert [p.name for p in nxt[:2]] == ["fg-0", "fg-1"]
+    s.queue.restore_window(nxt)
+
+
+# ---- parity pins ----------------------------------------------------------
+
+
+def _drain(pipeline_depth, pods_fn, *, gang_scheduling=True, n_nodes=24):
+    nodes, advisor = gen_host_cluster(n_nodes, seed=0)
+    running: list = []
+    s = _scheduler(
+        nodes, advisor, running,
+        pipeline_depth=pipeline_depth,
+        gang_scheduling=gang_scheduling,
+        # zero-delay retries so deferral/backoff traffic re-enters the
+        # run deterministically (prefetching is disabled at zero backoff
+        # exactly to keep serial/pipelined pops identical)
+        initial_backoff_seconds=0.0,
+    )
+    for pod in pods_fn():
+        s.submit(pod)
+    out = s.run_until_empty(max_cycles=32)
+    s.drain_pipeline()
+    return s, out
+
+
+def _mixed_traffic():
+    pods = []
+    for g in range(4):
+        size = 2 + g % 3
+        for i in range(size):
+            pods.append(_gang_pod(f"mg{g}-{i}", f"mix-{g}", size))
+    pods.extend(_plain_pod(f"mp-{i}") for i in range(12))
+    # one forever-incomplete gang churning through deferral
+    pods.extend(_gang_pod(f"short-{i}", "short", 5) for i in range(3))
+    return pods
+
+
+def test_gang_parity_serial_vs_pipelined():
+    s0, _ = _drain(0, _mixed_traffic)
+    s1, _ = _drain(1, _mixed_traffic)
+    assert _bindings(s0) == _bindings(s1)
+    assert s0.totals["gangs_admitted"] == s1.totals["gangs_admitted"] > 0
+    assert s0.totals["fallback_cycles"] == s1.totals["fallback_cycles"] == 0
+
+
+def test_gang_off_matches_no_gangs_in_traffic():
+    def plain_traffic():
+        return [_plain_pod(f"p-{i}") for i in range(24)]
+
+    on, _ = _drain(0, plain_traffic, gang_scheduling=True)
+    off, _ = _drain(0, plain_traffic, gang_scheduling=False)
+    assert _bindings(on) == _bindings(off)
+    assert on.totals["gangs_admitted"] == 0
+    assert on.totals["gangs_deferred"] == 0
+
+
+def test_scalar_fallback_never_binds_partial_gangs():
+    nodes, advisor = gen_host_cluster(12, seed=0)
+    running: list = []
+    s = Scheduler(
+        _cfg(feature_gates=FeatureGates(tpu_batch_score=False)),
+        advisor=advisor,
+        list_nodes=lambda: nodes,
+        list_running_pods=lambda: running,
+    )
+    for i in range(3):
+        s.submit(_gang_pod(f"sg-{i}", "scalarband", 3))
+    for i in range(4):
+        s.submit(_plain_pod(f"sp-{i}"))
+    m = s.run_cycle()
+    assert m.used_fallback
+    # the gang deferred whole (scalar cycles never bind gangs); plain
+    # pods scheduled normally
+    assert m.gangs_deferred == 1
+    names = [n for n, _ in _bindings(s)]
+    assert not any(n.startswith("sg-") for n in names)
+    assert sum(n.startswith("sp-") for n in names) == 4
+
+
+# ---- bridge: capability downgrade ----------------------------------------
+
+
+def test_gang_capability_downgrade_old_sidecar():
+    """An old sidecar (no gang_scheduling capability): the client strips
+    the gang tensors off the wire, the host's backstop enforces
+    all-or-nothing, and bindings match the local (device-masked) run —
+    degraded mode is invisible in the decisions."""
+    grpc = pytest.importorskip("grpc")  # noqa: F841
+    from kubernetes_scheduler_tpu.bridge.client import RemoteEngine
+    from kubernetes_scheduler_tpu.bridge.server import make_server
+
+    server, port, service = make_server("127.0.0.1:0")
+    service.gang_enabled = False  # impersonate the old build
+    server.start()
+    client = RemoteEngine(f"127.0.0.1:{port}", deadline_seconds=120.0)
+    try:
+        assert client.supports_gangs() is False
+
+        nodes, advisor = gen_host_cluster(12, seed=0)
+        running: list = []
+        s = Scheduler(
+            _cfg(),
+            advisor=advisor,
+            list_nodes=lambda: nodes,
+            list_running_pods=lambda: running,
+            engine=client,
+        )
+        # a gang that fits + one that cannot (members > cluster fit is
+        # not needed; missing member suffices to exercise the backstop)
+        for i in range(3):
+            s.submit(_gang_pod(f"ok-{i}", "fits", 3))
+        for i in range(2):
+            s.submit(_gang_pod(f"part-{i}", "short", 4))
+        for i in range(4):
+            s.submit(_plain_pod(f"pl-{i}"))
+        s.run_until_empty(max_cycles=8)
+        remote_bindings = _bindings(s)
+        assert s.totals["fallback_cycles"] == 0
+        assert s.totals["gangs_admitted"] == 1
+        names = [n for n, _ in remote_bindings]
+        assert not any(n.startswith("part-") for n in names)
+
+        # the same traffic against the local (gang-capable) engine
+        nodes2, advisor2 = gen_host_cluster(12, seed=0)
+        running2: list = []
+        s2 = Scheduler(
+            _cfg(),
+            advisor=advisor2,
+            list_nodes=lambda: nodes2,
+            list_running_pods=lambda: running2,
+        )
+        for i in range(3):
+            s2.submit(_gang_pod(f"ok-{i}", "fits", 3))
+        for i in range(2):
+            s2.submit(_gang_pod(f"part-{i}", "short", 4))
+        for i in range(4):
+            s2.submit(_plain_pod(f"pl-{i}"))
+        s2.run_until_empty(max_cycles=8)
+        assert remote_bindings == _bindings(s2)
+    finally:
+        client.close()
+        server.stop(grace=None)
+
+
+def test_gang_capable_sidecar_masks_on_device():
+    """A current sidecar advertises the capability, receives the gang
+    tensors, and rescinds partial placements on ITS side (sentinels in
+    the reply; the sidecar's gang_pods_masked_total counter moves)."""
+    pytest.importorskip("grpc")
+    from kubernetes_scheduler_tpu.bridge.client import RemoteEngine
+    from kubernetes_scheduler_tpu.bridge.server import make_server
+
+    server, port, service = make_server("127.0.0.1:0")
+    server.start()
+    client = RemoteEngine(f"127.0.0.1:{port}", deadline_seconds=120.0)
+    try:
+        assert client.supports_gangs() is True
+        alloc = np.array([[8.0, 100.0], [8.0, 100.0]], np.float32)
+        snap = make_snapshot(
+            alloc, np.zeros((2, 2), np.float32),
+            np.zeros(2), np.zeros(2), np.zeros(2),
+        )
+        pods = make_pod_batch(
+            request=np.full((3, 2), [8.0, 1.0], np.float32),
+            gang_id=np.zeros(3, np.int32),
+            gang_size=np.full(3, 3, np.int32),
+        )
+        res = client.schedule_batch(snap, pods, normalizer="none")
+        idx = np.asarray(res.node_idx)
+        assert (idx >= 0).sum() == 0
+        assert (idx <= GANG_MASKED_BASE).sum() == 2
+        assert "gang_pods_masked_total 2" in service.render_metrics()
+    finally:
+        client.close()
+        server.stop(grace=None)
+
+
+def test_pipelined_prefetch_flushed_on_gang_deferral():
+    """A gang that defers at RESOLVE time (complete in the window but
+    unschedulable) while the pipelined driver holds a prefetched window:
+    the prefetch is handed back behind the restored gang, so pop order —
+    and therefore bindings — stay identical to the serial driver."""
+
+    def traffic():
+        pods = [_plain_pod(f"w1-{i}", cpu=100.0) for i in range(8)]
+        # complete gang, but no node can hold any member: defers at
+        # resolve until the budget splits it (members then individually
+        # unschedulable, parked in backoff)
+        pods.extend(_gang_pod(f"big-{i}", "toobig", 3, cpu=10**6) for i in range(3))
+        pods.extend(_plain_pod(f"w2-{i}", cpu=100.0) for i in range(8))
+        return pods
+
+    def drain(depth):
+        nodes, advisor = gen_host_cluster(8, seed=0)
+        running: list = []
+        s = _scheduler(
+            nodes, advisor, running,
+            batch_window=8, pipeline_depth=depth, gang_max_defers=2,
+        )
+        for pod in traffic():
+            s.submit(pod)
+        s.run_until_empty(max_cycles=12)
+        s.drain_pipeline()
+        return s
+
+    s0, s1 = drain(0), drain(1)
+    assert _bindings(s0) == _bindings(s1)
+    assert s0.totals["gangs_deferred"] == s1.totals["gangs_deferred"] > 0
+    assert s0.totals["gangs_admitted"] == s1.totals["gangs_admitted"] == 0
+    names = [n for n, _ in _bindings(s1)]
+    assert not any(n.startswith("big-") for n in names)
+    assert sum(1 for n in names if n.startswith(("w1-", "w2-"))) == 16
+
+
+# ---- review-round pins ----------------------------------------------------
+
+
+def test_gang_off_ignores_gang_labels_entirely():
+    """config.gang_scheduling=False: gang labels are IGNORED — the
+    builder leaves the gang tensors at their no-gang defaults, members
+    schedule as individuals, and no gang counter ever moves."""
+    nodes, advisor = gen_host_cluster(16, seed=0)
+    running: list = []
+    s = _scheduler(nodes, advisor, running, gang_scheduling=False)
+    for i in range(2):
+        s.submit(_gang_pod(f"ig-{i}", "ignored", 4))  # 2 of 4 "members"
+    for i in range(3):
+        s.submit(_plain_pod(f"ip-{i}"))
+    batch = s.builder.build_pod_batch(
+        [_gang_pod("probe", "ignored", 4)]
+    )
+    assert (np.asarray(batch.gang_id) == -1).all()
+    s.run_until_empty(max_cycles=8)
+    names = [n for n, _ in _bindings(s)]
+    # the would-be-partial gang binds as individuals: labels ignored
+    assert sum(n.startswith("ig-") for n in names) == 2
+    assert sum(n.startswith("ip-") for n in names) == 3
+    assert s.totals["gangs_admitted"] == 0
+    assert s.totals["gangs_deferred"] == 0
+    assert s.totals["gang_pods_masked"] == 0
+
+
+def test_gang_window_routes_device_under_adaptive_default():
+    """Gang pods carry an scv/ label, so gang windows are never
+    scalar-eligible: even with the adaptive dispatcher's huge cold-start
+    threshold the cycle takes the engine path and the gang binds whole
+    — it is never scalar-deferred into a forced split."""
+    nodes, advisor = gen_host_cluster(16, seed=0)
+    running: list = []
+    s = _scheduler(
+        nodes, advisor, running,
+        min_device_work=1 << 20, adaptive_dispatch=True,
+    )
+    for i in range(3):
+        s.submit(_gang_pod(f"dev-{i}", "small", 3))
+    m = s.run_cycle()
+    assert not m.used_fallback
+    assert m.gangs_admitted == 1 and m.pods_bound == 3
+
+
+def test_over_submitted_gang_admits_by_count_like_the_device_op():
+    """More members in the window than the declared size: admission is
+    assigned-count >= size (the device op's rule); the surplus member
+    falls through to the ordinary requeue path, never a whole-gang
+    deferral of valid placements."""
+    nodes, advisor = gen_host_cluster(2, seed=0)
+    # shrink capacity so exactly 2 of the 3 members fit
+    for nd in nodes:
+        nd.allocatable["cpu"] = 1000.0
+        nd.allocatable["memory"] = 4 * 2**30
+    running: list = []
+    s = _scheduler(nodes, advisor, running)
+    for i in range(3):
+        s.submit(_gang_pod(f"ov-{i}", "over", 2, cpu=1000.0))
+    m = s.run_cycle()
+    assert m.gangs_admitted == 1, (m, _bindings(s))
+    assert m.pods_bound == 2
+    assert m.gangs_deferred == 0
+    assert m.pods_unschedulable == 1  # the surplus member, individually
+
+
+@pytest.mark.parametrize("native", [True, False])
+def test_pipelined_parity_with_traffic_beyond_the_prefetch(native):
+    """The review's divergence shape: a gang defers mid-drain while the
+    pipelined driver holds a prefetched window AND more traffic waits
+    behind it — pop order (and bindings) must still match serial on
+    BOTH queue implementations (the native heap restores to the back of
+    the priority class, the Python queue to the front; _defer_gang
+    branches on RESTORES_TO_FRONT)."""
+
+    def traffic():
+        pods = [_plain_pod(f"a-{i}") for i in range(8)]
+        pods.extend(
+            _gang_pod(f"big-{i}", "nofit", 3, cpu=10**6) for i in range(3)
+        )
+        pods.extend(_plain_pod(f"b-{i}") for i in range(8))
+        pods.extend(_plain_pod(f"c-{i}") for i in range(8))
+        return pods
+
+    def drain(depth):
+        nodes, advisor = gen_host_cluster(8, seed=0)
+        running: list = []
+        s = _scheduler(
+            nodes, advisor, running,
+            batch_window=8, pipeline_depth=depth, gang_max_defers=2,
+            feature_gates=FeatureGates(native_host=native),
+        )
+        if not native:
+            assert s.queue.RESTORES_TO_FRONT is True
+        for pod in traffic():
+            s.submit(pod)
+        s.run_until_empty(max_cycles=16)
+        s.drain_pipeline()
+        return s
+
+    s0, s1 = drain(0), drain(1)
+    assert _bindings(s0) == _bindings(s1)
+    assert s0.totals["gangs_deferred"] == s1.totals["gangs_deferred"] > 0
+    names = [n for n, _ in _bindings(s1)]
+    assert sum(1 for n in names if n.startswith(("a-", "b-", "c-"))) == 24
+
+
+def test_degraded_mode_journal_replays_clean(tmp_path):
+    """Recording against a gang-blind sidecar: the journaled node_idx
+    must be the MASKED vector (the host backstop's output), so a local
+    gang-capable replay reproduces it bitwise — the replay-pinning
+    guarantee holds in degraded mode too."""
+    pytest.importorskip("grpc")
+    from kubernetes_scheduler_tpu.bridge.client import RemoteEngine
+    from kubernetes_scheduler_tpu.bridge.server import make_server
+    from kubernetes_scheduler_tpu.trace.replay import replay_journal
+
+    server, port, service = make_server("127.0.0.1:0")
+    service.gang_enabled = False  # gang-blind: raw replies, host masks
+    server.start()
+    client = RemoteEngine(f"127.0.0.1:{port}", deadline_seconds=120.0)
+    journal = str(tmp_path / "degraded")
+    try:
+        nodes, advisor = gen_host_cluster(2, seed=0)
+        for nd in nodes:
+            nd.allocatable["cpu"] = 1000.0
+        running: list = []
+        s = Scheduler(
+            _cfg(trace_path=journal, gang_max_defers=1),
+            advisor=advisor,
+            list_nodes=lambda: nodes,
+            list_running_pods=lambda: running,
+            engine=client,
+        )
+        # a gang with a partial device fit: the raw reply carries real
+        # placements the backstop must rescind — exactly the records
+        # that used to replay dirty
+        for i in range(3):
+            s.submit(_gang_pod(f"dg-{i}", "nofit", 3, cpu=1000.0))
+        for i in range(2):
+            s.submit(_plain_pod(f"dp-{i}", cpu=100.0))
+        s.run_until_empty(max_cycles=6)
+        assert s.totals["gangs_deferred"] > 0
+        assert s.totals["gang_pods_masked"] > 0  # backstop rescinded
+        s.recorder.close()
+        report = replay_journal(journal)  # local, gang-capable engine
+        assert report.replayed > 0
+        assert report.binding_diffs == 0, report.to_dict()
+    finally:
+        client.close()
+        server.stop(grace=None)
+
+
+def test_deep_backlog_keeps_stride_aligned_gangs():
+    """A gang fully inside one stacked-window stride rides the
+    multi-window dispatch (no trim); only a straddling gang cuts the
+    pop, and only from its first member on."""
+    nodes, advisor = gen_host_cluster(16, seed=0)
+    running: list = []
+    s = _scheduler(
+        nodes, advisor, running, batch_window=8, max_windows_per_cycle=4,
+    )
+    # stride 0: 5 plain + aligned gang of 3 (rows 5..7); stride 1: 8 plain
+    for i in range(5):
+        s.submit(_plain_pod(f"s0-{i}"))
+    for i in range(3):
+        s.submit(_gang_pod(f"al-{i}", "aligned", 3))
+    for i in range(8):
+        s.submit(_plain_pod(f"s1-{i}"))
+    m = s.run_cycle()
+    # one deep cycle took everything: the aligned gang bound in-stride
+    assert m.pods_in == 16 and m.pods_bound == 16, m
+    assert m.gangs_admitted == 1
+    assert s.totals["gangs_deferred"] == 0
+
+    # straddling gang: rows 6..8 cross the stride boundary -> the pop
+    # cuts at the gang's first member; the suffix leads the next cycle
+    for i in range(6):
+        s.submit(_plain_pod(f"t0-{i}"))
+    for i in range(3):
+        s.submit(_gang_pod(f"st-{i}", "straddle", 3))
+    for i in range(4):
+        s.submit(_plain_pod(f"t1-{i}"))
+    m2 = s.run_cycle()
+    assert m2.pods_bound == 6, m2          # the clean prefix
+    m3 = s.run_cycle()
+    assert m3.gangs_admitted == 1          # gang re-popped whole
+    assert m3.pods_bound == 7, m3          # gang + trailing plains
+    assert s.totals["gangs_deferred"] == 0
+
+
+def test_np_mirror_per_lane_sizes_match_device():
+    """Members declaring INCONSISTENT gang sizes (malformed labels):
+    the np mirror must still match the device op lane for lane."""
+    import jax.numpy as jnp
+
+    gang_id = np.array([0, 0, 0, -1], np.int32)
+    gang_size = np.array([3, 2, 2, 0], np.int32)  # malformed: mixed
+    node_idx = np.array([0, 1, -1, 2], np.int32)  # cnt(assigned)=2
+    dev_idx, _, _ = gang_mask_assign(
+        jnp.asarray(gang_id), jnp.asarray(gang_size),
+        jnp.ones(4, bool), jnp.asarray(node_idx),
+        jnp.zeros((4, 2), jnp.float32), jnp.zeros((4, 2), jnp.float32),
+        jnp.asarray(0, jnp.int32),
+    )
+    np_idx, _ = mask_partial_gangs_np(gang_id, gang_size, node_idx)
+    assert np.array_equal(np.asarray(dev_idx), np_idx)
+    # lane 0 (declared 3 > cnt 2) masked; lanes 1-2 (declared 2) kept
+    assert np_idx[0] <= GANG_MASKED_BASE and np_idx[1] == 1

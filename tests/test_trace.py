@@ -531,3 +531,27 @@ def test_replay_parity_live_sidecar(tmp_path):
         return service
 
     _with_sidecar(body)
+
+
+def test_replay_backfills_pre_gang_pod_tensors():
+    """Journals recorded before the gang fields existed decode to a
+    PodBatch with the neutral no-gangs defaults; any OTHER missing leaf
+    is schema drift and fails loud."""
+    import numpy as np
+    import pytest
+
+    from kubernetes_scheduler_tpu.engine import PodBatch, make_pod_batch
+    from kubernetes_scheduler_tpu.trace.recorder import TraceError
+    from kubernetes_scheduler_tpu.trace.replay import pod_batch_from_record
+
+    pods = make_pod_batch(request=np.ones((4, 3), np.float32))
+    tensors = {
+        name: np.asarray(a) for name, a in zip(PodBatch._fields, pods)
+    }
+    del tensors["gang_id"], tensors["gang_size"]
+    out = pod_batch_from_record(tensors)
+    assert np.array_equal(np.asarray(out.gang_id), np.full(4, -1, np.int32))
+    assert np.array_equal(np.asarray(out.gang_size), np.zeros(4, np.int32))
+    del tensors["priority"]
+    with pytest.raises(TraceError, match="drift"):
+        pod_batch_from_record(tensors)

@@ -93,10 +93,13 @@ scenario-smoke:
 # end-to-end telemetry round trip on CPU: a sidecar with its own
 # /metrics + span files, a short sim-driven host run with spans + the
 # host exporter on, a sidecar-metrics scrape (device-step histograms
-# must be there), and the `spans merge` join — which exits non-zero
-# when host and sidecar span files share no trace ids (broken metadata
-# propagation). tests/test_bench_smoke.py wraps the same flow as a
-# slow-marked test.
+# must be there), the `spans merge` join — which exits non-zero when
+# host and sidecar span files share no trace ids (broken metadata
+# propagation) — and the analytics round trip: `spans report` over the
+# host spans, a self-diff that must exit 0, and a diff against a
+# synthetically slowed copy (perturb_spans, the test harness for the
+# gate) that must exit 1. tests/test_bench_smoke.py wraps the same
+# flow as a slow-marked test.
 OBS_SMOKE_DIR ?= /tmp/yoda-obs-smoke
 OBS_SMOKE_PORT ?= 50161
 OBS_SMOKE_METRICS_PORT ?= 9161
@@ -122,6 +125,16 @@ obs-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_scheduler_tpu spans merge \
 	  $(OBS_SMOKE_DIR)/host-spans $(OBS_SMOKE_DIR)/sidecar-spans \
 	  --out $(OBS_SMOKE_DIR)/merged.trace.json
+	$(PY) -m kubernetes_scheduler_tpu spans report \
+	  $(OBS_SMOKE_DIR)/host-spans > $(OBS_SMOKE_DIR)/report.json
+	$(PY) -m kubernetes_scheduler_tpu spans diff \
+	  $(OBS_SMOKE_DIR)/report.json $(OBS_SMOKE_DIR)/host-spans
+	$(PY) -c "from kubernetes_scheduler_tpu.trace.analyze import perturb_spans; \
+	  perturb_spans('$(OBS_SMOKE_DIR)/host-spans', \
+	  '$(OBS_SMOKE_DIR)/host-spans-slow', stage='engine_step', factor=4.0)"
+	$(PY) -m kubernetes_scheduler_tpu spans diff \
+	  $(OBS_SMOKE_DIR)/host-spans $(OBS_SMOKE_DIR)/host-spans-slow; \
+	  test $$? -eq 1  # exactly the regression exit — 2 (error) must fail
 
 native:
 	$(MAKE) -C native

@@ -347,7 +347,7 @@ def _resident_loop_rate() -> dict:
     )
 
 
-def _telemetry_loop_rate(pipelined: dict | None) -> dict:
+def _telemetry_loop_rate(pipelined: dict | None) -> tuple[dict, dict]:
     """The full-telemetry metric (host_loop_*_telemetry): the pipelined
     drain with per-cycle spans ON (config.span_path -> Chrome-trace
     files) and a /metrics exporter being scraped concurrently — the
@@ -355,7 +355,13 @@ def _telemetry_loop_rate(pipelined: dict | None) -> dict:
     pipelined baseline so the overhead is in-data. The acceptance gate
     (<5% drain-rate overhead with full telemetry on) reads
     telemetry_overhead_pct straight from the artifact; at smoke sizes
-    the ratio is reported, not asserted (~ms cycles drown in jitter)."""
+    the ratio is reported, not asserted (~ms cycles drown in jitter).
+
+    Returns (telemetry metric, attribution metric): the drain's own
+    span files are fed through trace/analyze.build_report before the
+    tempdir is dropped, so host_loop_*_attribution — the per-stage
+    cycle budget table, percentages summing to 100 by construction —
+    rides every bench round beside the drain rate."""
     import shutil
     import tempfile
 
@@ -380,7 +386,23 @@ def _telemetry_loop_rate(pipelined: dict | None) -> dict:
             out["telemetry_overhead_pct"] = round(
                 100.0 * (1.0 - out["pods_per_sec"] / base), 2
             )
-        return out
+        from kubernetes_scheduler_tpu.trace.analyze import build_report
+
+        rep = build_report(tmp)
+        attrib = {
+            "metric": f"host_loop_{n_nodes}nodes_attribution",
+            "cycles": rep["cycles"],
+            "cycle_p50_ms": rep["cycle_ms"]["p50_ms"],
+            "pods_per_sec": out["pods_per_sec"],
+            # per-stage share of cycle wall time (+ "other" residual),
+            # summing to ~100 — the budget table the sub-50ms-cycle
+            # ROADMAP item reads to pick the next bottleneck
+            "attribution_pct": rep["attribution_pct"],
+            "stage_p50_ms": {
+                name: s["p50_ms"] for name, s in rep["stages"].items()
+            },
+        }
+        return out, attrib
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 
@@ -780,7 +802,9 @@ def main():
         print(json.dumps(pipe))
         print(json.dumps(_resident_loop_rate()))
         print(json.dumps(_replay_loop_rate()))
-        print(json.dumps(_telemetry_loop_rate(pipe)))
+        tel, attrib = _telemetry_loop_rate(pipe)
+        print(json.dumps(tel))
+        print(json.dumps(attrib))
         print(json.dumps(_scenario_rate("burst", "burst")))
         print(json.dumps(_scenario_rate("gang-mix", "gang")))
         return
@@ -847,8 +871,11 @@ def main():
         # captured workload + bitwise binding parity (binding_diffs=0)
         print(json.dumps(_replay_loop_rate()), flush=True)
         # full telemetry on (spans + scraped exporter) beside the
-        # pipelined baseline: the <5%-overhead observability gate
-        print(json.dumps(_telemetry_loop_rate(pipe)), flush=True)
+        # pipelined baseline: the <5%-overhead observability gate, and
+        # the per-stage cycle budget table over the same drain's spans
+        tel, attrib = _telemetry_loop_rate(pipe)
+        print(json.dumps(tel), flush=True)
+        print(json.dumps(attrib), flush=True)
         # scenario harness (sim/scenarios) beside the pipelined
         # baseline: the burst program (time-varying arrivals) and the
         # gang-heavy mix (all-or-nothing admit rate)

@@ -8,6 +8,7 @@ from kubernetes_scheduler_tpu.analysis.rules import (
     metric_hygiene,
     pallas_vmem,
     sim_determinism,
+    span_hygiene,
     timeout_hygiene,
     wire_schema,
 )
@@ -22,4 +23,5 @@ RULES = {
     pallas_vmem.RULE: pallas_vmem.check,
     metric_hygiene.RULE: metric_hygiene.check,
     sim_determinism.RULE: sim_determinism.check,
+    span_hygiene.RULE: span_hygiene.check,
 }

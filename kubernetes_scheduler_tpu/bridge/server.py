@@ -90,6 +90,7 @@ class EngineService:
         resident_state: bool = True,
         span_path: str | None = None,
         profile_path: str | None = None,
+        step_slo_ms: float = 0.0,
     ):
         # serve a custom engine (e.g. models.learned.LearnedEngine) on
         # the dense branch instead of the module-level heuristic engine;
@@ -166,6 +167,17 @@ class EngineService:
             "gang_pods_masked_total",
             "Tentative placements rescinded on device by the gang "
             "all-or-nothing rule (ops/gang.py)",
+        )
+        # sidecar-side SLO watchdog (--step-slo-ms): the host's
+        # cycle_slo_ms detector cannot tell a slow device step from a
+        # slow host stage; this counter is the device half, so
+        # slo_breaches_total exists on BOTH exporters and an alert can
+        # attribute a breach to the right side of the bridge. 0 = off.
+        self.step_slo_ms = float(step_slo_ms)
+        self.metrics_slo = observe.Counter(
+            "slo_breaches_total",
+            "Device steps that blew the configured --step-slo-ms budget",
+            labels=("rpc",),
         )
         # server-side spans (trace/spans.py): opened under the trace id
         # the host shipped as gRPC metadata, so `spans merge` joins the
@@ -287,22 +299,31 @@ class EngineService:
             self.metrics_resident,
             self.metrics_sessions,
             self.metrics_gang_masked,
+            self.metrics_slo,
         ]
         out = []
         for c in collectors:
             out.extend(c.render())
         return "\n".join(out) + "\n"
 
-    def _finish_call(self, rpc: str, dt: float, seq: int, ss, marks) -> None:
+    def _finish_call(self, rpc: str, dt: float, tid: int, seq: int, ss) -> None:
         """Per-RPC telemetry epilogue, OFF the device section: histogram
-        + counter feeds and the span flush (deserialize, device step,
-        serialize — plus delta_apply when _resident_snapshot recorded
-        one into `ss` mid-call)."""
+        + counter feeds, the step-SLO watchdog, and the span flush (the
+        handler added its stage spans — deserialize, device_step,
+        serialize, plus delta_apply from _resident_snapshot — before
+        calling here; the names are a registry-pinned contract, see
+        observe.SHIPPED_SPANS)."""
         self.metrics_step.observe(dt, rpc=rpc)
         self.metrics_rpcs.inc(rpc=rpc)
+        if self.step_slo_ms > 0 and dt * 1e3 > self.step_slo_ms:
+            self.metrics_slo.inc(rpc=rpc)
+            log.warning(
+                "SLO breach: %s device step took %.1f ms (budget %.1f "
+                "ms) trace_id=%s journal_seq=%s",
+                rpc, dt * 1e3, self.step_slo_ms,
+                tid if tid > 0 else "-", seq if seq >= 0 else "-",
+            )
         if ss is not None:
-            for name, t0, t1 in marks:
-                ss.add(name, t0, t1, rpc=rpc)
             self.spans.flush(ss, seq=seq if seq >= 0 else None)
 
     def _resident_snapshot(self, request, context, snap_cache, ss=None):
@@ -495,14 +516,11 @@ class EngineService:
         reply = pb.ScheduleReply(engine_seconds=dt)
         only = set(_DECISION_FIELDS) if request.decisions_only else None
         codec.pack_fields(res, reply.result, only=only)
-        self._finish_call(
-            "schedule_batch", dt, seq, ss,
-            (
-                ("deserialize", t_des, t0),
-                ("device_step", t0, t1),
-                ("serialize", t1, time.perf_counter()),
-            ),
-        )
+        if ss is not None:
+            ss.add("deserialize", t_des, t0, rpc="schedule_batch")
+            ss.add("device_step", t0, t1, rpc="schedule_batch")
+            ss.add("serialize", t1, time.perf_counter(), rpc="schedule_batch")
+        self._finish_call("schedule_batch", dt, tid, seq, ss)
         return reply
 
     def schedule_windows(
@@ -586,14 +604,13 @@ class EngineService:
             self.metrics_gang_masked.inc(masked)
         reply = pb.ScheduleReply(engine_seconds=dt)
         codec.pack_fields(res, reply.result)
-        self._finish_call(
-            "schedule_windows", dt, seq, ss,
-            (
-                ("deserialize", t_des, t0),
-                ("device_step", t0, t1),
-                ("serialize", t1, time.perf_counter()),
-            ),
-        )
+        if ss is not None:
+            ss.add("deserialize", t_des, t0, rpc="schedule_windows")
+            ss.add("device_step", t0, t1, rpc="schedule_windows")
+            ss.add(
+                "serialize", t1, time.perf_counter(), rpc="schedule_windows"
+            )
+        self._finish_call("schedule_windows", dt, tid, seq, ss)
         return reply
 
     def preempt(self, request: pb.ScheduleRequest, context) -> pb.ScheduleReply:
@@ -658,6 +675,7 @@ def make_server(
     max_workers: int = 2,
     span_path: str | None = None,
     profile_path: str | None = None,
+    step_slo_ms: float = 0.0,
 ) -> tuple[grpc.Server, int, EngineService]:
     """Build (server, bound_port, service). Device access stays
     single-writer regardless of max_workers (EngineService._device_lock
@@ -673,6 +691,7 @@ def make_server(
         sharded_windows_fn_soft=sharded_windows_fn_soft,
         span_path=span_path,
         profile_path=profile_path,
+        step_slo_ms=step_slo_ms,
     )
     handlers = grpc.method_handlers_generic_handler(
         SERVICE,
@@ -794,6 +813,12 @@ def main(argv=None):
         "--profile-path", default=None,
         help="where on-demand /debug/profile jax.profiler dumps land "
         "(default: a tempdir)",
+    )
+    parser.add_argument(
+        "--step-slo-ms", type=float, default=0.0,
+        help="device-step SLO budget in ms: steps slower than this bump "
+        "slo_breaches_total{rpc} on the sidecar's /metrics and log the "
+        "offending trace id (0 = off)",
     )
     args = parser.parse_args(argv)
 
@@ -946,6 +971,7 @@ def main(argv=None):
         sharded_windows_fn_soft=sharded_windows_fn_soft,
         span_path=args.span_path,
         profile_path=args.profile_path,
+        step_slo_ms=args.step_slo_ms,
     )
     exporter = None
     if args.metrics_port:

@@ -336,6 +336,8 @@ def cmd_sidecar(args) -> int:
         argv += ["--span-path", args.span_path]
     if args.profile_path:
         argv += ["--profile-path", args.profile_path]
+    if args.step_slo_ms:
+        argv += ["--step-slo-ms", str(args.step_slo_ms)]
     if args.mesh_devices:
         argv += ["--mesh-devices", str(args.mesh_devices)]
         argv += ["--assigner", args.assigner]
@@ -396,6 +398,7 @@ def cmd_trace(args) -> int:
             mode=args.mode,
             resident=args.resident,
             record_path=args.out,
+            span_path=args.span_path,
         )
     finally:
         if engine is not None:
@@ -434,6 +437,7 @@ def cmd_scenario(args) -> int:
         intensity=args.intensity,
         seed=args.seed,
         trace_path=args.trace_path,
+        span_path=args.span_path,
         config=cfg,
     )
     print(json.dumps(summary))
@@ -441,12 +445,62 @@ def cmd_scenario(args) -> int:
 
 
 def cmd_spans(args) -> int:
-    """Span-timeline tooling: merge joins a host span directory and a
-    sidecar span directory on the shared trace ids into ONE
-    Perfetto-loadable Chrome trace; non-zero exit when the two sides
-    share no ids (broken metadata propagation — the join is the point)."""
+    """Span-timeline tooling: `merge` joins host + sidecar span
+    directories on the shared trace ids into ONE Perfetto-loadable
+    Chrome trace (non-zero exit when the two sides share no ids —
+    broken metadata propagation); `report` turns a span source into
+    per-stage percentiles + the cycle budget attribution table
+    (trace/analyze.py); `diff` compares two sources with per-stage
+    relative thresholds and exits non-zero on any regression — the
+    CI-able perf gate."""
     from kubernetes_scheduler_tpu.trace import spans as tspans
 
+    if args.spans_cmd == "report":
+        from kubernetes_scheduler_tpu.trace.analyze import (
+            AnalyzeError,
+            build_report,
+        )
+
+        try:
+            report = build_report(args.source)
+        except AnalyzeError as e:
+            print(json.dumps({"error": str(e)}))
+            return 1
+        print(json.dumps(report))
+        return 0
+    if args.spans_cmd == "diff":
+        from kubernetes_scheduler_tpu.trace.analyze import (
+            AnalyzeError,
+            diff_reports,
+            load_report,
+        )
+
+        stage_thresholds = {}
+        for spec in args.stage_threshold or ():
+            stage, _, pct = spec.partition("=")
+            try:
+                stage_thresholds[stage] = float(pct)
+            except ValueError:
+                pct = None
+            if not stage or pct is None:
+                print(json.dumps(
+                    {"error": f"--stage-threshold {spec!r}: want stage=pct"}
+                ))
+                return 2
+        try:
+            report = diff_reports(
+                load_report(args.baseline),
+                load_report(args.candidate),
+                threshold_pct=args.threshold_pct,
+                min_ms=args.min_ms,
+                stage_thresholds=stage_thresholds,
+            )
+        except AnalyzeError as e:
+            print(json.dumps({"error": str(e)}))
+            return 2
+        print(json.dumps(report))
+        return 0 if report["clean"] else 1
+    # merge
     report = tspans.merge_spans(args.host, args.sidecar, args.out)
     print(json.dumps(report))
     if report["merged_events"] == 0:
@@ -548,6 +602,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--profile-path", dest="profile_path", default=None,
         help="where /debug/profile jax.profiler dumps land",
     )
+    pc.add_argument(
+        "--step-slo-ms", dest="step_slo_ms", type=float, default=0.0,
+        help="device-step SLO: steps slower than this bump "
+        "slo_breaches_total{rpc} on the sidecar /metrics (0 = off)",
+    )
     pc.add_argument("--mesh-devices", type=int, default=0)
     pc.add_argument(
         "--assigner", default="greedy", choices=["greedy", "auction"],
@@ -608,6 +667,14 @@ def build_parser() -> argparse.ArgumentParser:
         default=None,
         help="re-record the replayed cycles as a new journal here",
     )
+    tr.add_argument(
+        "--spans",
+        dest="span_path",
+        default=None,
+        help="re-emit every replayed cycle as Chrome-trace spans under "
+        "this directory (post-hoc attribution for a telemetry-off "
+        "journal; analyze with `spans report`/`spans diff`)",
+    )
     pt.set_defaults(fn=cmd_trace)
 
     pz = sub.add_parser(
@@ -634,6 +701,12 @@ def build_parser() -> argparse.ArgumentParser:
         "(replay-pin with `yoda-tpu trace replay`)",
     )
     zr.add_argument(
+        "--spans", dest="span_path", default=None,
+        help="emit per-cycle span timelines under this directory "
+        "(adversarial programs produce attribution data: analyze with "
+        "`yoda-tpu spans report`)",
+    )
+    zr.add_argument(
         "--pipeline", action="store_true",
         help="drive the pipelined host loop (pipeline_depth=1)",
     )
@@ -648,7 +721,9 @@ def build_parser() -> argparse.ArgumentParser:
     zr.set_defaults(fn=cmd_scenario)
 
     pn = sub.add_parser(
-        "spans", help="span timelines: merge host + sidecar span files"
+        "spans",
+        help="span timelines: merge host + sidecar files, per-stage "
+        "budget reports, regression diffs",
     )
     nsub = pn.add_subparsers(dest="spans_cmd", required=True)
     nm = nsub.add_parser(
@@ -660,6 +735,37 @@ def build_parser() -> argparse.ArgumentParser:
     nm.add_argument("host", help="host span directory (--spans)")
     nm.add_argument("sidecar", help="sidecar span directory (--span-path)")
     nm.add_argument("--out", required=True, help="merged trace JSON path")
+    nr = nsub.add_parser(
+        "report",
+        help="per-stage p50/p95/p99 + the cycle budget attribution "
+        "table from a span directory, a merged trace, or one span file "
+        "(exit 1 when there is nothing to report on)",
+    )
+    nr.add_argument(
+        "source", help="span directory / merged trace JSON / span file"
+    )
+    nd = nsub.add_parser(
+        "diff",
+        help="compare two span sources (or saved reports) per stage; "
+        "exit 1 on any p50 regression over the thresholds — the "
+        "CI-able perf gate",
+    )
+    nd.add_argument("baseline", help="span dir / merged trace / report JSON")
+    nd.add_argument("candidate", help="span dir / merged trace / report JSON")
+    nd.add_argument(
+        "--threshold-pct", type=float, default=25.0,
+        help="default per-stage relative p50 regression threshold",
+    )
+    nd.add_argument(
+        "--min-ms", type=float, default=0.05,
+        help="absolute p50 growth floor below which a stage never "
+        "regresses (sub-tick jitter must not fail builds)",
+    )
+    nd.add_argument(
+        "--stage-threshold", action="append", metavar="STAGE=PCT",
+        help="per-stage threshold override (repeatable), e.g. "
+        "engine_step=10; use stage name `cycle` for the whole-cycle row",
+    )
     pn.set_defaults(fn=cmd_spans)
 
     pf = sub.add_parser("config", help="print effective config")

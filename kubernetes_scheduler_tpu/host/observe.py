@@ -238,6 +238,9 @@ SHIPPED_METRICS = (
     "cycle_duration_seconds",
     "engine_step_duration_seconds",
     "snapshot_uploads_total",
+    # SLO watchdog (config.cycle_slo_ms; host labels by driver path,
+    # the sidecar's own breach counter labels by rpc)
+    "slo_breaches_total",
     # sidecar exporter (bridge/server.EngineService)
     "device_step_duration_seconds",
     "rpcs_served_total",
@@ -393,6 +396,35 @@ class Gauge:
 
 
 # ---- per-cycle spans (Chrome trace events, merged across the bridge) ------
+
+
+# every span (stage) name this package has EVER emitted, pinned: span
+# names are a CONTRACT now — `spans report`'s attribution tables,
+# `spans diff`'s regression gate, and Perfetto bookmarks all reference
+# stages by name, so a shipped name is never removed and a new stage is
+# registered consciously. graftlint's `span-hygiene` family checks this
+# registry both ways against the names the code actually emits
+# (Scheduler._span / SpanSet.add call sites).
+SHIPPED_SPANS = (
+    # host cycle stages (host/scheduler.py, both drivers)
+    "queue_pop",
+    "state_fetch",
+    "snapshot_build",
+    "delta_derive",
+    "engine_step",
+    "bind",
+    "recorder_write",
+    "host_overlap",
+    "scalar_cycle",
+    "cycle",
+    # sidecar RPC stages (bridge/server.py), joined on trace id
+    "deserialize",
+    "delta_apply",
+    "device_step",
+    "serialize",
+    # post-hoc replay stages (trace/replay.py --spans)
+    "reconstruct",
+)
 
 
 class SpanSet:

@@ -429,9 +429,25 @@ class Scheduler:
             "Snapshot uploads to the engine (resident delta vs full)",
             labels=("upload",),
         )
+        self.ctr_slo = Counter(
+            "slo_breaches_total",
+            "Cycles that blew the configured cycle_slo_ms latency budget",
+            labels=("path",),
+        )
         self.prom_collectors = (
             self.hist_cycle, self.hist_engine, self.ctr_uploads,
+            self.ctr_slo,
         )
+        # SLO watchdog state (config.cycle_slo_ms): run totals, the last
+        # breach's identity (trace id + flight-recorder seq — the two
+        # handles that find the cycle in the span timeline and journal),
+        # and the self-arm window countdown (config.slo_profile_cycles):
+        # a breach storm arms the profiler once per window, not once per
+        # breach — re-arming every cycle would profile forever and keep
+        # resetting the dump the operator wants to read
+        self.slo_breaches = 0
+        self.last_slo_breach: dict | None = None
+        self._slo_profile_pending = 0
 
     def _cycle_path(self, m: CycleMetrics) -> str:
         """The histogram `path` label: which driver served the cycle."""
@@ -847,7 +863,66 @@ class Scheduler:
                 # cross-link rather than point at the wrong record (the
                 # sidecar's copy of the prediction cannot be retracted).
                 seq = None
+        # watchdog AFTER the recorder (it logs the seq the cycle was
+        # journaled under) and BEFORE the span flush (it reads the
+        # cycle's trace id off the still-open span set) — all of it on
+        # the completion stage, never the device-dispatch path
+        self._check_slo(m, seq)
         self._flush_spans(t0, m, seq=seq)
+
+    def _check_slo(self, m: CycleMetrics, seq: int | None) -> None:
+        """Live SLO watchdog (config.cycle_slo_ms): a cycle over budget
+        logs the handles that FIND it again — trace id (span timeline),
+        flight-recorder seq (journal record) — increments
+        slo_breaches_total{path}, and, with config.slo_profile_cycles
+        set, self-arms the jax.profiler hook for the next N engine calls
+        so the follow-up slow cycles leave a device-level profile dump
+        beside the spans. Pure observation: never touches a decision,
+        so watchdog-on/off bindings are bit-identical (PARITY.md)."""
+        slo = self.config.cycle_slo_ms
+        if slo <= 0 or m.pods_in == 0:
+            return
+        # the self-arm window drains one per watched cycle (~one engine
+        # call each), approximating "the armed dumps were taken"
+        if self._slo_profile_pending > 0:
+            self._slo_profile_pending -= 1
+        cycle_ms = m.cycle_seconds * 1e3
+        if cycle_ms <= slo:
+            return
+        path = self._cycle_path(m)
+        sp = self._cycle_span
+        trace_id = sp.trace_id if sp is not None else None
+        self.slo_breaches += 1
+        self.ctr_slo.inc(path=path)
+        armed = 0
+        if self.config.slo_profile_cycles > 0 and self._slo_profile_pending <= 0:
+            try:
+                report = self.arm_profile(self.config.slo_profile_cycles)
+                armed = int(report.get("armed", 0))
+            except Exception:
+                # the profiler is a bonus artifact; failing to arm it
+                # must not cost the breach record (or the cycle)
+                log.debug("slo: profile self-arm failed", exc_info=True)
+            if armed > 0:
+                self._slo_profile_pending = armed
+        self.last_slo_breach = {
+            "cycle_ms": round(cycle_ms, 3),
+            "slo_ms": slo,
+            "path": path,
+            "trace_id": trace_id,
+            "seq": seq,
+            "pods_in": m.pods_in,
+            "profile_armed": armed,
+        }
+        log.warning(
+            "SLO breach: cycle took %.1f ms (budget %.1f ms, path=%s, "
+            "pods_in=%d) trace_id=%s journal_seq=%s%s",
+            cycle_ms, slo, path, m.pods_in,
+            trace_id if trace_id is not None else "-",
+            seq if seq is not None else "-",
+            f"; armed profiler for next {armed} engine calls" if armed
+            else "",
+        )
 
     def _flush_spans(
         self, t0: float, m: CycleMetrics, seq: int | None = None

@@ -21,6 +21,7 @@ def run(
     intensity: float = 1.0,
     seed: int = 0,
     trace_path: str | None = None,
+    span_path: str | None = None,
     config=None,
 ) -> dict:
     """Instantiate and run a registered scenario by name."""
@@ -33,5 +34,6 @@ def run(
         cls(n_nodes=n_nodes, intensity=intensity),
         seed=seed,
         trace_path=trace_path,
+        span_path=span_path,
         config=config,
     )

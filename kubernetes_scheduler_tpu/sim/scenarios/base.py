@@ -177,19 +177,29 @@ def run_scenario(
     *,
     seed: int = 0,
     trace_path: str | None = None,
+    span_path: str | None = None,
     config: SchedulerConfig | None = None,
     max_cycles_per_tick: int = 64,
 ) -> dict:
     """Drive `scenario` through the host loop; returns the summary dict
     (one JSON-able line). With `trace_path`, every cycle lands in a
-    flight-recorder journal replay-pinnable via `trace replay`."""
+    flight-recorder journal replay-pinnable via `trace replay`; with
+    `span_path`, every cycle emits its span timeline too, so an
+    adversarial program produces attribution data (`spans report`) the
+    same way a production run does."""
     rng = np.random.default_rng(seed)
     nodes, utils = scenario.build_cluster(rng)
     cfg = config if config is not None else scenario_config()
-    if trace_path is not None and cfg.trace_path is None:
+    if (trace_path is not None and cfg.trace_path is None) or (
+        span_path is not None and cfg.span_path is None
+    ):
         import dataclasses
 
-        cfg = dataclasses.replace(cfg, trace_path=trace_path)
+        cfg = dataclasses.replace(
+            cfg,
+            trace_path=cfg.trace_path or trace_path,
+            span_path=cfg.span_path or span_path,
+        )
     clock = SimClock()
     world = ScenarioWorld(nodes=nodes, utils=utils, scheduler=None)
     sched = Scheduler(
@@ -250,4 +260,6 @@ def run_scenario(
     }
     if trace_path is not None:
         out["journal"] = trace_path
+    if span_path is not None:
+        out["spans"] = span_path
     return out

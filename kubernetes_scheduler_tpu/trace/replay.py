@@ -206,6 +206,7 @@ def replay_journal(
     resident: bool = False,
     limit: int | None = None,
     record_path: str | None = None,
+    span_path: str | None = None,
 ) -> ReplayReport:
     """Re-execute a journal and diff every replayed cycle's node_idx
     bitwise against the recording. `engine` defaults to a fresh
@@ -214,7 +215,18 @@ def replay_journal(
     resident=True drives the delta-upload surface with re-derived
     deltas. record_path re-records the replayed cycles as a new journal
     (same inputs, the REPLAYED decisions), so `trace diff` can compare
-    two replays record-for-record."""
+    two replays record-for-record.
+
+    span_path turns the replay into a POST-HOC attribution run: every
+    journal record re-emits a span set (observe.SpanRecorder, process
+    "replay") — `reconstruct` (journal decode + delta fold),
+    `engine_step` (the replayed dispatch, resident delta re-derivation
+    included), and one `cycle` span per record, each carrying the
+    SOURCE record's flight-recorder seq. A journal captured with
+    telemetry off becomes a Perfetto-loadable timeline after the fact,
+    and the same journal replayed through different engine/driver
+    combinations becomes a deterministic A/B measurement harness
+    (`spans report`/`spans diff` over the per-variant directories)."""
     if mode not in MODES:
         raise ValueError(f"unknown replay mode {mode!r}; expected {MODES}")
     if engine is None:
@@ -233,13 +245,30 @@ def replay_journal(
         out_rec = CycleRecorder(
             record_path, file_bytes=256 << 20, max_bytes=1 << 60
         )
+    spans = None
+    if span_path is not None:
+        from kubernetes_scheduler_tpu.host.observe import SpanRecorder
+
+        spans = SpanRecorder(span_path, process="replay")
     report = ReplayReport()
     state: dict = {}
     t0 = time.perf_counter()
+    it = reconstruct_cycles(path)
     try:
-        for rec, snapshot in reconstruct_cycles(path):
+        while True:
             if limit is not None and report.cycles >= limit:
                 break
+            t_cycle = time.perf_counter()
+            # the reconstruction cost (journal decode + delta fold)
+            # lives inside the generator's next() — timed around it so
+            # the replay timeline attributes it as its own stage
+            try:
+                rec, snapshot = next(it)
+            except StopIteration:
+                break
+            ss = spans.begin() if spans is not None else None
+            if ss is not None:
+                ss.add("reconstruct", t_cycle, time.perf_counter())
             report.cycles += 1
             recorded_idx = np.asarray(
                 (rec.get("assign") or {}).get("node_idx", np.zeros(0, np.int32))
@@ -263,9 +292,19 @@ def replay_journal(
                         node_idx=recorded_idx if recorded_idx.size else None,
                         seq=rec.get("seq"),
                     )
+                if ss is not None:
+                    # skipped cycles still own a timeline slot: the
+                    # span count must match the journal's cycle count,
+                    # and a scalar cycle's absence would read as a gap
+                    ss.add(
+                        "cycle", t_cycle, time.perf_counter(),
+                        path=rec.get("path", "scalar"), replayed=False,
+                    )
+                    spans.flush(ss, seq=rec.get("seq"))
                 continue
             pods = pod_batch_from_record(rec["pods"])
             kw = engine_kw_from_record(rec)
+            t_eng = time.perf_counter()
             if rec["path"] == "backlog":
                 bw = int(rec.get("batch_window") or 0)
                 if bw <= 0:
@@ -281,6 +320,11 @@ def replay_journal(
                 idx = _dispatch(
                     engine, snapshot, pods, kw,
                     mode=mode, resident=resident, state=state,
+                )
+            if ss is not None:
+                ss.add(
+                    "engine_step", t_eng, time.perf_counter(),
+                    backlog=rec["path"] == "backlog", resident=resident,
                 )
             n_real = len(pod_keys) if pod_keys else recorded_idx.shape[0]
             replay_idx = np.asarray(idx).reshape(-1)[:n_real].astype(np.int32)
@@ -324,8 +368,16 @@ def replay_journal(
                     fingerprint=rec.get("fingerprint"),
                     seq=rec.get("seq"),
                 )
+            if ss is not None:
+                ss.add(
+                    "cycle", t_cycle, time.perf_counter(),
+                    path=rec["path"], replayed=True,
+                )
+                spans.flush(ss, seq=rec.get("seq"))
     finally:
         if out_rec is not None:
             out_rec.close()
+        if spans is not None:
+            spans.close()
     report.seconds = time.perf_counter() - t0
     return report

@@ -207,6 +207,19 @@ class SchedulerConfig:
     # /metrics bind host (host/observe exporters): the deploy manifests
     # bind all interfaces for the Prometheus scrape; tests bind loopback
     metrics_bind_host: str = "0.0.0.0"
+    # live SLO watchdog (host/scheduler._check_slo, run from the cycle
+    # completion stage — never the dispatch path): a cycle slower than
+    # cycle_slo_ms logs its trace id + flight-recorder seq and bumps
+    # slo_breaches_total{path} on /metrics, so a slow production cycle
+    # leaves an addressable record instead of a vague p99 drift. With
+    # slo_profile_cycles > 0 a breach also self-arms the on-demand
+    # jax.profiler hook (the /debug/profile machinery) for the next N
+    # engine calls — the next slow cycle leaves a journal seq, a span
+    # timeline, AND a profile dump that `spans report` joins into one
+    # story. 0 = watchdog off (zero cost); the watchdog only reads
+    # clocks, so watchdog-on/off bindings are bit-identical (PARITY.md).
+    cycle_slo_ms: float = 0.0
+    slo_profile_cycles: int = 0
     preemption: bool = True
     preemption_max_victims: int = 8
     # preemptors evaluated per pass, highest priority first: the

@@ -1,0 +1,34 @@
+"""Fixture: a well-formed span surface the rule stays quiet on."""
+
+import time
+
+
+class SpanSet:  # stand-in for observe.SpanSet
+    def add(self, name, t0, t1, **args):
+        pass
+
+    def span(self, name, **args):
+        pass
+
+
+class Driver:
+    def _span(self, name, t0, t1=None, **args):
+        pass
+
+    def cycle(self, ss: SpanSet):
+        t0 = time.perf_counter()
+        self._span("queue_pop", t0)
+        self._span("snapshot_build", t0, t0 + 1.0)
+        ss.add("engine_step", t0, t0 + 2.0, resident=False)
+        with ss.span("bind"):
+            pass
+        ss.add("cycle", t0, t0 + 3.0, path="serial")
+
+
+SHIPPED_SPANS = (
+    "queue_pop",
+    "snapshot_build",
+    "engine_step",
+    "bind",
+    "cycle",
+)

@@ -1,0 +1,44 @@
+"""Fixture: every span-hygiene failure mode, one emission site each."""
+
+import time
+
+
+class SpanSet:  # stand-in for observe.SpanSet
+    def add(self, name, t0, t1, **args):
+        pass
+
+    def span(self, name, **args):
+        pass
+
+
+class Driver:
+    def _span(self, name, t0, t1=None, **args):
+        pass
+
+    def cycle(self, ss: SpanSet):
+        t0 = time.perf_counter()
+        # fine on its own (registered below)
+        self._span("queue_pop", t0)
+        # emitted but missing from SHIPPED_SPANS — an unregistered stage
+        # the attribution table and dashboards never hear about
+        self._span("mystery_stage", t0)
+        ss.add("orphan_stage", t0, t0 + 1.0)
+        # not lower_snake_case — renamed stages silently drop out of
+        # every report keyed on the old name
+        with ss.span("Bind-Phase"):
+            pass
+        ss.add("cycle", t0, t0 + 3.0, path="serial")
+        # ordinary set.add / two-arg adds must NOT match the pattern
+        seen = set()
+        seen.add("not_a_span")
+
+
+SHIPPED_SPANS = (
+    "queue_pop",
+    "cycle",
+    # registered twice
+    "cycle",
+    # shipped once, no longer emitted anywhere — the removal the rule
+    # exists to catch
+    "removed_stage",
+)

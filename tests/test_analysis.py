@@ -68,6 +68,12 @@ def active(violations):
             "sim_determinism_clean.py",
             6,
         ),
+        (
+            "span-hygiene",
+            "span_hygiene_violation.py",
+            "span_hygiene_clean.py",
+            5,
+        ),
     ],
 )
 def test_rule_fires_and_stays_quiet(rule, violating, clean, min_hits):
@@ -226,6 +232,42 @@ def test_shipped_registry_matches_help_table():
     from kubernetes_scheduler_tpu.host.observe import _HELP, SHIPPED_METRICS
 
     assert set(_HELP) <= set(SHIPPED_METRICS)
+
+
+def test_span_hygiene_covers_every_failure_mode():
+    """Each span-hygiene failure mode fires with a message naming the
+    stage — and the REAL span surfaces (Scheduler._span call sites, the
+    sidecar's SpanSet.add sites, the replay emitter) lint clean against
+    observe.SHIPPED_SPANS across the package (what `make lint`
+    enforces)."""
+    msgs = [
+        v.message
+        for v in active(
+            lint_fixture("span_hygiene_violation.py", "span-hygiene")
+        )
+    ]
+    assert any("`mystery_stage` is not registered" in m for m in msgs)
+    assert any("`orphan_stage` is not registered" in m for m in msgs)
+    assert any("'Bind-Phase' is not lower_snake_case" in m for m in msgs)
+    assert any("`cycle` registered twice" in m for m in msgs)
+    assert any(
+        "`removed_stage` is no longer emitted" in m for m in msgs
+    )
+    assert active(run_lint(rules=["span-hygiene"])) == []
+
+
+def test_shipped_spans_cover_attribution_stages():
+    """The analytics layer's attribution table only names registered
+    stages (a table row over an unshipped name could never fill)."""
+    from kubernetes_scheduler_tpu.host.observe import SHIPPED_SPANS
+    from kubernetes_scheduler_tpu.trace.analyze import (
+        ATTRIBUTION_STAGES,
+        NON_ATTRIBUTED_STAGES,
+    )
+
+    assert set(ATTRIBUTION_STAGES) <= set(SHIPPED_SPANS)
+    assert set(NON_ATTRIBUTED_STAGES) <= set(SHIPPED_SPANS)
+    assert "cycle" in SHIPPED_SPANS
 
 
 def test_real_schedule_proto_parses():

@@ -1,0 +1,507 @@
+"""Span analytics + SLO watchdog: golden `spans report`/`spans diff`
+runs over synthetic span files (torn tails and host-only directories
+included), the diff CLI's exit-code gate, post-hoc `trace replay
+--spans` timelines, and the cycle_slo_ms watchdog in both drivers
+(breach records, counter series, profiler self-arm, and on/off binding
+parity)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from kubernetes_scheduler_tpu.trace.analyze import (
+    AnalyzeError,
+    build_report,
+    diff_reports,
+    load_report,
+    perturb_spans,
+)
+
+# ---- synthetic span files --------------------------------------------------
+
+
+def write_span_dir(path, cycles, process="host"):
+    """One span file in the writer's crash-tolerant format: `[` header,
+    one comma-terminated event per line, no closing bracket. `cycles`
+    is a list of {trace_id, seq, path, cycle_ms, stages: {name: ms}}."""
+    os.makedirs(path, exist_ok=True)
+    events = [
+        {
+            "ph": "M", "name": "process_name", "pid": 1, "tid": 0,
+            "args": {"name": process},
+        }
+    ]
+    ts = 0.0
+    for c in cycles:
+        t = ts
+        args = {"trace_id": c["trace_id"], "seq": c.get("seq", 0)}
+        for name, dur_ms in c["stages"].items():
+            events.append(
+                {
+                    "name": name, "ph": "X", "cat": process, "ts": t,
+                    "dur": dur_ms * 1e3, "pid": 1, "tid": 0, "args": args,
+                }
+            )
+            t += dur_ms * 1e3
+        events.append(
+            {
+                "name": "cycle", "ph": "X", "cat": process, "ts": ts,
+                "dur": c["cycle_ms"] * 1e3, "pid": 1, "tid": 0,
+                "args": {**args, "path": c.get("path", "serial")},
+            }
+        )
+        ts += c["cycle_ms"] * 1e3
+    fp = os.path.join(path, "spans-00000000.trace.json")
+    with open(fp, "w", encoding="utf-8") as f:
+        f.write("[\n")
+        for ev in events:
+            f.write(json.dumps(ev, separators=(",", ":")) + ",\n")
+    return fp
+
+
+def golden_cycles(n=4, engine_ms=7.0):
+    return [
+        {
+            "trace_id": i + 1,
+            "seq": 10 + i,
+            "path": "pipelined" if i % 2 else "serial",
+            "cycle_ms": 2.0 + engine_ms + 1.0,
+            "stages": {
+                "queue_pop": 1.0,
+                "snapshot_build": 1.0,
+                "engine_step": engine_ms,
+                "bind": 1.0,
+            },
+        }
+        for i in range(n)
+    ]
+
+
+# ---- spans report ----------------------------------------------------------
+
+
+def test_report_golden_attribution(tmp_path):
+    d = str(tmp_path / "spans")
+    write_span_dir(d, golden_cycles())
+    rep = build_report(d)
+    assert rep["cycles"] == 4
+    assert rep["cycle_ms"]["p50_ms"] == 10.0
+    # per-stage percentiles over known durations
+    assert rep["stages"]["engine_step"]["p50_ms"] == 7.0
+    assert rep["stages"]["queue_pop"]["p50_ms"] == 1.0
+    # the budget table: stage totals / cycle total, residual as "other",
+    # summing to 100 by construction
+    att = rep["attribution_pct"]
+    assert att["engine_step"] == 70.0
+    assert att["queue_pop"] == 10.0
+    assert att["snapshot_build"] == 10.0
+    assert att["bind"] == 10.0
+    assert att["other"] == 0.0
+    assert abs(sum(att.values()) - 100.0) < 1e-6
+    # keyed by path label and flight-recorder seq range
+    assert rep["by_path"]["serial"]["count"] == 2
+    assert rep["by_path"]["pipelined"]["count"] == 2
+    assert rep["seq"] == {"first": 10, "last": 13, "cycles_with_seq": 4}
+
+
+def test_report_crash_truncated_file(tmp_path):
+    """A torn tail (crashed writer) costs at most the last line; the
+    report covers everything before it."""
+    d = str(tmp_path / "spans")
+    fp = write_span_dir(d, golden_cycles(n=3))
+    with open(fp, "a", encoding="utf-8") as f:
+        f.write('{"name": "engine_step", "ph": "X", "ts"')
+    rep = build_report(d)
+    assert rep["cycles"] == 3
+    assert rep["stages"]["engine_step"]["count"] == 3
+
+
+def test_report_host_only_dir(tmp_path):
+    """A local-engine run has no sidecar spans: the report carries only
+    host stages and the attribution table still closes at 100."""
+    d = str(tmp_path / "spans")
+    write_span_dir(d, golden_cycles())
+    rep = build_report(d)
+    assert "device_step" not in rep["stages"]
+    assert abs(sum(rep["attribution_pct"].values()) - 100.0) < 0.1
+
+
+def test_report_merged_trace_and_saved_report(tmp_path):
+    """`spans report` accepts a merged Chrome trace; `spans diff`
+    accepts a saved report JSON (load_report passes it through)."""
+    d = str(tmp_path / "spans")
+    write_span_dir(d, golden_cycles())
+    from kubernetes_scheduler_tpu.trace.spans import read_spans
+
+    merged = tmp_path / "merged.trace.json"
+    merged.write_text(json.dumps({"traceEvents": read_spans(d)}))
+    rep = build_report(str(merged))
+    assert rep["cycles"] == 4
+    saved = tmp_path / "report.json"
+    saved.write_text(json.dumps(rep))
+    assert load_report(str(saved))["cycles"] == 4
+
+
+def test_report_empty_inputs_fail_loudly(tmp_path):
+    with pytest.raises(AnalyzeError):
+        build_report(str(tmp_path / "nowhere"))
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    with pytest.raises(AnalyzeError):
+        build_report(str(empty))
+
+
+def test_report_cli_exit_codes(tmp_path, capsys):
+    from kubernetes_scheduler_tpu.cli import main
+
+    d = str(tmp_path / "spans")
+    write_span_dir(d, golden_cycles())
+    assert main(["spans", "report", d]) == 0
+    rep = json.loads(capsys.readouterr().out.splitlines()[-1])
+    assert rep["cycles"] == 4
+    assert main(["spans", "report", str(tmp_path / "missing")]) == 1
+
+
+# ---- spans diff ------------------------------------------------------------
+
+
+def test_diff_identical_is_clean(tmp_path):
+    d = str(tmp_path / "spans")
+    write_span_dir(d, golden_cycles())
+    diff = diff_reports(build_report(d), build_report(d))
+    assert diff["clean"] and diff["regressions"] == []
+    assert any(r["stage"] == "cycle" for r in diff["compared"])
+
+
+def test_diff_slowed_stage_trips_threshold(tmp_path):
+    """The acceptance shape: a synthetically slowed stage exits dirty
+    while the untouched stages stay clean."""
+    base = str(tmp_path / "base")
+    write_span_dir(base, golden_cycles())
+    slow = str(tmp_path / "slow")
+    touched = perturb_spans(base, slow, stage="engine_step", factor=2.0)
+    assert touched == 4
+    diff = diff_reports(build_report(base), build_report(slow))
+    assert not diff["clean"]
+    assert "engine_step" in diff["regressions"]
+    assert "cycle" in diff["regressions"]  # the cycle stretched too
+    assert "queue_pop" not in diff["regressions"]
+
+
+def test_diff_min_ms_floor_absorbs_micro_jitter(tmp_path):
+    """A 2x regression on a micro-stage under the absolute floor never
+    fails the gate (sub-tick jitter must not fail builds)."""
+    base = str(tmp_path / "base")
+    write_span_dir(
+        base,
+        [
+            {
+                "trace_id": 1, "seq": 1, "cycle_ms": 1.0,
+                "stages": {"queue_pop": 0.01, "engine_step": 0.9},
+            }
+        ],
+    )
+    slow = str(tmp_path / "slow")
+    perturb_spans(base, slow, stage="queue_pop", factor=2.0)
+    diff = diff_reports(
+        build_report(base), build_report(slow), min_ms=0.05
+    )
+    assert diff["clean"], diff
+
+
+def test_diff_per_stage_threshold_override(tmp_path):
+    base = str(tmp_path / "base")
+    write_span_dir(base, golden_cycles())
+    slow = str(tmp_path / "slow")
+    perturb_spans(base, slow, stage="bind", factor=1.2)  # +20%
+    b, c = build_report(base), build_report(slow)
+    assert diff_reports(b, c, threshold_pct=25.0)["clean"]
+    tightened = diff_reports(
+        b, c, threshold_pct=25.0, stage_thresholds={"bind": 10.0}
+    )
+    assert tightened["regressions"] == ["bind"]
+
+
+def test_diff_surfaces_candidate_only_stages(tmp_path):
+    """A stage only the candidate has (e.g. delta_derive when the
+    resident variant is the candidate) is surfaced as new_stages, not
+    silently invisible."""
+    base = str(tmp_path / "base")
+    write_span_dir(base, golden_cycles())
+    cand_cycles = golden_cycles()
+    for c in cand_cycles:
+        c["stages"]["delta_derive"] = 0.5
+    cand = str(tmp_path / "cand")
+    write_span_dir(cand, cand_cycles)
+    diff = diff_reports(build_report(base), build_report(cand))
+    assert diff["new_stages"] == ["delta_derive"]
+    # and the reverse direction lands in missing_stages
+    rev = diff_reports(build_report(cand), build_report(base))
+    assert rev["missing_stages"] == ["delta_derive"]
+
+
+def test_diff_cli_gate(tmp_path, capsys):
+    from kubernetes_scheduler_tpu.cli import main
+
+    base = str(tmp_path / "base")
+    write_span_dir(base, golden_cycles())
+    slow = str(tmp_path / "slow")
+    perturb_spans(base, slow, stage="engine_step", factor=2.0)
+    assert main(["spans", "diff", base, base]) == 0
+    capsys.readouterr()
+    assert main(["spans", "diff", base, slow]) == 1
+    out = json.loads(capsys.readouterr().out.splitlines()[-1])
+    assert "engine_step" in out["regressions"]
+    # per-stage override through the flag syntax
+    assert main(
+        ["spans", "diff", base, slow, "--stage-threshold",
+         "engine_step=1000", "--stage-threshold", "cycle=1000"]
+    ) == 0
+    capsys.readouterr()
+    # malformed specs exit 2 with the structured error (no traceback)
+    for bad in ("engine_step", "engine_step=ten", "=10"):
+        assert main(
+            ["spans", "diff", base, slow, "--stage-threshold", bad]
+        ) == 2
+        assert "want stage=pct" in json.loads(
+            capsys.readouterr().out.splitlines()[-1]
+        )["error"]
+
+
+# ---- trace replay --spans (post-hoc attribution) ---------------------------
+
+
+def _run_recorded(tmp_path, **cfg_kw):
+    from kubernetes_scheduler_tpu.host.scheduler import Scheduler
+    from kubernetes_scheduler_tpu.sim.host_gen import (
+        gen_host_cluster,
+        gen_host_pods,
+    )
+    from kubernetes_scheduler_tpu.utils.config import SchedulerConfig
+
+    nodes, advisor = gen_host_cluster(12, seed=0)
+    running: list = []
+    cfg = SchedulerConfig(
+        batch_window=16,
+        max_windows_per_cycle=1,
+        min_device_work=1,
+        adaptive_dispatch=False,
+        initial_backoff_seconds=3600.0,
+        max_backoff_seconds=3600.0,
+        **cfg_kw,
+    )
+    sched = Scheduler(
+        cfg,
+        advisor=advisor,
+        list_nodes=lambda: nodes,
+        list_running_pods=lambda: running,
+    )
+    for pod in gen_host_pods(48, seed=1):
+        sched.submit(pod)
+    sched.run_until_empty(max_cycles=16)
+    if sched.recorder is not None:
+        sched.recorder.close()
+    if sched.spans is not None:
+        sched.spans.close()
+    return sched
+
+
+def test_replay_spans_posthoc_timeline(tmp_path):
+    """A journal recorded with telemetry OFF replays into a
+    Perfetto-loadable timeline whose cycle-span count matches the
+    journal's cycle count, every span carrying its source record's
+    seq — the post-hoc attribution acceptance shape."""
+    from kubernetes_scheduler_tpu.trace.recorder import read_journal
+    from kubernetes_scheduler_tpu.trace.replay import replay_journal
+    from kubernetes_scheduler_tpu.trace.spans import read_spans
+
+    journal = str(tmp_path / "journal")
+    _run_recorded(tmp_path, trace_path=journal)  # span_path NOT set
+    span_dir = str(tmp_path / "replay-spans")
+    report = replay_journal(journal, span_path=span_dir)
+    assert report.binding_diffs == 0 and report.replayed > 0
+    records = list(read_journal(journal))
+    events = [ev for ev in read_spans(span_dir) if ev.get("ph") == "X"]
+    cycles = [ev for ev in events if ev["name"] == "cycle"]
+    assert len(cycles) == len(records)
+    assert {ev["args"]["seq"] for ev in cycles} == {
+        r["seq"] for r in records
+    }
+    stage_names = {ev["name"] for ev in events}
+    assert {"reconstruct", "engine_step", "cycle"} <= stage_names
+    # the re-emitted timeline feeds the analytics layer directly
+    rep = build_report(span_dir)
+    assert rep["cycles"] == len(records)
+    assert "engine_step" in rep["attribution_pct"]
+    # rows are rounded to 2 decimals, so the sum closes to ~100
+    assert abs(sum(rep["attribution_pct"].values()) - 100.0) < 0.1
+    # and the replay spans CLI round-trips with the same exit contract
+    from kubernetes_scheduler_tpu.cli import main
+
+    span_dir2 = str(tmp_path / "replay-spans-2")
+    assert main(["trace", "replay", journal, "--spans", span_dir2]) == 0
+    assert build_report(span_dir2)["cycles"] == len(records)
+
+
+# ---- SLO watchdog ----------------------------------------------------------
+
+
+@pytest.mark.parametrize("depth", [0, 1])
+def test_watchdog_breach_records_and_counter(tmp_path, depth):
+    sched = _run_recorded(
+        tmp_path / f"d{depth}",
+        pipeline_depth=depth,
+        trace_path=str(tmp_path / f"d{depth}-journal"),
+        span_path=str(tmp_path / f"d{depth}-spans"),
+        cycle_slo_ms=1e-6,  # every non-empty cycle breaches
+    )
+    assert sched.slo_breaches > 0
+    breach = sched.last_slo_breach
+    assert breach["path"] == ("pipelined" if depth else "serial")
+    # the two handles that FIND the cycle again: span trace id and
+    # flight-recorder seq
+    assert breach["trace_id"] is not None
+    assert breach["seq"] is not None
+    assert breach["cycle_ms"] > breach["slo_ms"]
+    text = "\n".join(sched.ctr_slo.render())
+    assert (
+        f'yoda_tpu_slo_breaches_total{{path="{breach["path"]}"}} '
+        f"{sched.slo_breaches}" in text
+    )
+
+
+def test_watchdog_off_by_default(tmp_path):
+    sched = _run_recorded(tmp_path)
+    assert sched.slo_breaches == 0
+    assert sched.last_slo_breach is None
+    assert "slo_breaches_total" in "\n".join(sched.ctr_slo.render())
+
+
+def test_watchdog_self_arms_profiler_once_per_window():
+    """A breach storm arms the profiler once per slo_profile_cycles
+    window — not once per breach — through the engine's own
+    arm_profile surface."""
+    from kubernetes_scheduler_tpu.host.advisor import NodeUtil, StaticAdvisor
+    from kubernetes_scheduler_tpu.host.scheduler import Scheduler
+    from kubernetes_scheduler_tpu.host.types import Node, Pod
+    from kubernetes_scheduler_tpu.utils.config import SchedulerConfig
+
+    class StubEngine:
+        """No-device engine: rejects every pod, records profile arms."""
+
+        def __init__(self):
+            self.arms = []
+
+        def schedule_batch(self, snapshot, pods, **kw):
+            import types
+
+            p = np.asarray(pods.request).shape[0]
+            return types.SimpleNamespace(node_idx=np.full(p, -1, np.int32))
+
+        def arm_profile(self, cycles, out_dir=None):
+            self.arms.append(int(cycles))
+            return {"armed": int(cycles), "out_dir": out_dir or "/tmp"}
+
+    engine = StubEngine()
+    nodes = [Node(name="n0", allocatable={"cpu": 4000.0})]
+    sched = Scheduler(
+        SchedulerConfig(
+            batch_window=4,
+            max_windows_per_cycle=1,
+            min_device_work=1,
+            adaptive_dispatch=False,
+            gang_scheduling=False,
+            preemption=False,
+            initial_backoff_seconds=0.0,
+            cycle_slo_ms=1e-6,
+            slo_profile_cycles=2,
+        ),
+        advisor=StaticAdvisor({"n0": NodeUtil()}),
+        list_nodes=lambda: nodes,
+        list_running_pods=lambda: [],
+        engine=engine,
+    )
+    for _ in range(4):
+        sched.submit(Pod(name="p", namespace="default"))
+        sched.run_cycle()
+    assert sched.slo_breaches == 4
+    # cycle 1 arms (pending=2); cycle 2 drains the window (pending=1);
+    # cycle 3 drains to 0 and re-arms; cycle 4 drains again
+    assert engine.arms == [2, 2]
+
+
+@pytest.mark.parametrize("depth", [0, 1])
+def test_watchdog_parity_bindings_bitidentical(tmp_path, depth):
+    """PARITY round 11: the watchdog only reads clocks — watchdog-on
+    and watchdog-off runs bind identically in both drivers."""
+
+    def run(slo):
+        sub = tmp_path / f"slo{int(slo * 10)}-{depth}"
+        sched = _run_recorded(
+            sub, pipeline_depth=depth, cycle_slo_ms=slo,
+            slo_profile_cycles=2,
+        )
+        return [
+            (b.pod.namespace, b.pod.name, b.node_name)
+            for b in sched.binder.bindings
+        ]
+
+    on = run(1e-6)
+    off = run(0.0)
+    assert on == off and on
+
+
+def test_watchdog_parity_gang_mix_scenario_e2e(tmp_path):
+    """The acceptance pin: watchdog-on vs watchdog-off journals of the
+    gang-mix scenario hold bit-identical decisions (trace/inspect.diff
+    compares path, window identity, and node_idx record by record)."""
+    import dataclasses
+
+    from kubernetes_scheduler_tpu.sim import scenarios
+    from kubernetes_scheduler_tpu.trace import inspect as tinspect
+
+    journals = {}
+    for tag, slo in (("on", 1e-6), ("off", 0.0)):
+        cfg = dataclasses.replace(
+            scenarios.scenario_config(), cycle_slo_ms=slo
+        )
+        journals[tag] = str(tmp_path / tag)
+        summary = scenarios.run(
+            "gang-mix", n_nodes=24, seed=0,
+            trace_path=journals[tag], config=cfg,
+        )
+        assert summary["pods_bound"] > 0
+    report = tinspect.diff(journals["on"], journals["off"])
+    assert report["differences"] == 0
+    assert report["extra_records_a"] == report["extra_records_b"] == 0
+
+
+def test_scenario_run_emits_spans(tmp_path):
+    """`scenario run --spans`: adversarial programs produce attribution
+    data — the span directory feeds `spans report` like any production
+    run's."""
+    from kubernetes_scheduler_tpu.sim import scenarios
+
+    span_dir = str(tmp_path / "spans")
+    summary = scenarios.run(
+        "burst", n_nodes=16, seed=0, span_path=span_dir
+    )
+    assert summary["pods_bound"] > 0
+    assert summary["spans"] == span_dir
+    rep = build_report(span_dir)
+    assert rep["cycles"] > 0
+    assert "engine_step" in rep["attribution_pct"]
+
+
+def test_sidecar_step_slo_counter():
+    """The sidecar half of the watchdog: a device step over
+    --step-slo-ms bumps slo_breaches_total{rpc} on its exporter."""
+    from kubernetes_scheduler_tpu.bridge.server import EngineService
+
+    svc = EngineService(step_slo_ms=0.5)
+    svc._finish_call("schedule_batch", 0.0001, 7, 3, None)  # under budget
+    svc._finish_call("schedule_batch", 0.9, 7, 3, None)     # breach
+    body = svc.render_metrics()
+    assert 'yoda_tpu_slo_breaches_total{rpc="schedule_batch"} 1' in body

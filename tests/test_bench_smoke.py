@@ -54,6 +54,7 @@ def test_bench_smoke_e2e():
         "host_loop_32nodes_resident",
         "host_loop_32nodes_replay",
         "host_loop_32nodes_telemetry",
+        "host_loop_32nodes_attribution",
         "scenario_burst_32nodes",
         "scenario_gang_32nodes",
     ):
@@ -98,6 +99,14 @@ def test_bench_smoke_e2e():
     assert tel["spans_dropped"] == 0, tel
     assert tel["metrics_scrapes"] > 0, tel
     assert "telemetry_overhead_pct" in tel, tel
+    # the attribution metric: per-stage cycle budget over the telemetry
+    # drain's own spans — the percentages (engine step, host stages,
+    # "other" residual) must close at ~100% of total cycle time
+    att = metrics["host_loop_32nodes_attribution"]
+    assert att["cycles"] > 0 and att["cycle_p50_ms"] > 0, att
+    assert "engine_step" in att["attribution_pct"], att
+    assert abs(sum(att["attribution_pct"].values()) - 100.0) < 0.5, att
+    assert att["stage_p50_ms"]["engine_step"] > 0, att
     # scenario-harness metrics: the burst program drained on the device
     # path; the gang mix reports the all-or-nothing admit rate
     for name in ("scenario_burst_32nodes", "scenario_gang_32nodes"):
@@ -215,6 +224,43 @@ def test_obs_smoke_e2e(tmp_path):
     assert report["host_events"] > 0 and report["sidecar_events"] > 0
     trace = json.load(open(merged))
     assert trace["traceEvents"], "merged timeline is empty"
+
+    # the analytics round trip (`make obs-smoke`'s report/diff tail):
+    # report over the run's own spans, a self-diff exiting 0, and a
+    # diff against a synthetically slowed copy exiting 1 — the span
+    # directory IS a working perf gate for the run that just happened
+    def spans_cli(*argv):
+        return subprocess.run(
+            [sys.executable, "-m", "kubernetes_scheduler_tpu", "spans",
+             *argv],
+            capture_output=True, text=True, timeout=120, cwd=REPO, env=env,
+        )
+
+    host_spans = str(tmp_path / "host-spans")
+    rep = spans_cli("report", host_spans)
+    assert rep.returncode == 0, rep.stderr[-2000:]
+    rep_json = json.loads(rep.stdout.splitlines()[-1])
+    assert rep_json["cycles"] > 0
+    assert "engine_step" in rep_json["attribution_pct"]
+    assert abs(sum(rep_json["attribution_pct"].values()) - 100.0) < 0.5
+    # the sidecar timeline reports too (device_step percentiles), and a
+    # merged trace is a valid report source
+    side_rep = spans_cli("report", merged)
+    assert side_rep.returncode == 0, side_rep.stderr[-2000:]
+    assert "device_step" in json.loads(
+        side_rep.stdout.splitlines()[-1]
+    )["stages"]
+    clean = spans_cli("diff", host_spans, host_spans)
+    assert clean.returncode == 0, clean.stdout[-500:]
+    from kubernetes_scheduler_tpu.trace.analyze import perturb_spans
+
+    slow = str(tmp_path / "host-spans-slow")
+    perturb_spans(host_spans, slow, stage="engine_step", factor=4.0)
+    dirty = spans_cli("diff", host_spans, slow)
+    assert dirty.returncode == 1, dirty.stdout[-500:]
+    assert "engine_step" in json.loads(
+        dirty.stdout.splitlines()[-1]
+    )["regressions"]
 
 
 def test_scenario_smoke_e2e(tmp_path):

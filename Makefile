@@ -5,7 +5,7 @@ PY ?= python
 IMAGE_REPO ?= registry.example.com/yoda-tpu
 TAG ?= latest
 
-.PHONY: local test test-fast bench trace-smoke obs-smoke scenario-smoke lint native native-asan native-tsan proto clean build push
+.PHONY: local test test-fast bench trace-smoke obs-smoke scenario-smoke perf-gate perf-baseline lint native native-asan native-tsan proto clean build push
 
 # "make local" in the reference = fmt + vet + compile. Here: byte-compile
 # the package, build the native library, lint, run the fast tests.
@@ -48,7 +48,7 @@ bench:
 bench-smoke:
 	env JAX_PLATFORMS=cpu BENCH_NODES=64 BENCH_PODS=128 BENCH_WINDOW=32 \
 	  BENCH_REPS=2 BENCH_BASELINE_PODS=8 BENCH_LOOP_NODES=32 \
-	  BENCH_LOOP_PODS=64 $(PY) bench.py
+	  BENCH_LOOP_PODS=64 BENCH_LOOP_SAMPLES=3 $(PY) bench.py
 
 # flight-recorder round trip on CPU: record a short sim-driven run (the
 # config pins the device path — tiny cycles would otherwise route to
@@ -135,6 +135,40 @@ obs-smoke:
 	$(PY) -m kubernetes_scheduler_tpu spans diff \
 	  $(OBS_SMOKE_DIR)/host-spans $(OBS_SMOKE_DIR)/host-spans-slow; \
 	  test $$? -eq 1  # exactly the regression exit — 2 (error) must fail
+
+# span-based perf regression gate: ONE telemetry-shaped pipelined drain
+# at smoke scale on CPU emits a fresh span directory, which `spans diff`
+# gates against the committed BENCH_SPAN_BASELINE.json with per-stage
+# thresholds. The floors are deliberately COARSE — a stage must grow by
+# >20 ms absolute AND >100%/the per-stage override. Every smoke-scale
+# stage p50 sits under ~6 ms, so a machine 3x slower than the baseline
+# machine (or the same one under load) cannot trip the gate, while the
+# regression class it exists for — an interpreter-mode Pallas kernel
+# sneaking onto the CPU host path (measured ~2x engine step, and 10x+
+# at interpret-unfriendly shapes), a serialization pass landing on the
+# dispatch path — blows through both floors. Regenerate the committed
+# baseline with `make perf-baseline` after an intentional stage-cost
+# change. tests/test_bench_smoke.py wraps the same flow as a
+# slow-marked test.
+PERF_GATE_DIR ?= /tmp/yoda-perf-gate
+PERF_GATE_ENV = env JAX_PLATFORMS=cpu BENCH_LOOP_NODES=32 BENCH_LOOP_PODS=64
+perf-gate:
+	rm -rf $(PERF_GATE_DIR)
+	mkdir -p $(PERF_GATE_DIR)
+	$(PERF_GATE_ENV) $(PY) bench.py --perf-gate-spans $(PERF_GATE_DIR)/spans
+	$(PY) -m kubernetes_scheduler_tpu spans diff \
+	  BENCH_SPAN_BASELINE.json $(PERF_GATE_DIR)/spans \
+	  --threshold-pct 100 --min-ms 20 \
+	  --stage-threshold engine_step=150 \
+	  --stage-threshold snapshot_build=150 \
+	  --stage-threshold cycle=150
+
+perf-baseline:
+	rm -rf $(PERF_GATE_DIR)
+	mkdir -p $(PERF_GATE_DIR)
+	$(PERF_GATE_ENV) $(PY) bench.py --perf-gate-spans $(PERF_GATE_DIR)/spans
+	$(PY) -m kubernetes_scheduler_tpu spans report $(PERF_GATE_DIR)/spans \
+	  > BENCH_SPAN_BASELINE.json
 
 native:
 	$(MAKE) -C native

@@ -347,6 +347,34 @@ def _resident_loop_rate() -> dict:
     )
 
 
+def _fused_loop_rate() -> dict:
+    """The fused-megakernel metric (host_loop_*_fused): the pipelined
+    single-window drain with the fused Pallas device step explicitly ON,
+    measured BESIDE an otherwise-identical unfused drain in the same
+    round — so the fused/unfused engine delta (the sub-50ms-cycle
+    tentpole's win) is visible in-data every round, not inferred from
+    cross-round comparisons. The headline fields are the FUSED drain's;
+    the unfused companion rides as unfused_* plus the p50 speedups."""
+    n_pods = int(os.environ.get("BENCH_LOOP_PODS", 1024 * DEFAULT_LOOP_WINDOWS))
+    kw = dict(
+        n_pods=n_pods, max_windows=1, pipeline_depth=1, force_device=True,
+    )
+    out = loop_rate(metric_suffix="_fused", fused_kernel=True, **kw)
+    unfused = loop_rate(
+        metric_suffix="_unfused_probe", fused_kernel=False, **kw
+    )
+    out["unfused_pods_per_sec"] = unfused["pods_per_sec"]
+    out["unfused_engine_p50_ms"] = unfused["engine_p50_ms"]
+    out["unfused_cycle_p50_ms"] = unfused["cycle_p50_ms"]
+    out["fused_engine_speedup"] = round(
+        unfused["engine_p50_ms"] / max(out["engine_p50_ms"], 1e-9), 3
+    )
+    out["fused_cycle_speedup"] = round(
+        unfused["cycle_p50_ms"] / max(out["cycle_p50_ms"], 1e-9), 3
+    )
+    return out
+
+
 def _telemetry_loop_rate(pipelined: dict | None) -> tuple[dict, dict]:
     """The full-telemetry metric (host_loop_*_telemetry): the pipelined
     drain with per-cycle spans ON (config.span_path -> Chrome-trace
@@ -512,6 +540,7 @@ def loop_rate(
     trace_path: str | None = None,
     span_path: str | None = None,
     scrape_metrics: bool = False,
+    fused_kernel: bool | None = None,
 ) -> dict:
     """END-TO-END host loop at the north-star scale: queue pop -> snapshot
     build -> device program -> binds, through host.Scheduler on a simulated
@@ -559,6 +588,17 @@ def loop_rate(
     # running pod, cold pod-side caches for newly arrived pods).
     nodes, advisor = gen_host_cluster(n_nodes, seed=0)
     running: list = []
+    extra = (
+        {"adaptive_dispatch": False, "min_device_work": 1}
+        if force_device
+        else {}
+    )
+    if fused_kernel is not None:
+        # the fused/unfused A-B knob (host_loop_*_fused): everything
+        # else identical, only the feature gate moves
+        from kubernetes_scheduler_tpu.utils.config import FeatureGates
+
+        extra["feature_gates"] = FeatureGates(fused_kernel=fused_kernel)
     sched = Scheduler(
         SchedulerConfig(
             batch_window=1024,
@@ -568,11 +608,7 @@ def loop_rate(
             resident_state=resident,
             trace_path=trace_path,
             span_path=span_path,
-            **(
-                {"adaptive_dispatch": False, "min_device_work": 1}
-                if force_device
-                else {}
-            ),
+            **extra,
         ),
         advisor=advisor,
         list_nodes=lambda: nodes,
@@ -633,8 +669,19 @@ def loop_rate(
         sched.recorder.seconds_spent if sched.recorder is not None else 0.0
     )
     cycles = []
-    for seed in (2, 3, 4):  # several samples: the tunnel's per-RPC
-        for pod in gen_host_pods(n_pods, seed=seed):  # latency is bimodal
+    # enough measured backlogs for a STABLE p50/p99: the single-dispatch
+    # shapes (serial 8-window, deep16w) drain one cycle per backlog, so
+    # the old fixed 3 samples left 3-cycle percentiles — meaningless
+    # order statistics the sub-50ms gate cannot be judged on. Target
+    # >= 10 cycles (BENCH_LOOP_SAMPLES overrides), floor 3 samples:
+    # the tunnel's per-RPC latency is bimodal either way.
+    window_cap = 1024 * max(1, max_windows)
+    cycles_per_drain = max(1, -(-n_pods // min(max(n_pods, 1), window_cap)))
+    samples = int(os.environ.get("BENCH_LOOP_SAMPLES", "0")) or max(
+        3, -(-10 // cycles_per_drain)
+    )
+    for seed in range(2, 2 + samples):
+        for pod in gen_host_pods(n_pods, seed=seed):
             sched.submit(pod)
         got, _ = drain()
         cycles.extend(got)
@@ -795,11 +842,37 @@ def main():
     from kubernetes_scheduler_tpu.sim import gen_cluster, gen_pods
 
     _backend_diag()
+    if "--perf-gate-spans" in sys.argv:
+        # `make perf-gate`: ONE telemetry-shaped pipelined drain whose
+        # span directory `spans diff` then gates against the committed
+        # BENCH_SPAN_BASELINE.json — a fusion regression in any stage
+        # (e.g. an interpreter-mode kernel sneaking onto the CPU path)
+        # fails the build loudly, per stage, with numbers attached
+        out_dir = sys.argv[sys.argv.index("--perf-gate-spans") + 1]
+        print(
+            json.dumps(
+                loop_rate(
+                    n_pods=int(
+                        os.environ.get(
+                            "BENCH_LOOP_PODS", 1024 * DEFAULT_LOOP_WINDOWS
+                        )
+                    ),
+                    max_windows=1,
+                    pipeline_depth=1,
+                    force_device=True,
+                    metric_suffix="_perfgate",
+                    span_path=out_dir,
+                )
+            ),
+            flush=True,
+        )
+        return
     if "--loop" in sys.argv:
         print(json.dumps(loop_rate()))
         print(json.dumps(loop_rate(max_windows=16, metric_suffix="_deep16w")))
         pipe = _pipelined_loop_rate()
         print(json.dumps(pipe))
+        print(json.dumps(_fused_loop_rate()))
         print(json.dumps(_resident_loop_rate()))
         print(json.dumps(_replay_loop_rate()))
         tel, attrib = _telemetry_loop_rate(pipe)
@@ -864,6 +937,9 @@ def main():
         # before/after for the pipelined host-loop change
         pipe = _pipelined_loop_rate()
         print(json.dumps(pipe), flush=True)
+        # fused megakernel vs unfused device step on the same drain
+        # shape: the per-round fused/unfused engine delta
+        print(json.dumps(_fused_loop_rate()), flush=True)
         # device-resident cluster state with epoch-validated delta
         # uploads, measured against the same cluster/backlog shape
         print(json.dumps(_resident_loop_rate()), flush=True)

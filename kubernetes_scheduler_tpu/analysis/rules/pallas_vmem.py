@@ -119,18 +119,41 @@ def _fn_bindings(fn: ast.AST, consts: dict[str, int]) -> dict[str, int]:
     return out
 
 
+def _resolve_expr(node: ast.AST, env: dict[str, int]) -> int | None:
+    """Resolve a dimension expression to an int where the AST proves it:
+    constants, bound names, and +/-/* arithmetic over resolvable
+    operands — the `4 * n_sel`-style stacked-row shapes the fused
+    megakernel's BlockSpecs use (a runtime operand anywhere makes the
+    whole dimension unresolvable, skipped not guessed)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return node.value
+    if isinstance(node, ast.Name):
+        return env.get(node.id)
+    if isinstance(node, ast.BinOp) and isinstance(
+        node.op, (ast.Add, ast.Sub, ast.Mult)
+    ):
+        left = _resolve_expr(node.left, env)
+        right = _resolve_expr(node.right, env)
+        if left is None or right is None:
+            return None
+        if isinstance(node.op, ast.Add):
+            return left + right
+        if isinstance(node.op, ast.Sub):
+            return left - right
+        return left * right
+    return None
+
+
 def _resolve_dims(shape: ast.AST, env: dict[str, int]) -> list[int | None]:
     if not isinstance(shape, ast.Tuple):
         return []
-    dims: list[int | None] = []
-    for el in shape.elts:
-        if isinstance(el, ast.Constant) and isinstance(el.value, int):
-            dims.append(el.value)
-        elif isinstance(el, ast.Name):
-            dims.append(env.get(el.id))
-        else:
-            dims.append(None)
-    return dims
+    # a non-positive resolution (a - b with a < b) is a wrong guess, not
+    # a provable dimension — treat it as unresolvable so it can never
+    # SUBTRACT from the VMEM total
+    return [
+        v if v is None or v > 0 else None
+        for v in (_resolve_expr(el, env) for el in shape.elts)
+    ]
 
 
 def _block_specs(call: ast.Call):

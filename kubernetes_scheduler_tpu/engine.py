@@ -591,6 +591,78 @@ def snapshot_nbytes(nt) -> int:
     return total
 
 
+class FusedLayout(NamedTuple):
+    """Device-resident KERNEL-LAYOUT node operands for the fused Pallas
+    megakernel: the transposed/padded/stacked buffers
+    ops.pallas_fused.prep_node_operands derives per call, retained
+    across resident cycles so a delta upload rewrites only the changed
+    columns instead of re-deriving the whole prep every step.
+
+    Built by build_fused_layout on a full resident upload and folded
+    forward by apply_layout_delta — both jitted, both writing the exact
+    float32 values the per-call prep would compute (same expressions on
+    the same row values), so resident-layout and re-pad cycles are
+    bitwise identical (PARITY round 12)."""
+
+    node_ft: jnp.ndarray  # [3, nn] rows = (u, v, node_mask) f32
+    alloc_t: jnp.ndarray  # [r, nn] allocatable, resource-major
+    reqd_t: jnp.ndarray   # [r, nn] requested, resource-major
+
+
+@jax.jit
+def build_fused_layout(snapshot: SnapshotArrays) -> FusedLayout:
+    """FusedLayout from a freshly-uploaded resident snapshot — ONE prep
+    per full upload; later delta cycles ship straight into the layout."""
+    from kubernetes_scheduler_tpu.ops.pallas_fused import prep_node_operands
+
+    stats = utilization_stats(
+        snapshot.disk_io, snapshot.cpu_pct, snapshot.node_mask
+    )
+    node_ft, alloc_t, reqd_t = prep_node_operands(
+        stats.u, stats.v, snapshot.node_mask,
+        snapshot.allocatable, snapshot.requested,
+    )
+    return FusedLayout(node_ft=node_ft, alloc_t=alloc_t, reqd_t=reqd_t)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def apply_layout_delta(layout: FusedLayout, delta: SnapshotDelta) -> FusedLayout:
+    """Fold a SnapshotDelta into the retained kernel-layout buffers in
+    place (donated, like apply_snapshot_delta): changed `requested` rows
+    become column writes into reqd_t, utilization rows become u/v cell
+    writes (the same divisor expressions utilization_stats applies, on
+    the same row values — bitwise what a re-prep would produce), and the
+    node-mask row is refreshed whole. `allocatable` never rides a delta,
+    so alloc_t passes through untouched."""
+    from kubernetes_scheduler_tpu.ops.stats import (
+        CPU_DIVISOR,
+        DISK_IO_DIVISOR,
+    )
+
+    n = delta.node_mask.shape[0]
+    nn = layout.node_ft.shape[1]
+    # the delta's padded row indices use sentinel `n` (the NODE axis
+    # length) — in range of these TILE-padded (nn >= n) buffers, so
+    # remap to nn for mode="drop" to actually drop them (a sentinel
+    # write would zero a padding column: benign today, silently wrong
+    # for any future non-zero-padded layout leaf)
+    util_rows = jnp.where(delta.util_rows >= n, jnp.int32(nn), delta.util_rows)
+    req_rows = jnp.where(delta.req_rows >= n, jnp.int32(nn), delta.req_rows)
+    node_ft = layout.node_ft.at[0, util_rows].set(
+        delta.util_vals[:, 0] / DISK_IO_DIVISOR, mode="drop"
+    )
+    node_ft = node_ft.at[1, util_rows].set(
+        delta.util_vals[:, 1] / CPU_DIVISOR, mode="drop"
+    )
+    node_ft = node_ft.at[2, :].set(
+        jnp.pad(delta.node_mask.astype(jnp.float32), (0, nn - n))
+    )
+    reqd_t = layout.reqd_t.at[:, req_rows].set(
+        delta.req_vals.T, mode="drop"
+    )
+    return FusedLayout(node_ft=node_ft, alloc_t=layout.alloc_t, reqd_t=reqd_t)
+
+
 class ResidentMismatch(RuntimeError):
     """A SnapshotDelta arrived for resident state this engine does not
     hold (wrong epoch, shape/layout churn, or no state at all); the
@@ -603,11 +675,15 @@ class ResidentState:
     leaves are PRIVATE device buffers (never the shared uniform-constant
     cache) because apply_snapshot_delta donates them."""
 
-    __slots__ = ("snapshot", "epoch")
+    __slots__ = ("snapshot", "epoch", "layout")
 
     def __init__(self, snapshot: SnapshotArrays, epoch: int):
         self.snapshot = snapshot
         self.epoch = epoch
+        # kernel-layout twin of the snapshot for the fused megakernel
+        # (FusedLayout); built lazily on the first fused dispatch
+        # against this state, then delta-folded in lockstep
+        self.layout: FusedLayout | None = None
 
     def accepts(self, delta: SnapshotDelta, epoch: int) -> bool:
         """Is `delta` (tagged to produce `epoch`) applicable to this
@@ -800,13 +876,22 @@ class LocalEngine:
             new_snap = apply_snapshot_delta(st.snapshot, delta)
             # the donated tree is dead: rebind before anything can read it
             st.snapshot = new_snap
+            if st.layout is not None:
+                # the kernel-layout twin folds the SAME delta (donated):
+                # fused resident cycles ship changed rows straight into
+                # kernel layout, no per-call transpose/pad/stack
+                st.layout = apply_layout_delta(st.layout, delta)
             st.epoch = epoch
             self.resident_used_delta = True
         else:
             # full upload into PRIVATE buffers — the uniform-constant
             # cache's shared device arrays must never be donated
-            self._resident = ResidentState(jax.device_put(snapshot), epoch)
+            self._resident = st = ResidentState(jax.device_put(snapshot), epoch)
             self.resident_used_delta = False
+        if kw.get("fused"):
+            if st.layout is None:
+                st.layout = build_fused_layout(st.snapshot)
+            kw = dict(kw, layout=st.layout)
         return self._maybe_profile(
             lambda: schedule_batch(
                 self._resident.snapshot, self._consts.swap(pods), **kw
@@ -859,6 +944,11 @@ class LocalEngine:
         if delta is not None and st is not None and st.accepts(delta, epoch):
             new_snap = apply_snapshot_delta(st.snapshot, delta)
             st.snapshot = new_snap
+            if st.layout is not None:
+                # keep the kernel-layout twin current for interleaved
+                # single-window fused cycles (the scan itself re-preps —
+                # its per-window `requested` carry cannot ride a layout)
+                st.layout = apply_layout_delta(st.layout, delta)
             st.epoch = epoch
             self.resident_used_delta = True
         else:
@@ -1105,18 +1195,32 @@ def local_spread_dmin(snapshot: SnapshotArrays) -> jnp.ndarray:
     ).min(0)
 
 
-def check_fused_contract(policy: str, normalizer: str) -> None:
+def check_fused_contract(
+    policy: str, normalizer: str, *, min_max_ok: bool = False
+) -> None:
     """The fused Pallas path's (policy, normalizer) domain — shared by
     schedule_batch and the sharded factories so the two surfaces cannot
-    enforce different contracts."""
+    enforce different contracts.
+
+    min_max_ok=True (the DENSE surfaces) additionally admits
+    normalizer="min_max": the kernel's epilogue applies the plain
+    min-max rescale in the same tiled pass, with row bounds from the
+    fused row-stats companion kernel, bitwise equal to the unfused
+    normalize-then-mask composition at every feasible cell. The sharded
+    factories keep the strict contract — their min-max bounds are
+    pmax/pmin-reduced GLOBAL values the shard-local kernel epilogue
+    cannot see."""
     if policy != "balanced_cpu_diskio":
         raise ValueError(
             f"fused kernel only implements balanced_cpu_diskio, not {policy!r}"
         )
-    if normalizer != "none":
+    allowed = ("none", "min_max") if min_max_ok else ("none",)
+    if normalizer not in allowed:
         raise ValueError(
-            "fused=True requires normalizer='none' (masked NEG sentinels "
-            "would skew min_max/softmax statistics)"
+            f"fused=True requires normalizer in {allowed}, not "
+            f"{normalizer!r} (masked NEG sentinels would skew the "
+            "statistics of any normalizer the kernel epilogue does not "
+            "implement)"
         )
 
 
@@ -1129,21 +1233,80 @@ def compute_free_capacity(snapshot: SnapshotArrays) -> jnp.ndarray:
     )
 
 
+def _fused_affinity_operands(
+    snapshot: SnapshotArrays, pods: PodBatch
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """(aff_pod [4S, p], aff_node [3S, n], valid [p]) — the count-based
+    constraint families (pod_affinity_fit, anti_reverse_bad,
+    topology_spread_fit) re-expressed as per-selector one-hot rows the
+    fused kernel folds in one tiled pass. Boolean-equivalent to the op
+    composition (duplicate/-1-padded selector ids collapse in the
+    one-hots exactly like the gathered all()/any() forms; a stale id
+    >= S surfaces in `valid`, making the pod infeasible everywhere —
+    pod_affinity_fit's documented stance)."""
+    s = snapshot.domain_counts.shape[1]
+    p = pods.request.shape[0]
+    a_hot = pod_has_anti_onehot(pods.affinity_sel, s).astype(jnp.float32)
+    t_hot = pod_has_anti_onehot(pods.anti_affinity_sel, s).astype(jnp.float32)
+    matches = match_matrix(pods, s).astype(jnp.float32)
+    # per-(pod, selector) spread threshold: the TIGHTEST maxSkew of the
+    # pod's constraints on that selector (+big when unconstrained) —
+    # all-k(skew_s <= max_k) == skew_s <= min-k(max_k)
+    big = jnp.asarray(jnp.finfo(jnp.float32).max, jnp.float32)
+    sel = jnp.clip(pods.spread_sel, 0, max(s - 1, 0))
+    rows = jnp.arange(p)[:, None]
+    thresh = jnp.full((p, s), big, jnp.float32).at[rows, sel].min(
+        jnp.where(pods.spread_sel >= 0, pods.spread_max.astype(jnp.float32), big)
+    )
+    aff_pod = jnp.concatenate([a_hot.T, t_hot.T, matches.T, thresh.T], axis=0)
+    present = (snapshot.domain_counts > 0).astype(jnp.float32).T
+    avoid_present = (snapshot.avoid_counts > 0).astype(jnp.float32).T
+    dmin = local_spread_dmin(snapshot)
+    # skew of a prospective placement: counts + 1 - dmin, per selector —
+    # the same expression (and op order) topology_spread_fit evaluates
+    cnt_plus = (snapshot.domain_counts + 1.0 - dmin[None, :]).T
+    aff_node = jnp.concatenate([present, avoid_present, cnt_plus], axis=0)
+    valid = ~(
+        (pods.affinity_sel >= s).any(-1)
+        | (pods.anti_affinity_sel >= s).any(-1)
+        | (pods.spread_sel >= s).any(-1)
+    )
+    return aff_pod, aff_node, valid
+
+
 def _fused_masked_scores(
-    snapshot: SnapshotArrays, pods: PodBatch, *, include_pod_affinity: bool
+    snapshot: SnapshotArrays,
+    pods: PodBatch,
+    *,
+    include_pod_affinity: bool,
+    normalizer: str = "none",
+    layout: "FusedLayout | None" = None,
 ) -> jnp.ndarray:
-    """[p, n] score-where-feasible-else-NEG via the fused Pallas kernel
-    (ops/pallas_fused.py): score + resource fit in one tiled VMEM pass,
-    remaining constraint families (cards, taints, node/pod affinity)
-    ANDed on top. Only the balanced_cpu_diskio policy has a fused kernel."""
-    from kubernetes_scheduler_tpu.ops.pallas_fused import fused_masked_score
+    """[p, n] score-where-feasible-else-NEG via the fused Pallas
+    megakernel (ops/pallas_fused.py): score, resource fit, spec.nodeName
+    pinning, the count-based (anti)affinity/avoider/spread families
+    (when the selector axis fits MAX_FUSED_SELECTORS), and the remaining
+    constraint mask (cards/taints/node-affinity, computed here and fed
+    to the kernel as ONE operand) in a single tiled VMEM pass — plus the
+    min-max normalize epilogue when normalizer="min_max". Only the
+    balanced_cpu_diskio policy has a fused kernel.
+
+    layout: optional engine.FusedLayout of device-resident kernel-layout
+    node buffers — resident cycles skip the per-call transpose/pad/stack
+    prep entirely (deltas land straight in kernel layout)."""
+    from kubernetes_scheduler_tpu.ops.pallas_fused import (
+        MAX_FUSED_SELECTORS,
+        fused_masked_score,
+    )
 
     stats = utilization_stats(snapshot.disk_io, snapshot.cpu_pct, snapshot.node_mask)
-    masked = fused_masked_score(
-        stats.u, stats.v, snapshot.node_mask,
-        snapshot.allocatable, snapshot.requested,
-        pods.request[:, 0], pods.r_io, pods.request, pods.pod_mask,
-    )
+    s = snapshot.domain_counts.shape[1]
+    fold_affinity = include_pod_affinity and s <= MAX_FUSED_SELECTORS
+    aff_pod = aff_node = None
+    pod_ok = pods.pod_mask
+    if fold_affinity:
+        aff_pod, aff_node, valid = _fused_affinity_operands(snapshot, pods)
+        pod_ok = pod_ok & valid
     gpu_fits, _ = card_fit(
         snapshot.cards, snapshot.card_mask, snapshot.card_healthy,
         pods.want_number, pods.want_memory, pods.want_clock,
@@ -1155,8 +1318,9 @@ def _fused_masked_scores(
         pods.na_key, pods.na_op, pods.na_vals, pods.na_val_mask, pods.na_mask,
         pods.na_term,
     )
-    other = other & node_name_fit(pods.target_node, snapshot.allocatable.shape[0])
-    if include_pod_affinity:
+    if include_pod_affinity and not fold_affinity:
+        # selector axis too wide for the kernel unroll: keep the
+        # outside composition for the count-based families
         other = other & pod_affinity_fit(
             snapshot.domain_counts, pods.affinity_sel, pods.anti_affinity_sel
         )
@@ -1166,7 +1330,16 @@ def _fused_masked_scores(
             snapshot.domain_counts, snapshot.node_mask,
             pods.spread_sel, pods.spread_max,
         )
-    return jnp.where(other, masked, NEG)
+    return fused_masked_score(
+        stats.u, stats.v, snapshot.node_mask,
+        snapshot.allocatable, snapshot.requested,
+        pods.request[:, 0], pods.r_io, pods.request, pod_ok,
+        target_node=pods.target_node,
+        other=other.astype(jnp.float32),
+        aff_pod=aff_pod, aff_node=aff_node,
+        node_prepped=None if layout is None else tuple(layout),
+        normalizer=normalizer,
+    )
 
 
 @functools.partial(
@@ -1189,6 +1362,7 @@ def schedule_batch(
     auction_rounds: int = 1024,
     auction_price_frac: float = 1.0,
     score_plugins: tuple | None = None,
+    layout: FusedLayout | None = None,
 ) -> ScheduleResult:
     """One scheduling cycle for the whole pending window, on device.
 
@@ -1206,17 +1380,30 @@ def schedule_batch(
     pod in the window uses (host.scheduler checks exactly that before
     passing False; it saves ~2x on selector-free windows).
 
-    fused=True routes score + resource-fit through the fused Pallas kernel
-    (one HBM pass instead of three). Requires policy="balanced_cpu_diskio"
-    and normalizer="none" (the masked matrix carries NEG sentinels, which
-    min_max/softmax would fold into their statistics); assignments are
-    identical to the unfused path — both assigners are invariant under
-    per-row monotone rescaling and read infeasible entries as NEG anyway.
-    Contract deviation: in fused replies `scores`/`raw_scores` ARE the
-    masked matrix (NEG in infeasible cells) — the unmasked policy score is
-    never materialized, that being the point of the fusion. Consumers that
-    need scores across infeasible cells (e.g. models/learned.py teacher
-    matrices) must use fused=False.
+    fused=True routes the whole masked-score pipeline — score, resource
+    fit, spec.nodeName pinning, the count-based (anti)affinity/avoider/
+    spread families, the remaining constraint mask, and (for
+    normalizer="min_max") the normalize epilogue — through the fused
+    Pallas megakernel (ops/pallas_fused.py): one [p, n] HBM write
+    instead of up to seven round-trips. Requires
+    policy="balanced_cpu_diskio" and normalizer in ("none", "min_max");
+    softmax stays unfused (its exp/sum statistics would fold the NEG
+    sentinels). Decisions match the unfused path: the kernel evaluates
+    the same expressions on the same operands (mask families are
+    boolean-EXACT; score values agree up to XLA's per-graph FMA
+    contraction of `alpha*v - beta*u`, so near-ulp ties are pinned
+    empirically by tests/test_pallas.py rather than guaranteed
+    algebraically), and both assigners read infeasible entries as NEG
+    anyway. Contract deviation:
+    in fused replies `scores`/`raw_scores` ARE the masked matrix (NEG in
+    infeasible cells) — the unmasked policy score is never materialized,
+    that being the point of the fusion. Consumers that need scores
+    across infeasible cells (e.g. models/learned.py teacher matrices)
+    must use fused=False.
+
+    layout: optional FusedLayout of device-resident kernel-layout node
+    buffers (resident cycles — see LocalEngine.schedule_resident); only
+    consulted on the fused path.
 
     score_plugins=((name, weight), ...) replaces the single `policy` with
     the upstream framework's weighted multi-plugin combination
@@ -1241,9 +1428,10 @@ def schedule_batch(
             auction_price_frac=auction_price_frac,
         )
     if fused:
-        check_fused_contract(policy, normalizer)
+        check_fused_contract(policy, normalizer, min_max_ok=True)
         raw = _fused_masked_scores(
-            snapshot, pods, include_pod_affinity=not affinity_aware
+            snapshot, pods, include_pod_affinity=not affinity_aware,
+            normalizer=normalizer, layout=layout,
         )
         feasible = raw > NEG * 0.5
         norm = raw

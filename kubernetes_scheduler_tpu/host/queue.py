@@ -151,6 +151,15 @@ class SchedulingQueue:
         """Highest-priority window of pending pods for one engine cycle."""
         with self._lock:
             self._drain_backoff()
+            if self._active and len(self._active) <= max_pods:
+                # whole-queue pop (the deep-backlog drain shape —
+                # queue_pop was a named stage in the 4k-node cycle
+                # budget): ONE sort instead of a heappop per pod, and
+                # the SAME order — sort keys are unique (seq counter),
+                # so heap drain order == sorted order
+                entries = sorted(self._active)
+                self._active.clear()
+                return [e.pod for e in entries]
             out = []
             while self._active and len(out) < max_pods:
                 out.append(heapq.heappop(self._active).pod)

@@ -1390,8 +1390,18 @@ class Scheduler:
             # vectorized scatter-add), so the next dispatch's build
             # skips re-walking them when the informer appends these pods
             try:
-                pos = {id(pod): i for i, pod in enumerate(window)}
-                rows = [pos[id(pod)] for pod in bound]
+                if (
+                    len(bound) == len(window)
+                    and bound[0] is window[0]
+                    and bound[-1] is window[-1]
+                ):
+                    # every pod bound in window order (the steady-state
+                    # drain shape): rows are the identity — skip the
+                    # 8k-entry id map
+                    rows = np.arange(len(window))
+                else:
+                    pos = {id(pod): i for i, pod in enumerate(window)}
+                    rows = [pos[id(pod)] for pod in bound]
                 self.builder.apply_assignment_deltas(
                     bound, idx[rows], np.asarray(infl.pods_batch.request)[rows]
                 )
@@ -2074,11 +2084,22 @@ class Scheduler:
             )
         )
         score_plugins = self.config.score_plugins_tuple()
+        # the fused megakernel's domain (engine.check_fused_contract with
+        # min_max_ok): "none" masked-raw, or "min_max" via the kernel's
+        # normalize epilogue — which puts the DEPLOYED DEFAULT
+        # (normalizer="min_max") on the fused path on TPU-backed engines
+        # (_fused_min_max_ok); softmax stays unfused
         fused = (
             self.config.feature_gates.fused_kernel
             and score_plugins is None
             and self.config.policy == "balanced_cpu_diskio"
-            and self.config.normalizer == "none"
+            and (
+                self.config.normalizer == "none"
+                or (
+                    self.config.normalizer == "min_max"
+                    and self._fused_min_max_ok()
+                )
+            )
         )
         kw = dict(
             policy=self.config.policy,
@@ -2297,6 +2318,31 @@ class Scheduler:
         if self._nominations:
             for pod in assigned:
                 self._nominations.pop(_pod_key(pod), None)
+
+    def _fused_min_max_ok(self) -> bool:
+        """Whether the min_max→fused widening applies for THIS engine.
+        LOCAL engines: only on a TPU backend — a CPU backend would
+        trade the XLA normalize pass for the interpret-mode Pallas
+        megakernel (~2x slower, exactly the per-stage regression `make
+        perf-gate` exists to catch). REMOTE engines: not yet — there is
+        no capability negotiation for the epilogue contract (unlike
+        supports_gangs/resident), so a version-skewed older sidecar
+        would reject fused+min_max every cycle and degrade the whole
+        deployment to the scalar fallback; remote sidecars keep the
+        pre-widening unfused min_max path until a HealthReply
+        capability bit ships. normalizer="none" configurations keep
+        their long-standing always-fused behavior either way. Cached —
+        one backend probe."""
+        v = self.__dict__.get("_fused_minmax_ok")
+        if v is None:
+            if isinstance(self.engine, LocalEngine):
+                import jax
+
+                v = jax.default_backend() == "tpu"
+            else:
+                v = False
+            self.__dict__["_fused_minmax_ok"] = v
+        return v
 
     def _run_batched(
         self, window, nodes, running, utils, m: CycleMetrics,

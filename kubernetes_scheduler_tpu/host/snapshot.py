@@ -724,6 +724,7 @@ class SnapshotBuilder:
                 "ids": node_ids,
                 "names_t": names_t,
                 "nodes_ref": list(nodes),
+                "names": [nd.name for nd in nodes],
                 "node_index": node_index,
                 "alloc": alloc,
                 "mask": mask,
@@ -737,21 +738,30 @@ class SnapshotBuilder:
         # utilization series are rebuilt EVERY cycle — advisors may
         # legitimately mutate NodeUtil values in place between fetches
         # (StaticAdvisor returns its own dict), so no identity cache is
-        # sound here; the O(n) loop is ~3ms at 4k nodes
-        disk_io = np.zeros(n, np.float32)
-        cpu_pct = np.zeros(n, np.float32)
-        mem_pct = np.zeros(n, np.float32)
-        net_up = np.zeros(n, np.float32)
-        net_down = np.zeros(n, np.float32)
+        # sound here. The fill is batch-assembled: one tuple-comprehension
+        # over the cached node-name list into a single np.array, instead
+        # of five scalar ndarray writes per node (the span data put the
+        # per-element loop at ~4ms of every 4k-node snapshot_build; this
+        # path is ~3x less)
+        node_names = self.__dict__["_node_static"]["names"]
         get_util = utils.get
-        for i, nd in enumerate(nodes):
-            u = get_util(nd.name)
-            if u:
-                disk_io[i] = u.disk_io
-                cpu_pct[i] = u.cpu_pct
-                mem_pct[i] = u.mem_pct
-                net_up[i] = u.net_up
-                net_down[i] = u.net_down
+        zero5 = (0.0, 0.0, 0.0, 0.0, 0.0)
+        util_block = np.zeros((n, 5), np.float32)
+        if n_real:
+            util_block[:n_real] = np.array(
+                [
+                    (u.disk_io, u.cpu_pct, u.mem_pct, u.net_up, u.net_down)
+                    if (u := get_util(name)) is not None
+                    else zero5
+                    for name in node_names
+                ],
+                np.float32,
+            )
+        disk_io = np.ascontiguousarray(util_block[:, 0])
+        cpu_pct = np.ascontiguousarray(util_block[:, 1])
+        mem_pct = np.ascontiguousarray(util_block[:, 2])
+        net_up = np.ascontiguousarray(util_block[:, 3])
+        net_down = np.ascontiguousarray(util_block[:, 4])
 
         # NonZeroRequested accumulation over running pods
         # (algorithm.go:219-221), incremental: the host loop passes the

@@ -24,12 +24,21 @@ with backoff — deploy/yoda-scheduler.yaml:19-20).
 
 from __future__ import annotations
 
+import os
 from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 
 NEG = -1.0e30
+
+# auction bid-kernel routing (ops/pallas_fused.fused_auction_bid):
+# "auto" engages the fused bid kernel on TPU backends for no-affinity
+# auctions (where the XLA round head materializes a [p, n, r] capacity
+# broadcast per round), "on"/"off" force it either way — the escape
+# hatch for shapes where per-round kernel-launch overhead outweighs the
+# saved HBM traffic. Read once at import (never inside a trace).
+_BID_KERNEL_MODE = os.environ.get("YODA_AUCTION_BID_KERNEL", "auto")
 
 # element budgets for trading dense compare-and-reduce formulations
 # against scatter forms (TPU scatters serialize per update, dense forms
@@ -590,6 +599,7 @@ def auction_assign(
     rounds: int = 1024,
     price_frac: float = 1.0,
     affinity: AffinityState | None = None,
+    bid_kernel: bool | None = None,
 ) -> AssignResult:
     """Price-guided parallel auction: rounds of bid → admit → reprice.
 
@@ -623,6 +633,14 @@ def auction_assign(
     for affinity windows with O(rounds) parallel rounds (~50x fewer
     device steps at 5k pods); placement ORDER differs from strict greedy
     (documented deviation), hard-constraint satisfaction does not.
+
+    bid_kernel routes the no-affinity round head (capacity mask + price
+    + row argmax) through the fused Pallas bid kernel instead of the
+    XLA body — bitwise-identical bids (first-max tie semantics), no
+    [p, n, r] capacity broadcast per round. None = auto (TPU backends
+    only; YODA_AUCTION_BID_KERNEL=on/off overrides). The affinity path
+    keeps the XLA body: its round mask depends on carried [n, S] count
+    state the kernel does not fold.
     """
     p, n = scores.shape
     # Per-row min-max to [0, 1] over feasible entries before pricing. Bids
@@ -651,26 +669,56 @@ def auction_assign(
     )
     prio_key = p - rank
     # the feasibility-masked jittered score matrix is round-invariant on
-    # the no-affinity path — build it once outside the loop. (A fused
-    # Pallas bid kernel folding capacity+price+argmax into one pass was
-    # measured SLOWER end-to-end than this XLA-fused body — per-round
-    # kernel-launch overhead inside the while_loop outweighs the saved
-    # HBM traffic — so the round body stays plain XLA.)
+    # the no-affinity path — build it once outside the loop
     sj = jnp.where(feasible, scores + jitter, NEG) if affinity is None else None
+    # bid kernel (ops/pallas_fused.fused_auction_bid): fold the round's
+    # capacity mask + price + row argmax into one tiled pass over sj —
+    # the XLA head's [p, n, r] capacity broadcast plus the [p, n] bid
+    # row were the round's dominant HBM traffic. Decisions are bitwise
+    # identical (the kernel replicates jnp.argmax's first-max ties).
+    # Auto-gated to TPU backends: under the CPU interpreter the kernel
+    # is a correctness path (tests pass bid_kernel=True), not a fast one.
+    if bid_kernel is None:
+        bid_kernel = _BID_KERNEL_MODE == "on" or (
+            _BID_KERNEL_MODE == "auto" and jax.default_backend() == "tpu"
+        )
+    use_bid_kernel = bool(bid_kernel) and affinity is None
+    if use_bid_kernel:
+        from kubernetes_scheduler_tpu.ops.pallas_fused import (
+            TILE_N,
+            TILE_P,
+            _pad2,
+            _pad_axis,
+            fused_auction_bid,
+        )
+
+        # round-invariant kernel operands, hoisted: NEG-padded sj and
+        # the resource-major request block
+        sj_pad = _pad2(sj, TILE_P, TILE_N, value=NEG)
+        req_t_pad = _pad_axis(pod_request.astype(jnp.float32).T, 1, TILE_P)
 
     def round_body(state):
         assigned, free, price, added, added_avoid, _, _round = state
         active = pod_mask & (assigned < 0)
-        cap_ok = (
-            (pod_request[:, None, :] <= free[None, :, :])
-            | (pod_request[:, None, :] == 0)
-        ).all(-1)
         if affinity is None:
-            mask = (sj > NEG * 0.5) & cap_ok & active[:, None]
-            row = jnp.where(mask, sj - price[None, :], NEG)
-            bid = jnp.argmax(row, axis=1).astype(jnp.int32)
-            has_bid = mask.any(axis=1)
+            if use_bid_kernel:
+                bid, has_bid = fused_auction_bid(
+                    sj_pad, price, active, req_t_pad, free, p=p,
+                )
+            else:
+                cap_ok = (
+                    (pod_request[:, None, :] <= free[None, :, :])
+                    | (pod_request[:, None, :] == 0)
+                ).all(-1)
+                mask = (sj > NEG * 0.5) & cap_ok & active[:, None]
+                row = jnp.where(mask, sj - price[None, :], NEG)
+                bid = jnp.argmax(row, axis=1).astype(jnp.int32)
+                has_bid = mask.any(axis=1)
         else:
+            cap_ok = (
+                (pod_request[:, None, :] <= free[None, :, :])
+                | (pod_request[:, None, :] == 0)
+            ).all(-1)
             mask = feasible & cap_ok & active[:, None]
             mask = mask & _affinity_round_mask(affinity, added, added_avoid)
             row = jnp.where(mask, scores + jitter - price[None, :], NEG)

@@ -1,23 +1,46 @@
-"""Fused Pallas TPU kernel: policy score + resource feasibility in one pass.
+"""Fused Pallas TPU kernels: the resident device step as tiled VMEM passes.
 
-The hot [p, n] pipeline of the batch engine is HBM-bandwidth-bound: the live
-policy score (ops/score.balanced_cpu_diskio, vectorizing
-pkg/yoda/score/algorithm.go:99-119) and the NodeResourcesFit mask
-(ops/feasibility.resource_fit, vectorizing algorithm.go:209-262) each stream
-a [p, n]-shaped intermediate through HBM, and the assignment step reads both
-to build `where(feasible, score, NEG)`. This kernel fuses all three into ONE
-tiled pass: each (TILE_P, TILE_N) block loads the per-pod and per-node
-vectors once into VMEM, evaluates score + fit on the VPU, and writes only
-the final masked-score block — one [p, n] HBM write instead of three
-[p, n] round-trips.
+The hot [p, n] pipeline of the batch engine is HBM-bandwidth-bound. Three
+kernels keep it resident:
+
+1. `fused_masked_score` — the masked-score MEGAKERNEL. The live policy
+   score (ops/score.balanced_cpu_diskio, vectorizing
+   pkg/yoda/score/algorithm.go:99-119), the NodeResourcesFit mask
+   (ops/feasibility.resource_fit, algorithm.go:209-262), spec.nodeName
+   pinning (ops/constraints.node_name_fit), the count-based inter-pod
+   (anti)affinity / reverse-avoider / topology-spread families
+   (ops/constraints.pod_affinity_fit, assign.anti_reverse_bad,
+   constraints.topology_spread_fit — previously three separate [p, n]
+   passes ANDed on top), the remaining externally-computed constraint
+   mask (cards/taints/node-affinity as one `other` operand), and an
+   optional min-max normalize epilogue all run in ONE tiled pass: each
+   (TILE_P, TILE_N) block loads the per-pod and per-node vectors once
+   into VMEM and writes only the final masked (optionally normalized)
+   score block — one [p, n] HBM write instead of up to seven [p, n]
+   round-trips.
+
+2. `fused_score_row_stats` — the tiny companion pass feeding the min-max
+   epilogue: per-pod (max, min) of the raw score over valid nodes,
+   computed from the [k, p]/[k, n] feature vectors alone (NO [p, n] HBM
+   traffic; the score is recomputed per tile on the VPU, which is free
+   next to one HBM round-trip of the full matrix).
+
+3. `fused_auction_bid` — the auction's inner-loop bid kernel
+   (ops/assign.auction_assign): per round, capacity mask + price
+   subtraction + row argmax in one pass over the precomputed masked
+   score matrix. The XLA round body materializes a [p, n, r] capacity
+   broadcast plus a [p, n] bid row every round; this kernel reads sj
+   once per tile and writes only three [p]-shaped vectors. Tie
+   semantics replicate jnp.argmax exactly (first index of the row
+   maximum), so auction decisions are bitwise identical.
 
 Layout: per-pod and per-node feature vectors are passed transposed —
 [k, p] and [k, n] with the batch axis in lanes — so every block's last
-dimension is the 128-aligned tile axis and the tiny feature axis (2-8 rows)
-sits in sublanes. The [p, n] output tiles map directly onto the VPU's
-(8, 128) native shape.
+dimension is the 128-aligned tile axis and the tiny feature axis sits in
+sublanes. The [p, n] tiles map directly onto the VPU's (8, 128) native
+shape.
 
-On non-TPU backends the same kernel runs through the Pallas interpreter
+On non-TPU backends the same kernels run through the Pallas interpreter
 (tests) — semantics, including padding behavior, are identical.
 """
 
@@ -30,44 +53,144 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from kubernetes_scheduler_tpu.ops.assign import NEG
+from kubernetes_scheduler_tpu.ops.normalize import MAX_NODE_SCORE
 from kubernetes_scheduler_tpu.ops.score import MAX_RAW_SCORE, alpha_beta
 
 TILE_P = 256
 TILE_N = 1024
 
+# selector-axis ceiling for folding the count-based constraint families
+# into the kernel: the per-selector mask work unrolls statically, so a
+# pathologically wide selector table (bucketed powers of two beyond
+# this) falls back to the outside [p, n] composition instead of
+# exploding kernel size. 32 selectors = 128 pod-side + 96 node-side
+# sublane rows, ~0.5 MB of extra VMEM at the default tiles.
+MAX_FUSED_SELECTORS = 32
 
-def _fused_kernel(pod_sc_ref, node_ft_ref, pod_req_ref, alloc_ref, reqd_ref,
-                  out_ref, *, n_res: int):
-    """One (TILE_P, TILE_N) block of masked scores.
+_F32_BIG = 3.4028235e38  # jnp.finfo(jnp.float32).max, as a literal
 
-    pod_sc_ref:  [3, TILE_P]  rows = (alpha, beta, pod_mask)
-    node_ft_ref: [3, TILE_N]  rows = (u, v, node_mask)
-    pod_req_ref: [n_res, TILE_P]   pod requests, resource-major
-    alloc_ref:   [n_res, TILE_N]   node allocatable
-    reqd_ref:    [n_res, TILE_N]   node requested (non-zero defaults applied)
-    out_ref:     [TILE_P, TILE_N]  score where feasible else NEG
-    """
+
+def _score_block(pod_sc_ref, node_ft_ref):
+    """One block's raw score + masks from the feature rows: the live
+    BalancedCpuDiskIOPriority expression (algorithm.go:105-111), shared
+    by the megakernel and the row-stats pass so the two cannot drift."""
     alpha = pod_sc_ref[0, :][:, None]      # [TILE_P, 1]
     beta = pod_sc_ref[1, :][:, None]
     pmask = pod_sc_ref[2, :][:, None] > 0.0
     u = node_ft_ref[0, :][None, :]         # [1, TILE_N]
     v = node_ft_ref[1, :][None, :]
     nmask = node_ft_ref[2, :][None, :] > 0.0
-
-    # BalancedCpuDiskIOPriority (algorithm.go:105-111), one VPU expression
     score = MAX_RAW_SCORE - MAX_RAW_SCORE * jnp.abs(alpha * v - beta * u)
+    return score, pmask, nmask
+
+
+def _fused_kernel(pod_sc_ref, node_ft_ref, pod_req_ref, alloc_ref, reqd_ref,
+                  *refs, n_res: int, n_sel: int, has_other: bool,
+                  minmax: bool, tile_n: int):
+    """One (TILE_P, TILE_N) block of masked (optionally normalized) scores.
+
+    pod_sc_ref:  [4, TILE_P]  rows = (alpha, beta, pod_ok, target_node)
+                 pod_ok folds pod_mask AND the selector-validity bits
+                 (stale selector ids make a pod infeasible everywhere)
+    node_ft_ref: [3, TILE_N]  rows = (u, v, node_mask)
+    pod_req_ref: [n_res, TILE_P]   pod requests, resource-major
+    alloc_ref:   [n_res, TILE_N]   node allocatable
+    reqd_ref:    [n_res, TILE_N]   node requested (non-zero defaults applied)
+    then, in order, the optional refs:
+    aff_pod_ref:  [4*n_sel, TILE_P] rows = required-selector one-hot,
+                  anti one-hot, label-match one-hot, spread threshold
+                  (min maxSkew per selector, +big when unconstrained)
+    aff_node_ref: [3*n_sel, TILE_N] rows = domain presence, avoider
+                  presence, count+1-dmin per selector
+    other_ref:    [TILE_P, TILE_N] externally-computed constraint mask
+                  (cards/taints/node-affinity; > 0 = feasible)
+    stats_ref:    [2, TILE_P] per-pod (highest, lowest) raw-score bounds
+                  for the min-max epilogue (ops/normalize semantics)
+    out_ref:      [TILE_P, TILE_N] score where feasible else NEG
+    """
+    i = 0
+    aff_pod_ref = aff_node_ref = None
+    if n_sel:
+        aff_pod_ref, aff_node_ref = refs[0], refs[1]
+        i = 2
+    other_ref = None
+    if has_other:
+        other_ref = refs[i]
+        i += 1
+    stats_ref = refs[i] if minmax else None
+    out_ref = refs[-1]
+
+    score, pmask, nmask = _score_block(pod_sc_ref, node_ft_ref)
+    fit = pmask & nmask
 
     # NodeResourcesFit with the unrequested-resource bypass
     # (algorithm.go:211-215): static unroll over the small resource axis
-    fit = pmask & nmask
-    for i in range(n_res):
-        req = pod_req_ref[i, :][:, None]       # [TILE_P, 1]
-        ok = (reqd_ref[i, :][None, :] + req <= alloc_ref[i, :][None, :]) | (
+    for r in range(n_res):
+        req = pod_req_ref[r, :][:, None]       # [TILE_P, 1]
+        ok = (reqd_ref[r, :][None, :] + req <= alloc_ref[r, :][None, :]) | (
             req == 0.0
         )
         fit = fit & ok
 
+    # spec.nodeName pinning (constraints.node_name_fit): target < 0 is
+    # unpinned; otherwise only the matching GLOBAL column passes. Both
+    # sides are small exact integers, so the f32 compare is exact.
+    tgt = pod_sc_ref[3, :][:, None]
+    cols = (pl.program_id(1) * tile_n).astype(jnp.float32) + (
+        jax.lax.broadcasted_iota(jnp.float32, (1, tile_n), 1)
+    )
+    fit = fit & ((tgt < 0.0) | (cols == tgt))
+
+    # count-based families, one statically-unrolled pass per selector:
+    # required presence, anti absence, reverse avoiders, spread skew —
+    # boolean-equivalent to pod_affinity_fit & ~anti_reverse_bad &
+    # topology_spread_fit (tests/test_pallas.py pins the identity)
+    if n_sel:
+        bad = None
+        for s in range(n_sel):
+            a = aff_pod_ref[s, :][:, None] > 0.0
+            t = aff_pod_ref[n_sel + s, :][:, None] > 0.0
+            mm = aff_pod_ref[2 * n_sel + s, :][:, None] > 0.0
+            th = aff_pod_ref[3 * n_sel + s, :][:, None]
+            pres = aff_node_ref[s, :][None, :] > 0.0
+            avo = aff_node_ref[n_sel + s, :][None, :] > 0.0
+            cplus = aff_node_ref[2 * n_sel + s, :][None, :]
+            b = (a & ~pres) | (t & pres) | (mm & avo) | (cplus > th)
+            bad = b if bad is None else (bad | b)
+        fit = fit & ~bad
+
+    if has_other:
+        fit = fit & (other_ref[:, :] > 0.0)
+
+    # min-max epilogue (ops/normalize.min_max_normalize over node_mask
+    # bounds): same expression, so feasible cells are bitwise equal to
+    # the unfused normalize pass
+    if minmax:
+        hi = stats_ref[0, :][:, None]
+        lo = stats_ref[1, :][:, None]
+        score = (score - lo) * MAX_NODE_SCORE / (hi - lo)
+
     out_ref[:, :] = jnp.where(fit, score, NEG)
+
+
+def _row_stats_kernel(pod_sc_ref, node_ft_ref, out_ref):
+    """Accumulate per-pod (max, min) of the raw score over valid nodes
+    across the node-tile axis — the bounds feed for the min-max
+    epilogue. out_ref [2, TILE_P] is revisited for every node tile of a
+    pod tile (the index map drops j), initialized on the first."""
+    score, _, nmask = _score_block(pod_sc_ref, node_ft_ref)
+    hi = jnp.where(nmask, score, -_F32_BIG).max(axis=1)
+    lo = jnp.where(nmask, score, _F32_BIG).min(axis=1)
+
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        out_ref[0, :] = hi
+        out_ref[1, :] = lo
+
+    @pl.when(pl.program_id(1) != 0)
+    def _fold():
+        out_ref[0, :] = jnp.maximum(out_ref[0, :], hi)
+        out_ref[1, :] = jnp.minimum(out_ref[1, :], lo)
 
 
 def _pad_axis(x: jnp.ndarray, axis: int, to: int) -> jnp.ndarray:
@@ -80,8 +203,42 @@ def _pad_axis(x: jnp.ndarray, axis: int, to: int) -> jnp.ndarray:
     return jnp.pad(x, pad)
 
 
+def _pad2(x: jnp.ndarray, tile_p: int, tile_n: int, value=0.0) -> jnp.ndarray:
+    """Pad a [p, n] matrix to tile multiples with a constant."""
+    pp = -(-x.shape[0] // tile_p) * tile_p
+    nn = -(-x.shape[1] // tile_n) * tile_n
+    if (pp, nn) == x.shape:
+        return x
+    return jnp.pad(
+        x, ((0, pp - x.shape[0]), (0, nn - x.shape[1])),
+        constant_values=value,
+    )
+
+
+def prep_node_operands(u, v, node_mask, alloc, reqd, *, tile_n: int = TILE_N):
+    """The node-side kernel-layout buffers (node_ft [3, nn], alloc_t and
+    reqd_t [r, nn]) — ONE definition shared by the per-call prep below
+    and engine.build_fused_layout, so the resident-layout path cannot
+    drift from the re-pad path (PARITY round 12)."""
+    node_ft = _pad_axis(
+        jnp.stack(
+            [
+                u.astype(jnp.float32),
+                v.astype(jnp.float32),
+                node_mask.astype(jnp.float32),
+            ]
+        ),
+        1,
+        tile_n,
+    )
+    alloc_t = _pad_axis(alloc.astype(jnp.float32).T, 1, tile_n)
+    reqd_t = _pad_axis(reqd.astype(jnp.float32).T, 1, tile_n)
+    return node_ft, alloc_t, reqd_t
+
+
 @functools.partial(
-    jax.jit, static_argnames=("tile_p", "tile_n", "interpret")
+    jax.jit,
+    static_argnames=("tile_p", "tile_n", "interpret", "normalizer"),
 )
 def fused_masked_score(
     u: jnp.ndarray,
@@ -94,66 +251,273 @@ def fused_masked_score(
     pod_request: jnp.ndarray,
     pod_mask: jnp.ndarray,
     *,
+    target_node: jnp.ndarray | None = None,
+    other: jnp.ndarray | None = None,
+    aff_pod: jnp.ndarray | None = None,
+    aff_node: jnp.ndarray | None = None,
+    node_prepped: tuple | None = None,
+    normalizer: str = "none",
     tile_p: int = TILE_P,
     tile_n: int = TILE_N,
     interpret: bool | None = None,
 ) -> jnp.ndarray:
     """Masked score matrix [p, n]: balanced_cpu_diskio where the pod fits
-    the node (resource_fit & node_mask & pod_mask), NEG elsewhere.
+    the node (resource_fit & node_mask & pod_mask & every folded
+    constraint family), NEG elsewhere.
 
     u, v:        [n] utilization (disk_io/50, cpu/100 — ops/stats.py)
     node_mask:   [n] bool
     alloc, reqd: [n, r] float32
     r_cpu, r_io: [p] pod CPU request (milli) and diskIO annotation (MB/s)
     pod_request: [p, r] float32 with non-zero defaults
-    pod_mask:    [p] bool
+    pod_mask:    [p] bool — callers fold selector-validity bits in here
+    target_node: optional [p] int32 spec.nodeName pinning (-1 unpinned;
+                 out-of-range matches nothing — constraints.node_name_fit)
+    other:       optional [p, n] float32 externally-computed constraint
+                 mask (> 0 feasible): cards/taints/node-affinity, and the
+                 count-based families when the selector axis exceeds
+                 MAX_FUSED_SELECTORS
+    aff_pod:     optional [4*S, p] float32 pod-side selector rows (see
+                 _fused_kernel); engine._fused_masked_scores builds them
+    aff_node:    optional [3*S, n] float32 node-side selector rows
+    node_prepped: optional prepped (node_ft, alloc_t, reqd_t) kernel-
+                 layout buffers (engine.FusedLayout): resident cycles
+                 ship deltas straight into these instead of re-deriving
+                 the transpose/pad/stack every step
+    normalizer:  "none" (raw masked scores) or "min_max" — the
+                 ops/normalize.min_max_normalize epilogue applied in the
+                 kernel, with row bounds from the fused_score_row_stats
+                 pass; feasible cells are bitwise equal to the unfused
+                 normalize-then-mask composition
 
-    Semantically identical to
-        where(resource_fit(...) & masks, balanced_cpu_diskio(...), NEG)
-    (pinned by tests/test_pallas.py); padded rows/cols return NEG.
+    Semantically identical to the unfused op composition (pinned by
+    tests/test_pallas.py); padded rows/cols return NEG.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    p, n = pod_request.shape[0], alloc.shape[0]
-    n_res = alloc.shape[1]
+    if normalizer not in ("none", "min_max"):
+        raise ValueError(
+            f"fused kernel epilogue supports normalizer 'none' or "
+            f"'min_max', not {normalizer!r}"
+        )
+    p, n = pod_request.shape[0], node_mask.shape[0]
+    n_res = pod_request.shape[1]
 
     alpha, beta = alpha_beta(r_cpu, r_io)
-
+    if target_node is None:
+        target = jnp.full((p,), -1.0, jnp.float32)
+    else:
+        target = target_node.astype(jnp.float32)
     pod_sc = _pad_axis(
-        jnp.stack([alpha, beta, pod_mask.astype(jnp.float32)]), 1, tile_p
+        jnp.stack([alpha, beta, pod_mask.astype(jnp.float32), target]),
+        1, tile_p,
     )
-    node_ft = _pad_axis(
-        jnp.stack(
-            [
-                u.astype(jnp.float32),
-                v.astype(jnp.float32),
-                node_mask.astype(jnp.float32),
-            ]
-        ),
-        1,
-        tile_n,
-    )
+    if node_prepped is not None:
+        node_ft, alloc_t, reqd_t = node_prepped
+        if node_ft.shape[1] % tile_n:
+            raise ValueError(
+                f"prepped node operands ({node_ft.shape[1]} cols) do not "
+                f"tile by tile_n={tile_n}"
+            )
+    else:
+        node_ft, alloc_t, reqd_t = prep_node_operands(
+            u, v, node_mask, alloc, reqd, tile_n=tile_n
+        )
     pod_req_t = _pad_axis(pod_request.astype(jnp.float32).T, 1, tile_p)
-    alloc_t = _pad_axis(alloc.astype(jnp.float32).T, 1, tile_n)
-    reqd_t = _pad_axis(reqd.astype(jnp.float32).T, 1, tile_n)
 
     pp, nn = pod_sc.shape[1], node_ft.shape[1]
     grid = (pp // tile_p, nn // tile_n)
     pod_side = lambda i, j: (0, i)  # noqa: E731 — block index, node-invariant
     node_side = lambda i, j: (0, j)  # noqa: E731
 
+    n_sel = 0
+    operands = [pod_sc, node_ft, pod_req_t, alloc_t, reqd_t]
+    in_specs = [
+        pl.BlockSpec((4, tile_p), pod_side),
+        pl.BlockSpec((3, tile_n), node_side),
+        pl.BlockSpec((n_res, tile_p), pod_side),
+        pl.BlockSpec((n_res, tile_n), node_side),
+        pl.BlockSpec((n_res, tile_n), node_side),
+    ]
+    if aff_pod is not None:
+        n_sel = aff_pod.shape[0] // 4
+        operands.append(_pad_axis(aff_pod.astype(jnp.float32), 1, tile_p))
+        in_specs.append(pl.BlockSpec((4 * n_sel, tile_p), pod_side))
+        operands.append(_pad_axis(aff_node.astype(jnp.float32), 1, tile_n))
+        in_specs.append(pl.BlockSpec((3 * n_sel, tile_n), node_side))
+    has_other = other is not None
+    if has_other:
+        operands.append(_pad2(other.astype(jnp.float32), tile_p, tile_n))
+        in_specs.append(pl.BlockSpec((tile_p, tile_n), lambda i, j: (i, j)))
+    minmax = normalizer == "min_max"
+    if minmax:
+        operands.append(
+            fused_score_row_stats(
+                pod_sc, node_ft, tile_p=tile_p, tile_n=tile_n,
+                interpret=interpret,
+            )
+        )
+        in_specs.append(pl.BlockSpec((2, tile_p), pod_side))
+
     out = pl.pallas_call(
-        functools.partial(_fused_kernel, n_res=n_res),
+        functools.partial(
+            _fused_kernel, n_res=n_res, n_sel=n_sel, has_other=has_other,
+            minmax=minmax, tile_n=tile_n,
+        ),
         out_shape=jax.ShapeDtypeStruct((pp, nn), jnp.float32),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((3, tile_p), pod_side),
-            pl.BlockSpec((3, tile_n), node_side),
-            pl.BlockSpec((n_res, tile_p), pod_side),
-            pl.BlockSpec((n_res, tile_n), node_side),
-            pl.BlockSpec((n_res, tile_n), node_side),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((tile_p, tile_n), lambda i, j: (i, j)),
         interpret=interpret,
-    )(pod_sc, node_ft, pod_req_t, alloc_t, reqd_t)
+    )(*operands)
     return out[:p, :n]
+
+
+def fused_score_row_stats(
+    pod_sc: jnp.ndarray,
+    node_ft: jnp.ndarray,
+    *,
+    tile_p: int = TILE_P,
+    tile_n: int = TILE_N,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """[2, pp] per-pod (highest, lowest) bounds of the raw score, with
+    ops/normalize.score_bounds semantics (highest floored at 0, the
+    hi==lo guard applied) — the min-max epilogue's stats feed. Operands
+    are the already-prepped [4, pp]/[3, nn] feature blocks; the raw
+    score is recomputed per tile and reduced in VMEM, so this pass
+    reads/writes NO [p, n] HBM intermediate."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    pp, nn = pod_sc.shape[1], node_ft.shape[1]
+    raw = pl.pallas_call(
+        _row_stats_kernel,
+        out_shape=jax.ShapeDtypeStruct((2, pp), jnp.float32),
+        grid=(pp // tile_p, nn // tile_n),
+        in_specs=[
+            pl.BlockSpec((4, tile_p), lambda i, j: (0, i)),
+            pl.BlockSpec((3, tile_n), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((2, tile_p), lambda i, j: (0, i)),
+        interpret=interpret,
+    )(pod_sc, node_ft)
+    # ops/normalize.score_bounds + min_max_normalize's hi==lo guard —
+    # tiny [pp] ops, kept OUTSIDE the kernels so the expression is the
+    # shared normalize module's, line for line
+    highest = jnp.maximum(raw[0], 0.0)
+    lowest = jnp.where(highest == raw[1], raw[1] - 1.0, raw[1])
+    return jnp.stack([highest, lowest])
+
+
+def _bid_kernel(sj_ref, price_ref, act_ref, req_ref, free_ref,
+                bid_ref, has_ref, best_ref, *, n_res: int, tile_n: int):
+    """One (TILE_P, TILE_N) block of one auction round's bidding:
+    capacity mask + price-adjusted value + running row argmax.
+
+    sj_ref:    [TILE_P, TILE_N] feasibility-masked jittered scores (NEG
+               where infeasible — round-invariant, precomputed once)
+    price_ref: [1, TILE_N] current node prices
+    act_ref:   [1, TILE_P] active (unassigned, real) pods as float
+    req_ref:   [n_res, TILE_P] pod requests, resource-major
+    free_ref:  [n_res, TILE_N] current free capacity, resource-major
+    bid_ref:   [1, TILE_P] int32 — running argmax (global column id)
+    has_ref:   [1, TILE_P] int32 — running any-feasible-bid flag
+    best_ref:  [1, TILE_P] f32 — running row maximum
+
+    Tie semantics replicate jnp.argmax(row) exactly: within a block the
+    FIRST column attaining the block max wins; across blocks a later
+    block replaces the running best only when STRICTLY greater.
+    """
+    j = pl.program_id(1)
+    sj = sj_ref[:, :]
+    price = price_ref[0, :][None, :]
+    act = act_ref[0, :][:, None] > 0.0
+    cap_ok = act
+    for r in range(n_res):
+        req = req_ref[r, :][:, None]
+        cap_ok = cap_ok & (
+            (req <= free_ref[r, :][None, :]) | (req == 0.0)
+        )
+    mask = (sj > NEG * 0.5) & cap_ok
+    row = jnp.where(mask, sj - price, NEG)
+    blk_max = row.max(axis=1)                                  # [TILE_P]
+    iota = jax.lax.broadcasted_iota(jnp.int32, row.shape, 1)
+    blk_arg = jnp.where(
+        row == blk_max[:, None], iota + j * tile_n, jnp.int32(2**31 - 1)
+    ).min(axis=1)
+    anyb = mask.any(axis=1).astype(jnp.int32)
+
+    @pl.when(j == 0)
+    def _init():
+        best_ref[0, :] = blk_max
+        bid_ref[0, :] = blk_arg
+        has_ref[0, :] = anyb
+
+    @pl.when(j != 0)
+    def _fold():
+        prev = best_ref[0, :]
+        better = blk_max > prev
+        best_ref[0, :] = jnp.where(better, blk_max, prev)
+        bid_ref[0, :] = jnp.where(better, blk_arg, bid_ref[0, :])
+        has_ref[0, :] = has_ref[0, :] | anyb
+
+
+def fused_auction_bid(
+    sj_padded: jnp.ndarray,
+    price: jnp.ndarray,
+    active: jnp.ndarray,
+    req_t_padded: jnp.ndarray,
+    free: jnp.ndarray,
+    *,
+    p: int,
+    tile_p: int = TILE_P,
+    tile_n: int = TILE_N,
+    interpret: bool | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(bid [p] int32, has_bid [p] bool) for one auction round — the
+    fused equivalent of ops/assign's XLA round head
+
+        mask = (sj > NEG/2) & cap_ok & active[:, None]
+        bid  = argmax(where(mask, sj - price, NEG), axis=1)
+
+    without materializing the [p, n, r] capacity broadcast or the
+    [p, n] bid row in HBM (at 1k pods x 4k nodes x 7 resources those
+    were ~130 MB of HBM traffic PER ROUND).
+
+    sj_padded:    [pp, nn] round-invariant masked scores, NEG-padded
+                  (hoisted out of the round loop by the caller)
+    price:        [n] current prices
+    active:       [p] bool — pod_mask & unassigned
+    req_t_padded: [r, pp] resource-major requests (round-invariant)
+    free:         [n, r] current free capacity
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    pp, nn = sj_padded.shape
+    n_res = free.shape[1]
+    price_p = _pad_axis(price.astype(jnp.float32)[None, :], 1, tile_n)
+    act_p = _pad_axis(active.astype(jnp.float32)[None, :], 1, tile_p)
+    free_t = _pad_axis(free.astype(jnp.float32).T, 1, tile_n)
+    bid, has, _best = pl.pallas_call(
+        functools.partial(_bid_kernel, n_res=n_res, tile_n=tile_n),
+        out_shape=(
+            jax.ShapeDtypeStruct((1, pp), jnp.int32),
+            jax.ShapeDtypeStruct((1, pp), jnp.int32),
+            jax.ShapeDtypeStruct((1, pp), jnp.float32),
+        ),
+        grid=(pp // tile_p, nn // tile_n),
+        in_specs=[
+            pl.BlockSpec((tile_p, tile_n), lambda i, j: (i, j)),
+            pl.BlockSpec((1, tile_n), lambda i, j: (0, j)),
+            pl.BlockSpec((1, tile_p), lambda i, j: (0, i)),
+            pl.BlockSpec((n_res, tile_p), lambda i, j: (0, i)),
+            pl.BlockSpec((n_res, tile_n), lambda i, j: (0, j)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, tile_p), lambda i, j: (0, i)),
+            pl.BlockSpec((1, tile_p), lambda i, j: (0, i)),
+            pl.BlockSpec((1, tile_p), lambda i, j: (0, i)),
+        ),
+        interpret=interpret,
+    )(sj_padded, price_p, act_p, req_t_padded, free_t)
+    return bid[0, :p], has[0, :p] > 0

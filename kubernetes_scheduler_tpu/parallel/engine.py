@@ -540,8 +540,12 @@ def _window_pipeline(snapshot, pods, policy, normalizer, soft, axes,
     this shard's node columns — the balanced_cpu_diskio formula is
     purely node-local (u, v per node; no cross-node statistic), so the
     kernel shards with zero extra collectives. Requires
-    normalizer="none", like the dense fused path; `scores`/`feasible`
-    carry the NEG-masked contract of engine._fused_masked_scores."""
+    normalizer="none" (STRICTER than the dense path, which also admits
+    min_max via the kernel epilogue: the sharded min-max bounds are
+    pmax/pmin-reduced GLOBAL values a shard-local epilogue cannot see —
+    engine.check_fused_contract's min_max_ok stays False here);
+    `scores`/`feasible` carry the NEG-masked contract of
+    engine._fused_masked_scores."""
     # spec.nodeName pinning is GLOBAL (target_node indexes the full
     # node axis) but feasibility columns are shard-LOCAL: translate by
     # this shard's offset, mapping out-of-shard targets to the

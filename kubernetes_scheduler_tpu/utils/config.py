@@ -28,9 +28,16 @@ class FeatureGates:
     # use the C++ host runtime (native/) for the queue and the scalar
     # fallback cycle; off -> pure-Python equivalents, same decisions
     native_host: bool = True
-    # route score + resource-fit through the fused Pallas kernel
-    # (ops/pallas_fused.py) when policy/normalizer permit; decisions are
-    # identical, the [p, n] pass is one HBM round-trip instead of three
+    # route the device step through the fused Pallas megakernel
+    # (ops/pallas_fused.py) when policy/normalizer permit: score,
+    # resource fit, nodeName pinning, the count-based constraint
+    # families, the remaining constraint mask, and the min-max epilogue
+    # in ONE tiled [p, n] pass instead of up to seven HBM round-trips.
+    # Engages for policy="balanced_cpu_diskio" with normalizer "none"
+    # OR — for local TPU-backend engines — "min_max" (the deployed
+    # default); softmax configurations, CPU engines under min_max, and
+    # remote sidecars under min_max (no capability bit yet) run
+    # unfused (decisions identical either way — PARITY round 12)
     fused_kernel: bool = True
 
 

@@ -19,6 +19,25 @@ def _bad_kernel(x_ref, y_ref, out_ref):
     out_ref[...] = (acc + y_ref[...]).astype(jnp.float32)
 
 
+def _binop_kernel(x_ref, out_ref):
+    out_ref[...] = x_ref[...]
+
+
+def bad_binop_call(x):
+    return pl.pallas_call(
+        _binop_kernel,
+        out_shape=jax.ShapeDtypeStruct((8, 192), jnp.float32),
+        grid=(1, 1),
+        in_specs=[
+            # LINE: minor axis 64 * 3 = 192, resolved through the BinOp
+            # arithmetic the fused megakernel's stacked-row shapes use —
+            # not a multiple of 128
+            pl.BlockSpec((8, 64 * 3), lambda i, j: (i, j)),
+        ],
+        out_specs=pl.BlockSpec((8, 64 * 3), lambda i, j: (i, j)),
+    )(x)
+
+
 def bad_call(x, y):
     return pl.pallas_call(
         functools.partial(_bad_kernel),

@@ -24,6 +24,10 @@ SMOKE_ENV = {
     "BENCH_BASELINE_PODS": "8",
     "BENCH_LOOP_NODES": "32",
     "BENCH_LOOP_PODS": "64",
+    # smoke keeps the old 3-sample drains: the >=10-cycle sampling the
+    # real bench uses for stable p50/p99 would multiply this test's
+    # wall time for percentiles nobody reads at toy sizes
+    "BENCH_LOOP_SAMPLES": "3",
 }
 
 
@@ -51,6 +55,7 @@ def test_bench_smoke_e2e():
         "host_loop_32nodes",
         "host_loop_32nodes_deep16w",
         "host_loop_32nodes_pipelined",
+        "host_loop_32nodes_fused",
         "host_loop_32nodes_resident",
         "host_loop_32nodes_replay",
         "host_loop_32nodes_telemetry",
@@ -69,6 +74,14 @@ def test_bench_smoke_e2e():
     # the pipelined loop reports its observability companions
     assert "host_overlap_p50_ms" in metrics["host_loop_32nodes_pipelined"]
     assert "pipeline_flushes" in metrics["host_loop_32nodes_pipelined"]
+    # the fused metric carries the in-round fused/unfused A-B so the
+    # megakernel's engine delta is in-data every round (the speedup
+    # itself is not asserted at smoke sizes — CPU interpreter cycles)
+    fus = metrics["host_loop_32nodes_fused"]
+    assert fus["pods_bound"] > 0, fus
+    assert fus["unfused_pods_per_sec"] > 0, fus
+    assert "fused_engine_speedup" in fus and "fused_cycle_speedup" in fus
+    assert fus["fallback_cycles"] == 0, fus
     # the resident loop actually exercised the delta path and reports
     # the upload accounting the acceptance gate reads
     res = metrics["host_loop_32nodes_resident"]
@@ -115,6 +128,61 @@ def test_bench_smoke_e2e():
     gang = metrics["scenario_gang_32nodes"]
     assert gang["gangs_admitted"] > 0, gang
     assert 0.0 < gang["gang_admit_rate"] <= 1.0, gang
+
+
+def test_perf_gate_e2e(tmp_path):
+    """The `make perf-gate` flow as a test: a fresh telemetry-shaped
+    drain's span directory diffed against the COMMITTED
+    BENCH_SPAN_BASELINE.json with the gate's per-stage thresholds —
+    a per-stage fusion regression (e.g. an interpreter-mode kernel
+    sneaking onto the CPU host path) fails loudly; then the synthetic
+    trip-wire check (a slowed engine_step must exit 1) proves the gate
+    can actually fail."""
+    spans_dir = str(tmp_path / "spans")
+    env = {
+        **os.environ, "JAX_PLATFORMS": "cpu",
+        "BENCH_LOOP_NODES": "32", "BENCH_LOOP_PODS": "64",
+    }
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--perf-gate-spans", spans_dir],
+        capture_output=True, text=True, timeout=560, cwd=REPO, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:] + proc.stdout[-500:]
+    metric = json.loads(proc.stdout.splitlines()[-1])
+    assert metric["metric"] == "host_loop_32nodes_perfgate"
+    assert metric["spans_written"] > 0, metric
+
+    def spans_diff(base, cand):
+        # the `make perf-gate` thresholds: coarse floors (>20 ms AND
+        # >100-150%) so cross-machine wall-clock variance cannot trip
+        # the gate while an interpret-mode-kernel-class regression does
+        return subprocess.run(
+            [sys.executable, "-m", "kubernetes_scheduler_tpu", "spans",
+             "diff", base, cand,
+             "--threshold-pct", "100", "--min-ms", "20",
+             "--stage-threshold", "engine_step=150",
+             "--stage-threshold", "snapshot_build=150",
+             "--stage-threshold", "cycle=150"],
+            capture_output=True, text=True, timeout=120, cwd=REPO, env=env,
+        )
+
+    baseline = os.path.join(REPO, "BENCH_SPAN_BASELINE.json")
+    gate = spans_diff(baseline, spans_dir)
+    report = json.loads(gate.stdout.splitlines()[-1])
+    assert gate.returncode == 0, report
+    assert report["clean"], report
+    # trip-wire: the gate must be able to FAIL — a 20x engine_step blows
+    # both the 5 ms floor and the 150% stage threshold
+    from kubernetes_scheduler_tpu.trace.analyze import perturb_spans
+
+    slow = str(tmp_path / "spans-slow")
+    perturb_spans(spans_dir, slow, stage="engine_step", factor=20.0)
+    tripped = spans_diff(baseline, slow)
+    assert tripped.returncode == 1, tripped.stdout[-800:]
+    assert "engine_step" in json.loads(
+        tripped.stdout.splitlines()[-1]
+    )["regressions"]
 
 
 def test_obs_smoke_e2e(tmp_path):

@@ -6,6 +6,7 @@ semantics.
 """
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 import pytest
 
@@ -36,16 +37,26 @@ def make_problem(p, n, r=3):
 
 def reference_masked(alloc, reqd, disk_io, cpu, pod_req, r_cpu, r_io,
                      node_mask, pod_mask):
-    stats = utilization_stats(
-        jnp.asarray(disk_io), jnp.asarray(cpu), jnp.asarray(node_mask)
+    return np.asarray(
+        _reference_masked_jit(
+            jnp.asarray(alloc), jnp.asarray(reqd), jnp.asarray(disk_io),
+            jnp.asarray(cpu), jnp.asarray(pod_req), jnp.asarray(r_cpu),
+            jnp.asarray(r_io), jnp.asarray(node_mask), jnp.asarray(pod_mask),
+        )
     )
-    score = balanced_cpu_diskio(stats, jnp.asarray(r_cpu), jnp.asarray(r_io))
-    fits = resource_fit(
-        jnp.asarray(alloc), jnp.asarray(reqd), jnp.asarray(pod_req),
-        jnp.asarray(node_mask),
-    )
-    fits = fits & jnp.asarray(pod_mask)[:, None]
-    return np.asarray(jnp.where(fits, score, NEG))
+
+
+@jax.jit
+def _reference_masked_jit(alloc, reqd, disk_io, cpu, pod_req, r_cpu, r_io,
+                          node_mask, pod_mask):
+    # jitted like the engine's unfused path: eager op-by-op dispatch
+    # rounds float contractions differently from compiled XLA, and the
+    # parity the engine pins is between the two COMPILED paths
+    stats = utilization_stats(disk_io, cpu, node_mask)
+    score = balanced_cpu_diskio(stats, r_cpu, r_io)
+    fits = resource_fit(alloc, reqd, pod_req, node_mask)
+    fits = fits & pod_mask[:, None]
+    return jnp.where(fits, score, NEG)
 
 
 @pytest.mark.parametrize("p,n", [(4, 16), (17, 130), (64, 300)])
@@ -131,15 +142,297 @@ def test_fused_windows_match_unfused():
     assert int(got.n_assigned) == int(base.n_assigned)
 
 
-def test_fused_rejects_incompatible_options():
+# tile-boundary property sweep (the shapes that break tiled kernels:
+# exactly at and one off the TILE multiples, with the small tiles the
+# interpreter can afford), crossed with the resource-axis widths the
+# unrolled fit loop sees in production. On a TPU backend the same cases
+# compile through Mosaic (interpret=None auto-selects the native path).
+_TILE_P, _TILE_N = 8, 128
+_BOUNDARY_SHAPES = [
+    (_TILE_P, _TILE_N),                  # exactly one tile
+    (_TILE_P - 1, _TILE_N - 1),          # one under
+    (_TILE_P + 1, _TILE_N + 1),          # one over
+    (2 * _TILE_P, 2 * _TILE_N),          # exact multiple
+    (2 * _TILE_P + 1, _TILE_N),          # ragged pod axis only
+    (_TILE_P, 2 * _TILE_N - 1),          # ragged node axis only
+]
+
+
+@pytest.mark.parametrize("p,n", _BOUNDARY_SHAPES)
+@pytest.mark.parametrize("n_res", [1, 4, 8])
+def test_fused_tile_boundaries_bitwise(p, n, n_res):
+    """Tile-boundary parity with the unfused reference: the feasibility
+    pattern, the NEG sentinels, and the per-row DECISION (argmax over
+    feasible cells — what the assigners consume) are bitwise equal;
+    feasible-cell values agree to float-contraction tolerance (XLA is
+    free to FMA-contract `alpha*v - beta*u` differently per graph, so
+    exact value identity between two compiled graphs is not a
+    guarantee either path makes)."""
+    alloc, reqd, disk_io, cpu, pod_req, r_cpu, r_io = make_problem(
+        p, n, r=n_res
+    )
+    node_mask = np.ones(n, bool)
+    node_mask[- max(1, n // 5):] = False
+    pod_mask = np.ones(p, bool)
+    pod_mask[-1] = False
+    stats = utilization_stats(
+        jnp.asarray(disk_io), jnp.asarray(cpu), jnp.asarray(node_mask)
+    )
+    got = np.asarray(
+        fused_masked_score(
+            stats.u, stats.v, jnp.asarray(node_mask),
+            jnp.asarray(alloc), jnp.asarray(reqd),
+            jnp.asarray(r_cpu), jnp.asarray(r_io),
+            jnp.asarray(pod_req), jnp.asarray(pod_mask),
+            tile_p=_TILE_P, tile_n=_TILE_N,
+        )
+    )
+    want = reference_masked(
+        alloc, reqd, disk_io, cpu, pod_req, r_cpu, r_io, node_mask, pod_mask
+    )
+    feas_got = got > NEG * 0.5
+    feas_want = want > NEG * 0.5
+    np.testing.assert_array_equal(feas_got, feas_want)
+    assert (got[~feas_want] == NEG).all()
+    np.testing.assert_allclose(
+        got[feas_want], want[feas_want], rtol=1e-5, atol=1e-5
+    )
+    np.testing.assert_array_equal(
+        np.argmax(got, axis=1), np.argmax(want, axis=1)
+    )
+
+
+@pytest.mark.parametrize("which", ["rows", "cols", "both"])
+def test_fused_all_masked(which):
+    """Fully-masked pod rows / node columns return exactly NEG
+    everywhere (the all-padding degenerate tiles)."""
+    p, n = 9, 130
+    alloc, reqd, disk_io, cpu, pod_req, r_cpu, r_io = make_problem(p, n)
+    node_mask = np.zeros(n, bool) if which in ("cols", "both") else np.ones(n, bool)
+    pod_mask = np.zeros(p, bool) if which in ("rows", "both") else np.ones(p, bool)
+    stats = utilization_stats(
+        jnp.asarray(disk_io), jnp.asarray(cpu), jnp.asarray(node_mask)
+    )
+    got = np.asarray(
+        fused_masked_score(
+            stats.u, stats.v, jnp.asarray(node_mask),
+            jnp.asarray(alloc), jnp.asarray(reqd),
+            jnp.asarray(r_cpu), jnp.asarray(r_io),
+            jnp.asarray(pod_req), jnp.asarray(pod_mask),
+            tile_p=_TILE_P, tile_n=_TILE_N,
+        )
+    )
+    assert got.shape == (p, n)
+    assert (got == NEG).all()
+
+
+@pytest.mark.parametrize("normalizer", ["none", "min_max"])
+def test_fused_folded_constraints_match_unfused(normalizer):
+    """The megakernel's folded families — count-based (anti)affinity,
+    reverse avoiders, topology spread, spec.nodeName pinning — against
+    the unfused composition: include_pod_affinity engaged via
+    affinity_aware=False, bitwise decisions and feasibility."""
     from kubernetes_scheduler_tpu.engine import schedule_batch
+    from kubernetes_scheduler_tpu.sim import gen_cluster, gen_pods
+
+    snap = gen_cluster(96, seed=21, constraints=True)
+    pods = gen_pods(32, seed=22, constraints=True)
+    # pin a few pods to nodes (incl. one out-of-range = never fits) so
+    # the kernel's global-column target fold is exercised
+    tgt = np.asarray(pods.target_node).copy()
+    tgt[0], tgt[1], tgt[2] = 5, 95, 200
+    pods = pods._replace(target_node=jnp.asarray(tgt))
+    for assigner in ("greedy", "auction"):
+        base = schedule_batch(
+            snap, pods, assigner=assigner, normalizer=normalizer,
+            fused=False, affinity_aware=False,
+        )
+        got = schedule_batch(
+            snap, pods, assigner=assigner, normalizer=normalizer,
+            fused=True, affinity_aware=False,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got.feasible), np.asarray(base.feasible)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got.node_idx), np.asarray(base.node_idx)
+        )
+
+
+def test_fused_wide_selector_axis_falls_back():
+    """A selector axis past MAX_FUSED_SELECTORS routes the count-based
+    families through the outside composition — decisions unchanged."""
+    from kubernetes_scheduler_tpu.engine import schedule_batch
+    from kubernetes_scheduler_tpu.ops.pallas_fused import MAX_FUSED_SELECTORS
+    from kubernetes_scheduler_tpu.sim import gen_cluster, gen_pods
+
+    snap = gen_cluster(64, seed=31, constraints=True)
+    pods = gen_pods(16, seed=32, constraints=True)
+    s_wide = MAX_FUSED_SELECTORS * 2
+    n = np.asarray(snap.domain_counts).shape[0]
+    dc = np.zeros((n, s_wide), np.float32)
+    dc[:, : np.asarray(snap.domain_counts).shape[1]] = np.asarray(
+        snap.domain_counts
+    )
+    dom = np.tile(np.arange(n, dtype=np.int32)[:, None], (1, s_wide))
+    zeros = np.zeros_like(dc)
+    snap = snap._replace(
+        domain_counts=jnp.asarray(dc), domain_id=jnp.asarray(dom),
+        avoid_counts=jnp.asarray(zeros), pref_attract=jnp.asarray(zeros),
+        pref_avoid=jnp.asarray(zeros),
+    )
+    base = schedule_batch(
+        snap, pods, normalizer="none", fused=False, affinity_aware=False
+    )
+    got = schedule_batch(
+        snap, pods, normalizer="none", fused=True, affinity_aware=False
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.node_idx), np.asarray(base.node_idx)
+    )
+
+
+def test_resident_layout_matches_repad():
+    """FusedLayout delta-folding vs per-call re-pad: a resident engine
+    serving fused cycles off delta-updated kernel-layout buffers makes
+    bitwise the same decisions as full re-uploads re-deriving the prep
+    (PARITY round 12, resident-layout <-> re-pad identity)."""
+    import jax
+
+    from kubernetes_scheduler_tpu.engine import (
+        LocalEngine,
+        build_fused_layout,
+        schedule_batch,
+    )
+    from kubernetes_scheduler_tpu.host.snapshot import snapshot_delta
+    from kubernetes_scheduler_tpu.sim import gen_cluster, gen_pods
+
+    # host-shaped inputs (numpy leaves, like SnapshotBuilder emits):
+    # the resident path device_puts its own PRIVATE copy, which the
+    # delta apply then donates — device-array inputs would alias it
+    snap0 = gen_cluster(64, seed=41)
+    snap0 = type(snap0)(*[np.asarray(a) for a in snap0])
+    pods = gen_pods(16, seed=42)
+    kw = dict(normalizer="none", fused=True)
+
+    eng = LocalEngine()
+    res0 = eng.schedule_resident(snap0, pods, epoch=1, **kw)
+    assert eng._resident.layout is not None  # fused cycle built it
+
+    # a second cycle's snapshot: utilization + requested rows moved
+    d_io = np.asarray(snap0.disk_io).copy()
+    d_io[:5] += 3.0
+    req = np.asarray(snap0.requested).copy()
+    req[7] += 1.5
+    snap1 = snap0._replace(disk_io=d_io, requested=req)
+    delta = snapshot_delta(snap0, snap1)
+    assert delta is not None
+    res1 = eng.schedule_resident(snap1, pods, delta=delta, epoch=2, **kw)
+    assert eng.resident_used_delta
+
+    # reference: fresh full uploads, layout re-derived from scratch
+    ref0 = schedule_batch(jax.device_put(snap0), pods, **kw)
+    ref1 = schedule_batch(jax.device_put(snap1), pods, **kw)
+    np.testing.assert_array_equal(
+        np.asarray(res0.node_idx), np.asarray(ref0.node_idx)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(res1.node_idx), np.asarray(ref1.node_idx)
+    )
+    # and the delta-folded layout buffers ARE the from-scratch prep
+    fresh = build_fused_layout(jax.device_put(snap1))
+    for a, b in zip(eng._resident.layout, fresh):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_auction_bid_kernel_bitwise():
+    """fused_auction_bid vs the XLA round head: bitwise-identical
+    assignments under capacity contention, priorities, and ties."""
+    from kubernetes_scheduler_tpu.ops.assign import auction_assign
+
+    rng = np.random.default_rng(3)
+    for p, n, r in ((17, 130, 3), (64, 256, 5), (8, 128, 1)):
+        scores = rng.uniform(0, 10, (p, n)).astype(np.float32)
+        # inject exact ties so first-max semantics are actually exercised
+        scores[:, n // 2] = scores[:, n // 3]
+        feasible = rng.uniform(size=(p, n)) < 0.7
+        feasible[-1] = False  # an all-infeasible pod
+        req = rng.uniform(0, 4, (p, r)).astype(np.float32)
+        req[rng.uniform(size=(p, r)) < 0.3] = 0.0
+        free = rng.uniform(1, 6, (n, r)).astype(np.float32)
+        prio = rng.integers(0, 3, p).astype(np.int32)
+        mask = np.ones(p, bool)
+        kw = dict(rounds=64, price_frac=1.0)
+        base = auction_assign(
+            jnp.asarray(scores), jnp.asarray(feasible), jnp.asarray(req),
+            jnp.asarray(free), jnp.asarray(prio), jnp.asarray(mask),
+            bid_kernel=False, **kw,
+        )
+        got = auction_assign(
+            jnp.asarray(scores), jnp.asarray(feasible), jnp.asarray(req),
+            jnp.asarray(free), jnp.asarray(prio), jnp.asarray(mask),
+            bid_kernel=True, **kw,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got.node_idx), np.asarray(base.node_idx)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got.free_after), np.asarray(base.free_after)
+        )
+
+
+def test_fused_rejects_incompatible_options():
+    from kubernetes_scheduler_tpu.engine import (
+        check_fused_contract,
+        schedule_batch,
+    )
     from kubernetes_scheduler_tpu.sim import gen_cluster, gen_pods
 
     snap = gen_cluster(8, seed=0)
     pods = gen_pods(2, seed=1)
+    # softmax stays outside the fused contract (its exp/sum statistics
+    # would fold the NEG sentinels); min_max is admitted on the dense
+    # surface via the kernel epilogue (test_fused_min_max_matches_unfused)
     with pytest.raises(ValueError, match="normalizer"):
-        schedule_batch(snap, pods, normalizer="min_max", fused=True)
+        schedule_batch(snap, pods, normalizer="softmax", fused=True)
     with pytest.raises(ValueError, match="fused kernel"):
         schedule_batch(
             snap, pods, policy="free_capacity", normalizer="none", fused=True
         )
+    # the sharded factories keep the strict contract: their min-max
+    # bounds are global pmax/pmin reductions the shard-local kernel
+    # epilogue cannot see (engine.check_fused_contract min_max_ok)
+    with pytest.raises(ValueError, match="normalizer"):
+        check_fused_contract("balanced_cpu_diskio", "min_max")
+    check_fused_contract("balanced_cpu_diskio", "min_max", min_max_ok=True)
+
+
+def test_fused_min_max_matches_unfused():
+    """normalizer="min_max" through the kernel epilogue: decisions AND
+    feasible-cell score values bitwise equal to the unfused
+    normalize-then-mask composition, on both assigners."""
+    from kubernetes_scheduler_tpu.engine import schedule_batch
+    from kubernetes_scheduler_tpu.sim import gen_cluster, gen_pods
+
+    snap = gen_cluster(96, seed=11, constraints=True)
+    pods = gen_pods(24, seed=12, constraints=True)
+    for assigner in ("greedy", "auction"):
+        for soft in (False, True):
+            base = schedule_batch(
+                snap, pods, assigner=assigner, normalizer="min_max",
+                fused=False, soft=soft,
+            )
+            got = schedule_batch(
+                snap, pods, assigner=assigner, normalizer="min_max",
+                fused=True, soft=soft,
+            )
+            np.testing.assert_array_equal(
+                np.asarray(got.feasible), np.asarray(base.feasible)
+            )
+            np.testing.assert_array_equal(
+                np.asarray(got.node_idx), np.asarray(base.node_idx)
+            )
+            feas = np.asarray(base.feasible)
+            np.testing.assert_array_equal(
+                np.asarray(got.scores)[feas], np.asarray(base.scores)[feas]
+            )

@@ -1,17 +1,28 @@
-"""graftlint: repo-native static analysis.
+"""graftlint: repo-native static analysis, two layers.
 
 The scheduler's correctness rests on invariants no test can check
-exhaustively — pure jitted scoring kernels, lock-guarded shared caches
-between the advisor/queue/bridge threads, a stable wire schema between
-host and sidecar. This package machine-enforces them as AST-level lint
-rules over the repo's own source:
+exhaustively — pure jitted scoring kernels, donated resident buffers,
+lock-guarded shared caches between the driver/bridge/exporter threads,
+a stable wire schema between host and sidecar. This package
+machine-enforces them:
 
-  jit-purity       no side effects reachable from jax.jit entry points
-  host-sync        no device barriers / per-element syncs in the cycle path
-  lock-discipline  attrs mutated under a class's lock stay under it
-  wire-schema      schedule_pb2 field usage must exist in schedule.proto
-  dtype-shape      no float64 promotion / traced-bool branching in kernels
-  timeout-hygiene  external calls (HTTP, subprocess, waits) carry timeouts
+Layer 1 — fourteen AST rule families over the repo's own source. The
+per-file era families (jit-purity, host-sync, lock-discipline,
+wire-schema, dtype-shape, timeout-hygiene, pallas-vmem, metric-hygiene,
+sim-determinism, span-hygiene) plus four interprocedural families built
+on the shared dataflow core (analysis/dataflow.py — parse-once module
+index, project call graph, branch-path def-use, donation summaries,
+lockset fixpoint):
+
+  donation-aliasing  donated buffer re-read, across modules/helpers
+  host-transfer      implicit device→host syncs in the hot-path modules
+  tracer-leak        tracers stored where they outlive the traced call
+  lockset-race       guarded attrs need a consistent call-graph lockset
+
+Layer 2 — engine contracts (analysis/contracts.py): every engine entry
+point's shape/dtype contract verified by jax.eval_shape tracing on CPU
+across a bucket-shape grid, fused and unfused paths diffed against the
+same declaration.
 
 Run:  python -m kubernetes_scheduler_tpu.analysis   (or `make lint`)
 
@@ -19,7 +30,11 @@ A genuine-but-intended site is waived inline with a justification:
 
   x = a.item()  # graftlint: disable=host-sync -- host numpy by contract
 
-A waiver without the `-- reason` clause is itself a violation.
+A waiver without the `-- reason` clause is itself a violation; a waiver
+above a decorator covers the whole def, one on a multi-line statement
+covers the statement. CI artifacts: `--format json|sarif`,
+`--json-artifact`, and the LINT_BASELINE.json suppression file (stale
+or unexplained entries fail lint).
 """
 
 from kubernetes_scheduler_tpu.analysis.core import (  # noqa: F401

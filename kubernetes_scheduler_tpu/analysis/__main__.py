@@ -1,18 +1,47 @@
 """graftlint CLI: `python -m kubernetes_scheduler_tpu.analysis`.
 
 Exits non-zero on any unwaived violation; `make lint` wires this into
-the build. Waived sites are listed (with their justifications) under
---verbose so the allow-list stays reviewable.
+the build. Beyond the fourteen AST families, a full-repo run also
+traces the engine-contract layer (analysis/contracts.py, jax.eval_shape
+on CPU) unless --no-contracts; machine output: `--format json|sarif`
+(SARIF 2.1.0 — validated structurally before printing, so a malformed
+artifact fails lint, not the CI uploader), `--json-artifact PATH` to
+drop the findings JSON beside any display format, `--baseline` for the
+checked-in suppression file (stale or unexplained entries fail lint),
+and `--budget-seconds` asserting the whole run's wall time — the
+parse-once index keeps full-repo lint inside it. Waived sites are
+listed (with their justifications) under --verbose so the allow-list
+stays reviewable.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+import time
 
-from kubernetes_scheduler_tpu.analysis.core import run_lint
+from kubernetes_scheduler_tpu.analysis.core import (
+    BASELINE_NAME,
+    _REPO_ROOT,
+    apply_baseline,
+    load_baseline,
+    run_lint,
+)
 from kubernetes_scheduler_tpu.analysis.rules import RULES
+
+
+def _rule_docs() -> dict:
+    """rule id -> first docstring line of its module (SARIF metadata)."""
+    import importlib
+
+    docs = {}
+    for name, fn in RULES.items():
+        mod = importlib.import_module(fn.__module__)
+        head = (mod.__doc__ or name).strip().splitlines()[0]
+        docs[name] = head
+    return docs
 
 
 def main(argv=None) -> int:
@@ -29,13 +58,36 @@ def main(argv=None) -> int:
         help=f"comma-separated rule subset of: {', '.join(sorted(RULES))}",
     )
     parser.add_argument(
-        "--format", choices=("text", "json"), default="text",
+        "--format", choices=("text", "json", "sarif"), default="text",
+    )
+    parser.add_argument(
+        "--json-artifact", metavar="PATH",
+        help="also write the findings JSON to PATH (CI artifact)",
+    )
+    parser.add_argument(
+        "--baseline", metavar="PATH", default=None,
+        help=f"suppression file (default: {BASELINE_NAME} at the repo "
+             "root when present); --no-baseline disables",
+    )
+    parser.add_argument("--no-baseline", action="store_true")
+    parser.add_argument(
+        "--contracts", action="store_true",
+        help="run the engine-contract layer even for a path-scoped lint",
+    )
+    parser.add_argument(
+        "--no-contracts", action="store_true",
+        help="skip the engine-contract layer on a full-repo lint",
+    )
+    parser.add_argument(
+        "--budget-seconds", type=float, default=None,
+        help="fail if the whole run exceeds this wall time",
     )
     parser.add_argument(
         "--verbose", action="store_true",
         help="also list waived violations with their justifications",
     )
     args = parser.parse_args(argv)
+    t0 = time.monotonic()
 
     rules = (
         [r.strip() for r in args.rules.split(",") if r.strip()]
@@ -46,11 +98,53 @@ def main(argv=None) -> int:
         violations = run_lint(args.paths or None, rules=rules)
     except ValueError as e:
         parser.error(str(e))
+
+    # layer 2: engine contracts — on by default for the full-repo run
+    # `make lint` does, opt-in for scoped runs (tracing needs jax)
+    full_repo = not args.paths and rules is None
+    if args.contracts or (full_repo and not args.no_contracts):
+        from kubernetes_scheduler_tpu.analysis.contracts import (
+            check_contracts,
+        )
+
+        violations.extend(check_contracts())
+
+    baseline = args.baseline
+    if baseline is None and not args.no_baseline:
+        default = os.path.join(_REPO_ROOT, BASELINE_NAME)
+        baseline = default if os.path.exists(default) else None
+    if baseline and not args.no_baseline:
+        try:
+            entries = load_baseline(baseline)
+        except (OSError, ValueError) as e:
+            parser.error(f"--baseline {baseline}: {e}")
+        # scoped runs can't distinguish out-of-scope from stale — only
+        # the full-repo run polices baseline liveness
+        violations.extend(
+            apply_baseline(
+                violations, entries, baseline, check_stale=full_repo
+            )
+        )
+
+    violations.sort(key=lambda v: (v.path, v.line, v.rule))
     active = [v for v in violations if not v.waived]
     waived = [v for v in violations if v.waived]
 
+    if args.json_artifact:
+        with open(args.json_artifact, "w", encoding="utf-8") as f:
+            json.dump([v.__dict__ for v in violations], f, indent=2)
+
     if args.format == "json":
         print(json.dumps([v.__dict__ for v in violations], indent=2))
+    elif args.format == "sarif":
+        from kubernetes_scheduler_tpu.analysis.sarif import (
+            render_sarif,
+            validate_sarif,
+        )
+
+        doc = render_sarif(violations, _rule_docs())
+        validate_sarif(doc)
+        print(json.dumps(doc, indent=2))
     else:
         for v in active:
             print(v.format())
@@ -62,6 +156,14 @@ def main(argv=None) -> int:
             f"{len(waived)} waived",
             file=sys.stderr,
         )
+    elapsed = time.monotonic() - t0
+    if args.budget_seconds is not None and elapsed > args.budget_seconds:
+        print(
+            f"graftlint: wall time {elapsed:.1f}s exceeded the "
+            f"--budget-seconds {args.budget_seconds:.1f}s gate",
+            file=sys.stderr,
+        )
+        return 1
     return 1 if active else 0
 
 

@@ -1,0 +1,315 @@
+"""Layer 2: trace-backed engine contracts, verified with jax.eval_shape.
+
+The AST families (layer 1) prove properties of the SOURCE; this layer
+proves the engine boundary's shape/dtype contract by actually TRACING
+it — `jax.eval_shape` runs the full jaxpr abstraction on CPU (Pallas
+kernels included — abstract eval never launches them) without
+compiling or allocating, so `make lint` catches a contract drift
+between the fused and unfused paths, or between a wire-schema field's
+dtype and the engine's expectation, instead of a 4k-node bench round
+discovering it.
+
+Every entry point the host/bridge dispatch to is declared here with its
+EXPECTED output spec as a function of the bucket shape, and checked
+across a small grid of bucket shapes (two points per axis — enough to
+catch a shape formula drifting with n or p, cheap enough for lint):
+
+- `engine.schedule_batch` (greedy + auction, unfused) — ScheduleResult;
+- the fused path drift check: `schedule_batch(fused=True)` must produce
+  the IDENTICAL output spec as the unfused call it replaces;
+- `engine.schedule_windows` — WindowsResult;
+- `engine.apply_snapshot_delta` / `engine.apply_layout_delta` — donated
+  folds must be spec-preserving leaf for leaf (the resident-state
+  parity guarantee's static half);
+- `engine.build_fused_layout` and the `ops/pallas_fused` wrappers
+  (`fused_masked_score`, `fused_score_row_stats`, `fused_auction_bid`)
+  — the kernel-layout padding formulas.
+
+Violations surface as pseudo-rule `engine-contract` findings through
+the same CLI/baseline machinery as layer 1. Fixture modules (the
+violating/clean drift pair in tests/analysis_fixtures/) declare the
+same thing in miniature via a CONTRACTS table checked by
+`check_fixture_module`.
+"""
+
+from __future__ import annotations
+
+import os
+
+from kubernetes_scheduler_tpu.analysis.core import Violation
+
+RULE = "engine-contract"
+
+# bucket-shape grid: (nodes, pods, resources, selectors, windows)
+GRID = (
+    dict(n=16, p=8, r=7, s=3, w=2),
+    dict(n=64, p=32, r=7, s=3, w=2),
+)
+
+ENGINE_PATH = "kubernetes_scheduler_tpu/engine.py"
+FUSED_PATH = "kubernetes_scheduler_tpu/ops/pallas_fused.py"
+
+
+def _spec_tree(tree):
+    """Pytree of concrete arrays -> pytree of ShapeDtypeStruct."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), tree
+    )
+
+
+def _make_inputs(g):
+    """Concrete tiny snapshot/pods/delta/layout for one grid point —
+    built through the SAME constructors the host uses, then abstracted
+    to specs, so the contract tracks the real dispatch payload."""
+    import numpy as np
+
+    from kubernetes_scheduler_tpu import engine
+
+    n, p, r, s = g["n"], g["p"], g["r"], g["s"]
+    snap = engine.make_snapshot(
+        np.ones((n, r), np.float32),
+        np.zeros((n, r), np.float32),
+        np.zeros(n, np.float32),
+        np.zeros(n, np.float32),
+        np.zeros(n, np.float32),
+        domain_counts=np.zeros((n, s), np.float32),
+    )
+    pods = engine.make_pod_batch(
+        np.zeros((p, r), np.float32),
+        pod_matches=np.zeros((p, s), bool),
+    )
+    k = 2
+    delta = engine.SnapshotDelta(
+        req_rows=np.full(k, n, np.int32),
+        req_vals=np.zeros((k, r), np.float32),
+        util_rows=np.full(k, n, np.int32),
+        util_vals=np.zeros((k, 5), np.float32),
+        dom_rows=np.full(k, n, np.int32),
+        dom_vals=np.zeros((k, s, 4), np.float32),
+        node_mask=np.ones(n, bool),
+    )
+    return snap, pods, delta
+
+
+def _leaf_mismatches(name, got, want, fields=None):
+    """Human-readable diffs between two spec pytrees (NamedTuples or
+    single specs), field names attached."""
+    import jax
+
+    got_leaves, got_def = jax.tree_util.tree_flatten(got)
+    want_leaves, want_def = jax.tree_util.tree_flatten(want)
+    if got_def != want_def:
+        return [f"{name}: pytree structure {got_def} != declared {want_def}"]
+    names = fields or [str(i) for i in range(len(got_leaves))]
+    out = []
+    for fname, a, b in zip(names, got_leaves, want_leaves):
+        if tuple(a.shape) != tuple(b.shape) or a.dtype != b.dtype:
+            out.append(
+                f"{name}.{fname}: traced {tuple(a.shape)}/{a.dtype} != "
+                f"declared {tuple(b.shape)}/{b.dtype}"
+            )
+    return out
+
+
+def check_contracts() -> list[Violation]:
+    """Trace every declared engine entry point across the bucket grid
+    and diff against the declared specs. Returns [] when the engine
+    honors its contracts."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubernetes_scheduler_tpu import engine
+    from kubernetes_scheduler_tpu.ops import pallas_fused
+
+    out: list[Violation] = []
+
+    def sds(shape, dtype):
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+    for g in GRID:
+        n, p, r, s, w = g["n"], g["p"], g["r"], g["s"], g["w"]
+        snap_c, pods_c, delta_c = _make_inputs(g)
+        snap, pods, delta = (
+            _spec_tree(snap_c), _spec_tree(pods_c), _spec_tree(delta_c)
+        )
+        tag = f"[n={n} p={p} r={r} s={s}]"
+
+        def expect(name, path, fn, args, want, fields=None, line=1):
+            try:
+                got = jax.eval_shape(fn, *args)
+            except Exception as e:  # noqa: BLE001 — the trace failing IS the finding
+                out.append(Violation(
+                    RULE, path, line,
+                    f"{name} {tag}: eval_shape trace failed: {e}",
+                ))
+                return None
+            for msg in _leaf_mismatches(name, got, want, fields):
+                out.append(Violation(RULE, path, line, f"{tag} {msg}"))
+            return got
+
+        sched_want = engine.ScheduleResult(
+            node_idx=sds((p,), jnp.int32),
+            scores=sds((p, n), jnp.float32),
+            raw_scores=sds((p, n), jnp.float32),
+            feasible=sds((p, n), jnp.bool_),
+            free_after=sds((n, r), jnp.float32),
+            n_assigned=sds((), jnp.int32),
+        )
+        unfused = expect(
+            "schedule_batch", ENGINE_PATH,
+            functools.partial(engine.schedule_batch, assigner="greedy"),
+            (snap, pods), sched_want, engine.ScheduleResult._fields,
+        )
+        expect(
+            "schedule_batch(auction)", ENGINE_PATH,
+            functools.partial(
+                engine.schedule_batch, assigner="auction", auction_rounds=4
+            ),
+            (snap, pods), sched_want, engine.ScheduleResult._fields,
+        )
+        # fused-vs-unfused drift: the fused megakernel path must present
+        # the EXACT spec of the path it replaces
+        if unfused is not None:
+            expect(
+                "schedule_batch(fused)", ENGINE_PATH,
+                functools.partial(
+                    engine.schedule_batch, assigner="greedy", fused=True
+                ),
+                (snap, pods), unfused, engine.ScheduleResult._fields,
+            )
+        pods_w = jax.tree_util.tree_map(
+            lambda spec: sds((w,) + tuple(spec.shape), spec.dtype), pods
+        )
+        expect(
+            "schedule_windows", ENGINE_PATH,
+            engine.schedule_windows, (snap, pods_w),
+            engine.WindowsResult(
+                node_idx=sds((w, p), jnp.int32),
+                free_after=sds((n, r), jnp.float32),
+                n_assigned=sds((), jnp.int32),
+            ),
+            engine.WindowsResult._fields,
+        )
+        # donated folds are spec-preserving leaf for leaf
+        expect(
+            "apply_snapshot_delta", ENGINE_PATH,
+            engine.apply_snapshot_delta, (snap, delta), snap,
+            engine.SnapshotArrays._fields,
+        )
+        nn = -(-n // pallas_fused.TILE_N) * pallas_fused.TILE_N
+        layout_want = engine.FusedLayout(
+            node_ft=sds((3, nn), jnp.float32),
+            alloc_t=sds((r, nn), jnp.float32),
+            reqd_t=sds((r, nn), jnp.float32),
+        )
+        layout = expect(
+            "build_fused_layout", ENGINE_PATH,
+            engine.build_fused_layout, (snap,), layout_want,
+            engine.FusedLayout._fields,
+        )
+        if layout is not None:
+            expect(
+                "apply_layout_delta", ENGINE_PATH,
+                engine.apply_layout_delta, (layout, delta), layout_want,
+                engine.FusedLayout._fields,
+            )
+        # ops/pallas_fused wrappers: kernel-layout padding formulas
+        pp = -(-p // pallas_fused.TILE_P) * pallas_fused.TILE_P
+        expect(
+            "fused_masked_score", FUSED_PATH,
+            pallas_fused.fused_masked_score,
+            (
+                sds((n,), jnp.float32), sds((n,), jnp.float32),
+                sds((n,), jnp.bool_), sds((n, r), jnp.float32),
+                sds((n, r), jnp.float32), sds((p,), jnp.float32),
+                sds((p,), jnp.float32), sds((p, r), jnp.float32),
+                sds((p,), jnp.bool_),
+            ),
+            sds((p, n), jnp.float32),
+        )
+        expect(
+            "fused_score_row_stats", FUSED_PATH,
+            pallas_fused.fused_score_row_stats,
+            (sds((4, pp), jnp.float32), sds((3, nn), jnp.float32)),
+            sds((2, pp), jnp.float32),
+        )
+        expect(
+            "fused_auction_bid", FUSED_PATH,
+            functools.partial(pallas_fused.fused_auction_bid, p=p),
+            (
+                sds((pp, nn), jnp.float32), sds((n,), jnp.float32),
+                sds((p,), jnp.bool_), sds((r, pp), jnp.float32),
+                sds((n, r), jnp.float32),
+            ),
+            (sds((p,), jnp.int32), sds((p,), jnp.bool_)),
+        )
+    return out
+
+
+# the entry points the acceptance criteria pin — tests assert coverage
+CONTRACT_NAMES = (
+    "schedule_batch", "schedule_batch(auction)", "schedule_batch(fused)",
+    "schedule_windows", "apply_snapshot_delta", "apply_layout_delta",
+    "build_fused_layout", "fused_masked_score", "fused_score_row_stats",
+    "fused_auction_bid",
+)
+
+
+def check_fixture_module(path: str) -> list[Violation]:
+    """The miniature declarative form for fixtures and one-off modules:
+    the module's CONTRACTS table is a list of
+
+        {"fn": "name",
+         "args": [("float32", ("n", "r")), ...],
+         "out":  ("float32", ("n", "r"))  # or a list for tuple returns
+         "grid": [{"n": 8, "r": 4}, ...]}
+
+    dims are grid keys or int literals; each entry is eval_shape-checked
+    at every grid point."""
+    import importlib.util
+
+    import jax
+    import numpy as np
+
+    rel = os.path.basename(path)
+    spec = importlib.util.spec_from_file_location(
+        f"_contract_fixture_{abs(hash(path))}", path
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out: list[Violation] = []
+
+    def resolve(shape, g):
+        return tuple(d if isinstance(d, int) else g[d] for d in shape)
+
+    def to_spec(entry, g):
+        dtype, shape = entry
+        return jax.ShapeDtypeStruct(resolve(shape, g), np.dtype(dtype))
+
+    for decl in getattr(mod, "CONTRACTS", ()):
+        fn = getattr(mod, decl["fn"])
+        line = getattr(fn, "__code__", None)
+        line = line.co_firstlineno if line else 1
+        for g in decl["grid"]:
+            args = [to_spec(a, g) for a in decl["args"]]
+            want = decl["out"]
+            want = (
+                tuple(to_spec(o, g) for o in want)
+                if isinstance(want, list)
+                else to_spec(want, g)
+            )
+            try:
+                got = jax.eval_shape(fn, *args)
+            except Exception as e:  # noqa: BLE001
+                out.append(Violation(
+                    RULE, rel, line,
+                    f"{decl['fn']} {g}: eval_shape trace failed: {e}",
+                ))
+                continue
+            for msg in _leaf_mismatches(decl["fn"], got, want):
+                out.append(Violation(RULE, rel, line, f"{g} {msg}"))
+    return out

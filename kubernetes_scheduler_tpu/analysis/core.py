@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import ast
 import fnmatch
+import json
 import os
 import re
 from dataclasses import dataclass, field
@@ -52,9 +53,24 @@ class SourceFile:
     lines: list[str] = field(default_factory=list)
     # line -> (set of rule names | {"all"}, reason | None)
     waivers: dict[int, tuple[set, str | None]] = field(default_factory=dict)
+    # (start, end, rules, reason) spans: a waiver above a decorator
+    # covers the whole def; one on a multi-line statement covers every
+    # line of the statement
+    waiver_spans: list[tuple] = field(default_factory=list)
 
     def matches(self, patterns) -> bool:
         return any(fnmatch.fnmatch(self.path, p) for p in patterns)
+
+    def waiver_for(self, line: int, rule: str):
+        """(rules, reason) of the waiver covering `line` for `rule`, or
+        None — exact-line waivers first, then statement/def spans."""
+        w = self.waivers.get(line)
+        if w and (rule in w[0] or "all" in w[0]):
+            return w
+        for start, end, rules, reason in self.waiver_spans:
+            if start <= line <= end and (rule in rules or "all" in rules):
+                return (rules, reason)
+        return None
 
 
 @dataclass
@@ -65,6 +81,9 @@ class Context:
     explicit: bool = False
     # proto override for the wire-schema rule (tests)
     proto_path: str | None = None
+    # the run's shared parse-once ModuleIndex (analysis/dataflow.py),
+    # built lazily by dataflow.get_index and reused by every family
+    _index: object | None = None
 
     def scoped(self, patterns) -> list[SourceFile]:
         if self.explicit:
@@ -97,7 +116,56 @@ def _parse_waivers(sf: SourceFile) -> list[Violation]:
             target = i + 1
         entry = sf.waivers.setdefault(target, (set(), reason.strip()))
         entry[0].update(rules)
+    _resolve_waiver_spans(sf)
     return bad
+
+
+def _resolve_waiver_spans(sf: SourceFile) -> None:
+    """Widen line-targeted waivers whose target is structural:
+
+    - a waiver landing on a DECORATOR line (a comment above `@jit(...)`)
+      waives the whole decorated def — the finding it suppresses is a
+      property of the function, not of the one line the parser happened
+      to attribute it to;
+    - a waiver landing on the first line of a MULTI-LINE simple
+      statement covers every line of that statement (a violating
+      `dtype=` keyword two lines into a call is the same finding).
+
+    Waivers already inside the def/statement keep exact-line semantics —
+    widening those would let one waiver silence unrelated findings."""
+    if not sf.waivers:
+        return
+    dec_spans = []   # (first decorator line, def line, def end)
+    stmt_spans = {}  # lineno -> end_lineno for multi-line simple stmts
+    for node in ast.walk(sf.tree):
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ) and node.decorator_list:
+            first = min(d.lineno for d in node.decorator_list)
+            dec_spans.append((first, node.lineno, node.end_lineno or node.lineno))
+        elif isinstance(node, ast.stmt) and not isinstance(
+            node,
+            (
+                ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                ast.If, ast.For, ast.AsyncFor, ast.While, ast.With,
+                ast.AsyncWith, ast.Try,
+            ),
+        ):
+            end = node.end_lineno or node.lineno
+            if end > node.lineno:
+                stmt_spans[node.lineno] = max(
+                    end, stmt_spans.get(node.lineno, 0)
+                )
+    for target, (rules, reason) in sf.waivers.items():
+        for first, def_line, def_end in dec_spans:
+            if first <= target < def_line:
+                sf.waiver_spans.append((first, def_end, rules, reason))
+                break
+        else:
+            if target in stmt_spans:
+                sf.waiver_spans.append(
+                    (target, stmt_spans[target], rules, reason)
+                )
 
 
 def load_file(abspath: str, root: str) -> SourceFile | None:
@@ -173,18 +241,159 @@ def run_lint(
         raise ValueError(f"unknown lint rules: {sorted(unknown)}")
     for name in selected:
         violations.extend(RULES[name](ctx))
+    if not explicit and rules is None:
+        violations.extend(_check_readme_rules(root, RULES))
     # apply waivers
     by_path = {f.path: f for f in files}
     for v in violations:
         sf = by_path.get(v.path)
         if sf is None or v.rule == "bad-waiver":
             continue
-        w = sf.waivers.get(v.line)
-        if w and (v.rule in w[0] or "all" in w[0]):
+        w = sf.waiver_for(v.line, v.rule)
+        if w is not None:
             v.waived = True
             v.waiver_reason = w[1]
     violations.sort(key=lambda v: (v.path, v.line, v.rule))
     return violations
+
+
+def _check_readme_rules(root: str, rules: dict) -> list[Violation]:
+    """README's lint table must name EXACTLY the registered rule
+    families — drift in either direction fails `make lint` (pseudo-rule
+    `docs-drift`, unwaivable like bad-waiver). The table is the block of
+    `| \\`rule\\` | ... |` rows under the "## Static analysis" heading."""
+    readme = os.path.join(root, "README.md")
+    if not os.path.exists(readme):
+        return []
+    with open(readme, encoding="utf-8") as f:
+        text = f.read()
+    m = re.search(r"^## Static analysis.*?$", text, re.M)
+    if m is None:
+        return [
+            Violation(
+                "docs-drift", "README.md", 1,
+                "README has no `## Static analysis` section documenting "
+                "the lint families",
+            )
+        ]
+    section = text[m.end():]
+    nxt = re.search(r"^## ", section, re.M)
+    if nxt:
+        section = section[: nxt.start()]
+    documented: dict[str, int] = {}
+    base_line = text[: m.end()].count("\n") + 1
+    for i, line in enumerate(section.splitlines()):
+        row = re.match(r"\|\s*`([a-z][\w-]*)`\s*\|", line)
+        if row:
+            documented[row.group(1)] = base_line + i
+    out = []
+    for name in sorted(set(rules) - set(documented)):
+        out.append(
+            Violation(
+                "docs-drift", "README.md", base_line,
+                f"registered lint family `{name}` is missing from the "
+                "README's Static analysis table",
+            )
+        )
+    for name, line in sorted(documented.items()):
+        if name not in rules:
+            out.append(
+                Violation(
+                    "docs-drift", "README.md", line,
+                    f"README's Static analysis table documents `{name}`, "
+                    "which is not a registered lint family",
+                )
+            )
+    return out
+
+
+# ---- baseline (CI suppression) file ---------------------------------------
+
+BASELINE_NAME = "LINT_BASELINE.json"
+
+# hygiene pseudo-rules police the suppression machinery itself — letting
+# the baseline waive them would let it silence its own failure modes
+UNBASELINABLE = frozenset(
+    {"bad-waiver", "docs-drift", "bad-baseline", "stale-baseline"}
+)
+
+
+def load_baseline(path: str) -> list[dict]:
+    """Entries of a checked-in baseline file: each {"rule", "path",
+    "contains", "reason"} suppresses active findings whose rule+path
+    match and whose message contains the fragment. CI diffs findings
+    against this instead of grepping logs."""
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or not isinstance(doc.get("entries"), list):
+        raise ValueError(f"{path}: baseline must be {{'entries': [...]}}")
+    return doc["entries"]
+
+
+def apply_baseline(
+    violations: list[Violation], entries: list[dict], baseline_path: str,
+    check_stale: bool = True,
+) -> list[Violation]:
+    """Waive findings matched by baseline entries. Returns EXTRA
+    violations: an entry with no reason, and an entry matching nothing
+    (stale — the finding it blessed is gone), both fail lint so the
+    baseline can only hold explained, live suppressions. Pass
+    check_stale=False for path/rule-scoped runs: an entry whose target
+    is outside the scope produces no finding to match, and only the
+    full-repo run can tell 'out of scope' from 'actually stale'."""
+    rel = os.path.basename(baseline_path)
+    extra: list[Violation] = []
+    for i, e in enumerate(entries):
+        if not isinstance(e, dict):
+            extra.append(
+                Violation(
+                    "bad-baseline", rel, i + 1,
+                    f"baseline entry {i} is {type(e).__name__!s}, not an "
+                    "object — each entry must be {rule, path, contains, "
+                    "reason}",
+                )
+            )
+            continue
+        reason = (e.get("reason") or "").strip()
+        if not reason:
+            extra.append(
+                Violation(
+                    "bad-baseline", rel, i + 1,
+                    f"baseline entry {i} ({e.get('rule')}: {e.get('path')}) "
+                    "has no reason — every suppression must be explained",
+                )
+            )
+            continue
+        if e.get("rule") in UNBASELINABLE:
+            extra.append(
+                Violation(
+                    "bad-baseline", rel, i + 1,
+                    f"baseline entry {i} targets hygiene pseudo-rule "
+                    f"`{e.get('rule')}` — waiver/baseline/docs findings "
+                    "cannot be suppressed",
+                )
+            )
+            continue
+        matched = False
+        for v in violations:
+            if v.waived or v.rule != e.get("rule"):
+                continue
+            if v.path != e.get("path"):
+                continue
+            if e.get("contains") and e["contains"] not in v.message:
+                continue
+            v.waived = True
+            v.waiver_reason = f"baseline: {reason}"
+            matched = True
+        if not matched and check_stale:
+            extra.append(
+                Violation(
+                    "stale-baseline", rel, i + 1,
+                    f"baseline entry {i} ({e.get('rule')}: {e.get('path')}) "
+                    "matches no current finding — delete it",
+                )
+            )
+    return extra
 
 
 # ---- shared AST helpers ---------------------------------------------------

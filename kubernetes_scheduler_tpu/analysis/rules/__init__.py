@@ -1,15 +1,27 @@
-"""Rule registry: name -> check(ctx) -> list[Violation]."""
+"""Rule registry: name -> check(ctx) -> list[Violation].
+
+Fourteen families. The first ten are the per-file era; the last four
+(donation-aliasing, host-transfer, tracer-leak, lockset-race) ride the
+interprocedural dataflow core (analysis/dataflow.py) — call-graph,
+def-use, and lockset analyses a single-file AST scan cannot express.
+The README's Static analysis table must name exactly this registry
+(checked both ways by the `docs-drift` runner check).
+"""
 
 from kubernetes_scheduler_tpu.analysis.rules import (
+    donation_aliasing,
     dtype_shape,
     host_sync,
+    host_transfer,
     jit_purity,
     lock_discipline,
+    lockset_race,
     metric_hygiene,
     pallas_vmem,
     sim_determinism,
     span_hygiene,
     timeout_hygiene,
+    tracer_leak,
     wire_schema,
 )
 
@@ -24,4 +36,8 @@ RULES = {
     metric_hygiene.RULE: metric_hygiene.check,
     sim_determinism.RULE: sim_determinism.check,
     span_hygiene.RULE: span_hygiene.check,
+    donation_aliasing.RULE: donation_aliasing.check,
+    host_transfer.RULE: host_transfer.check,
+    tracer_leak.RULE: tracer_leak.check,
+    lockset_race.RULE: lockset_race.check,
 }

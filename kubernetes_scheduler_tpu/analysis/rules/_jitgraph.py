@@ -7,6 +7,14 @@ bare function reference (e.g. `lax.scan(step, ...)`) to a known name
 marks every same-named def reachable. Over-approximation flags at worst
 an extra site — the waiver syntax absorbs those — while attribute calls
 on `self.` are skipped so host-object plumbing never leaks in.
+
+This traversal deliberately DIFFERS from `ModuleIndex.jit_reachable()`:
+it is scoped to the caller's `files` (each family polices its own
+SCOPE, while the index always answers for the whole project), and its
+bare-name resolution marks EVERY same-named def reachable rather than
+resolving through imports. Only the entry detection
+(`_decorator_is_jit` / `_JIT_MAKERS`, imported below) must stay
+shared — a new jit spelling belongs in dataflow, nowhere else.
 """
 
 from __future__ import annotations
@@ -15,37 +23,37 @@ import ast
 
 from kubernetes_scheduler_tpu.analysis.core import SourceFile, dotted_name
 
-_JIT_MAKERS = {"jit", "jax.jit", "pjit", "jax.experimental.pjit.pjit"}
+# ONE jit-entry detector for the whole package: _jitgraph and the
+# ModuleIndex must never disagree about what is jit-reachable (a new
+# jit spelling added in only one place would silently split the
+# families' notions of the kernel set)
+from kubernetes_scheduler_tpu.analysis.dataflow import (  # noqa: E402
+    _JIT_MAKERS,
+    _decorator_is_jit,
+)
 
 
-def _decorator_is_jit(dec: ast.AST) -> bool:
-    name = dotted_name(dec)
-    if name in _JIT_MAKERS:
-        return True
-    if isinstance(dec, ast.Call):
-        fname = dotted_name(dec.func)
-        if fname in _JIT_MAKERS:
-            return True
-        # functools.partial(jax.jit, ...) / partial(jit, ...)
-        if fname in ("functools.partial", "partial") and dec.args:
-            return dotted_name(dec.args[0]) in _JIT_MAKERS
-    return False
+def _collect_defs(ctx, files: list[SourceFile]):
+    """name -> [(SourceFile, FunctionDef)] over every def, nested
+    included — read off the run's shared walk-once index."""
+    from kubernetes_scheduler_tpu.analysis import dataflow
 
-
-def _collect_defs(files: list[SourceFile]):
-    """name -> [(SourceFile, FunctionDef)] over every def, nested included."""
+    index = dataflow.get_index(ctx)
     defs: dict[str, list] = {}
     for sf in files:
-        for node in ast.walk(sf.tree):
+        for node in index.walk(sf):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 defs.setdefault(node.name, []).append((sf, node))
     return defs
 
 
-def _entry_names(files: list[SourceFile]) -> set[str]:
+def _entry_names(ctx, files: list[SourceFile]) -> set[str]:
+    from kubernetes_scheduler_tpu.analysis import dataflow
+
+    index = dataflow.get_index(ctx)
     entries: set[str] = set()
     for sf in files:
-        for node in ast.walk(sf.tree):
+        for node in index.walk(sf):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 if any(_decorator_is_jit(d) for d in node.decorator_list):
                     entries.add(node.name)
@@ -78,13 +86,13 @@ def _referenced_names(fn: ast.AST) -> set[str]:
     return out
 
 
-def jit_reachable(files: list[SourceFile]):
+def jit_reachable(ctx, files: list[SourceFile]):
     """[(SourceFile, FunctionDef)] reachable from any jit entry point in
     `files`, the entry defs included."""
-    defs = _collect_defs(files)
+    defs = _collect_defs(ctx, files)
     seen_ids: set[int] = set()
     out = []
-    queue = sorted(_entry_names(files))
+    queue = sorted(_entry_names(ctx, files))
     visited_names: set[str] = set()
     while queue:
         name = queue.pop()

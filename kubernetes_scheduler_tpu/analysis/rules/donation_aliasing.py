@@ -1,0 +1,111 @@
+"""donation-aliasing: a value passed through a donated position is read
+again on a path after the call — including through helper functions and
+across modules.
+
+`donate_argnums` hands the buffer's storage to XLA: by the time the call
+returns, the donated array may already back the OUTPUT, so any later
+read returns garbage on some backends and a deleted-buffer error on
+others. This repo has been bitten once (the resident-state
+`apply_snapshot_delta` path); the single-file AST check that came out of
+that incident could not see the two shapes this interprocedural version
+exists for:
+
+- the donator is defined in ANOTHER module (`engine.apply_snapshot_delta`
+  called from host/scheduler.py or bridge/server.py) — resolved through
+  the project import index;
+- the donation happens inside a HELPER (`def step(s): return
+  apply_snapshot_delta(s, d)`) — donation summaries propagate to a
+  fixpoint, so `step(snap); snap.sum()` is flagged in the caller.
+
+Also tracked: attribute-chain arguments (`self._state.snapshot` donated
+and re-read — the session-keyed resident maps), and donating
+`jax.device_put(x, ..., donate=True)`.
+
+Rebinding the result to the donated name (`x = f(x)`, or assigning any
+prefix of the donated attribute chain) clears the taint — that IS the
+idiomatic donation pattern. A load in a mutually exclusive branch arm is
+not a read-after-donation (branch-path prefixes, same discipline as the
+original check: precision over recall, because this gate fails
+`make lint`).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kubernetes_scheduler_tpu.analysis.core import (
+    Context,
+    Violation,
+    dotted_name,
+)
+from kubernetes_scheduler_tpu.analysis import dataflow
+
+RULE = "donation-aliasing"
+
+SCOPE = ("kubernetes_scheduler_tpu/**/*.py", "kubernetes_scheduler_tpu/*.py")
+
+
+def check(ctx: Context) -> list[Violation]:
+    out: list[Violation] = []
+    index = dataflow.get_index(ctx)
+    donors = dataflow.donation_summaries(index)
+    scoped = set(id(sf) for sf in ctx.scoped(SCOPE))
+    for fi in index.funcs.values():
+        if id(fi.sf) not in scoped:
+            continue
+        du = dataflow.def_use(fi.node)
+        # (call line, call end line, donated name, callee, branch path)
+        donations: list[tuple[int, int, str, str, tuple]] = []
+        for line, call, path in du.calls:
+            end = call.end_lineno or line
+            dp = dataflow.donated_device_put_arg(call)
+            if dp is not None:
+                nm = dotted_name(dp)
+                if nm:
+                    donations.append((line, end, nm, "jax.device_put", path))
+                continue
+            positions: set[int] = set()
+            for cand in index.resolve_call(fi, call):
+                positions.update(donors.get(cand.qname, ()))
+            if not positions:
+                continue
+            callee = dotted_name(call.func) or "<call>"
+            for i in sorted(positions):
+                if i < len(call.args):
+                    nm = dotted_name(call.args[i])
+                    if nm:
+                        donations.append((line, end, nm, callee, path))
+        if not donations:
+            continue
+        # one finding per (name, line) across ALL donations: `f(a);
+        # f(a); a.sum()` is one bad re-read, not one per earlier call
+        flagged: set[tuple[str, int]] = set()
+        for call_line, call_end, name, callee, cpath in donations:
+            for load_line, nm, lpath in du.loads:
+                # loads inside the donating call's own (possibly
+                # multi-line) expression are the argument itself
+                if load_line <= call_end or (name, load_line) in flagged:
+                    continue
+                if nm != name and not nm.startswith(name + "."):
+                    continue
+                if not dataflow.path_prefix(cpath, lpath):
+                    continue  # mutually exclusive arm / sibling branch
+                if any(
+                    (nm2 == name or name.startswith(nm2 + ".")
+                     or nm2.startswith(name + "."))
+                    and call_line <= aline <= load_line
+                    and dataflow.path_prefix(apath, lpath)
+                    for aline, nm2, apath in du.assigns
+                ):
+                    continue  # rebound (x = f(x)) before the read
+                flagged.add((name, load_line))
+                out.append(
+                    Violation(
+                        RULE, fi.sf.path, load_line,
+                        f"`{name}` re-read after being donated to "
+                        f"`{callee}` — the buffer may already be reused "
+                        "for the output; rebind the result to the name "
+                        "instead",
+                    )
+                )
+    return out

@@ -1,5 +1,5 @@
-"""dtype-shape: no float64 promotion, traced-bool branching, or
-donated-buffer re-reads in kernels.
+"""dtype-shape: no float64 promotion or traced-bool branching in
+kernels.
 
 The engine is a float32 machine end to end (the codec's allowed dtypes,
 the Pallas tiles, the wire contract): one float64 leaf silently doubles
@@ -13,13 +13,11 @@ Flagged in the kernel dirs:
 - dtype arguments / astype targets that resolve to float64 (`float`,
   `np.float64`, `jnp.float64`, `"float64"`, `"double"`);
 - `if`/`while` tests inside jit-reachable functions that call
-  `.any()` / `.all()` / `.item()` / `bool(...)` on traced values;
-- re-reading a buffer after donating it to a `donate_argnums` jitted
-  function (the resident-state apply_snapshot_delta signature): XLA may
-  already have reused the donated storage for the output, so the read
-  returns garbage (or a deleted-buffer error) depending on backend.
-  Rebinding the name to the call's result (`x = f(x)`) is the idiomatic
-  donation pattern and clears the taint.
+  `.any()` / `.all()` / `.item()` / `bool(...)` on traced values.
+
+Donated-buffer re-reads, which this family caught per-file through
+PR 8, moved to the interprocedural `donation-aliasing` family — it sees
+cross-module donators and helper indirection this scan could not.
 
 Static-shape branching (`if x.shape[0] < n:`) is idiomatic JAX and
 deliberately NOT flagged — shapes are Python ints at trace time.
@@ -63,8 +61,10 @@ def _is_f64(node: ast.AST) -> bool:
     )
 
 
-def _check_f64(sf, tree, out: list[Violation]) -> None:
-    for node in ast.walk(tree):
+def _check_f64(ctx, sf, out: list[Violation]) -> None:
+    from kubernetes_scheduler_tpu.analysis import dataflow
+
+    for node in dataflow.get_index(ctx).walk(sf):
         if not isinstance(node, ast.Call):
             continue
         fn = node.func
@@ -123,155 +123,11 @@ def _check_branching(sf, fn, out: list[Violation]) -> None:
             )
 
 
-def _donated_positions(fn: ast.AST) -> tuple[int, ...]:
-    """Positional argument indices a function donates, read off its
-    decorators: `functools.partial(jax.jit, donate_argnums=...)` and
-    `jax.jit(donate_argnums=...)` forms; () when it donates nothing."""
-    for dec in getattr(fn, "decorator_list", ()):
-        if not isinstance(dec, ast.Call):
-            continue
-        callee = dotted_name(dec.func)
-        is_partial_jit = callee in ("functools.partial", "partial") and (
-            dec.args and dotted_name(dec.args[0]) in ("jax.jit", "jit")
-        )
-        is_jit_call = callee in ("jax.jit", "jit")
-        if not (is_partial_jit or is_jit_call):
-            continue
-        for kw in dec.keywords:
-            if kw.arg != "donate_argnums":
-                continue
-            v = kw.value
-            if isinstance(v, ast.Constant) and isinstance(v.value, int):
-                return (v.value,)
-            if isinstance(v, (ast.Tuple, ast.List)):
-                return tuple(
-                    e.value
-                    for e in v.elts
-                    if isinstance(e, ast.Constant) and isinstance(e.value, int)
-                )
-    return ()
-
-
-_FN_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
-_SUITE_FIELDS = ("body", "orelse", "finalbody")
-
-
-def _shallow(node):
-    """The node plus its expression-level children — never descending
-    into nested suites (those get their own branch path) or nested
-    function scopes (analyzed as their own functions)."""
-    yield node
-    for fname, value in ast.iter_fields(node):
-        if fname in _SUITE_FIELDS or fname == "handlers":
-            continue
-        for child in value if isinstance(value, list) else [value]:
-            if isinstance(child, ast.AST) and not isinstance(child, _FN_DEFS):
-                yield from _shallow(child)
-
-
-def _visit_suites(stmts, path, sink):
-    """Walk statement suites recording each node's branch path — a tuple
-    of (enclosing statement id, suite field) — so the donation check can
-    tell 'after the call on the same control path' from a load in a
-    mutually exclusive arm."""
-    for st in stmts:
-        if isinstance(st, _FN_DEFS):
-            continue  # separate scope: iterated as its own function
-        for node in _shallow(st):
-            sink(node, path)
-        for fname in _SUITE_FIELDS:
-            suite = getattr(st, fname, None)
-            if suite:
-                _visit_suites(suite, path + ((id(st), fname),), sink)
-        for h in getattr(st, "handlers", None) or ():
-            _visit_suites(h.body, path + ((id(st), id(h)),), sink)
-
-
-def _check_donation(sf, tree, out: list[Violation]) -> None:
-    """Flag re-reads of a Name after it was passed in a donated position
-    of a donate_argnums-jitted function defined in the same file. Only
-    plain Name arguments are tracked (an attribute like `self._state`
-    rebound right at the call site is the caller's own discipline); an
-    assignment to the name at or after the call line — including the
-    idiomatic `x = f(x)` rebind — clears the taint. A load is only
-    flagged when the donating call's branch path is a prefix of the
-    load's (the call structurally precedes it on the same control path):
-    a read in the other arm of an `if` never executes after the
-    donation, so it is not a violation (at the cost of missing a
-    donation inside one arm read after the join — precision over
-    recall, this gate fails `make lint`)."""
-    donators: dict[str, tuple[int, ...]] = {}
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            pos = _donated_positions(node)
-            if pos:
-                donators[node.name] = pos
-    if not donators:
-        return
-    for fn in ast.walk(tree):
-        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        calls: list[tuple[int, str, str, tuple]] = []
-        assigns: list[tuple[int, str, tuple]] = []
-        loads: list[tuple[int, str, tuple]] = []
-
-        def sink(node, path):
-            if isinstance(node, ast.Call):
-                callee = dotted_name(node.func) or ""
-                pos = donators.get(callee.split(".")[-1])
-                if pos:
-                    for i in pos:
-                        if i < len(node.args) and isinstance(
-                            node.args[i], ast.Name
-                        ):
-                            calls.append(
-                                (node.lineno, node.args[i].id, callee, path)
-                            )
-            elif isinstance(node, ast.Assign):
-                for t in node.targets:
-                    for leaf in ast.walk(t):
-                        if isinstance(leaf, ast.Name):
-                            assigns.append((node.lineno, leaf.id, path))
-            elif isinstance(node, ast.Name) and isinstance(
-                node.ctx, ast.Load
-            ):
-                loads.append((node.lineno, node.id, path))
-
-        _visit_suites(fn.body, (), sink)
-
-        def prefix(a, b):
-            return b[: len(a)] == a
-
-        for call_line, name, callee, cpath in calls:
-            for load_line, nm, lpath in loads:
-                if nm != name or load_line <= call_line:
-                    continue
-                if not prefix(cpath, lpath):
-                    continue  # mutually exclusive arm / sibling branch
-                if any(
-                    nm2 == name
-                    and call_line <= aline <= load_line
-                    and prefix(apath, lpath)
-                    for aline, nm2, apath in assigns
-                ):
-                    continue  # rebound (x = f(x)) before the read
-                out.append(
-                    Violation(
-                        RULE, sf.path, load_line,
-                        f"`{name}` re-read after being donated to "
-                        f"`{callee}` (donate_argnums) — the buffer may "
-                        "already be reused for the output; rebind the "
-                        "result to the name instead",
-                    )
-                )
-
-
 def check(ctx: Context) -> list[Violation]:
     out: list[Violation] = []
     files = ctx.scoped(SCOPE)
     for sf in files:
-        _check_f64(sf, sf.tree, out)
-        _check_donation(sf, sf.tree, out)
-    for sf, fn in jit_reachable(files):
+        _check_f64(ctx, sf, out)
+    for sf, fn in jit_reachable(ctx, files):
         _check_branching(sf, fn, out)
     return out

@@ -1,0 +1,167 @@
+"""host-transfer: implicit device→host syncs on jax values in the
+hot-path modules.
+
+`host-sync` catches the SHAPE of a bad sync (barriers, per-element
+syncs in loops). This family catches the VALUE: a local bound to a jax
+expression (def-use taint over the function body — `x = jnp.sum(...)`,
+`r = schedule_batch(...)`, chains hanging off either) that then flows
+into an implicit transfer:
+
+- `.item()` — one blocking device round-trip;
+- `float(x)` / `int(x)` — calls `__float__`/`__int__`, a hidden
+  `.item()`;
+- `np.asarray(x)` / `np.array(x)` — a full device→host copy;
+- `if x:` / `while x:` / `assert x` / `not x` — `__bool__` on a
+  concrete device array blocks (and on a tracer it raises at trace
+  time).
+
+Scope is the HOT PATH only — engine.py, ops/, host/scheduler.py,
+host/snapshot.py — by configuration here, not by per-site waiver: cold
+modules (CLI, sim, tests plumbing) convert freely and waiving every one
+of those sites would bury the signal. The ONE intended bulk sync per
+dispatch carries an inline waiver naming the contract, which is exactly
+the reviewable allow-list the cycle's sync budget wants.
+
+Untainted receivers are NOT flagged: if local dataflow cannot show the
+value came from jax, staying quiet beats burying real syncs in noise
+(the clean fixture pins host-numpy patterns as unflagged).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kubernetes_scheduler_tpu.analysis.core import (
+    Context,
+    Violation,
+    dotted_name,
+)
+from kubernetes_scheduler_tpu.analysis import dataflow
+
+RULE = "host-transfer"
+
+SCOPE = (
+    "kubernetes_scheduler_tpu/engine.py",
+    "kubernetes_scheduler_tpu/ops/*.py",
+    "kubernetes_scheduler_tpu/host/scheduler.py",
+    "kubernetes_scheduler_tpu/host/snapshot.py",
+)
+
+_CONVERTERS = {"float", "int", "bool", "complex"}
+_COPIERS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+            "jax.device_get"}
+
+
+def _tainted_expr(node: ast.AST, tainted: set[str]) -> str | None:
+    """The tainted name a (sub)expression reads, or None. Direct jnp/jax
+    calls count too — `float(jnp.sum(x))` syncs without a binding.
+    Static-metadata reads (`float(y.ndim)`) are host values, not
+    syncs — same exemption the taint binder applies."""
+    meta = dataflow.static_meta_node_ids(node)
+    for sub in ast.walk(node):
+        if id(sub) in meta:
+            continue
+        if isinstance(sub, ast.Name) and sub.id in tainted:
+            return sub.id
+        if isinstance(sub, ast.Call):
+            dn = dotted_name(sub.func) or ""
+            if dn.startswith(("jnp.", "jax.numpy.", "lax.", "jax.lax.")):
+                return dn
+    return None
+
+
+def check(ctx: Context) -> list[Violation]:
+    out: list[Violation] = []
+    index = dataflow.get_index(ctx)
+    # device-returning project entry points: names of jitted defs — a
+    # call like `engine.schedule_batch(...)` taints its binding even
+    # though the jit wrapper lives in another module
+    jitted_names = {
+        index.funcs[q].name for q in index.jit_entries() if q in index.funcs
+    }
+    for sf in ctx.scoped(SCOPE):
+        for fi in index.functions(sf):
+            tainted = dataflow.jax_tainted_names(fi.node, jitted_names)
+            # parameters annotated as jax arrays are device values too
+            # (keyword-only included — `def f(*, scores: jax.Array)`)
+            for a in (
+                fi.node.args.args
+                + fi.node.args.posonlyargs
+                + fi.node.args.kwonlyargs
+            ):
+                ann = a.annotation
+                if ann is not None and (
+                    (dotted_name(ann) or "").startswith(("jnp.", "jax."))
+                ):
+                    tainted = tainted | {a.arg}
+            # no early-out on an empty taint set: a converter applied
+            # DIRECTLY to a jnp call (`float(jnp.mean(x))`) syncs with
+            # no binding anywhere
+            for node in dataflow.shallow_walk(fi.node):
+                if isinstance(node, ast.Call):
+                    dn = dotted_name(node.func) or ""
+                    attr = (
+                        node.func.attr
+                        if isinstance(node.func, ast.Attribute)
+                        else None
+                    )
+                    if attr == "item":
+                        src = _tainted_expr(node.func.value, tainted)
+                        if src:
+                            out.append(Violation(
+                                RULE, sf.path, node.lineno,
+                                f".item() on jax value `{src}` — a blocking "
+                                "device→host transfer on the hot path",
+                            ))
+                    elif dn in _CONVERTERS and node.args:
+                        src = _tainted_expr(node.args[0], tainted)
+                        if src:
+                            out.append(Violation(
+                                RULE, sf.path, node.lineno,
+                                f"{dn}() on jax value `{src}` — implicit "
+                                ".item() device sync on the hot path",
+                            ))
+                    elif dn in _COPIERS and node.args:
+                        src = _tainted_expr(node.args[0], tainted)
+                        if src:
+                            out.append(Violation(
+                                RULE, sf.path, node.lineno,
+                                f"{dn}() on jax value `{src}` — device→host "
+                                "copy on the hot path; sync once in bulk at "
+                                "the dispatch boundary",
+                            ))
+                elif isinstance(node, (ast.If, ast.While)):
+                    src = _bare_tainted_test(node.test, tainted)
+                    if src:
+                        out.append(Violation(
+                            RULE, sf.path, node.test.lineno,
+                            f"branch on jax value `{src}` — __bool__ blocks "
+                            "on a device array (and raises on a tracer); "
+                            "compute the predicate on host or use jnp.where",
+                        ))
+                elif isinstance(node, ast.Assert):
+                    src = _bare_tainted_test(node.test, tainted)
+                    if src:
+                        out.append(Violation(
+                            RULE, sf.path, node.lineno,
+                            f"assert on jax value `{src}` — __bool__ device "
+                            "sync on the hot path",
+                        ))
+    return out
+
+
+def _bare_tainted_test(test: ast.AST, tainted: set[str]) -> str | None:
+    """A test that IS a tainted value (bare name, `not name`, or a
+    boolean combination of them) — comparisons and shape probes stay
+    host-side and are not flagged."""
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _bare_tainted_test(test.operand, tainted)
+    if isinstance(test, ast.BoolOp):
+        for v in test.values:
+            src = _bare_tainted_test(v, tainted)
+            if src:
+                return src
+        return None
+    if isinstance(test, ast.Name) and test.id in tainted:
+        return test.id
+    return None

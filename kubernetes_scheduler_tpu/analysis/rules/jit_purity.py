@@ -78,7 +78,7 @@ def _base_name(node: ast.AST) -> str | None:
 def check(ctx: Context) -> list[Violation]:
     out: list[Violation] = []
     files = ctx.scoped(SCOPE)
-    for sf, fn in jit_reachable(files):
+    for sf, fn in jit_reachable(ctx, files):
         local = _local_names(fn)
         for node in ast.walk(fn):
             if isinstance(node, (ast.Global, ast.Nonlocal)):

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import ast
 
+from kubernetes_scheduler_tpu.analysis import dataflow
 from kubernetes_scheduler_tpu.analysis.core import Context, Violation
 
 RULE = "lock-discipline"
@@ -127,7 +128,7 @@ def _walk_mutations(node: ast.AST, locks: set, in_lock: bool, acc: list):
 def check(ctx: Context) -> list[Violation]:
     out: list[Violation] = []
     for sf in ctx.scoped(SCOPE):
-        for cls in ast.walk(sf.tree):
+        for cls in dataflow.get_index(ctx).walk(sf):
             if not isinstance(cls, ast.ClassDef):
                 continue
             locks = _lock_attrs(cls)

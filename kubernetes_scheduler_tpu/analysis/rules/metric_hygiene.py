@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import ast
 
+from kubernetes_scheduler_tpu.analysis import dataflow
 from kubernetes_scheduler_tpu.analysis.core import Context, Violation
 
 RULE = "metric-hygiene"
@@ -80,7 +81,7 @@ def check(ctx: Context) -> list[Violation]:
     registries: list[tuple] = []
 
     for sf in ctx.scoped(SCOPE):
-        for node in ast.walk(sf.tree):
+        for node in dataflow.get_index(ctx).walk(sf):
             # ---- *_HELP dict literals ---------------------------------
             if isinstance(node, ast.Assign):
                 for t in node.targets:

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import ast
 
+from kubernetes_scheduler_tpu.analysis import dataflow
 from kubernetes_scheduler_tpu.analysis.core import (
     Context,
     Violation,
@@ -237,7 +238,7 @@ def check(ctx: Context) -> list[Violation]:
     for sf in ctx.scoped(SCOPE):
         consts = _module_consts(sf.tree)
         fns = [
-            n for n in ast.walk(sf.tree)
+            n for n in dataflow.get_index(ctx).walk(sf)
             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
         ]
         checked_kernels: set[str] = set()

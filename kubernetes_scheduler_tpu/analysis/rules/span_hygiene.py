@@ -33,6 +33,7 @@ from __future__ import annotations
 import ast
 import re
 
+from kubernetes_scheduler_tpu.analysis import dataflow
 from kubernetes_scheduler_tpu.analysis.core import Context, Violation
 
 RULE = "span-hygiene"
@@ -72,7 +73,7 @@ def check(ctx: Context) -> list[Violation]:
     registries: list[tuple] = []
 
     for sf in ctx.scoped(SCOPE):
-        for node in ast.walk(sf.tree):
+        for node in dataflow.get_index(ctx).walk(sf):
             if isinstance(node, ast.Assign):
                 for t in node.targets:
                     if (

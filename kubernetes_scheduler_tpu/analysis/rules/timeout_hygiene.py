@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import ast
 
+from kubernetes_scheduler_tpu.analysis import dataflow
 from kubernetes_scheduler_tpu.analysis.core import (
     Context,
     Violation,
@@ -40,7 +41,7 @@ _SUBPROCESS = {
 def check(ctx: Context) -> list[Violation]:
     out: list[Violation] = []
     for sf in ctx.scoped(SCOPE):
-        for node in ast.walk(sf.tree):
+        for node in dataflow.get_index(ctx).walk(sf):
             if not isinstance(node, ast.Call):
                 continue
             name = dotted_name(node.func) or ""

@@ -1,0 +1,158 @@
+"""tracer-leak: tracers stored where they outlive the traced call.
+
+Inside a jit-traced function every argument-derived value is a Tracer.
+Storing one onto an object that survives the trace — `self.cache = x`,
+`param.field = x`, `slots.history.append(x)` — leaks an abstract value
+into post-trace code: the next read gets a `JaxprTracer` that raises
+`TracerLeakError`/`UnexpectedTracer` far from the store, usually in an
+unrelated cycle. The jit-purity family covers MODULE state (globals,
+nonlocal); this family covers ARGUMENT-OBJECT state, which jit-purity
+deliberately exempts because a parameter base is a local binding.
+
+Reachability is the project call graph (analysis/dataflow.py), so a
+helper called from a jitted entry — across modules — is analyzed too;
+that is the interprocedural case a per-file scan misses.
+
+Flagged inside jit-reachable functions:
+
+- `<param>.attr = value` / `<param>[k] = value` where `value` derives
+  from arguments or jnp expressions (constants are fine — shape tables
+  and config stores are not tracers);
+- mutating-method calls (`append`/`update`/`setdefault`/...) on an
+  attribute-chained container reached FROM a parameter (`slots.history`,
+  `obj.cache`) with a traced argument.
+
+Deliberately NOT flagged: NamedTuple `_replace` and functional
+`.at[...].set(...)` construct NEW values — no store happens; and a
+mutator on a BARE parameter (`accum.append(x)`) is the trace-local
+accumulator idiom (the `_affinity_update` pattern — a list built and
+consumed within one trace), not an escape, so only attribute-chained
+containers count as outliving the call.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kubernetes_scheduler_tpu.analysis.core import (
+    Context,
+    Violation,
+    dotted_name,
+)
+from kubernetes_scheduler_tpu.analysis import dataflow
+
+RULE = "tracer-leak"
+
+SCOPE = (
+    "kubernetes_scheduler_tpu/engine.py",
+    "kubernetes_scheduler_tpu/ops/*.py",
+    "kubernetes_scheduler_tpu/parallel/*.py",
+    "kubernetes_scheduler_tpu/models/*.py",
+)
+
+# method-call mutators only: subscript stores (`obj.cache[k] = x`)
+# arrive as ast.Assign and are handled by the store branch instead
+_MUTATORS = {"append", "extend", "insert", "add", "update", "setdefault",
+             "appendleft"}
+
+
+def _params(fn: ast.AST) -> set[str]:
+    args = fn.args
+    return {
+        a.arg
+        for a in (
+            args.posonlyargs + args.args + args.kwonlyargs
+            + ([args.vararg] if args.vararg else [])
+            + ([args.kwarg] if args.kwarg else [])
+        )
+    }
+
+
+def _value_is_traced(value: ast.AST, traced: set[str]) -> bool:
+    """True when the stored value can be a tracer: reads a traced name
+    or calls into jnp/jax/lax. Pure constants/shape-tuple stores are
+    host values even at trace time."""
+    for sub in ast.walk(value):
+        if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
+            if sub.id in traced:
+                return True
+        elif isinstance(sub, ast.Call):
+            dn = dotted_name(sub.func) or ""
+            if dn.startswith(("jnp.", "jax.numpy.", "lax.", "jax.lax.")):
+                return True
+    return False
+
+
+def check(ctx: Context) -> list[Violation]:
+    out: list[Violation] = []
+    index = dataflow.get_index(ctx)
+    scoped = {id(sf) for sf in ctx.scoped(SCOPE)}
+    reachable = index.jit_reachable()
+    for qname in sorted(reachable):
+        fi = index.funcs[qname]
+        if id(fi.sf) not in scoped:
+            continue
+        fn = fi.node
+        params = _params(fn)
+        # every param is abstract under trace; so is anything derived
+        traced = params | dataflow.jax_tainted_names(fn)
+        for node in dataflow.shallow_walk(fn):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                value = node.value
+                for t in targets:
+                    if not isinstance(t, (ast.Attribute, ast.Subscript)):
+                        continue
+                    base = t
+                    while isinstance(base, (ast.Attribute, ast.Subscript)):
+                        base = base.value
+                    if (
+                        isinstance(base, ast.Name)
+                        and base.id in params
+                        and value is not None
+                        and _value_is_traced(value, traced)
+                    ):
+                        out.append(Violation(
+                            RULE, fi.sf.path, node.lineno,
+                            f"jit-reachable `{fn.name}` stores a traced "
+                            f"value onto argument object `{base.id}` — the "
+                            "tracer outlives the traced call; return the "
+                            "value instead of mutating the argument",
+                        ))
+            elif isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute
+            ):
+                if node.func.attr not in _MUTATORS:
+                    continue
+                base = node.func.value
+                chain = []
+                while isinstance(base, (ast.Attribute, ast.Subscript)):
+                    if isinstance(base, ast.Attribute):
+                        chain.append(base.attr)
+                    base = base.value
+                # `x.at[...].add(v)` is jax's FUNCTIONAL update — a new
+                # array, no store; and a bare list param mutated between
+                # kernel helpers is a trace-LOCAL accumulator (the
+                # _affinity_update pattern), not an escape — only
+                # attribute-chained containers (self.cache, obj.slots)
+                # outlive the call
+                if "at" in chain or not chain:
+                    continue
+                if not (isinstance(base, ast.Name) and base.id in params):
+                    continue
+                if any(
+                    _value_is_traced(a, traced)
+                    for a in list(node.args)
+                    + [kw.value for kw in node.keywords]
+                ):
+                    out.append(Violation(
+                        RULE, fi.sf.path, node.lineno,
+                        f"jit-reachable `{fn.name}` appends a traced value "
+                        f"into argument container `{base.id}` — the tracer "
+                        "outlives the traced call",
+                    ))
+    return out

@@ -33,6 +33,7 @@ import ast
 import os
 import re
 
+from kubernetes_scheduler_tpu.analysis import dataflow
 from kubernetes_scheduler_tpu.analysis.core import (
     Context,
     SourceFile,
@@ -307,7 +308,7 @@ def check(ctx: Context) -> list[Violation]:
         messages = parse_proto(proto)
 
         # pass 1: constructor kwargs anywhere in the file
-        for node in ast.walk(sf.tree):
+        for node in dataflow.get_index(ctx).walk(sf):
             if not isinstance(node, ast.Call):
                 continue
             msg = _message_of(node, aliases)
@@ -334,7 +335,7 @@ def check(ctx: Context) -> list[Violation]:
 
         # pass 2: attribute access on vars of known Message type,
         # function by function
-        for fn in ast.walk(sf.tree):
+        for fn in dataflow.get_index(ctx).walk(sf):
             if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
             var_types: dict[str, str] = {}

@@ -1633,7 +1633,9 @@ class Scheduler:
             from kubernetes_scheduler_tpu.engine import preempt_batch
 
             res = preempt_batch(snapshot, pend, victims, k_cap=k_cap)
+        # graftlint: disable=host-transfer -- the preemption pass's TWO bulk boundary syncs (node + victim matrices, whole result at once); the per-victim reads below stay on host numpy
         chosen_node = np.asarray(res.node)
+        # graftlint: disable=host-transfer -- second leaf of the same bulk boundary sync
         victim_ids = np.asarray(res.victims)
         prio = np.asarray(pend.priority)
         order = sorted(range(len(pods)), key=lambda i: (-int(prio[i]), i))

@@ -1,0 +1,30 @@
+"""graftlint fixture: an honored eval_shape contract (never imported by
+product code — loaded by contracts.check_fixture_module)."""
+
+import jax.numpy as jnp
+
+
+def scale_rows(x, w):
+    return x * w[:, None]
+
+
+def row_stats(x):
+    hi = jnp.max(x, axis=1)
+    lo = jnp.min(x, axis=1)
+    return jnp.stack([hi, lo])
+
+
+CONTRACTS = [
+    {
+        "fn": "scale_rows",
+        "args": [("float32", ("n", "r")), ("float32", ("n",))],
+        "out": ("float32", ("n", "r")),
+        "grid": [{"n": 8, "r": 4}, {"n": 16, "r": 4}],
+    },
+    {
+        "fn": "row_stats",
+        "args": [("float32", ("n", "r"))],
+        "out": ("float32", (2, "n")),
+        "grid": [{"n": 8, "r": 4}],
+    },
+]

@@ -1,0 +1,34 @@
+"""graftlint fixture: eval_shape contract DRIFT (never imported by
+product code — loaded by contracts.check_fixture_module).
+
+The declared contract says `scale_rows` preserves [n, r] float32; the
+implementation transposes — the class of fused/unfused drift the
+engine-contract layer exists to catch before a bench round does."""
+
+import jax.numpy as jnp
+
+
+def scale_rows(x, w):
+    # drift: returns [r, n], the declaration says [n, r]
+    return (x * w[:, None]).T
+
+
+def cast_rows(x):
+    # drift: promotes dtype vs the declared float32
+    return x.astype(jnp.int32)
+
+
+CONTRACTS = [
+    {
+        "fn": "scale_rows",
+        "args": [("float32", ("n", "r")), ("float32", ("n",))],
+        "out": ("float32", ("n", "r")),
+        "grid": [{"n": 8, "r": 4}, {"n": 16, "r": 4}],
+    },
+    {
+        "fn": "cast_rows",
+        "args": [("float32", ("n", "r"))],
+        "out": ("float32", ("n", "r")),
+        "grid": [{"n": 8, "r": 4}],
+    },
+]

@@ -30,3 +30,19 @@ def cycle_exclusive_arms(state, rows, vals, flag):
     # the other arm of the branch: the donation never executed on this
     # control path, so this read is fine
     return state.sum()
+
+
+def cycle_attribute_rebind(st, rows, vals):
+    # the resident-state idiom: donate the retained attribute chain and
+    # rebind it before anything can read the dead tree
+    st.snapshot = apply_delta(st.snapshot, rows, vals)
+    return st.snapshot
+
+
+def cycle_multiline_call(state, rows, vals):
+    # the donating call spans lines: the argument load on line 2 of the
+    # call is the donation itself, not a re-read
+    state = apply_delta(
+        state, rows, vals,
+    )
+    return state

@@ -1,0 +1,74 @@
+"""graftlint fixture: donated-buffer re-reads (never imported).
+
+Every shape the donation-aliasing family flags in ONE file: the plain
+re-read, the two-reads case, an attribute-chain argument (the resident
+`st.snapshot` pattern), and a donating `jax.device_put`."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def apply_delta(state, rows, vals):
+    return state.at[rows].set(vals, mode="drop")
+
+
+def cycle(state, rows, vals):
+    new = apply_delta(state, rows, vals)
+    # `state` was donated — its buffer may already back `new`
+    return new + state.sum()
+
+
+def cycle_two_reads(state, rows, vals):
+    out = apply_delta(state, rows, vals)
+    total = jnp.sum(state)  # donated leaf re-read
+    return out, total
+
+
+def cycle_attribute_chain(st, rows, vals):
+    new = apply_delta(st.snapshot, rows, vals)
+    # the donated ATTRIBUTE chain re-read before the rebind — the exact
+    # resident-state shape (st.snapshot = new must come FIRST)
+    probe = st.snapshot.sum()
+    st.snapshot = new
+    return probe
+
+
+def cycle_device_put(buf, dev):
+    moved = jax.device_put(buf, dev, donate=True)
+    return moved + buf.sum()  # donated via device_put, then re-read
+
+
+class ResidentFold:
+    # jax counts the bound `self` at position 0, so donate_argnums=(1,)
+    # donates `buf` — the summary must shift onto the receiver-dropped
+    # numbering call sites use (watching `d` instead misses this)
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def fold(self, buf, d):
+        return buf.at[d].add(1.0)
+
+    def cycle(self, buf, d):
+        out = self.fold(buf, d)
+        return out + buf.sum()  # re-read after method donation
+
+
+def cycle_double_donation(state, rows, vals):
+    # TWO donating calls before one re-read: the second call's argument
+    # is itself a re-read (flagged), but the final `state.sum()` is ONE
+    # finding, not one per preceding donation
+    a = apply_delta(state, rows, vals)
+    b = apply_delta(state, rows, vals)
+    return a + b + state.sum()  # re-read after double donation
+
+
+def cycle_in_match_arm(state, rows, vals, mode):
+    # match arms are suites too: a re-read inside one must be visible
+    # to the branch-path walker (regression: Match.cases was skipped)
+    match mode:
+        case "delta":
+            new = apply_delta(state, rows, vals)
+            return new + state.sum()  # re-read inside the case body
+        case _:
+            return state

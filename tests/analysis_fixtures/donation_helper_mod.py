@@ -1,0 +1,19 @@
+"""graftlint fixture (cross-file half): a helper module whose wrapper
+donates transitively. Linted TOGETHER with
+donation_interproc_violation.py — the case a single-file AST scan
+cannot catch."""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def apply_delta(state, delta):
+    return state + delta
+
+
+def fold(state, delta):
+    # passes its own parameter into a donated position: the donation
+    # summary fixpoint marks `fold` as donating argument 0 too
+    return apply_delta(state, delta)

@@ -1,0 +1,20 @@
+"""graftlint fixture (cross-file half): donation through an IMPORTED
+helper — invisible to any per-file scan, caught by the project call
+graph + donation-summary fixpoint. Lint with donation_helper_mod.py."""
+
+from donation_helper_mod import apply_delta, fold
+
+
+def cycle_through_helper(snap, delta):
+    new = fold(snap, delta)   # `fold` donates arg 0 transitively
+    return new + snap.sum()   # re-read after the helper's donation
+
+
+def cycle_direct_import(snap, delta):
+    new = apply_delta(snap, delta)  # donor imported from another module
+    return new, snap.mean()         # re-read
+
+
+def clean_through_helper(snap, delta):
+    snap = fold(snap, delta)  # rebind clears — stays quiet
+    return snap

@@ -1,0 +1,53 @@
+"""graftlint fixture: implicit device→host syncs on jax values (never
+imported). Each conversion shape the host-transfer family flags."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def item_sync(x):
+    total = jnp.sum(x)
+    return total.item()  # blocking device round-trip
+
+
+def float_sync(x):
+    score = jnp.max(x)
+    return float(score)  # implicit .item()
+
+
+def int_sync(x):
+    n = jnp.argmax(x)
+    best = int(n)  # implicit .item()
+    return best
+
+
+def copy_sync(x):
+    scores = jnp.where(x > 0, x, 0.0)
+    host = np.asarray(scores)  # device→host copy mid-function
+    return host[0]
+
+
+def bool_branch(x):
+    ok = jnp.all(x > 0)
+    if ok:  # __bool__ blocks (raises on a tracer)
+        return 1
+    return 0
+
+
+def assert_sync(x):
+    mask = jnp.any(x)
+    assert mask  # __bool__ device sync
+    return x
+
+
+def direct_call_sync(x):
+    return float(jnp.mean(x))  # no binding needed — direct jnp call
+
+
+def annotated_binding_sync(x):
+    total: jnp.ndarray = jnp.sum(x)  # AnnAssign taints like Assign
+    return float(total)
+
+
+def kwonly_param_sync(*, scores: jnp.ndarray):
+    return float(scores)  # keyword-only annotated param is tainted too

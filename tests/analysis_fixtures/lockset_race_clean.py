@@ -1,0 +1,96 @@
+"""graftlint fixture: lockset-consistent classes the lockset-race
+family must NOT flag (never imported) — including the private-helper
+pattern that needs a hand waiver under per-file lock-discipline but is
+PROVEN safe by the call graph here."""
+
+import threading
+
+
+class DisciplinedCache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._store = {}
+        self._bytes = 0
+        # __init__ may call helpers lock-free: construction
+        # happens-before publication
+        self._rebuild()
+
+    def put(self, k, v):
+        with self._lock:
+            self._store[k] = v
+            self._bytes += len(v)
+
+    def drop(self, k):
+        with self._lock:
+            self._store.pop(k, None)
+
+    def flush(self):
+        with self._lock:
+            # the helper mutates guarded state WITHOUT a lexical lock —
+            # every intra-class call site holds self._lock, so its
+            # entry lockset is {_lock}: clean, no waiver needed
+            self._rebuild()
+
+    def _rebuild(self):
+        self._store = {}
+        self._bytes = 0
+
+
+class HelpersDefinedFirst:
+    """Definition-order regression: the helper chain appears BEFORE its
+    only (lock-holding) entry. A fixpoint that injects a default empty
+    context for not-yet-computed callers would flag `_deep` here — the
+    real entry lockset is {_lock} regardless of method order."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._table = {}
+
+    def _deep(self):
+        self._table = {}
+
+    def _shallow(self):
+        self._deep()
+
+    def rebuild(self):
+        with self._lock:
+            self._shallow()
+
+    def put(self, k, v):
+        with self._lock:
+            self._table[k] = v
+
+
+class InitOnlyHelper:
+    """Constructor setup refactored into a private helper: `_reset` is
+    reachable ONLY from `__init__`, so its lock-free mutation of
+    `_store` inherits the construction happens-before exemption — no
+    finding, even though `put` guards the same attribute."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._reset()
+
+    def _reset(self):
+        self._store = {}
+
+    def put(self, k, v):
+        with self._lock:
+            self._store[k] = v
+
+
+class UnguardedScratch:
+    """A lock exists for something else; `notes` is never mutated under
+    it anywhere — no lockset claim, no finding."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.notes = []
+        self._active = False
+
+    def start(self):
+        with self._lock:
+            self._active = True
+
+    def scribble(self, line):
+        self.notes.append(line)

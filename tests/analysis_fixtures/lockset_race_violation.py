@@ -1,0 +1,49 @@
+"""graftlint fixture: inconsistent locksets through the class call
+graph (never imported)."""
+
+import threading
+
+
+class TornCache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._store = {}
+        self._count = 0
+
+    def put(self, k, v):
+        with self._lock:
+            self._store[k] = v
+            self._count += 1
+
+    def drop(self, k):
+        # public method, lock-free mutation of guarded state — the
+        # classic torn write (lock-discipline catches this too)
+        self._store.pop(k, None)
+
+    def reset(self):
+        # a private helper called WITHOUT the lock from a public
+        # method: the call graph proves the lock-free path — this is
+        # the case per-file lexical analysis cannot justify either way
+        self._wipe()
+
+    def _wipe(self):
+        self._store.clear()
+        self._count = 0
+
+
+class MixedGuards:
+    """The same attribute guarded by DIFFERENT locks in different
+    methods: no common lock exists, every site flagged."""
+
+    def __init__(self):
+        self._lock_a = threading.Lock()
+        self._lock_b = threading.Lock()
+        self.shared = []
+
+    def writer_a(self, v):
+        with self._lock_a:
+            self.shared.append(v)
+
+    def writer_b(self, v):
+        with self._lock_b:
+            self.shared.append(v)

@@ -1,0 +1,39 @@
+"""graftlint fixture: stores the tracer-leak family must NOT flag
+(never imported) — jax functional updates, trace-local accumulators,
+and host-constant stores."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def functional_update(state, rows, vals):
+    # `.at[...].set/add` builds a NEW array — jax's functional update,
+    # not a store through the argument
+    return state.at[rows].add(vals)
+
+
+@jax.jit
+def entry(counts, x):
+    acc = []
+    return _accumulate(acc, counts, x)
+
+
+def _accumulate(acc, counts, x):
+    # bare-list accumulator passed between kernel helpers: trace-LOCAL,
+    # consumed before the trace ends (the ops/assign _affinity_update
+    # pattern) — never flagged
+    acc.append(counts * x)
+    return jnp.stack(acc)
+
+
+@jax.jit
+def constant_store(cfg, x):
+    cfg.shape_hint = (4, 8)  # host constant, not a tracer
+    return x * 2
+
+
+def host_only(store, x):
+    # not jit-reachable: host code mutates freely
+    store.cache = x
+    return x

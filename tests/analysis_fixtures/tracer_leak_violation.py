@@ -1,0 +1,37 @@
+"""graftlint fixture: tracers stored where they outlive the traced call
+(never imported)."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def leak_to_self(self, x):
+    y = jnp.tanh(x)
+    self.cache = y  # tracer stored onto the receiver object
+    return y
+
+
+@jax.jit
+def leak_via_helper_entry(state, x):
+    return _helper_leak(state, x)
+
+
+def _helper_leak(state, x):
+    # reachable from the jitted entry above THROUGH the call graph: a
+    # per-file scan of this function alone sees no jit anywhere
+    state.last = x * 2.0
+    return x
+
+
+@jax.jit
+def leak_into_container(slots, x):
+    v = jnp.exp(x)
+    slots.history.append(v)  # attribute-chained container outlives
+    return v
+
+
+@jax.jit
+def leak_subscript(registry, x):
+    registry["latest"] = jnp.abs(x)  # param subscript store
+    return x

@@ -1,0 +1,53 @@
+"""graftlint fixture: STRUCTURAL waiver placement (never imported) —
+the decorated-def and multi-line-statement shapes `_parse_waivers` +
+`_resolve_waiver_spans` must honor, plus unwaived twins proving the
+spans do not over-cover."""
+
+import jax
+import jax.numpy as jnp
+import urllib.request
+
+
+# a waiver above the DECORATOR waives the WHOLE def: the traced-bool
+# branch is three lines below the comment, inside the body
+# graftlint: disable=dtype-shape -- fixture: decorated-def waiver covers the body finding
+@jax.jit
+def gated_waived(x):
+    if x.any():
+        return x
+    return -x
+
+
+@jax.jit
+def gated_unwaived(x):
+    # the twin without a waiver: still fires (the span above covers
+    # ONLY its own def)
+    if x.any():
+        return x
+    return -x
+
+
+def multiline_statement_waived():
+    # graftlint: disable=timeout-hygiene -- fixture: the call spans three lines; the waiver covers all of them
+    body = urllib.request.urlopen(
+        "http://localhost:9/metrics",
+    )
+    return body
+
+
+def multiline_statement_unwaived():
+    # no waiver: stays a timeout-hygiene finding (attributed to some
+    # line of this multi-line statement)
+    body = urllib.request.urlopen(
+        "http://localhost:9/metrics",
+    )
+    return body
+
+
+def dtype_kw_on_later_line():
+    # graftlint: disable=dtype-shape -- fixture: the dtype kw lands two lines into the statement
+    table = jnp.zeros(
+        (4, 4),
+        dtype=jnp.float64,
+    )
+    return table

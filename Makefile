@@ -5,7 +5,7 @@ PY ?= python
 IMAGE_REPO ?= registry.example.com/yoda-tpu
 TAG ?= latest
 
-.PHONY: local test test-fast bench trace-smoke obs-smoke scenario-smoke perf-gate perf-baseline lint lint-sarif native native-asan native-tsan proto clean build push
+.PHONY: local test test-fast bench trace-smoke obs-smoke scenario-smoke perf-gate perf-baseline lint lint-sarif model-check native native-asan native-tsan proto clean build push
 
 # "make local" in the reference = fmt + vet + compile. Here: byte-compile
 # the package, build the native library, lint, run the fast tests.
@@ -14,20 +14,41 @@ local: native lint
 	$(PY) -m pytest tests/ -x -q -m "not slow"
 
 # repo-native static analysis (kubernetes_scheduler_tpu/analysis):
-# fourteen AST rule families over the interprocedural dataflow core,
+# fifteen AST rule families over the interprocedural dataflow core,
 # plus the engine-contract layer (jax.eval_shape traces of every engine
-# entry point on CPU). Exits non-zero on any unwaived violation; see
+# entry point on CPU) and the protocol-model layer (bounded model
+# checking of the session/epoch/capability protocol with anchor-drift
+# detection and the seeded mutation harness — `make model-check` is the
+# standalone loop). Exits non-zero on any unwaived violation; see
 # the README's "Static analysis" section for the inline-waiver syntax.
 # The run drops a findings-JSON artifact for CI diffing and asserts a
 # wall-time budget — the parse-once index must keep full-repo lint
-# (contracts included) inside LINT_BUDGET seconds despite fourteen
-# families; tests/test_bench_smoke.py holds the sharper relative gate
-# (14 families < 2x the 10-family PR-8 baseline on the same machine).
+# (contracts and models included) inside LINT_BUDGET seconds;
+# tests/test_bench_smoke.py holds the sharper relative gate.
+# `--changed-only REF` is the fast pre-commit loop (findings scoped to
+# the changed files' reverse-dependency closure, subset-of-full-run
+# pinned in tests/test_analysis.py).
 LINT_BUDGET ?= 120
 LINT_ARTIFACT ?= /tmp/yoda-lint.json
 lint:
 	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_scheduler_tpu.analysis \
 	  --budget-seconds $(LINT_BUDGET) --json-artifact $(LINT_ARTIFACT)
+
+# bounded model checking of the session/epoch/capability protocol
+# (kubernetes_scheduler_tpu/analysis/model/): exhausts every shipped
+# protocol model's state space, verifies every transition's code
+# anchors against the live source, and runs the seeded mutation
+# harness (protocol-bug reintroductions the checker must each catch).
+# The same layer is folded into `make lint` as pseudo-rule
+# `protocol-model`; this target is the standalone loop with per-model
+# state counts, mutant verdicts, and a JSON artifact for CI diffing.
+# Exit 3 = a model blew the budget — the bounded proof is incomplete;
+# raise the budget or shrink the model, never ignore it.
+MODEL_BUDGET ?= 60
+MODEL_ARTIFACT ?= /tmp/yoda-model-check.json
+model-check:
+	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_scheduler_tpu.analysis.model \
+	  --budget-seconds $(MODEL_BUDGET) --json-artifact $(MODEL_ARTIFACT)
 
 # SARIF 2.1.0 artifact (CI code-scanning upload). The renderer
 # structurally validates the document before printing — a malformed

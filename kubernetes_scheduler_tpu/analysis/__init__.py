@@ -1,28 +1,38 @@
-"""graftlint: repo-native static analysis, two layers.
+"""graftlint: repo-native static analysis, three layers.
 
 The scheduler's correctness rests on invariants no test can check
 exhaustively — pure jitted scoring kernels, donated resident buffers,
 lock-guarded shared caches between the driver/bridge/exporter threads,
-a stable wire schema between host and sidecar. This package
-machine-enforces them:
+a stable wire schema between host and sidecar, a session/epoch/
+capability protocol across it. This package machine-enforces them:
 
-Layer 1 — fourteen AST rule families over the repo's own source. The
+Layer 1 — fifteen AST rule families over the repo's own source. The
 per-file era families (jit-purity, host-sync, lock-discipline,
 wire-schema, dtype-shape, timeout-hygiene, pallas-vmem, metric-hygiene,
-sim-determinism, span-hygiene) plus four interprocedural families built
-on the shared dataflow core (analysis/dataflow.py — parse-once module
-index, project call graph, branch-path def-use, donation summaries,
-lockset fixpoint):
+sim-determinism, span-hygiene) plus the families built on the shared
+dataflow core (analysis/dataflow.py — parse-once module index, project
+call graph, branch-path def-use, donation summaries, lockset fixpoint):
 
   donation-aliasing  donated buffer re-read, across modules/helpers
   host-transfer      implicit device→host syncs in the hot-path modules
   tracer-leak        tracers stored where they outlive the traced call
   lockset-race       guarded attrs need a consistent call-graph lockset
 
+plus capability-completeness: every HealthReply capability bit wired
+end to end (latch/switch tables vs the .proto both ways, table-driven
+probe/invalidate, accessors, per-RPC except-path discipline).
+
 Layer 2 — engine contracts (analysis/contracts.py): every engine entry
 point's shape/dtype contract verified by jax.eval_shape tracing on CPU
 across a bucket-shape grid, fused and unfused paths diffed against the
 same declaration.
+
+Layer 3 — protocol models (analysis/model/): the session/epoch/
+capability protocol, the queue's gang-deferral semantics, the
+pipelined in-flight slot, and the proposed 2-replica bind-conflict
+protocol as declared state machines, EXHAUSTIVELY model-checked with
+transition anchors that fail lint on code drift and a seeded mutation
+harness proving the checker's teeth (`make model-check`).
 
 Run:  python -m kubernetes_scheduler_tpu.analysis   (or `make lint`)
 

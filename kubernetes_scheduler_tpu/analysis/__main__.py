@@ -1,9 +1,12 @@
 """graftlint CLI: `python -m kubernetes_scheduler_tpu.analysis`.
 
 Exits non-zero on any unwaived violation; `make lint` wires this into
-the build. Beyond the fourteen AST families, a full-repo run also
+the build. Beyond the fifteen AST families, a full-repo run also
 traces the engine-contract layer (analysis/contracts.py, jax.eval_shape
-on CPU) unless --no-contracts; machine output: `--format json|sarif`
+on CPU) unless --no-contracts, and the protocol-model layer
+(analysis/model/: bounded model checking of the session/epoch/
+capability protocol, anchor drift, mutation harness) unless
+--no-models; machine output: `--format json|sarif`
 (SARIF 2.1.0 — validated structurally before printing, so a malformed
 artifact fails lint, not the CI uploader), `--json-artifact PATH` to
 drop the findings JSON beside any display format, `--baseline` for the
@@ -12,6 +15,15 @@ and `--budget-seconds` asserting the whole run's wall time — the
 parse-once index keeps full-repo lint inside it. Waived sites are
 listed (with their justifications) under --verbose so the allow-list
 stays reviewable.
+
+`--changed-only REF` is the fast pre-commit loop: the AST families
+still parse the whole package (the interprocedural core needs every
+edge), but findings are scoped to the files changed vs REF plus their
+reverse-dependency closure from the shared call graph, and the two
+whole-program layers (contracts, protocol models) run only when a file
+on their surface is in that closure. Changed-only findings are a
+subset of the full run's by construction (pinned in
+tests/test_analysis.py).
 """
 
 from __future__ import annotations
@@ -79,6 +91,27 @@ def main(argv=None) -> int:
         help="skip the engine-contract layer on a full-repo lint",
     )
     parser.add_argument(
+        "--models", action="store_true",
+        help="run the protocol-model layer even for a scoped lint",
+    )
+    parser.add_argument(
+        "--no-models", action="store_true",
+        help="skip the protocol-model layer on a full-repo lint",
+    )
+    parser.add_argument(
+        "--model-budget-seconds", type=float, default=60.0,
+        help="wall budget for the protocol-model layer (models + "
+             "anchors + mutation harness); an un-exhausted model is a "
+             "violation, never a silent skip",
+    )
+    parser.add_argument(
+        "--changed-only", metavar="REF",
+        help="scope findings to files changed vs the git REF plus "
+             "their reverse-dependency closure (fast pre-commit loop); "
+             "whole-program layers run only when their surface is in "
+             "the closure",
+    )
+    parser.add_argument(
         "--budget-seconds", type=float, default=None,
         help="fail if the whole run exceeds this wall time",
     )
@@ -94,20 +127,85 @@ def main(argv=None) -> int:
         if args.rules
         else None
     )
+    if args.changed_only and args.paths:
+        parser.error("--changed-only and explicit paths are exclusive")
+    ctx_sink: list = []
     try:
-        violations = run_lint(args.paths or None, rules=rules)
+        violations = run_lint(args.paths or None, rules=rules,
+                              ctx_out=ctx_sink)
     except ValueError as e:
         parser.error(str(e))
 
+    # --changed-only: the families parsed (and analyzed) the whole
+    # package — the interprocedural core needs every edge — but the
+    # findings reported are those in the changed files' reverse-
+    # dependency closure. Whole-program layers below key off the same
+    # closure. Subset-of-full-run by construction.
+    scope = None
+    if args.changed_only:
+        from kubernetes_scheduler_tpu.analysis.core import (
+            changed_vs_ref,
+            reverse_dependency_closure,
+        )
+
+        try:
+            changed = changed_vs_ref(_REPO_ROOT, args.changed_only)
+        except ValueError as e:
+            parser.error(str(e))
+        scope = reverse_dependency_closure(ctx_sink[0], changed)
+        violations = [v for v in violations if v.path in scope]
+
+    def _surface_hit(patterns) -> bool:
+        import fnmatch
+
+        if scope is None:
+            return False
+        return any(
+            fnmatch.fnmatch(p, pat) for p in scope for pat in patterns
+        )
+
     # layer 2: engine contracts — on by default for the full-repo run
-    # `make lint` does, opt-in for scoped runs (tracing needs jax)
-    full_repo = not args.paths and rules is None
-    if args.contracts or (full_repo and not args.no_contracts):
+    # `make lint` does, opt-in for scoped runs (tracing needs jax); a
+    # changed-only run traces them only when the closure touches the
+    # engine/ops surface
+    full_repo = not args.paths and rules is None and not args.changed_only
+    run_contracts = args.contracts or (full_repo and not args.no_contracts)
+    if args.changed_only and not args.no_contracts:
+        from kubernetes_scheduler_tpu.analysis.contracts import SURFACE
+
+        run_contracts = run_contracts or _surface_hit(SURFACE)
+    if run_contracts:
         from kubernetes_scheduler_tpu.analysis.contracts import (
             check_contracts,
         )
 
         violations.extend(check_contracts())
+
+    # layer 3: protocol models (analysis/model/) — bounded model
+    # checking of the session/epoch/capability protocol, transition
+    # anchor drift, and the mutation harness, reported as pseudo-rule
+    # `protocol-model`; same full-repo default / surface-keyed
+    # changed-only behavior as the contracts layer
+    run_models = args.models or (full_repo and not args.no_models)
+    if args.changed_only and not args.no_models:
+        from kubernetes_scheduler_tpu.analysis.model.runner import (
+            SURFACE as MODEL_SURFACE,
+        )
+
+        run_models = run_models or _surface_hit(MODEL_SURFACE)
+    if run_models:
+        from kubernetes_scheduler_tpu.analysis.model.runner import (
+            check_protocol_layer,
+        )
+
+        violations.extend(
+            check_protocol_layer(
+                # a path-scoped ctx would miss anchor targets: let the
+                # layer build its own full-package index in that case
+                ctx_sink[0] if (ctx_sink and not args.paths) else None,
+                budget_seconds=args.model_budget_seconds,
+            )
+        )
 
     baseline = args.baseline
     if baseline is None and not args.no_baseline:

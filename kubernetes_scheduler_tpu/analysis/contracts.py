@@ -49,6 +49,14 @@ GRID = (
 ENGINE_PATH = "kubernetes_scheduler_tpu/engine.py"
 FUSED_PATH = "kubernetes_scheduler_tpu/ops/pallas_fused.py"
 
+# the files whose edits can move a declared contract — a changed-only
+# lint run traces the layer only when its closure touches these
+SURFACE = (
+    ENGINE_PATH,
+    "kubernetes_scheduler_tpu/ops/*.py",
+    "kubernetes_scheduler_tpu/analysis/contracts.py",
+)
+
 
 def _spec_tree(tree):
     """Pytree of concrete arrays -> pytree of ShapeDtypeStruct."""

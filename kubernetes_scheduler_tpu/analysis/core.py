@@ -206,9 +206,13 @@ def run_lint(
     rules: list[str] | None = None,
     root: str | None = None,
     proto_path: str | None = None,
+    ctx_out: list | None = None,
 ) -> list[Violation]:
     """Lint `paths` (default: the whole package) with `rules` (default:
-    all). Returns every violation, waived ones flagged."""
+    all). Returns every violation, waived ones flagged. `ctx_out`, if
+    given, receives the run's Context (the CLI's --changed-only mode
+    reuses its parse-once index for the reverse-dependency closure
+    instead of re-parsing the repo)."""
     from kubernetes_scheduler_tpu.analysis.rules import RULES
 
     root = root or _REPO_ROOT
@@ -235,6 +239,8 @@ def run_lint(
     ctx = Context(
         root=root, files=files, explicit=explicit, proto_path=proto_path
     )
+    if ctx_out is not None:
+        ctx_out.append(ctx)
     selected = rules or list(RULES)
     unknown = set(selected) - set(RULES)
     if unknown:
@@ -277,7 +283,10 @@ def _check_readme_rules(root: str, rules: dict) -> list[Violation]:
             )
         ]
     section = text[m.end():]
-    nxt = re.search(r"^## ", section, re.M)
+    # the families table lives in the section intro; subsections (the
+    # contract and protocol-model layers) may carry tables of their own
+    # (model inventories), which are not rule rows
+    nxt = re.search(r"^#{2,3} ", section, re.M)
     if nxt:
         section = section[: nxt.start()]
     documented: dict[str, int] = {}
@@ -305,6 +314,94 @@ def _check_readme_rules(root: str, rules: dict) -> list[Violation]:
                 )
             )
     return out
+
+
+# ---- changed-only scoping (fast pre-commit loop) ---------------------------
+
+
+def changed_vs_ref(root: str, ref: str) -> set[str]:
+    """Repo-relative paths changed vs `ref` (committed diff + working
+    tree + untracked). A change to bridge/schedule.proto counts as a
+    change to the bridge modules that encode it — the wire-schema and
+    capability-completeness families check .py files against the proto,
+    so a proto-only edit must still pull them into scope."""
+    import subprocess
+
+    out: set[str] = set()
+    for args in (
+        ["git", "diff", "--name-only", ref, "--"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        try:
+            res = subprocess.run(
+                args, cwd=root, capture_output=True, text=True,
+                check=True, timeout=30,
+            )
+        except (OSError, subprocess.SubprocessError) as e:
+            detail = getattr(e, "stderr", "") or str(e)
+            raise ValueError(
+                f"--changed-only {ref}: {' '.join(args)} failed: "
+                f"{detail.strip()}"
+            ) from e
+        out.update(p.strip() for p in res.stdout.splitlines() if p.strip())
+    changed: set[str] = set()
+    for p in out:
+        p = p.replace(os.sep, "/")
+        if p.endswith("schedule.proto"):
+            changed.update((
+                "kubernetes_scheduler_tpu/bridge/client.py",
+                "kubernetes_scheduler_tpu/bridge/server.py",
+                "kubernetes_scheduler_tpu/bridge/codec.py",
+            ))
+        elif p.endswith(".py") and p.startswith("kubernetes_scheduler_tpu/"):
+            changed.add(p)
+    return changed
+
+
+def reverse_dependency_closure(ctx: Context, changed: set[str]) -> set[str]:
+    """`changed` plus every package file that depends on one of them,
+    transitively — dependence meaning a module import OR a resolved
+    call-graph edge into the file (the shared parse-once ModuleIndex).
+    A pre-commit lint scoped to this closure sees every finding the
+    edit could have created or fixed; findings wholly outside it are
+    unaffected by construction (pinned: changed-only findings are a
+    subset of the full run's)."""
+    from kubernetes_scheduler_tpu.analysis import dataflow
+
+    index = dataflow.get_index(ctx)
+    known = {f.path for f in ctx.files}
+    # file -> files it depends on (imports + call edges)
+    deps: dict[str, set[str]] = {p: set() for p in known}
+    for path, imports in index.imports.items():
+        for dotted in imports.values():
+            # `from pkg.mod import name` records pkg.mod.name; resolve
+            # the longest module prefix actually in the package
+            parts = dotted.split(".")
+            for i in range(len(parts), 0, -1):
+                target = index.by_module.get(".".join(parts[:i]))
+                if target is not None:
+                    if target.path != path:
+                        deps[path].add(target.path)
+                    break
+    for caller, edges in index.call_graph().items():
+        cfile = caller.split("::", 1)[0]
+        for callee, _ in edges:
+            tfile = callee.split("::", 1)[0]
+            if tfile != cfile and cfile in deps:
+                deps[cfile].add(tfile)
+    closure = set(changed) & known
+    frontier = list(closure)
+    rev: dict[str, list[str]] = {}
+    for p, targets in deps.items():
+        for t in targets:
+            rev.setdefault(t, []).append(p)
+    while frontier:
+        t = frontier.pop()
+        for p in rev.get(t, ()):
+            if p not in closure:
+                closure.add(p)
+                frontier.append(p)
+    return closure
 
 
 # ---- baseline (CI suppression) file ---------------------------------------

@@ -1,14 +1,19 @@
 """Rule registry: name -> check(ctx) -> list[Violation].
 
-Fourteen families. The first ten are the per-file era; the last four
-(donation-aliasing, host-transfer, tracer-leak, lockset-race) ride the
+Fifteen families. The first ten are the per-file era; donation-
+aliasing, host-transfer, tracer-leak, and lockset-race ride the
 interprocedural dataflow core (analysis/dataflow.py) — call-graph,
-def-use, and lockset analyses a single-file AST scan cannot express.
-The README's Static analysis table must name exactly this registry
-(checked both ways by the `docs-drift` runner check).
+def-use, and lockset analyses a single-file AST scan cannot express —
+and capability-completeness pins the bridge's HealthReply capability
+wiring (latch/switch tables, probe/invalidate discipline, RPC
+except-paths) against the .proto, the static twin of the
+analysis/model/ protocol checker. The README's Static analysis table
+must name exactly this registry (checked both ways by the `docs-drift`
+runner check).
 """
 
 from kubernetes_scheduler_tpu.analysis.rules import (
+    capability_completeness,
     donation_aliasing,
     dtype_shape,
     host_sync,
@@ -40,4 +45,5 @@ RULES = {
     host_transfer.RULE: host_transfer.check,
     tracer_leak.RULE: tracer_leak.check,
     lockset_race.RULE: lockset_race.check,
+    capability_completeness.RULE: capability_completeness.check,
 }

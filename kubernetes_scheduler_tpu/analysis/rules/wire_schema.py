@@ -64,14 +64,17 @@ _PROTOBUF_API = {
 _MSG_RE = re.compile(r"^\s*message\s+(\w+)\s*\{", re.M)
 _FIELD_RE = re.compile(
     r"^\s*(?:repeated\s+|optional\s+)?"
-    r"(?:map\s*<[^>]+>|[\w.]+)\s+(\w+)\s*=\s*\d+\s*;",
+    r"(map\s*<[^>]+>|[\w.]+)\s+(\w+)\s*=\s*\d+\s*;",
 )
 
 
-def parse_proto(path: str) -> dict[str, set]:
-    """message name -> set of field names, by brace-tracking text scan
-    (enough for the proto3 subset this repo uses)."""
-    messages: dict[str, set] = {}
+def parse_proto_fields(path: str) -> dict[str, dict[str, str]]:
+    """message name -> {field name: declared type}, by brace-tracking
+    text scan (enough for the proto3 subset this repo uses). The ONE
+    proto tokenizer: parse_proto derives its name sets from this, and
+    capability_completeness filters HealthReply's bool fields off the
+    types."""
+    messages: dict[str, dict[str, str]] = {}
     current = None
     depth = 0
     with open(path, encoding="utf-8") as f:
@@ -80,7 +83,7 @@ def parse_proto(path: str) -> dict[str, set]:
             m = _MSG_RE.match(line)
             if m and depth == 0:
                 current = m.group(1)
-                messages[current] = set()
+                messages[current] = {}
                 # count the rest of the line too: `message Empty {}`
                 # opens and closes in one line
                 depth = line.count("{") - line.count("}")
@@ -92,12 +95,20 @@ def parse_proto(path: str) -> dict[str, set]:
                 if depth == 1:
                     fm = _FIELD_RE.match(line)
                     if fm:
-                        messages[current].add(fm.group(1))
+                        messages[current][fm.group(2)] = fm.group(1)
                 depth += line.count("{") - line.count("}")
                 if depth <= 0:
                     current = None
                     depth = 0
     return messages
+
+
+def parse_proto(path: str) -> dict[str, set]:
+    """message name -> set of field names (parse_proto_fields sans
+    types — the shape the wire-schema checks key on)."""
+    return {
+        msg: set(fields) for msg, fields in parse_proto_fields(path).items()
+    }
 
 
 def _pb_aliases(tree: ast.AST) -> set:

@@ -43,6 +43,24 @@ class EngineUnavailable(RuntimeError):
 _GANG_FIELDS = ("gang_id", "gang_size")
 _PODS_SANS_GANGS = frozenset(engine.PodBatch._fields) - set(_GANG_FIELDS)
 
+# HealthReply capability bit -> the RemoteEngine latch attribute holding
+# it. THE canonical table: _probe_capabilities resolves every unresolved
+# latch from one Health reply through it, _invalidate_session drops the
+# whole set back to unknown through it, and the capability-completeness
+# lint family checks it against the .proto both ways — a new HealthReply
+# bool that is not wired in here fails lint, and the parametrized
+# mid-stream-downgrade regression tests (tests/test_resident.py) pick a
+# new entry up for free. The protocol itself (probe fills ALL unresolved
+# latches together; any failure invalidates ALL of them together with
+# the wire field cache) is model-checked in analysis/model/protocols.py.
+CAPABILITY_LATCHES = {
+    "field_cache": "_field_cache_ok",
+    "resident_state": "_resident_cap",
+    "windows_resident": "_windows_resident_cap",
+    "gang_scheduling": "_gang_cap",
+    "fused_min_max": "_fused_min_max_cap",
+}
+
 
 class _FutureSchedule:
     """RemoteEngine's in-flight ScheduleBatch handle: the whole RPC
@@ -125,6 +143,11 @@ class RemoteEngine:
         # whether the sidecar's PodBatch knows the gang tensors — same
         # latch/invalidate discipline as the other capability bits
         self._gang_cap: bool | None = None
+        # fused min-max capability (HealthReply.fused_min_max): the
+        # sidecar serves the fused megakernel's min-max epilogue AND
+        # sits on a backend that profits from it (TPU) — the host's
+        # min_max->fused widening keys off this latch; same discipline
+        self._fused_min_max_cap: bool | None = None
         # did the LAST schedule_resident call apply a delta server-side?
         # (mirrors LocalEngine.resident_used_delta for the host's
         # delta/full upload metrics)
@@ -182,21 +205,20 @@ class RemoteEngine:
         call."""
         info = self.health_info()
         if info is not None:
-            # fill only UNRESOLVED latches: a latch someone already
-            # resolved (or pinned) stays put until _invalidate_session
-            # drops the whole set back to unknown together
-            if self._field_cache_ok is None:
-                self._field_cache_ok = bool(info.field_cache)
-            if self._resident_cap is None:
-                self._resident_cap = bool(info.resident_state)
-            if self._windows_resident_cap is None:
-                self._windows_resident_cap = bool(
-                    getattr(info, "windows_resident", False)
-                )
-            if self._gang_cap is None:
-                self._gang_cap = bool(
-                    getattr(info, "gang_scheduling", False)
-                )
+            # fill only UNRESOLVED latches, and fill every unresolved
+            # one from this ONE reply: a latch someone already resolved
+            # (or pinned) stays put until _invalidate_session drops the
+            # whole set back to unknown together. Table-driven so a new
+            # HealthReply bit cannot be probed without also being
+            # invalidated (capability-completeness lint + the
+            # analysis/model/ protocol model both check this shape).
+            # getattr default False: a reply from a build older than
+            # the field reads as "capability absent".
+            for fieldname, attr in CAPABILITY_LATCHES.items():
+                if getattr(self, attr) is None:
+                    setattr(
+                        self, attr, bool(getattr(info, fieldname, False))
+                    )
 
     def _field_cache_enabled(self) -> bool:
         """Resolve the sidecar's field-cache capability ONCE per client
@@ -235,25 +257,38 @@ class RemoteEngine:
             self._probe_capabilities()
         return bool(self._gang_cap)
 
+    def supports_fused_min_max(self) -> bool:
+        """Resolve the sidecar's fused min-max epilogue capability
+        (HealthReply.fused_min_max) — same latch discipline. False
+        keeps the host's normalizer="min_max" cycles on the unfused
+        path (exactly the pre-widening behavior), so a version-skewed
+        or CPU-backed sidecar is never asked for a fused contract it
+        would reject or serve slowly."""
+        if self._fused_min_max_cap is None:
+            self._probe_capabilities()
+        return bool(self._fused_min_max_cap)
+
     def _pods_wire_fields(self) -> frozenset | None:
         """The PodBatch fields to put on the wire: everything, or
         everything minus the gang tensors against a gang-blind sidecar."""
         return None if self.supports_gangs() else _PODS_SANS_GANGS
 
     def _invalidate_session(self) -> None:
-        """Reset everything scoped to the sidecar behind this target: the
-        wire field cache AND both capability latches (field cache,
-        resident state) — always together. A failed send means the
-        sidecar may have been replaced (restart, rollback to an older
-        build): clearing only the field cache would leave the resident
-        latch trusting the dead sidecar's advertisement, so the client
-        would keep shipping deltas an older build cannot parse. The next
-        call re-probes Health and re-learns both capabilities."""
+        """Reset everything scoped to the sidecar behind this target:
+        the wire field cache AND every capability latch — always
+        together, through the one canonical latch table. A failed
+        send means the sidecar may have been replaced (restart,
+        rollback to an older build): clearing only the field cache
+        would leave the other latches trusting the dead sidecar's
+        advertisement, so the client would keep shipping deltas/gang
+        tensors/fused contracts an older build cannot serve. The next
+        call re-probes Health and re-learns the whole set. This
+        invalidate-together contract is a checked invariant of the
+        analysis/model/ client-session protocol model (and the PR-3
+        regression class its mutation harness re-introduces)."""
         self._wire_cache.clear()
-        self._field_cache_ok = None
-        self._resident_cap = None
-        self._windows_resident_cap = None
-        self._gang_cap = None
+        for attr in CAPABILITY_LATCHES.values():
+            setattr(self, attr, None)
 
     def _cache_for(self, key: str, enabled: bool):
         if not enabled:
@@ -570,7 +605,21 @@ class RemoteEngine:
         codec.pack_fields(snapshot, request.snapshot)
         codec.pack_fields(pods, request.pods)
         codec.pack_fields(victims, request.victims)
-        reply = self._call_with_retry(self._preempt, request, profile_ok=False)
+        try:
+            reply = self._call_with_retry(
+                self._preempt, request, profile_ok=False
+            )
+        except EngineUnavailable:
+            # same session hygiene as every schedule path: a failed
+            # Preempt means the sidecar behind this target may have
+            # been replaced, so the latched capabilities and the wire
+            # field cache must not outlive it. (Previously the ONE RPC
+            # surface that skipped the session invalidation — found by
+            # the capability-completeness lint family; a clean
+            # UNIMPLEMENTED degrade keeps the session, the sidecar
+            # answered.)
+            self._invalidate_session()
+            raise
         return codec.unpack_fields(PreemptResult, reply.result)
 
     def _call_with_retry(self, method, request, *, profile_ok: bool = True):

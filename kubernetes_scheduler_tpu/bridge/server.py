@@ -71,6 +71,21 @@ _POD_WIRE_DEFAULTS = {
 # Matrices are ~P*N*4 bytes; 10k nodes x 4k pods of f32 scores is ~160 MB.
 MAX_MESSAGE_BYTES = 512 * 1024 * 1024
 
+# HealthReply capability bit -> the EngineService switch attribute that
+# answers it. THE canonical server-side table (the twin of
+# bridge/client.CAPABILITY_LATCHES): health() renders every advertised
+# capability through it, tests/canaries flip individual switches to
+# impersonate older builds, and the capability-completeness lint family
+# checks it against the .proto both ways — a new HealthReply bool that
+# is not wired in here fails lint.
+CAPABILITY_SWITCHES = {
+    "field_cache": "field_cache_enabled",
+    "resident_state": "resident_enabled",
+    "windows_resident": "windows_resident_enabled",
+    "gang_scheduling": "gang_enabled",
+    "fused_min_max": "fused_min_max_enabled",
+}
+
 
 class EngineService:
     """Unary handlers for the two RPCs. A single worker thread serializes
@@ -123,6 +138,14 @@ class EngineService:
         # can impersonate an OLD sidecar and exercise the client's
         # strip-and-degrade path (host-side all-or-nothing backstop).
         self.gang_enabled = True
+        # fused min-max epilogue (HealthReply.fused_min_max): this
+        # build's engine serves fused=True with normalizer="min_max"
+        # (PR-8's megakernel epilogue), but the bit is advertised only
+        # when the backend PROFITS from it — a CPU sidecar would trade
+        # the XLA normalize pass for the interpret-mode Pallas kernel,
+        # so it keeps the bit off and hosts stay on unfused min_max.
+        # Tests/canaries flip the switch to exercise the latch on CPU.
+        self.fused_min_max_enabled = jax.default_backend() == "tpu"
         # resident-state observability (tests + ops): how many cycles
         # were served from an applied delta vs. a full resident upload
         self.resident_deltas_served = 0
@@ -651,15 +674,20 @@ class EngineService:
     def health(self, request: pb.HealthRequest, context) -> pb.HealthReply:
         devs = jax.devices()
         self.metrics_rpcs.inc(rpc="health")
+        # every capability bit rides the one CAPABILITY_SWITCHES table:
+        # a bit that exists in the proto but not in the table would be
+        # silently False forever (capability-completeness lint pins the
+        # two in sync)
+        caps = {
+            fieldname: bool(getattr(self, attr))
+            for fieldname, attr in CAPABILITY_SWITCHES.items()
+        }
         return pb.HealthReply(
             status="SERVING",
             device_count=len(devs),
             platform=devs[0].platform if devs else "none",
             cycles_served=self.cycles_served,
-            field_cache=self.field_cache_enabled,
-            resident_state=self.resident_enabled,
-            windows_resident=self.windows_resident_enabled,
-            gang_scheduling=self.gang_enabled,
+            **caps,
         )
 
 

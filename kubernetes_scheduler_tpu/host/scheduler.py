@@ -2326,15 +2326,20 @@ class Scheduler:
         LOCAL engines: only on a TPU backend — a CPU backend would
         trade the XLA normalize pass for the interpret-mode Pallas
         megakernel (~2x slower, exactly the per-stage regression `make
-        perf-gate` exists to catch). REMOTE engines: not yet — there is
-        no capability negotiation for the epilogue contract (unlike
-        supports_gangs/resident), so a version-skewed older sidecar
-        would reject fused+min_max every cycle and degrade the whole
-        deployment to the scalar fallback; remote sidecars keep the
-        pre-widening unfused min_max path until a HealthReply
-        capability bit ships. normalizer="none" configurations keep
-        their long-standing always-fused behavior either way. Cached —
-        one backend probe."""
+        perf-gate` exists to catch); cached, one backend probe.
+        REMOTE engines: the HealthReply.fused_min_max capability bit —
+        the sidecar advertises the epilogue contract only when its own
+        backend profits (TPU), the client latches it with the other
+        capability bits, and the answer is deliberately NOT cached
+        here: a mid-stream downgrade invalidates the latch and the
+        next cycle must come back unfused instead of rejecting the
+        fused contract forever. Engines without the probe (version
+        skew, learned overrides) keep the pre-widening unfused min_max
+        path. normalizer="none" configurations keep their
+        long-standing always-fused behavior either way."""
+        probe = getattr(self.engine, "supports_fused_min_max", None)
+        if probe is not None:
+            return bool(probe())
         v = self.__dict__.get("_fused_minmax_ok")
         if v is None:
             if isinstance(self.engine, LocalEngine):

@@ -1,0 +1,79 @@
+"""graftlint fixture: fully-wired capability bits (never imported, only
+parsed). The sibling fixture.proto's HealthReply declares cap_a and
+cap_b; both ride the canonical tables end to end — probe and
+invalidate are table-driven, every latch has an accessor, every switch
+is assigned, every direct sender reaches _invalidate_session.
+"""
+
+
+class EngineUnavailable(RuntimeError):
+    pass
+
+
+CAPABILITY_LATCHES = {
+    "cap_a": "_cap_a",
+    "cap_b": "_cap_b",
+}
+
+
+class WiredClient:
+    def __init__(self, target):
+        self._target = target
+        self._cap_a = None
+        self._cap_b = None
+        self._wire_cache = {}
+
+    def _probe_capabilities(self):
+        info = self.health_info()
+        if info is not None:
+            for fieldname, attr in CAPABILITY_LATCHES.items():
+                if getattr(self, attr) is None:
+                    setattr(self, attr, bool(getattr(info, fieldname, False)))
+
+    def _invalidate_session(self):
+        self._wire_cache.clear()
+        for attr in CAPABILITY_LATCHES.values():
+            setattr(self, attr, None)
+
+    def health_info(self):
+        return None
+
+    def supports_a(self):
+        if self._cap_a is None:
+            self._probe_capabilities()
+        return bool(self._cap_a)
+
+    def supports_b(self):
+        if self._cap_b is None:
+            self._probe_capabilities()
+        return bool(self._cap_b)
+
+    def preempt(self, request):
+        try:
+            return self._call_with_retry(self._target, request)
+        except EngineUnavailable:
+            self._invalidate_session()
+            raise
+
+    def _call_with_retry(self, method, request):
+        raise EngineUnavailable(method)
+
+
+CAPABILITY_SWITCHES = {
+    "cap_a": "cap_a_enabled",
+    "cap_b": "cap_b_enabled",
+}
+
+
+class WiredServer:
+    def __init__(self):
+        self.cap_a_enabled = True
+        self.cap_b_enabled = False
+        self.cycles_served = 0
+
+    def health(self, request, context):
+        caps = {
+            fieldname: bool(getattr(self, attr))
+            for fieldname, attr in CAPABILITY_SWITCHES.items()
+        }
+        return dict({"status": "SERVING"}, **caps)

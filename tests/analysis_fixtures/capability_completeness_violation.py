@@ -1,0 +1,65 @@
+"""graftlint fixture: half-wired capability bits (never imported, only
+parsed). The sibling fixture.proto's HealthReply declares cap_a and
+cap_b; everything below wires them WRONG — see the LINE comments.
+"""
+
+
+class EngineUnavailable(RuntimeError):
+    pass
+
+
+# LINE 12: cap_b missing from the table; cap_zz names no proto field
+CAPABILITY_LATCHES = {
+    "cap_a": "_cap_a",
+    "cap_zz": "_cap_zz",
+}
+
+
+class HalfWiredClient:
+    def __init__(self, target):
+        self._target = target
+        self._cap_a = None
+        self._cap_zz = None
+        self._wire_cache = {}
+
+    def _probe_capabilities(self):
+        # LINE 26: hand-rolled latch list, not driven by the table
+        info = self.health_info()
+        if info is not None and self._cap_a is None:
+            self._cap_a = bool(info.cap_a)
+
+    def _invalidate_session(self):
+        # LINE 32: resets one latch by hand instead of the whole table
+        self._wire_cache.clear()
+        self._cap_a = None
+
+    def health_info(self):
+        return None
+
+    # no accessor ever reads self._cap_a or self._cap_zz outside the
+    # plumbing above: both latches gate nothing
+
+    def preempt(self, request):
+        # LINE 43: sends through _call_with_retry but a failure never
+        # reaches the session invalidation — latches outlive the sidecar
+        return self._call_with_retry(self._target, request)
+
+    def _call_with_retry(self, method, request):
+        raise EngineUnavailable(method)
+
+
+# LINE 51: cap_b missing from the switch table too
+CAPABILITY_SWITCHES = {
+    "cap_a": "cap_a_enabled",
+}
+
+
+class HalfWiredServer:
+    def __init__(self):
+        # LINE 58: cap_a_enabled is never assigned anywhere in the
+        # class — health() would getattr-default its way to False
+        self.cycles_served = 0
+
+    def health(self, request, context):
+        # LINE 63: renders a hand-picked bit, not the switch table
+        return {"status": "SERVING", "cap_a": True}

@@ -98,6 +98,12 @@ def active(violations):
             "span_hygiene_clean.py",
             5,
         ),
+        (
+            "capability-completeness",
+            "capability_completeness_violation.py",
+            "capability_completeness_clean.py",
+            8,
+        ),
     ],
 )
 def test_rule_fires_and_stays_quiet(rule, violating, clean, min_hits):
@@ -454,12 +460,13 @@ def test_unknown_rule_rejected():
         run_lint(rules=["no-such-rule"])
 
 
-def test_registry_has_all_fourteen_families():
+def test_registry_has_all_fifteen_families():
     assert set(RULES) == {
         "jit-purity", "host-sync", "lock-discipline", "wire-schema",
         "dtype-shape", "timeout-hygiene", "pallas-vmem", "metric-hygiene",
         "sim-determinism", "span-hygiene", "donation-aliasing",
         "host-transfer", "tracer-leak", "lockset-race",
+        "capability-completeness",
     }
 
 
@@ -915,6 +922,146 @@ def test_lint_main_json_format(capsys):
     assert rc == 1
     payload = json.loads(capsys.readouterr().out)
     assert payload and payload[0]["rule"] == "lock-discipline"
+
+
+# ---- capability-completeness specifics ------------------------------------
+
+
+def test_capability_completeness_names_every_gap():
+    msgs = [
+        v.message
+        for v in active(lint_fixture(
+            "capability_completeness_violation.py",
+            "capability-completeness",
+        ))
+    ]
+    # table vs proto, both directions, both sides of the bridge
+    assert any("`cap_b` is missing from CAPABILITY_LATCHES" in m
+               for m in msgs)
+    assert any("`cap_zz` names no HealthReply bool" in m for m in msgs)
+    assert any("`cap_b` is missing from CAPABILITY_SWITCHES" in m
+               for m in msgs)
+    # hand-rolled probe/invalidate instead of the table
+    assert any("_probe_capabilities` does not iterate" in m for m in msgs)
+    assert any("_invalidate_session` does not iterate" in m for m in msgs)
+    # a latch nobody reads, a switch nobody assigns, a health() that
+    # bypasses the table
+    assert any("has no accessor" in m for m in msgs)
+    assert any("never assigned" in m for m in msgs)
+    assert any("does not render through" in m for m in msgs)
+    # the except-path discipline (the historical Preempt gap)
+    assert any("sends through _call_with_retry" in m for m in msgs)
+
+
+def test_capability_completeness_on_the_real_bridge():
+    """The live bridge wires every HealthReply bit end to end (this is
+    the family that found the Preempt except-path gap)."""
+    client = "kubernetes_scheduler_tpu/bridge/client.py"
+    server = "kubernetes_scheduler_tpu/bridge/server.py"
+    vs = active(run_lint([client, server],
+                         rules=["capability-completeness"]))
+    assert vs == [], [v.format() for v in vs]
+    # and the proto reader sees the full capability set, fused_min_max
+    # included
+    from kubernetes_scheduler_tpu.analysis.rules.capability_completeness import (
+        health_bool_fields,
+    )
+    from kubernetes_scheduler_tpu.bridge.client import CAPABILITY_LATCHES
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    fields = health_bool_fields(
+        os.path.join(root, "kubernetes_scheduler_tpu/bridge/schedule.proto")
+    )
+    assert fields == set(CAPABILITY_LATCHES)
+    assert "fused_min_max" in fields
+
+
+# ---- --changed-only: the fast pre-commit loop -----------------------------
+
+
+def _full_ctx():
+    sink = []
+    run_lint(rules=["timeout-hygiene"], ctx_out=sink)
+    return sink[0]
+
+
+def test_reverse_dependency_closure_follows_imports_and_calls():
+    from kubernetes_scheduler_tpu.analysis.core import (
+        reverse_dependency_closure,
+    )
+
+    ctx = _full_ctx()
+    client = "kubernetes_scheduler_tpu/bridge/client.py"
+    closure = reverse_dependency_closure(ctx, {client})
+    assert client in closure
+    # the host scheduler dispatches through RemoteEngine — it depends
+    # on the client, so a client edit pulls it into scope
+    assert "kubernetes_scheduler_tpu/host/scheduler.py" in closure
+    # kernel code has no path into the bridge client
+    assert "kubernetes_scheduler_tpu/ops/normalize.py" not in closure
+    # closure of nothing is nothing
+    assert reverse_dependency_closure(ctx, set()) == set()
+
+
+def test_changed_vs_ref_maps_proto_to_bridge(monkeypatch):
+    import subprocess
+
+    from kubernetes_scheduler_tpu.analysis import core as core_mod
+
+    def fake_run(args, **kw):
+        out = (
+            "kubernetes_scheduler_tpu/bridge/schedule.proto\n"
+            "kubernetes_scheduler_tpu/host/queue.py\n"
+            "README.md\n"
+            if args[1] == "diff" else ""
+        )
+        return subprocess.CompletedProcess(args, 0, stdout=out, stderr="")
+
+    monkeypatch.setattr("subprocess.run", fake_run)
+    changed = core_mod.changed_vs_ref(core_mod._REPO_ROOT, "HEAD")
+    # proto edits pull the modules that encode the schema into scope;
+    # non-package files are ignored
+    assert "kubernetes_scheduler_tpu/bridge/client.py" in changed
+    assert "kubernetes_scheduler_tpu/bridge/server.py" in changed
+    assert "kubernetes_scheduler_tpu/host/queue.py" in changed
+    assert "README.md" not in changed
+
+
+def test_changed_only_findings_subset_of_full(tmp_path, monkeypatch, capsys):
+    """The pinned --changed-only contract: a scoped run never reports a
+    finding the full run would not."""
+    import json
+
+    from kubernetes_scheduler_tpu.analysis import core as core_mod
+
+    monkeypatch.setattr(
+        core_mod, "changed_vs_ref",
+        lambda root, ref: {"kubernetes_scheduler_tpu/bridge/client.py"},
+    )
+    full_art = tmp_path / "full.json"
+    changed_art = tmp_path / "changed.json"
+    base = ["--no-contracts", "--no-models", "--no-baseline"]
+    assert lint_main(base + ["--json-artifact", str(full_art)]) == 0
+    assert lint_main(
+        base + ["--changed-only", "HEAD", "--json-artifact",
+                str(changed_art)]
+    ) == 0
+    capsys.readouterr()
+    key = lambda v: (v["rule"], v["path"], v["line"])  # noqa: E731
+    full = {key(v) for v in json.loads(full_art.read_text())}
+    changed = {key(v) for v in json.loads(changed_art.read_text())}
+    assert changed <= full
+    # and the scoped run is non-trivial: the closure of the bridge
+    # client reaches the host scheduler's waived boundary syncs
+    assert any(p.startswith("kubernetes_scheduler_tpu/") for _, p, _ in changed)
+
+
+def test_changed_only_rejects_explicit_paths(capsys):
+    with pytest.raises(SystemExit) as e:
+        lint_main(["--changed-only", "HEAD",
+                   "kubernetes_scheduler_tpu/engine.py"])
+    assert e.value.code == 2
+    capsys.readouterr()
 
 
 # ---- the capstone: the repo itself lints clean ----------------------------

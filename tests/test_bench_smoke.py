@@ -437,7 +437,7 @@ def test_lint_artifact_and_sarif_e2e(tmp_path):
 
 
 def test_lint_walltime_budget_e2e():
-    """The parse-once index gate: running ALL fourteen AST families over
+    """The parse-once index gate: running ALL fifteen AST families over
     the full repo must cost less than 2x the ten-family PR-8 baseline
     measured in the SAME process (the four interprocedural families ride
     the shared index instead of re-parsing/re-walking). Measured on
@@ -457,13 +457,57 @@ def test_lint_walltime_budget_e2e():
     run_lint(rules=pr8_families)
     t_base = time.monotonic() - t0
     t0 = time.monotonic()
-    vs = run_lint()  # all fourteen + docs-drift
+    vs = run_lint()  # all fifteen + docs-drift
     t_all = time.monotonic() - t0
     assert [v for v in vs if not v.waived] == []
     # generous noise floor for a loaded 1-CPU box: the gate is the
     # RATIO, and an index regression (each family re-walking every
     # tree) blows straight through 2x
     assert t_all < 2.0 * t_base + 0.75, (
-        f"14-family lint {t_all:.2f}s vs 10-family baseline "
+        f"15-family lint {t_all:.2f}s vs 10-family baseline "
         f"{t_base:.2f}s — the parse-once index contract is broken"
     )
+
+
+def test_model_check_e2e(tmp_path):
+    """The `make model-check` CI surface, minus the shell: one run of
+    the protocol-model layer — every shipped model's bounded state
+    space exhausted, every transition anchor verified against the live
+    source, every seeded mutant caught — under the acceptance budget
+    (<60s on CPU; in practice ~2s), writing the JSON artifact CI diffs,
+    plus the SARIF rendering. Exit 3 (un-exhausted proof) and exit 1
+    (violation/survived mutant) would both fail here."""
+    import time
+
+    artifact = tmp_path / "model.json"
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, "-m", "kubernetes_scheduler_tpu.analysis.model",
+         "--budget-seconds", "60", "--json-artifact", str(artifact)],
+        capture_output=True, text=True, timeout=120, cwd=REPO, env=env,
+    )
+    wall = time.monotonic() - t0
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    assert wall < 60.0, f"model-check took {wall:.1f}s — smoke budget blown"
+    doc = json.loads(artifact.read_text())
+    assert len(doc["models"]) == 5
+    assert all(m["exhausted"] and not m["violations"] for m in doc["models"])
+    assert doc["mutants"] and all(
+        d["caught"] for d in doc["mutants"].values()
+    ), doc["mutants"]
+    assert doc["anchor_drift"] == []
+    # every model actually explored a nontrivial space and the harness
+    # names the first finding that catches each mutant
+    assert all(m["states"] > 1 for m in doc["models"])
+    assert all(d["first_finding"] for d in doc["mutants"].values())
+
+    sarif_proc = subprocess.run(
+        [sys.executable, "-m", "kubernetes_scheduler_tpu.analysis.model",
+         "--format", "sarif", "--no-mutants"],
+        capture_output=True, text=True, timeout=120, cwd=REPO, env=env,
+    )
+    assert sarif_proc.returncode == 0, sarif_proc.stderr[-2000:]
+    from kubernetes_scheduler_tpu.analysis.sarif import validate_sarif
+
+    validate_sarif(json.loads(sarif_proc.stdout))

@@ -345,6 +345,10 @@ def test_scalar_fallback_never_binds_partial_gangs():
 
 
 # ---- bridge: capability downgrade ----------------------------------------
+# (the generic mid-stream-downgrade pin — probe/invalidate/re-learn for
+# EVERY HealthReply bit, parametrized off the proto — lives in
+# tests/test_resident.py::test_mid_stream_downgrade_relearns_every_bit;
+# this test pins the gang-specific degrade behavior on top of it)
 
 
 def test_gang_capability_downgrade_old_sidecar():

@@ -1,0 +1,325 @@
+"""graftmodel tests: the checker core (partial-order-reduction
+soundness against known full-interleaving state counts, counterexample
+determinism, writes-declaration validation, budget handling), the
+shipped protocol models (exhausted, zero violations, anchors bound),
+the mutation harness asserted mutant by mutant, and the CLI contract
+(exit 0 / 1-on-violation / 3-on-blown-budget, JSON artifact)."""
+
+import json
+
+import pytest
+
+from kubernetes_scheduler_tpu.analysis.model import (
+    Convergence,
+    Invariant,
+    ProtocolModel,
+    Transition,
+    check_model,
+)
+from kubernetes_scheduler_tpu.analysis.model import mutants as mutants_mod
+from kubernetes_scheduler_tpu.analysis.model.__main__ import main as model_main
+from kubernetes_scheduler_tpu.analysis.model.checker import _explore
+from kubernetes_scheduler_tpu.analysis.model.protocols import build_models
+
+# ---- checker core ---------------------------------------------------------
+
+
+def _counter_model(n=3, invariants=(), convergences=()):
+    """Two independent per-process counters 0..n: the full interleaving
+    lattice has EXACTLY (n+1)^2 reachable states — the analytic pin the
+    POR soundness test compares against."""
+    t = (
+        Transition(
+            name="inc_x", process="px",
+            guard=lambda s: s["x"] < n,
+            effect=lambda s: {"x": s["x"] + 1},
+            reads=frozenset({"x"}), writes=frozenset({"x"}),
+        ),
+        Transition(
+            name="inc_y", process="py",
+            guard=lambda s: s["y"] < n,
+            effect=lambda s: {"y": s["y"] + 1},
+            reads=frozenset({"y"}), writes=frozenset({"y"}),
+        ),
+    )
+    return ProtocolModel(
+        name="counters", description="two independent counters",
+        init={"x": 0, "y": 0}, transitions=t,
+        invariants=tuple(invariants), convergences=tuple(convergences),
+    )
+
+
+def test_por_visits_every_state_of_known_lattice():
+    res = check_model(_counter_model(3))
+    assert res.exhausted and res.ok
+    # sleep sets prune TRANSITIONS, never states: all (3+1)^2 states
+    assert res.states == 16
+    # and the reduction actually reduced something
+    assert res.transitions_slept > 0
+
+
+@pytest.mark.parametrize(
+    "model", build_models(), ids=lambda m: m.name
+)
+def test_por_state_set_equals_full_interleaving(model):
+    """POR soundness on every SHIPPED model: the reduced exploration
+    reaches exactly the states the unreduced one does."""
+    full = _explore(model, por=False, record_edges=False,
+                    max_states=200_000, deadline=None)
+    red = _explore(model, por=True, record_edges=False,
+                   max_states=200_000, deadline=None)
+    assert full.exhausted and red.exhausted
+    # every reachable state is still visited — the soundness claim
+    # (sleep sets prune transitions, and may re-expand a state under
+    # incomparable sleep sets, so FIRED counts are not comparable)
+    assert set(red.states) == set(full.states)
+
+
+def test_undeclared_write_is_an_error_not_an_unsoundness():
+    lying = ProtocolModel(
+        name="liar", description="", init={"x": 0, "y": 0},
+        transitions=(
+            Transition(
+                name="sneak", process="p",
+                guard=lambda s: s["x"] == 0,
+                effect=lambda s: {"x": 1, "y": 1},  # y undeclared
+                reads=frozenset({"x"}), writes=frozenset({"x"}),
+            ),
+        ),
+    )
+    with pytest.raises(ValueError, match="undeclared variables.*'y'"):
+        check_model(lying)
+
+
+def test_invariant_counterexample_renders_schedule():
+    res = check_model(_counter_model(2, invariants=(
+        Invariant("x-bounded", lambda s: s["x"] < 2, "x reached 2"),
+    )))
+    (v,) = res.violations
+    assert v.kind == "invariant" and v.name == "x-bounded"
+    assert v.schedule[0].startswith("schedule (2 events")
+    assert v.schedule[1:3] == ["1. inc_x", "2. inc_x"]
+    assert "reaches {" in v.schedule[-1]
+
+
+def test_convergence_livelock_renders_lasso():
+    toggle = ProtocolModel(
+        name="toggler", description="", init={"x": 0},
+        transitions=(
+            Transition(
+                name="flip", process="p", guard=lambda s: True,
+                effect=lambda s: {"x": 1 - s["x"]},
+                reads=frozenset({"x"}), writes=frozenset({"x"}),
+            ),
+        ),
+        convergences=(
+            Convergence("settles", trigger=lambda s: True,
+                        goal=lambda s: s["x"] == 2),
+        ),
+    )
+    res = check_model(toggle)
+    (v,) = res.violations
+    assert v.kind == "convergence"
+    assert any("livelock cycle" in line for line in v.schedule)
+
+
+def test_convergence_dead_end_renders():
+    one_shot = ProtocolModel(
+        name="oneshot", description="", init={"x": 0},
+        transitions=(
+            Transition(
+                name="step", process="p", guard=lambda s: s["x"] == 0,
+                effect=lambda s: {"x": 1},
+                reads=frozenset({"x"}), writes=frozenset({"x"}),
+            ),
+        ),
+        convergences=(
+            Convergence("settles", trigger=lambda s: True,
+                        goal=lambda s: s["x"] == 2),
+        ),
+    )
+    res = check_model(one_shot)
+    (v,) = res.violations
+    assert any("dead end at" in line for line in v.schedule)
+
+
+def test_state_budget_reports_unexhausted():
+    res = check_model(_counter_model(10), max_states=5)
+    assert not res.exhausted and not res.ok
+    assert any(v.kind == "budget" for v in res.violations)
+
+
+def test_counterexample_deterministic_across_runs():
+    a = mutants_mod.run_mutant("partial-probe")
+    b = mutants_mod.run_mutant("partial-probe")
+    assert [(v.kind, v.name, v.schedule) for v in a.violations] == [
+        (v.kind, v.name, v.schedule) for v in b.violations
+    ]
+    assert a.states == b.states
+    assert a.transitions_fired == b.transitions_fired
+
+
+# ---- the shipped models hold at HEAD --------------------------------------
+
+
+@pytest.mark.parametrize(
+    "model", build_models(), ids=lambda m: m.name
+)
+def test_shipped_model_exhausts_clean(model):
+    res = check_model(model)
+    assert res.exhausted, f"{model.name} blew its budget"
+    assert res.violations == [], "\n".join(
+        v.render() for v in res.violations
+    )
+
+
+# ---- the mutation harness: every seeded bug caught, by name ---------------
+
+_EXPECTED_CATCH = {
+    "invalidate-keeps-latches": "downgrade-relearned",
+    "invalidate-keeps-wire-cache": "no-marker-without-latch",
+    "partial-probe": "latches-resolved-together",
+    "delta-across-layout-churn": "resident-state-faithful",
+    "defer-restores-to-back": "deferred-gang-leads-next-pop",
+    "fail-keeps-resident-commit": "failure-invalidates-resident",
+    "dispatch-scores-stale-batch": "stale-spec-batch-never-scored",
+    "unfenced-replica-bind": "no-double-bind",
+}
+
+
+def test_every_mutant_has_an_expectation():
+    assert set(_EXPECTED_CATCH) == set(mutants_mod.MUTANTS)
+
+
+@pytest.mark.parametrize("name", list(mutants_mod.MUTANTS))
+def test_mutant_caught_with_rendered_schedule(name):
+    res = mutants_mod.run_mutant(name)
+    assert res.exhausted
+    assert res.violations, f"mutant `{name}` SURVIVED"
+    assert _EXPECTED_CATCH[name] in {v.name for v in res.violations}
+    caught = [v for v in res.violations if v.name == _EXPECTED_CATCH[name]]
+    assert any(
+        line.startswith("schedule (") for v in caught for line in v.schedule
+    ), f"mutant `{name}` caught without a rendered event schedule"
+
+
+# ---- anchors: the drift layer ---------------------------------------------
+
+
+def _index():
+    from kubernetes_scheduler_tpu.analysis.model.runner import _index_for
+
+    return _index_for(None)
+
+
+def test_shipped_anchors_bind():
+    from kubernetes_scheduler_tpu.analysis.model.anchors import (
+        verify_model_anchors,
+    )
+
+    index = _index()
+    for model in build_models():
+        vs = verify_model_anchors(index, model)
+        assert vs == [], "\n".join(v.format() for v in vs)
+
+
+def test_anchor_drift_detected():
+    from kubernetes_scheduler_tpu.analysis.model.anchors import (
+        Anchor,
+        verify_anchor,
+    )
+
+    index = _index()
+    client = "kubernetes_scheduler_tpu/bridge/client.py"
+    # missing def
+    vs = verify_anchor(index, "m", "t", Anchor(client, "RemoteEngine.gone"))
+    assert len(vs) == 1 and "no longer exists" in vs[0].message
+    # present def, vanished fragment
+    vs = verify_anchor(index, "m", "t", Anchor(
+        client, "RemoteEngine._invalidate_session",
+        must_contain=("FRAGMENT_THE_REFACTOR_DROPPED",),
+    ))
+    assert len(vs) == 1 and "no longer contains" in vs[0].message
+    # present def, vanished call edge
+    vs = verify_anchor(index, "m", "t", Anchor(
+        client, "RemoteEngine._invalidate_session",
+        calls=("helper_nobody_calls",),
+    ))
+    assert len(vs) == 1 and "no longer calls" in vs[0].message
+
+
+def test_anchor_drift_fails_the_lint_layer(monkeypatch):
+    """Moving the code out from under a model is a `protocol-model`
+    lint finding, end to end through the runner."""
+    import dataclasses
+
+    from kubernetes_scheduler_tpu.analysis.model import protocols, runner
+    from kubernetes_scheduler_tpu.analysis.model.anchors import Anchor
+
+    def drifted():
+        m = protocols.client_session_model()
+        old = m.transitions[0]
+        bad = dataclasses.replace(
+            old,
+            anchors=(Anchor(
+                "kubernetes_scheduler_tpu/bridge/client.py",
+                "RemoteEngine._probe_capabilities",
+                must_contain=("THE_CODE_MOVED",),
+            ),),
+        )
+        return (protocols.replace_transition(m, old.name, bad),)
+
+    monkeypatch.setattr(runner, "build_models", drifted)
+    vs = runner.check_protocol_layer(budget_seconds=30.0)
+    assert any(
+        v.rule == "protocol-model" and "THE_CODE_MOVED" in v.message
+        for v in vs
+    )
+
+
+# ---- the lint layer & CLI -------------------------------------------------
+
+
+def test_protocol_layer_clean_at_head():
+    from kubernetes_scheduler_tpu.analysis.model.runner import (
+        check_protocol_layer,
+    )
+
+    vs = check_protocol_layer(budget_seconds=60.0)
+    assert vs == [], "\n".join(v.format() for v in vs)
+
+
+def test_model_cli_json_artifact_and_exit_codes(tmp_path, capsys):
+    art = tmp_path / "model.json"
+    rc = model_main(["--json-artifact", str(art), "--format", "json"])
+    capsys.readouterr()
+    assert rc == 0
+    doc = json.loads(art.read_text())
+    assert {m["name"] for m in doc["models"]} == {
+        "client-session", "gang-queue-front", "gang-queue-native",
+        "pipeline-slot", "replica-bind",
+    }
+    assert all(m["exhausted"] and not m["violations"]
+               for m in doc["models"])
+    assert doc["mutants"] and all(
+        d["caught"] for d in doc["mutants"].values()
+    )
+    assert doc["anchor_drift"] == []
+
+
+def test_model_cli_budget_exit_code(capsys):
+    # a 5-state cap cannot exhaust any shipped model: exit 3, and the
+    # un-exhausted proof is reported as a budget violation, not hidden
+    rc = model_main(["--max-states", "5", "--no-mutants"])
+    out = capsys.readouterr().out
+    assert rc == 3
+    assert "NOT EXHAUSTED" in out
+
+
+def test_model_cli_sarif(capsys):
+    from kubernetes_scheduler_tpu.analysis.sarif import validate_sarif
+
+    rc = model_main(["--format", "sarif", "--no-mutants"])
+    doc = json.loads(capsys.readouterr().out)
+    validate_sarif(doc)
+    assert rc == 0

@@ -527,3 +527,88 @@ def test_resident_capability_downgrade_invalidates_together():
     assert sum(1 for m in metrics if m.used_fallback) <= 1
     assert sum(m.pods_bound for m in metrics) == 128
     assert not metrics[-1].used_fallback
+
+
+# every HealthReply capability bit, read off the proto itself — a bit
+# added to the schema joins this parametrization (and so gets the
+# mid-stream-downgrade pin) for free, before anyone remembers to write
+# a bespoke test for it
+def _capability_bits():
+    import os
+
+    from kubernetes_scheduler_tpu.analysis.rules.capability_completeness import (
+        health_bool_fields,
+    )
+
+    proto = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "kubernetes_scheduler_tpu", "bridge", "schedule.proto",
+    )
+    return sorted(health_bool_fields(proto))
+
+
+@pytest.mark.parametrize("fieldname", _capability_bits())
+def test_mid_stream_downgrade_relearns_every_bit(fieldname):
+    """The PR-3 bug class, pinned generically for EVERY capability bit
+    (test_resident/test_gang used to pin it ad hoc per bit): one probe
+    resolves the whole latch set; a mid-stream downgrade (the sidecar
+    behind the target now advertises the opposite) funnels through
+    `_invalidate_session`, which must drop every latch WITH the wire
+    field cache; the next cycles re-learn the new advertisement and
+    keep binding. The protocol itself (all interleavings) is
+    model-checked in analysis/model/protocols.py `client-session`; the
+    per-RPC except-path wiring is the capability-completeness lint
+    family. This is the live-sidecar spot check of both."""
+
+    def body(client, service):
+        from kubernetes_scheduler_tpu.bridge.client import (
+            CAPABILITY_LATCHES,
+        )
+        from kubernetes_scheduler_tpu.bridge.server import (
+            CAPABILITY_SWITCHES,
+        )
+
+        attr = CAPABILITY_LATCHES[fieldname]
+        switch = CAPABILITY_SWITCHES[fieldname]
+        nodes, advisor = gen_host_cluster(24, seed=0)
+        running: list = []
+        sched = make_sched(
+            nodes, advisor, running, resident=True, engine=client,
+        )
+        for pod in gen_host_pods(32, seed=1):
+            sched.submit(pod)
+        metrics = drain(sched, running)
+        before = bool(getattr(service, switch))
+        # one probe resolved the WHOLE set, this bit to the server's
+        # advertisement — a partially-unknown latch set is the bug
+        assert getattr(client, attr) is before
+        assert all(
+            getattr(client, a) is not None
+            for a in CAPABILITY_LATCHES.values()
+        )
+        # the downgrade/upgrade: same target, opposite advertisement;
+        # every RPC failure path reaches _invalidate_session (pinned
+        # per-surface by capability-completeness), which drops every
+        # latch and the wire field cache together
+        setattr(service, switch, not before)
+        client._invalidate_session()
+        assert all(
+            getattr(client, a) is None
+            for a in CAPABILITY_LATCHES.values()
+        )
+        assert len(client._wire_cache) == 0
+        for pod in gen_host_pods(32, seed=2):
+            sched.submit(pod)
+        metrics += drain(sched, running)
+        # the flipped advertisement is re-learned — the bit and the set
+        assert getattr(client, attr) is (not before)
+        assert all(
+            getattr(client, a) is not None
+            for a in CAPABILITY_LATCHES.values()
+        )
+        return metrics
+
+    metrics = _with_sidecar(body)
+    assert sum(m.pods_bound for m in metrics) == 64
+    assert sum(1 for m in metrics if m.used_fallback) <= 1
+    assert not metrics[-1].used_fallback

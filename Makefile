@@ -5,7 +5,7 @@ PY ?= python
 IMAGE_REPO ?= registry.example.com/yoda-tpu
 TAG ?= latest
 
-.PHONY: local test test-fast bench trace-smoke obs-smoke scenario-smoke perf-gate perf-baseline lint lint-sarif model-check native native-asan native-tsan proto clean build push
+.PHONY: local test test-fast bench trace-smoke obs-smoke scenario-smoke perf-gate perf-baseline lint lint-fast lint-sarif collective-baseline model-check native native-asan native-tsan proto clean build push
 
 # "make local" in the reference = fmt + vet + compile. Here: byte-compile
 # the package, build the native library, lint, run the fast tests.
@@ -14,25 +14,56 @@ local: native lint
 	$(PY) -m pytest tests/ -x -q -m "not slow"
 
 # repo-native static analysis (kubernetes_scheduler_tpu/analysis):
-# fifteen AST rule families over the interprocedural dataflow core,
-# plus the engine-contract layer (jax.eval_shape traces of every engine
-# entry point on CPU) and the protocol-model layer (bounded model
-# checking of the session/epoch/capability protocol with anchor-drift
-# detection and the seeded mutation harness — `make model-check` is the
-# standalone loop). Exits non-zero on any unwaived violation; see
-# the README's "Static analysis" section for the inline-waiver syntax.
-# The run drops a findings-JSON artifact for CI diffing and asserts a
-# wall-time budget — the parse-once index must keep full-repo lint
-# (contracts and models included) inside LINT_BUDGET seconds;
-# tests/test_bench_smoke.py holds the sharper relative gate.
-# `--changed-only REF` is the fast pre-commit loop (findings scoped to
-# the changed files' reverse-dependency closure, subset-of-full-run
-# pinned in tests/test_analysis.py).
+# sixteen AST rule families over the interprocedural dataflow core
+# (spmd-collective rides the replication-lattice interpreter in
+# analysis/spmd.py), plus the engine-contract layer (jax.eval_shape
+# traces of every engine entry point on CPU — the mesh-sharded
+# surfaces traced THROUGH shard_map on the virtual 8-device topology,
+# with the sharded==dense spec pin, the COLLECTIVE_BUDGET.json gate,
+# and the seeded SPMD mutant harness) and the protocol-model layer
+# (bounded model checking of the session/epoch/capability protocol
+# with anchor-drift detection and the seeded mutation harness — `make
+# model-check` is the standalone loop). Exits non-zero on any unwaived
+# violation; see the README's "Static analysis" section for the
+# inline-waiver syntax. The run drops a findings-JSON artifact for CI
+# diffing and asserts a wall-time budget — the parse-once index must
+# keep full-repo lint (contracts and models included) inside
+# LINT_BUDGET seconds; tests/test_bench_smoke.py holds the sharper
+# relative gate.
 LINT_BUDGET ?= 120
 LINT_ARTIFACT ?= /tmp/yoda-lint.json
+# the sharded-contract traces need the virtual multi-device topology
+LINT_ENV = env JAX_PLATFORMS=cpu \
+  XLA_FLAGS="--xla_force_host_platform_device_count=8"
 lint:
-	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_scheduler_tpu.analysis \
+	$(LINT_ENV) $(PY) -m kubernetes_scheduler_tpu.analysis \
 	  --budget-seconds $(LINT_BUDGET) --json-artifact $(LINT_ARTIFACT)
+
+# the pre-commit loop: `graftlint --changed-only` against the merge
+# base — findings scoped to the changed files' reverse-dependency
+# closure from the shared call graph, the whole-program layers
+# (contracts incl. the sharded/collective-budget gates, protocol
+# models) tracing only when a file on their declared SURFACE is in the
+# closure. Changed-only findings are a subset of the full run's by
+# construction (pinned in tests/test_analysis.py). Override LINT_BASE
+# to diff against any ref (default: merge-base with origin/main when
+# one exists, else HEAD — uncommitted work is always included).
+LINT_BASE ?= $(shell git merge-base HEAD origin/main 2>/dev/null || echo HEAD)
+lint-fast:
+	$(LINT_ENV) $(PY) -m kubernetes_scheduler_tpu.analysis \
+	  --changed-only $(LINT_BASE)
+
+# regenerate the sharded engine's collective budget from the traced
+# jaxprs after an INTENTIONAL collective-structure change — `make
+# lint` diffs every sharded surface's static psum/pmax/pmin/
+# all_gather/axis_index counts against this checked-in file, so an
+# accidental extra collective in the election scan body fails lint
+# with a diff instead of surfacing as a bench regression.
+collective-baseline:
+	$(LINT_ENV) $(PY) -c "import json; \
+	  from kubernetes_scheduler_tpu.analysis.contracts import write_collective_budget; \
+	  doc = write_collective_budget(); \
+	  print(json.dumps(doc['surfaces'], indent=2))"
 
 # bounded model checking of the session/epoch/capability protocol
 # (kubernetes_scheduler_tpu/analysis/model/): exhausts every shipped
@@ -56,7 +87,7 @@ model-check:
 # the written file.
 LINT_SARIF ?= /tmp/yoda-lint.sarif
 lint-sarif:
-	@rc=0; env JAX_PLATFORMS=cpu $(PY) -m kubernetes_scheduler_tpu.analysis \
+	@rc=0; $(LINT_ENV) $(PY) -m kubernetes_scheduler_tpu.analysis \
 	  --format sarif > $(LINT_SARIF) || rc=$$?; \
 	$(PY) -c "import json; from kubernetes_scheduler_tpu.analysis.sarif import validate_sarif; validate_sarif(json.load(open('$(LINT_SARIF)'))); print('sarif ok: $(LINT_SARIF)')" || exit $$?; \
 	exit $$rc

@@ -6,7 +6,7 @@ lock-guarded shared caches between the driver/bridge/exporter threads,
 a stable wire schema between host and sidecar, a session/epoch/
 capability protocol across it. This package machine-enforces them:
 
-Layer 1 — fifteen AST rule families over the repo's own source. The
+Layer 1 — sixteen AST rule families over the repo's own source. The
 per-file era families (jit-purity, host-sync, lock-discipline,
 wire-schema, dtype-shape, timeout-hygiene, pallas-vmem, metric-hygiene,
 sim-determinism, span-hygiene) plus the families built on the shared
@@ -18,14 +18,21 @@ call graph, branch-path def-use, donation summaries, lockset fixpoint):
   tracer-leak        tracers stored where they outlive the traced call
   lockset-race       guarded attrs need a consistent call-graph lockset
 
-plus capability-completeness: every HealthReply capability bit wired
-end to end (latch/switch tables vs the .proto both ways, table-driven
-probe/invalidate, accessors, per-RPC except-path discipline).
+plus capability-completeness (every HealthReply capability bit wired
+end to end: latch/switch tables vs the .proto both ways, table-driven
+probe/invalidate, accessors, per-RPC except-path discipline) and
+spmd-collective (analysis/spmd.py — a replication-lattice abstract
+interpreter over the mesh-sharded engine's shard_map bodies:
+double-counting psums, unbound axis names, redundant gathers,
+out_specs replication the body never establishes).
 
 Layer 2 — engine contracts (analysis/contracts.py): every engine entry
 point's shape/dtype contract verified by jax.eval_shape tracing on CPU
 across a bucket-shape grid, fused and unfused paths diffed against the
-same declaration.
+same declaration — the mesh-sharded surfaces traced THROUGH shard_map
+on the virtual multi-device topology, with the sharded==dense spec
+pin, the COLLECTIVE_BUDGET.json collective-count gate, and the seeded
+SPMD mutant harness (analysis/spmd_mutants.py).
 
 Layer 3 — protocol models (analysis/model/): the session/epoch/
 capability protocol, the queue's gang-deferral semantics, the

@@ -1,9 +1,12 @@
 """graftlint CLI: `python -m kubernetes_scheduler_tpu.analysis`.
 
 Exits non-zero on any unwaived violation; `make lint` wires this into
-the build. Beyond the fifteen AST families, a full-repo run also
+the build. Beyond the sixteen AST families, a full-repo run also
 traces the engine-contract layer (analysis/contracts.py, jax.eval_shape
-on CPU) unless --no-contracts, and the protocol-model layer
+on CPU — the mesh-sharded surfaces through shard_map on the virtual
+multi-device topology, the COLLECTIVE_BUDGET.json gate, and the
+seeded SPMD mutant harness ride along) unless --no-contracts, and the
+protocol-model layer
 (analysis/model/: bounded model checking of the session/epoch/
 capability protocol, anchor drift, mutation harness) unless
 --no-models; machine output: `--format json|sarif`
@@ -177,9 +180,21 @@ def main(argv=None) -> int:
     if run_contracts:
         from kubernetes_scheduler_tpu.analysis.contracts import (
             check_contracts,
+            check_sharded_contracts,
         )
 
         violations.extend(check_contracts())
+        # the sharded half: eval_shape through shard_map on the virtual
+        # CPU mesh (sharded==dense spec pin, divisibility formula, the
+        # COLLECTIVE_BUDGET.json gate) plus the seeded SPMD mutant
+        # harness — an analyzer that stops catching a bug class is
+        # itself a lint violation, like the protocol-model mutants
+        violations.extend(check_sharded_contracts())
+        from kubernetes_scheduler_tpu.analysis.spmd_mutants import (
+            check_spmd_mutants,
+        )
+
+        violations.extend(check_spmd_mutants())
 
     # layer 3: protocol models (analysis/model/) — bounded model
     # checking of the session/epoch/capability protocol, transition

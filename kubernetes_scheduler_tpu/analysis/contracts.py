@@ -23,13 +23,27 @@ catch a shape formula drifting with n or p, cheap enough for lint):
   parity guarantee's static half);
 - `engine.build_fused_layout` and the `ops/pallas_fused` wrappers
   (`fused_masked_score`, `fused_score_row_stats`, `fused_auction_bid`)
-  — the kernel-layout padding formulas.
+  — the kernel-layout padding formulas;
+- the MESH-SHARDED engine surfaces (`parallel/engine.py`'s
+  `make_sharded_schedule_fn` greedy/auction and
+  `make_sharded_windows_fn` greedy/auction), traced THROUGH shard_map
+  on a virtual multi-device CPU mesh: the sharded output spec must
+  equal the dense spec it replaces LEAF FOR LEAF (sharded/dense drift
+  fails lint exactly like fused/dense drift does), the declared
+  node-axis divisibility formula (n % mesh.size == 0) must predict
+  trace success AND failure, and the static collective count of each
+  traced program (psum/pmax/pmin/all_gather/axis_index, walked out of
+  the jaxpr) must match the checked-in COLLECTIVE_BUDGET.json — an
+  accidental extra collective in the election scan body fails lint
+  with a diff (pseudo-rule `collective-budget`) instead of surfacing
+  as a bench regression three rounds later. Regenerate the budget
+  after an intentional change with `make collective-baseline`.
 
-Violations surface as pseudo-rule `engine-contract` findings through
-the same CLI/baseline machinery as layer 1. Fixture modules (the
-violating/clean drift pair in tests/analysis_fixtures/) declare the
-same thing in miniature via a CONTRACTS table checked by
-`check_fixture_module`.
+Violations surface as pseudo-rule `engine-contract` (and
+`collective-budget`) findings through the same CLI/baseline machinery
+as layer 1. Fixture modules (the violating/clean drift pair in
+tests/analysis_fixtures/) declare the same thing in miniature via a
+CONTRACTS table checked by `check_fixture_module`.
 """
 
 from __future__ import annotations
@@ -48,13 +62,18 @@ GRID = (
 
 ENGINE_PATH = "kubernetes_scheduler_tpu/engine.py"
 FUSED_PATH = "kubernetes_scheduler_tpu/ops/pallas_fused.py"
+PARALLEL_PATH = "kubernetes_scheduler_tpu/parallel/engine.py"
 
 # the files whose edits can move a declared contract — a changed-only
-# lint run traces the layer only when its closure touches these
+# lint run traces the layer only when its closure touches these (the
+# sharded surfaces and the SPMD mutant harness included)
 SURFACE = (
     ENGINE_PATH,
     "kubernetes_scheduler_tpu/ops/*.py",
+    "kubernetes_scheduler_tpu/parallel/*.py",
     "kubernetes_scheduler_tpu/analysis/contracts.py",
+    "kubernetes_scheduler_tpu/analysis/spmd.py",
+    "kubernetes_scheduler_tpu/analysis/spmd_mutants.py",
 )
 
 
@@ -265,6 +284,343 @@ CONTRACT_NAMES = (
     "build_fused_layout", "fused_masked_score", "fused_score_row_stats",
     "fused_auction_bid",
 )
+
+
+# ---- sharded engine contracts + the collective budget ---------------------
+
+BUDGET_RULE = "collective-budget"
+COLLECTIVE_BUDGET_NAME = "COLLECTIVE_BUDGET.json"
+# the collective kinds budgeted per surface, in report order
+COLLECTIVE_KINDS = ("psum", "pmax", "pmin", "all_gather", "axis_index")
+
+# the sharded entry points the acceptance criteria pin
+SHARDED_CONTRACT_NAMES = (
+    "sharded_schedule(greedy)", "sharded_schedule(auction)",
+    "sharded_windows(greedy)", "sharded_windows(auction)",
+)
+
+
+def node_axis_divisor(mesh) -> int:
+    """The declared node-axis divisibility formula: every sharded
+    surface requires n % (product of the mesh's node axes) == 0 — the
+    host pads the node bucket to it. Checked below by predicting both
+    trace success AND failure."""
+    return int(mesh.size)
+
+
+def _virtual_mesh():
+    """1-D mesh over every visible device. Lint runs force the CPU
+    platform with a virtual 8-device topology (conftest / the Makefile
+    lint targets); with fewer devices the layer still traces — the
+    collective counts are device-count-independent static facts — and
+    only the divisibility-failure prediction is skipped (D == 1 divides
+    everything)."""
+    from kubernetes_scheduler_tpu.parallel.mesh import make_mesh
+
+    return make_mesh()
+
+
+def sharded_surfaces(mesh) -> dict:
+    """name -> built sharded schedule fn for every declared surface —
+    the SAME factories the host dispatches through, at their build-time
+    default knobs, so the budget tracks the production programs."""
+    from kubernetes_scheduler_tpu.parallel.engine import (
+        make_sharded_schedule_fn,
+        make_sharded_windows_fn,
+    )
+
+    return {
+        "sharded_schedule(greedy)": make_sharded_schedule_fn(
+            mesh, assigner="greedy"
+        ),
+        "sharded_schedule(auction)": make_sharded_schedule_fn(
+            mesh, assigner="auction"
+        ),
+        "sharded_windows(greedy)": make_sharded_windows_fn(
+            mesh, assigner="greedy"
+        ),
+        "sharded_windows(auction)": make_sharded_windows_fn(
+            mesh, assigner="auction"
+        ),
+    }
+
+
+def collective_counts(fn, *args) -> dict:
+    """Static per-kind collective counts of `fn`'s traced jaxpr —
+    sub-jaxprs (shard_map bodies, scans, while loops, pjit calls)
+    walked recursively. Counts are trace-time facts: a scan's body
+    traces once however many steps run, so the budget pins the
+    PER-ROUND collective structure, not a runtime tally."""
+    import jax
+
+    jaxpr = jax.make_jaxpr(fn)(*args)
+    counts = dict.fromkeys(COLLECTIVE_KINDS, 0)
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            name = eqn.primitive.name
+            if name in counts:
+                counts[name] += 1
+            for v in eqn.params.values():
+                vals = v if isinstance(v, (list, tuple)) else [v]
+                for vv in vals:
+                    if hasattr(vv, "eqns"):
+                        walk(vv)
+                    elif hasattr(vv, "jaxpr") and hasattr(
+                        vv.jaxpr, "eqns"
+                    ):
+                        walk(vv.jaxpr)
+
+    walk(jaxpr.jaxpr)
+    return counts
+
+
+def _sharded_inputs(g):
+    """Spec pytrees for one grid point, windows variant included."""
+    import jax
+
+    snap_c, pods_c, _ = _make_inputs(g)
+    snap, pods = _spec_tree(snap_c), _spec_tree(pods_c)
+    pods_w = jax.tree_util.tree_map(
+        lambda spec: jax.ShapeDtypeStruct(
+            (g["w"],) + tuple(spec.shape), spec.dtype
+        ),
+        pods,
+    )
+    return snap, pods, pods_w
+
+
+def traced_surface_counts(mesh=None) -> dict:
+    """name -> collective counts for every declared sharded surface
+    (what `make collective-baseline` writes and the gate re-traces)."""
+    mesh = mesh or _virtual_mesh()
+    g = GRID[0]
+    snap, pods, pods_w = _sharded_inputs(g)
+    out = {}
+    for name, fn in sharded_surfaces(mesh).items():
+        args = (snap, pods_w) if "windows" in name else (snap, pods)
+        out[name] = collective_counts(fn, *args)
+    return out
+
+
+def write_collective_budget(path: str | None = None) -> dict:
+    """Regenerate COLLECTIVE_BUDGET.json from the traced jaxprs (the
+    `make collective-baseline` entry point). Returns the document."""
+    import json
+
+    mesh = _virtual_mesh()
+    doc = {
+        "comment": (
+            "Static collective counts of every declared sharded engine "
+            "surface, walked out of the traced jaxpr. `make lint` "
+            "re-traces and diffs; regenerate with `make "
+            "collective-baseline` after an INTENTIONAL collective-"
+            "structure change."
+        ),
+        "mesh_devices": int(mesh.size),
+        "surfaces": traced_surface_counts(mesh),
+    }
+    path = path or os.path.join(_repo_root(), COLLECTIVE_BUDGET_NAME)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return doc
+
+
+def _repo_root() -> str:
+    from kubernetes_scheduler_tpu.analysis.core import _REPO_ROOT
+
+    return _REPO_ROOT
+
+
+def check_collective_budget(
+    budget_path: str | None = None,
+    traced: dict | None = None,
+    failed: set | None = None,
+) -> list[Violation]:
+    """Diff the traced per-surface collective counts against the
+    checked-in budget. Every failure mode is loud: a missing or
+    unparseable budget file, a traced surface the file does not budget,
+    a stale budgeted surface nothing traces anymore, and any per-kind
+    count drift (the extra-collective class) each produce a finding.
+    `failed` names surfaces whose TRACE failed this run: their budget
+    entries are exempt from the staleness check — the trace failure is
+    already its own finding, and advising `make collective-baseline`
+    there would point the maintainer at dropping the pin instead of at
+    the broken trace."""
+    import json
+
+    path = budget_path or os.path.join(
+        _repo_root(), COLLECTIVE_BUDGET_NAME
+    )
+    rel = os.path.basename(path)
+    if traced is None:
+        try:
+            traced = traced_surface_counts()
+        except Exception as e:  # noqa: BLE001 — the trace failing IS the finding
+            return [Violation(
+                BUDGET_RULE, PARALLEL_PATH, 1,
+                f"tracing the sharded surfaces for the collective "
+                f"budget failed: {e}",
+            )]
+    if not os.path.exists(path):
+        return [Violation(
+            BUDGET_RULE, rel, 1,
+            f"{rel} is missing — the sharded engine's collective "
+            "budget is unpinned; run `make collective-baseline`",
+        )]
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        budget = doc["surfaces"]
+        if not isinstance(budget, dict) or not all(
+            isinstance(v, dict) for v in budget.values()
+        ):
+            raise TypeError("'surfaces' must map names to count dicts")
+    except Exception as e:  # noqa: BLE001
+        return [Violation(
+            BUDGET_RULE, rel, 1,
+            f"{rel} does not parse as {{'surfaces': {{...}}}}: {e} — "
+            "regenerate with `make collective-baseline`",
+        )]
+    out: list[Violation] = []
+    for name, counts in sorted(traced.items()):
+        want = budget.get(name)
+        if want is None:
+            out.append(Violation(
+                BUDGET_RULE, rel, 1,
+                f"sharded surface `{name}` has no budget entry — new "
+                "surfaces must be consciously budgeted; run `make "
+                "collective-baseline`",
+            ))
+            continue
+        diffs = [
+            f"{kind}: traced {counts.get(kind, 0)} != budgeted "
+            f"{want.get(kind, 0)}"
+            for kind in COLLECTIVE_KINDS
+            if counts.get(kind, 0) != want.get(kind, 0)
+        ]
+        if diffs:
+            out.append(Violation(
+                BUDGET_RULE, PARALLEL_PATH, 1,
+                f"`{name}` collective budget drift ({'; '.join(diffs)}) "
+                "— an unbudgeted collective is a per-round latency tax "
+                "on the election scan; fix the program, or regenerate "
+                "with `make collective-baseline` if the change is "
+                "intentional",
+            ))
+    for name in sorted(set(budget) - set(traced) - (failed or set())):
+        out.append(Violation(
+            BUDGET_RULE, rel, 1,
+            f"budget entry `{name}` matches no declared sharded "
+            "surface — stale; run `make collective-baseline`",
+        ))
+    return out
+
+
+def check_sharded_contracts() -> list[Violation]:
+    """Trace every sharded surface through shard_map on the virtual
+    CPU mesh and pin (a) sharded output spec == dense output spec leaf
+    for leaf, (b) the node-axis divisibility formula predicting both
+    trace success and failure, (c) the collective budget. Returns []
+    when the mesh-sharded engine honors its contracts."""
+    import jax
+
+    from kubernetes_scheduler_tpu import engine
+
+    out: list[Violation] = []
+    try:
+        mesh = _virtual_mesh()
+    except Exception as e:  # noqa: BLE001
+        return [Violation(
+            RULE, PARALLEL_PATH, 1,
+            f"virtual mesh construction failed: {e}",
+        )]
+    divisor = node_axis_divisor(mesh)
+    try:
+        surfaces = sharded_surfaces(mesh)
+    except Exception as e:  # noqa: BLE001
+        return [Violation(
+            RULE, PARALLEL_PATH, 1,
+            f"building the sharded surfaces failed: {e}",
+        )]
+    for g in GRID:
+        if g["n"] % divisor:
+            out.append(Violation(
+                RULE, PARALLEL_PATH, 1,
+                f"grid point n={g['n']} violates the declared "
+                f"divisibility formula n % {divisor} == 0 — the "
+                "sharded layer cannot be checked at it",
+            ))
+            continue
+        snap, pods, pods_w = _sharded_inputs(g)
+        tag = f"[n={g['n']} p={g['p']} D={divisor}]"
+        dense = {
+            "batch": jax.eval_shape(engine.schedule_batch, snap, pods),
+            "windows": jax.eval_shape(
+                engine.schedule_windows, snap, pods_w
+            ),
+        }
+        fields = {
+            "batch": engine.ScheduleResult._fields,
+            "windows": engine.WindowsResult._fields,
+        }
+        for name, fn in surfaces.items():
+            kind = "windows" if "windows" in name else "batch"
+            args = (snap, pods_w) if kind == "windows" else (snap, pods)
+            try:
+                got = jax.eval_shape(fn, *args)
+            except Exception as e:  # noqa: BLE001
+                out.append(Violation(
+                    RULE, PARALLEL_PATH, 1,
+                    f"{name} {tag}: eval_shape through shard_map "
+                    f"failed: {e}",
+                ))
+                continue
+            for msg in _leaf_mismatches(
+                name, got, dense[kind], fields[kind]
+            ):
+                out.append(Violation(
+                    RULE, PARALLEL_PATH, 1,
+                    f"{tag} sharded/dense drift: {msg.replace('declared', 'dense')}",
+                ))
+    # the divisibility formula must also predict FAILURE: a node count
+    # the formula rejects must actually fail to trace (D == 1 divides
+    # everything — nothing to predict)
+    if divisor > 1:
+        g = dict(GRID[0])
+        g["n"] = divisor + 1  # never divisible by D > 1
+        snap, pods, _ = _sharded_inputs(g)
+        fn = surfaces["sharded_schedule(greedy)"]
+        try:
+            jax.eval_shape(fn, snap, pods)
+        except Exception:  # noqa: BLE001 — expected: the formula holds
+            pass
+        else:
+            out.append(Violation(
+                RULE, PARALLEL_PATH, 1,
+                f"n={g['n']} traces despite violating the declared "
+                f"divisibility formula n % {divisor} == 0 — the "
+                "formula drifted from shard_map's actual constraint",
+            ))
+    # the budget gate reuses the surfaces already built above (the
+    # jaxpr walk is the only extra trace)
+    g0 = GRID[0]
+    snap, pods, pods_w = _sharded_inputs(g0)
+    traced: dict = {}
+    failed: set = set()
+    for name, fn in surfaces.items():
+        args = (snap, pods_w) if "windows" in name else (snap, pods)
+        try:
+            traced[name] = collective_counts(fn, *args)
+        except Exception as e:  # noqa: BLE001
+            failed.add(name)
+            out.append(Violation(
+                BUDGET_RULE, PARALLEL_PATH, 1,
+                f"tracing `{name}` for the collective budget failed: {e}",
+            ))
+    out.extend(check_collective_budget(traced=traced, failed=failed))
+    return out
 
 
 def check_fixture_module(path: str) -> list[Violation]:

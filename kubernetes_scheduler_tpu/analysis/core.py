@@ -353,6 +353,9 @@ def changed_vs_ref(root: str, ref: str) -> set[str]:
                 "kubernetes_scheduler_tpu/bridge/server.py",
                 "kubernetes_scheduler_tpu/bridge/codec.py",
             ))
+        elif p.endswith("COLLECTIVE_BUDGET.json"):
+            # a budget edit must re-trace the sharded surfaces it pins
+            changed.add("kubernetes_scheduler_tpu/parallel/engine.py")
         elif p.endswith(".py") and p.startswith("kubernetes_scheduler_tpu/"):
             changed.add(p)
     return changed

@@ -6,7 +6,7 @@ run, cached on the Context:
 
 - a parse-once, WALK-once module index: every file's AST node list,
   function/class/import tables, and dotted-module resolution, so the
-  fifteen rule families share one traversal instead of re-walking the
+  sixteen rule families share one traversal instead of re-walking the
   tree per family (the wall-time budget `make lint` asserts rides on
   this);
 - a project call graph with call-site attribution, resolved through

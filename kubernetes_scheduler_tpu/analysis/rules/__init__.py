@@ -1,15 +1,18 @@
 """Rule registry: name -> check(ctx) -> list[Violation].
 
-Fifteen families. The first ten are the per-file era; donation-
+Sixteen families. The first ten are the per-file era; donation-
 aliasing, host-transfer, tracer-leak, and lockset-race ride the
 interprocedural dataflow core (analysis/dataflow.py) — call-graph,
 def-use, and lockset analyses a single-file AST scan cannot express —
-and capability-completeness pins the bridge's HealthReply capability
+capability-completeness pins the bridge's HealthReply capability
 wiring (latch/switch tables, probe/invalidate discipline, RPC
 except-paths) against the .proto, the static twin of the
-analysis/model/ protocol checker. The README's Static analysis table
-must name exactly this registry (checked both ways by the `docs-drift`
-runner check).
+analysis/model/ protocol checker, and spmd-collective runs the
+replication-lattice abstract interpreter (analysis/spmd.py) over the
+mesh-sharded engine's shard_map bodies — double-counting psums,
+unbound axis names, redundant gathers, out_specs replication drift.
+The README's Static analysis table must name exactly this registry
+(checked both ways by the `docs-drift` runner check).
 """
 
 from kubernetes_scheduler_tpu.analysis.rules import (
@@ -25,6 +28,7 @@ from kubernetes_scheduler_tpu.analysis.rules import (
     pallas_vmem,
     sim_determinism,
     span_hygiene,
+    spmd_collective,
     timeout_hygiene,
     tracer_leak,
     wire_schema,
@@ -46,4 +50,5 @@ RULES = {
     tracer_leak.RULE: tracer_leak.check,
     lockset_race.RULE: lockset_race.check,
     capability_completeness.RULE: capability_completeness.check,
+    spmd_collective.RULE: spmd_collective.check,
 }

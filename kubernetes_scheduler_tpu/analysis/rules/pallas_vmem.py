@@ -96,7 +96,13 @@ def _module_consts(tree: ast.AST) -> dict[str, int]:
 def _fn_bindings(fn: ast.AST, consts: dict[str, int]) -> dict[str, int]:
     """Parameter defaults + simple local int assigns, resolved against
     the module constants (`tile_p: int = TILE_P` resolves through
-    TILE_P = 256)."""
+    TILE_P = 256) — arithmetic assigns included, so the per-shard
+    `n_local = N_NODES // MESH_DEVICES` split a shard_map'd kernel
+    tiles over resolves to the per-shard dimension. A name assigned
+    more than once, or a local assign shadowing a parameter/module
+    constant (`n_loc = n_loc // 2`), is UNRESOLVABLE — skipped, not
+    guessed: a single flow-insensitive value would check some
+    BlockSpec in the function against the wrong dimension."""
     out = dict(consts)
     args = fn.args
     named = args.posonlyargs + args.args + args.kwonlyargs
@@ -108,30 +114,52 @@ def _fn_bindings(fn: ast.AST, consts: dict[str, int]) -> dict[str, int]:
             out[a.arg] = d.value
         elif isinstance(d, ast.Name) and d.id in consts:
             out[a.arg] = consts[d.id]
+    assigns: dict[str, list] = {}
     for node in ast.walk(fn):
         if isinstance(node, ast.Assign) and len(node.targets) == 1:
             t = node.targets[0]
-            if (
-                isinstance(t, ast.Name)
-                and isinstance(node.value, ast.Constant)
-                and isinstance(node.value.value, int)
-            ):
-                out[t.id] = node.value.value
+            if isinstance(t, ast.Name):
+                assigns.setdefault(t.id, []).append(node.value)
+    poisoned = {
+        name
+        for name, values in assigns.items()
+        if len(values) > 1 or name in out
+    }
+    for name in poisoned:
+        out.pop(name, None)
+    # fixpoint: single-assigned fresh names may reference each other
+    # in any ast.walk order
+    changed = True
+    while changed:
+        changed = False
+        for name, values in assigns.items():
+            if name in poisoned or name in out:
+                continue
+            v = _resolve_expr(values[0], out)
+            if v is not None:
+                out[name] = v
+                changed = True
     return out
 
 
 def _resolve_expr(node: ast.AST, env: dict[str, int]) -> int | None:
     """Resolve a dimension expression to an int where the AST proves it:
-    constants, bound names, and +/-/* arithmetic over resolvable
+    constants, bound names, and +/-/*/'//' arithmetic over resolvable
     operands — the `4 * n_sel`-style stacked-row shapes the fused
-    megakernel's BlockSpecs use (a runtime operand anywhere makes the
-    whole dimension unresolvable, skipped not guessed)."""
+    megakernel's BlockSpecs use, and the `n // MESH_DEVICES` per-shard
+    node-axis split a kernel invoked under shard_map tiles over (the
+    node axis is divided by the mesh size BEFORE tiling, so the lane
+    check must see the per-shard dimension, not the global one). A
+    runtime operand anywhere makes the whole dimension unresolvable,
+    skipped not guessed; a floor division that does not divide evenly
+    is likewise skipped — the true per-shard dim is not what the
+    expression computes, and shard_map would reject the layout first."""
     if isinstance(node, ast.Constant) and isinstance(node.value, int):
         return node.value
     if isinstance(node, ast.Name):
         return env.get(node.id)
     if isinstance(node, ast.BinOp) and isinstance(
-        node.op, (ast.Add, ast.Sub, ast.Mult)
+        node.op, (ast.Add, ast.Sub, ast.Mult, ast.FloorDiv)
     ):
         left = _resolve_expr(node.left, env)
         right = _resolve_expr(node.right, env)
@@ -141,6 +169,10 @@ def _resolve_expr(node: ast.AST, env: dict[str, int]) -> int | None:
             return left + right
         if isinstance(node.op, ast.Sub):
             return left - right
+        if isinstance(node.op, ast.FloorDiv):
+            if right == 0 or left % right:
+                return None
+            return left // right
         return left * right
     return None
 

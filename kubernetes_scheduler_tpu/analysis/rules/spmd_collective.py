@@ -1,0 +1,23 @@
+"""spmd-collective: SPMD replication/collective discipline inside
+shard_map bodies — psum double-counts, unbound axis names, redundant
+gathers of replicated values, and out_specs declaring replication the
+body never establishes.
+
+Thin registry shim: the replication-lattice abstract interpreter that
+powers the family lives in analysis/spmd.py (it rides the shared
+parse-once ModuleIndex the way the donation/lockset families ride the
+dataflow core). Scope is the shard_map surface — parallel/ — plus, in
+fixture mode, whatever files the caller passed."""
+
+from __future__ import annotations
+
+from kubernetes_scheduler_tpu.analysis import spmd
+from kubernetes_scheduler_tpu.analysis.core import Context, Violation
+
+RULE = spmd.RULE
+
+SCOPE = ("kubernetes_scheduler_tpu/parallel/*.py",)
+
+
+def check(ctx: Context) -> list[Violation]:
+    return spmd.check_files(ctx, ctx.scoped(SCOPE))

@@ -1,0 +1,1171 @@
+"""SPMD replication/collective analysis over shard_map bodies.
+
+The one engine surface the AST families could not see into is
+parallel/engine.py: 800+ lines of shard_map bodies whose correctness
+rests on REPLICATION facts — which values are identical on every shard
+(replicated), which hold one shard of a global array (sharded), and
+which are genuinely device-varying (an axis_index offset, a
+pcast-varying carry). SPMD bugs here are silent in exactly the way this
+repo's lint exists to prevent: a `psum` of an already-replicated value
+double-counts by the axis size, a collective on an axis name the mesh
+never bound deadlocks or miscounts, and `out_specs` declaring
+replication the body never establishes ships one shard's garbage as
+the global answer.
+
+This module is an abstract interpreter on the PR-9 dataflow core: it
+finds `shard_map(body, mesh=..., in_specs=..., out_specs=...)` regions,
+seeds each body parameter's replication state from its `in_specs` leaf
+(`P()` -> replicated, any sharding axis -> sharded), and propagates a
+four-point lattice
+
+    replicated < sharded < varying < unknown
+
+through the body flow-sensitively: assignments strong-update, `if`
+arms analyze separately and join, `lax.scan`/`while_loop`/`fori_loop`
+bodies run to a carry fixpoint, and project-local helper calls are
+analyzed interprocedurally (memoized per argument-state tuple, depth-
+and cycle-guarded to `unknown`). Collectives are the lattice's
+transfer-function anchors: `psum`/`pmax`/`pmin`/`all_gather` over the
+mesh axes produce REPLICATED values regardless of operand (every shard
+computes the same reduction), `axis_index`/`pcast(..., to="varying")`
+produce VARYING ones, and everything else — jnp math, project helpers,
+NamedTuple constructors — is a pure function of its operands, so its
+state is the JOIN of theirs (deterministic SPMD execution: identical
+inputs on every device produce identical outputs; this is also why a
+pmax over provably-equal values is the identity, the sanctioned
+re-replication discharge at parallel/engine.py `_sharded_greedy`).
+
+Checks (rule family `spmd-collective`):
+
+- unbound-axis: a collective whose axis-name operand resolves to a
+  string (or tuple of strings) not declared by any mesh in the linted
+  file set (`*_AXIS` module constants, `Mesh(..., (names,))` tuples) —
+  the wrong-axis class that deadlocks or miscounts on hardware;
+- replicated-psum: `psum` applied to a provably-replicated operand —
+  the double-count class. `psum(1, axes)`/`psum(literal, axes)` is the
+  sanctioned device-count idiom and exempt;
+- replicated-gather: `all_gather` of a provably-replicated operand —
+  D identical copies for one collective launch, always a latency bug;
+- gather-axis-misuse: `all_gather(..., axis=<axis name>)` — `axis` is
+  the INSERTION POSITION (an int); the mesh axis name is the second
+  positional (`axis_name`). Statically a string there is always wrong;
+- out-spec-replication: a body return leaf whose `out_specs` leaf is
+  `P()` (replicated) but whose abstract state is provably sharded or
+  varying — the body never established the replication it declares.
+  The discharge pattern is the engine's pmax-over-equal idiom:
+  `x = jax.lax.pmax(x, axes)` is the identity on equal values and
+  makes replication provable (to this analysis AND jax's vma checker).
+
+Everything unresolvable degrades to `unknown`, which can never fire a
+finding — the rule reports what the AST proves, like pallas-vmem. The
+traced half of the story (sharded contracts, collective budgets
+counted from real jaxprs) lives in analysis/contracts.py; the seeded
+mutant harness proving both halves catch their classes lives in
+analysis/spmd_mutants.py.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kubernetes_scheduler_tpu.analysis import dataflow
+from kubernetes_scheduler_tpu.analysis.core import (
+    Context,
+    SourceFile,
+    Violation,
+    dotted_name,
+)
+
+RULE = "spmd-collective"
+
+# ---- the lattice ----------------------------------------------------------
+
+REP = "replicated"
+SHD = "sharded"
+VAR = "varying"
+UNK = "unknown"
+
+_RANK = {REP: 0, SHD: 1, VAR: 2, UNK: 3}
+
+# state encodings: a bare rank string, ("T", s0, s1, ...) for tuples,
+# ("F", (("field", s), ...)) for keyword-constructed records, and
+# ("FN", name, id(def node)) for local function values (scan bodies)
+
+
+def is_scalar(s) -> bool:
+    return isinstance(s, str)
+
+
+def collapse(s) -> str:
+    """Fold a structured state to one lattice point (join of leaves)."""
+    if is_scalar(s):
+        return s
+    if s[0] == "FN":
+        return REP  # a Python function object is host data
+    if s[0] == "T":
+        parts = s[1:]
+    else:  # "F"
+        parts = tuple(v for _, v in s[1])
+    if not parts:
+        return REP
+    return max((collapse(p) for p in parts), key=lambda x: _RANK[x])
+
+
+def join(a, b):
+    if a == b:
+        return a
+    if is_scalar(a) or is_scalar(b):
+        sa, sb = collapse(a), collapse(b)
+        return sa if _RANK[sa] >= _RANK[sb] else sb
+    if a[0] == "T" and b[0] == "T" and len(a) == len(b):
+        return ("T",) + tuple(join(x, y) for x, y in zip(a[1:], b[1:]))
+    if a[0] == "F" and b[0] == "F":
+        da, db = dict(a[1]), dict(b[1])
+        if set(da) == set(db):
+            return (
+                "F",
+                tuple(sorted((k, join(da[k], db[k])) for k in da)),
+            )
+    return join(collapse(a), collapse(b))
+
+
+def join_all(states):
+    states = list(states)
+    if not states:
+        return REP
+    out = states[0]
+    for s in states[1:]:
+        out = join(out, s)  # a scalar seed would collapse structure
+    return out
+
+
+# ---- collective / varying-source tables -----------------------------------
+
+# final-segment names treated as mesh collectives; value = index of the
+# axis-name positional
+COLLECTIVES = {
+    "psum": 1,
+    "pmax": 1,
+    "pmin": 1,
+    "all_gather": 1,
+    "all_to_all": 1,
+    "pbroadcast": 1,
+    "psum_scatter": 1,
+    "axis_index": 0,
+}
+# collectives whose RESULT is replicated over the reduced axes (every
+# shard computes the identical value). psum_scatter is NOT here: it
+# hands each shard a DIFFERENT chunk of the reduced array — sharded.
+_REPLICATING = {
+    "psum", "pmax", "pmin", "all_gather", "pbroadcast",
+}
+# axis_size is NOT here: its result is the same integer on every shard
+_VARYING_SOURCES = {"axis_index", "pcast", "_pcast_varying"}
+# shape-only constructors: the VALUE is fresh replicated data even when
+# the shape donor is sharded
+_SHAPE_ONLY = {"zeros_like", "ones_like", "empty_like"}
+
+_MAX_DEPTH = 7
+
+
+def _tail(name: str | None) -> str | None:
+    return name.rsplit(".", 1)[-1] if name else None
+
+
+# ---- declared mesh axis names ---------------------------------------------
+
+
+def declared_axis_names(
+    files: list[SourceFile], index: dataflow.ModuleIndex
+) -> set[str]:
+    """Every axis name the linted file set declares: module-level
+    `*_AXIS = "name"` string constants plus string literals inside the
+    axis tuple of a `Mesh(devices, (names...))` construction. Rides
+    the index's parse-once node lists — no re-walk."""
+    out: set[str] = set()
+    for sf in files:
+        for node in index.walk(sf):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                if (
+                    isinstance(t, ast.Name)
+                    and t.id.endswith("_AXIS")
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)
+                ):
+                    out.add(node.value.value)
+            elif isinstance(node, ast.Call) and (
+                _tail(dotted_name(node.func)) == "Mesh"
+            ):
+                for arg in list(node.args[1:2]) + [
+                    kw.value for kw in node.keywords
+                    if kw.arg == "axis_names"
+                ]:
+                    if isinstance(arg, (ast.Tuple, ast.List)):
+                        for el in arg.elts:
+                            if isinstance(el, ast.Constant) and isinstance(
+                                el.value, str
+                            ):
+                                out.add(el.value)
+    return out
+
+
+def _module_str_consts(sf: SourceFile) -> dict[str, str]:
+    out: dict[str, str] = {}
+    for node in sf.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if (
+                isinstance(t, ast.Name)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)
+            ):
+                out[t.id] = node.value.value
+    return out
+
+
+def resolve_axis_operand(expr: ast.AST, consts: dict[str, str]):
+    """The axis names a collective's axis operand denotes, as a list of
+    strings — or None when unresolvable (a runtime `axes` parameter)."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return [expr.value]
+    if isinstance(expr, ast.Name) and expr.id in consts:
+        return [consts[expr.id]]
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        names = []
+        for el in expr.elts:
+            got = resolve_axis_operand(el, consts)
+            if got is None:
+                return None
+            names.extend(got)
+        return names
+    return None
+
+
+# ---- spec resolution (in_specs / out_specs -> spec-state trees) -----------
+
+
+def _spec_of_p_call(call: ast.Call) -> str:
+    """P() -> replicated spec; P(...) with any non-None axis -> sharded."""
+    parts = list(call.args) + [kw.value for kw in call.keywords]
+    for a in parts:
+        if isinstance(a, ast.Starred):
+            return SHD
+        if not (isinstance(a, ast.Constant) and a.value is None):
+            return SHD
+    return REP
+
+
+class SpecResolver:
+    """Syntactic resolver for PartitionSpec expressions: direct `P(...)`
+    calls, names bound in the enclosing function or module, tuple
+    unpacking through a local helper call (`axes, node, rep, ... =
+    _mesh_specs(...)`), and NamedTuple-style constructors — keyword
+    fields and the `Cls(**{f: spec for f in Cls._fields})` uniform-tree
+    idiom the engine's `_mesh_specs` uses."""
+
+    def __init__(self, index: dataflow.ModuleIndex, sf: SourceFile):
+        self.index = index
+        self.sf = sf
+
+    def resolve(self, expr: ast.AST, scope: ast.AST | None, depth: int = 0):
+        # the engine's spec indirection (in_specs tuple -> name ->
+        # unpack -> _mesh_specs return -> ctor -> dict-comp -> P())
+        # is eight hops deep; the bound only guards pathological cycles
+        if depth > 16 or expr is None:
+            return UNK
+        if isinstance(expr, ast.Call):
+            fname = _tail(dotted_name(expr.func))
+            if fname in ("P", "PartitionSpec"):
+                return _spec_of_p_call(expr)
+            # Cls(**{f: spec for f in Cls._fields}) -> uniform tree
+            if len(expr.keywords) == 1 and expr.keywords[0].arg is None:
+                v = expr.keywords[0].value
+                if isinstance(v, ast.DictComp):
+                    return self.resolve(v.value, scope, depth + 1)
+            if expr.keywords and not expr.args:
+                fields = []
+                for kw in expr.keywords:
+                    if kw.arg is None:
+                        return UNK
+                    fields.append(
+                        (kw.arg, self.resolve(kw.value, scope, depth + 1))
+                    )
+                return ("F", tuple(sorted(fields)))
+            # a call into a local helper returning a literal tuple
+            ret = self._local_return(expr)
+            if ret is not None:
+                fn, retexpr = ret
+                return self.resolve(retexpr, fn, depth + 1)
+            return UNK
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return ("T",) + tuple(
+                self.resolve(el, scope, depth + 1) for el in expr.elts
+            )
+        if isinstance(expr, ast.Name):
+            return self._resolve_name(expr.id, scope, depth)
+        return UNK
+
+    def _local_return(self, call: ast.Call):
+        fname = _tail(dotted_name(call.func))
+        if not fname:
+            return None
+        cands = [
+            fi for fi in self.index.by_name.get(fname, ())
+            if fi.sf is self.sf and fi.cls is None
+        ]
+        if len(cands) != 1:
+            return None
+        rets = [
+            n for n in dataflow.shallow_walk(cands[0].node)
+            if isinstance(n, ast.Return) and n.value is not None
+        ]
+        if len(rets) != 1:
+            return None
+        return cands[0].node, rets[0].value
+
+    def _resolve_name(self, name: str, scope: ast.AST | None, depth: int):
+        scopes = [s for s in (scope, self.sf.tree) if s is not None]
+        for sc in scopes:
+            walker = (
+                dataflow.shallow_walk(sc)
+                if not isinstance(sc, ast.Module)
+                else ast.walk(sc)
+            )
+            for node in walker:
+                if not isinstance(node, ast.Assign):
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == name:
+                        return self.resolve(node.value, scope, depth + 1)
+                    if isinstance(t, ast.Tuple):
+                        for i, el in enumerate(t.elts):
+                            if isinstance(el, ast.Name) and el.id == name:
+                                v = self.resolve(
+                                    node.value, scope, depth + 1
+                                )
+                                if (
+                                    not is_scalar(v)
+                                    and v[0] == "T"
+                                    and i < len(v) - 1
+                                ):
+                                    return v[1 + i]
+                                return UNK
+        return UNK
+
+
+# ---- the abstract interpreter ---------------------------------------------
+
+
+class Analyzer:
+    """One lint run's SPMD interpreter: shared across regions so helper
+    summaries memoize across shard_map call sites."""
+
+    def __init__(self, ctx: Context, report):
+        self.ctx = ctx
+        self.index = dataflow.get_index(ctx)
+        self.report = report            # (sf, lineno, message) sink
+        self._memo: dict = {}
+        self._stack: list = []
+        # def node id -> AST node, registered at every fnval creation
+        # site, per RUN (a class-level cache would pin every linted
+        # module's subtrees for the process lifetime — the mutant
+        # harness lints scratch modules every run)
+        self._fnval_nodes: dict[int, object] = {}
+        # per-file FuncInfo lists + enclosing-def memo: _eval_call asks
+        # for the enclosing def once per Call; a repo-wide scan there
+        # would be the interpreter's hot path
+        self._file_funcs: dict[str, list] = {}
+        self._enclosing_memo: dict = {}
+        # set whenever a computation hits the depth/recursion cutoff:
+        # such summaries depend on the call stack and are not memoized
+        self._degraded = False
+
+    def _fnval(self, name: str, node: ast.AST):
+        """("FN", name, id) — a local function value; the def node is
+        registered so application never re-walks the repo."""
+        self._fnval_nodes[id(node)] = node
+        return ("FN", name, id(node))
+
+    # -- public entry --
+
+    def analyze_region(self, sf: SourceFile, call: ast.Call) -> None:
+        """One shard_map(body, ..., in_specs=..., out_specs=...) region:
+        seed the body params from in_specs, run the body, diff the
+        return states against out_specs."""
+        body_fi = self._body_func(sf, call)
+        if body_fi is None:
+            return
+        resolver = SpecResolver(self.index, sf)
+        scope = self._enclosing_def(sf, call)
+        in_specs = next(
+            (kw.value for kw in call.keywords if kw.arg == "in_specs"), None
+        )
+        out_specs = next(
+            (kw.value for kw in call.keywords if kw.arg == "out_specs"),
+            None,
+        )
+        spec_tree = resolver.resolve(in_specs, scope)
+        params = [
+            a.arg
+            for a in body_fi.node.args.posonlyargs + body_fi.node.args.args
+        ]
+        env: dict[str, object] = {}
+        if not is_scalar(spec_tree) and spec_tree[0] == "T":
+            leaves = list(spec_tree[1:])
+        else:
+            leaves = [spec_tree] * len(params)
+        for p, s in zip(params, leaves + [UNK] * len(params)):
+            env[p] = s
+        rets = self._run_function(body_fi.node, env, sf, depth=0)
+        want = resolver.resolve(out_specs, scope)
+        for ret_node, state in rets:
+            self._diff_out_spec(sf, ret_node, state, want)
+
+    # -- out_specs diff --
+
+    def _diff_out_spec(self, sf, ret_node, state, spec, field="") -> None:
+        if spec == UNK or state == UNK:
+            return
+        if is_scalar(spec):
+            if spec == REP and collapse(state) in (SHD, VAR):
+                where = f" (field `{field}`)" if field else ""
+                self.report(
+                    sf, ret_node.lineno,
+                    f"out_specs declares a replicated output{where} but the "
+                    f"body's value is provably {collapse(state)} — establish "
+                    "replication before returning (the sanctioned discharge "
+                    "is the pmax-over-equal idiom: `x = jax.lax.pmax(x, "
+                    "axes)` is the identity on equal values and makes "
+                    "replication provable)",
+                )
+            return
+        if is_scalar(state):
+            # uniform value tree against a structured spec: check every
+            # replicated spec leaf against the one state
+            for leaf_field, leaf in self._spec_leaves(spec):
+                self._diff_out_spec(sf, ret_node, state, leaf, leaf_field)
+            return
+        if spec[0] == "F" and state[0] == "F":
+            ds, dv = dict(spec[1]), dict(state[1])
+            for k in set(ds) & set(dv):
+                self._diff_out_spec(sf, ret_node, dv[k], ds[k], k)
+            return
+        if spec[0] == "T" and state[0] == "T" and len(spec) == len(state):
+            for i, (sp, st) in enumerate(zip(spec[1:], state[1:])):
+                self._diff_out_spec(sf, ret_node, st, sp, field or str(i))
+            return
+
+    @staticmethod
+    def _spec_leaves(spec, prefix=""):
+        if is_scalar(spec):
+            yield prefix, spec
+            return
+        if spec[0] == "F":
+            for k, v in spec[1]:
+                yield from Analyzer._spec_leaves(v, k)
+        elif spec[0] == "T":
+            for i, v in enumerate(spec[1:]):
+                yield from Analyzer._spec_leaves(v, prefix or str(i))
+
+    # -- region discovery helpers --
+
+    def _body_func(self, sf: SourceFile, call: ast.Call):
+        if not call.args:
+            return None
+        name = _tail(dotted_name(call.args[0]))
+        if not name:
+            return None
+        cands = [
+            fi
+            for fi in self.index.by_name.get(name, ())
+            if fi.sf is sf
+        ]
+        if len(cands) == 1:
+            return cands[0]
+        # several same-named defs (every factory names its body `body`):
+        # the one the call references is the nearest PRECEDING def
+        before = [
+            fi for fi in cands
+            if (fi.node.end_lineno or fi.node.lineno) < call.lineno
+        ]
+        if before:
+            return max(before, key=lambda fi: fi.node.lineno)
+        return None
+
+    def _enclosing_def(self, sf: SourceFile, call: ast.Call):
+        fi = self._enclosing_fi(sf, call)
+        return fi.node if fi is not None else None
+
+    # -- function execution --
+
+    def _run_function(self, fn, env, sf, depth):
+        """Execute a function body; returns [(return node, state)]."""
+        rets: list = []
+        self._exec_suite(fn.body, env, sf, depth, rets)
+        return rets
+
+    def _summary(self, fi, arg_states, depth, kw_states=None):
+        """Return-state of a project function under positional
+        `arg_states` and keyword `kw_states` ({name: state}), memoized;
+        UNK on recursion or depth exhaustion — and a summary whose
+        computation HIT either cutoff is not memoized at all (its value
+        depends on the call stack it was computed under, and caching it
+        would make findings flip with analysis order)."""
+        kw_states = kw_states or {}
+        key = (
+            fi.qname,
+            tuple(self._key_of(s) for s in arg_states),
+            tuple(sorted(
+                (k, self._key_of(v)) for k, v in kw_states.items()
+            )),
+        )
+        if key in self._memo:
+            return self._memo[key]
+        if depth >= _MAX_DEPTH or fi.qname in self._stack:
+            self._degraded = True
+            return UNK
+        self._stack.append(fi.qname)
+        env = self._seed_params(fi.node, fi.cls, arg_states, kw_states)
+        was_degraded, self._degraded = self._degraded, False
+        rets = self._run_function(fi.node, env, fi.sf, depth + 1)
+        self._stack.pop()
+        out = join_all([s for _, s in rets]) if rets else REP
+        if not self._degraded:
+            self._memo[key] = out
+        self._degraded = self._degraded or was_degraded
+        return out
+
+    @staticmethod
+    def _seed_params(fn, cls, arg_states, kw_states):
+        """Bind a call's argument states onto a def's parameters:
+        positionals in order, keywords by name, and UNMATCHED params
+        from their literal default when it is a constant — anything
+        else degrades to UNK (never REP: a mis-seeded parameter on the
+        replicated end of the lattice FIRES findings)."""
+        params = list(fn.args.posonlyargs + fn.args.args)
+        if cls is not None and params and params[0].arg == "self":
+            params = params[1:]
+        env = {p.arg: s for p, s in zip(params, arg_states)}
+        defaults = dict(
+            zip(
+                [p.arg for p in params[len(params) - len(fn.args.defaults):]],
+                fn.args.defaults,
+            )
+        )
+        for a, d in zip(fn.args.kwonlyargs, fn.args.kw_defaults):
+            if d is not None:
+                defaults[a.arg] = d
+        declared = {p.arg for p in params + list(fn.args.kwonlyargs)}
+        leftover_kw = []
+        for name, state in kw_states.items():
+            if name in declared:
+                env[name] = state
+            else:
+                leftover_kw.append(state)
+        for p in params + list(fn.args.kwonlyargs):
+            if p.arg not in env:
+                d = defaults.get(p.arg)
+                env[p.arg] = (
+                    REP if isinstance(d, ast.Constant) else UNK
+                )
+        # *args / **kwargs catch-alls: the join of whatever spilled
+        # past the declared parameters (a sharded value passed through
+        # *vals must not fall to the replicated Name fallback)
+        spill = list(arg_states[len(params):])
+        if fn.args.vararg:
+            env[fn.args.vararg.arg] = (
+                collapse(join_all(spill)) if spill else REP
+            )
+        if fn.args.kwarg:
+            env[fn.args.kwarg.arg] = (
+                collapse(join_all(leftover_kw)) if leftover_kw else REP
+            )
+        return env
+
+    @staticmethod
+    def _key_of(s):
+        if is_scalar(s):
+            return s
+        if s[0] == "FN":
+            return ("FN", s[1])
+        if s[0] == "T":
+            return ("T",) + tuple(Analyzer._key_of(x) for x in s[1:])
+        return ("F", tuple((k, Analyzer._key_of(v)) for k, v in s[1]))
+
+    # -- statements --
+
+    def _exec_suite(self, stmts, env, sf, depth, rets):
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                env[st.name] = self._fnval(st.name, st)
+                continue
+            if isinstance(st, ast.Return):
+                state = (
+                    self._eval(st.value, env, sf, depth)
+                    if st.value is not None
+                    else REP
+                )
+                rets.append((st, state))
+                continue
+            if isinstance(st, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                self._exec_assign(st, env, sf, depth)
+                continue
+            if isinstance(st, ast.If):
+                self._eval(st.test, env, sf, depth)
+                e1, e2 = dict(env), dict(env)
+                self._exec_suite(st.body, e1, sf, depth, rets)
+                self._exec_suite(st.orelse, e2, sf, depth, rets)
+                env.clear()
+                env.update(self._join_envs(e1, e2))
+                continue
+            if isinstance(st, (ast.For, ast.AsyncFor, ast.While)):
+                # two passes to a (cheap) fixpoint over the loop carry
+                if isinstance(st, ast.For):
+                    it = self._eval(st.iter, env, sf, depth)
+                    self._bind_target(st.target, it, env)
+                else:
+                    self._eval(st.test, env, sf, depth)
+                for _ in range(2):
+                    before = dict(env)
+                    self._exec_suite(st.body, env, sf, depth, rets)
+                    env.update(self._join_envs(before, env))
+                self._exec_suite(st.orelse, env, sf, depth, rets)
+                continue
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                for item in st.items:
+                    self._eval(item.context_expr, env, sf, depth)
+                self._exec_suite(st.body, env, sf, depth, rets)
+                continue
+            if isinstance(st, ast.Try):
+                self._exec_suite(st.body, env, sf, depth, rets)
+                for h in st.handlers:
+                    self._exec_suite(h.body, dict(env), sf, depth, rets)
+                self._exec_suite(st.orelse, env, sf, depth, rets)
+                self._exec_suite(st.finalbody, env, sf, depth, rets)
+                continue
+            if isinstance(st, ast.Expr):
+                self._eval(st.value, env, sf, depth)
+                continue
+            if isinstance(st, ast.Raise):
+                continue
+            # anything else (Pass, Assert, imports, ...): evaluate child
+            # expressions for their collective-call side effects
+            for child in ast.iter_child_nodes(st):
+                if isinstance(child, ast.expr):
+                    self._eval(child, env, sf, depth)
+
+    @staticmethod
+    def _join_envs(e1, e2):
+        out = {}
+        for k in set(e1) | set(e2):
+            if k in e1 and k in e2:
+                out[k] = join(e1[k], e2[k])
+            else:
+                out[k] = e1.get(k, e2.get(k))
+        return out
+
+    def _exec_assign(self, st, env, sf, depth):
+        value = st.value
+        if value is None:
+            return
+        state = self._eval(value, env, sf, depth)
+        if isinstance(st, ast.AugAssign):
+            if isinstance(st.target, ast.Name):
+                old = env.get(st.target.id, REP)
+                env[st.target.id] = join(old, state)
+            return
+        targets = st.targets if isinstance(st, ast.Assign) else [st.target]
+        for t in targets:
+            self._bind_target(t, state, env)
+
+    def _bind_target(self, target, state, env):
+        if isinstance(target, ast.Name):
+            env[target.id] = state
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            elts = target.elts
+            if not is_scalar(state) and state[0] == "T" and len(
+                state
+            ) - 1 == len(elts):
+                for el, s in zip(elts, state[1:]):
+                    self._bind_target(el, s, env)
+            else:
+                flat = collapse(state)
+                for el in elts:
+                    self._bind_target(el, flat, env)
+        elif isinstance(target, ast.Starred):
+            self._bind_target(target.value, collapse(state), env)
+        # attribute/subscript stores: no tracked base mutation
+
+    # -- expressions --
+
+    def _eval(self, node, env, sf, depth):
+        if node is None:
+            return REP
+        if isinstance(node, ast.Constant):
+            return REP
+        if isinstance(node, ast.Name):
+            if node.id in env:
+                return env[node.id]
+            # local defs visible before flow reaches them (rare) and
+            # module-level names: host config -> replicated
+            cands = [
+                fi for fi in self.index.by_name.get(node.id, ())
+                if fi.sf is sf
+            ]
+            if len(cands) == 1:
+                return self._fnval(node.id, cands[0].node)
+            return REP
+        if isinstance(node, ast.Attribute):
+            if node.attr in dataflow._STATIC_META_ATTRS:
+                return REP  # shapes/dtypes are trace-time host metadata
+            base = self._eval(node.value, env, sf, depth)
+            if not is_scalar(base) and base[0] == "F":
+                d = dict(base[1])
+                if node.attr in d:
+                    return d[node.attr]
+                return collapse(base)
+            return collapse(base)
+        if isinstance(node, ast.Subscript):
+            base = self._eval(node.value, env, sf, depth)
+            idx = self._eval(node.slice, env, sf, depth)
+            return join(collapse(base), collapse(idx))
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return ("T",) + tuple(
+                self._eval(el, env, sf, depth) for el in node.elts
+            )
+        if isinstance(node, ast.Dict):
+            return join_all(
+                [
+                    self._eval(v, env, sf, depth)
+                    for v in list(node.keys) + list(node.values)
+                    if v is not None
+                ]
+            )
+        if isinstance(node, ast.BinOp):
+            return join(
+                collapse(self._eval(node.left, env, sf, depth)),
+                collapse(self._eval(node.right, env, sf, depth)),
+            )
+        if isinstance(node, ast.BoolOp):
+            return join_all(
+                [collapse(self._eval(v, env, sf, depth)) for v in node.values]
+            )
+        if isinstance(node, ast.UnaryOp):
+            return collapse(self._eval(node.operand, env, sf, depth))
+        if isinstance(node, ast.Compare):
+            return join_all(
+                [collapse(self._eval(node.left, env, sf, depth))]
+                + [
+                    collapse(self._eval(c, env, sf, depth))
+                    for c in node.comparators
+                ]
+            )
+        if isinstance(node, ast.IfExp):
+            self._eval(node.test, env, sf, depth)
+            return join(
+                self._eval(node.body, env, sf, depth),
+                self._eval(node.orelse, env, sf, depth),
+            )
+        if isinstance(node, ast.Call):
+            return self._eval_call(node, env, sf, depth)
+        if isinstance(node, ast.NamedExpr):
+            # walrus: bind the target so the later Name lookup sees the
+            # real state instead of the replicated-config fallback
+            state = self._eval(node.value, env, sf, depth)
+            if isinstance(node.target, ast.Name):
+                env[node.target.id] = state
+            return state
+        if isinstance(node, ast.Starred):
+            return self._eval(node.value, env, sf, depth)
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            states = [
+                self._eval(g.iter, env, sf, depth) for g in node.generators
+            ]
+            return join_all([collapse(s) for s in states] + [REP])
+        if isinstance(node, ast.Lambda):
+            return self._fnval("<lambda>", node)
+        if isinstance(node, ast.Slice):
+            return join_all(
+                [
+                    collapse(self._eval(p, env, sf, depth))
+                    for p in (node.lower, node.upper, node.step)
+                    if p is not None
+                ]
+            )
+        if isinstance(node, ast.JoinedStr):
+            return REP
+        return UNK
+
+    def _eval_call(self, call: ast.Call, env, sf, depth):
+        fname = dotted_name(call.func)
+        tail = _tail(fname) or (
+            call.func.attr if isinstance(call.func, ast.Attribute) else None
+        )
+        arg_states = [self._eval(a, env, sf, depth) for a in call.args]
+        kw_states = [
+            self._eval(kw.value, env, sf, depth) for kw in call.keywords
+        ]
+
+        # control-flow special forms first
+        if tail == "scan":
+            return self._eval_scan(call, env, sf, depth, arg_states)
+        if tail == "while_loop":
+            return self._eval_while_loop(call, env, sf, depth, arg_states)
+        if tail == "fori_loop":
+            return self._eval_fori_loop(call, env, sf, depth, arg_states)
+        if tail == "cond" and fname and "lax" in fname:
+            # lax.cond(pred, true_fn, false_fn, *operands): operand
+            # states start AFTER the predicate and the branch functions
+            branches = [
+                a for a in call.args if self._as_fnval(a, env, sf)
+            ]
+            operands = arg_states[1 + len(branches):]
+            states = [
+                self._apply_fnval(
+                    self._as_fnval(b, env, sf), operands, env, sf, depth,
+                )
+                for b in branches
+            ]
+            return join_all(states) if states else UNK
+
+        if tail in COLLECTIVES:
+            self._check_collective(call, tail, arg_states, env, sf)
+            if tail in _REPLICATING:
+                return REP
+            if tail == "axis_index":
+                return VAR
+            if tail == "psum_scatter":
+                # each shard receives a distinct reduced chunk: at
+                # LEAST sharded, whatever the operand was
+                return join(SHD, collapse(join_all(arg_states)))
+            return collapse(join_all(arg_states + kw_states))
+        if tail in _VARYING_SOURCES:
+            return VAR
+        if tail in _SHAPE_ONLY:
+            return join_all([REP] + kw_states)
+
+        # project-local resolution through the shared index; keyword
+        # arguments bind BY NAME onto the callee's parameters (a
+        # sharded value passed by keyword must not fall through to the
+        # unmatched-parameter default)
+        fi_caller = self._enclosing_fi(sf, call)
+        cands = (
+            self.index.resolve_call(fi_caller, call, loose=False)
+            if fi_caller is not None
+            else []
+        )
+        named_kw = {
+            kw.arg: s
+            for kw, s in zip(call.keywords, kw_states)
+            if kw.arg is not None
+        }
+        splat_kw = [
+            s
+            for kw, s in zip(call.keywords, kw_states)
+            if kw.arg is None
+        ]
+        if cands:
+            summaries = [
+                self._summary(fi, arg_states, depth, named_kw)
+                for fi in cands
+            ]
+            # a **spread cannot be mapped onto parameters: join its
+            # states in (the old conservative treatment)
+            return join_all(
+                summaries + [collapse(s) for s in splat_kw]
+            )
+        # a direct call of a local function value (nested def)
+        fn = self._as_fnval(call.func, env, sf)
+        if fn is not None:
+            return self._apply_fnval(
+                fn, arg_states, env, sf, depth, named_kw
+            )
+
+        # NamedTuple-style ctor: a BARE NAME called with keywords only
+        # -> record state (an Attribute callee is a method — `x.sum(
+        # axis=1)` — whose state is its receiver's, never a ctor)
+        if (
+            isinstance(call.func, ast.Name)
+            and call.keywords
+            and not call.args
+            and all(kw.arg is not None for kw in call.keywords)
+        ):
+            return (
+                "F",
+                tuple(
+                    sorted(
+                        (kw.arg, s)
+                        for kw, s in zip(call.keywords, kw_states)
+                    )
+                ),
+            )
+        # method calls on tracked values (x.sum(), snap._replace(...)):
+        # join receiver and arguments; everything else is a pure
+        # function of its operands under deterministic SPMD execution
+        recv = []
+        if isinstance(call.func, ast.Attribute):
+            recv = [self._eval(call.func.value, env, sf, depth)]
+        return collapse(join_all(recv + arg_states + kw_states))
+
+    def _enclosing_fi(self, sf, node):
+        key = (sf.path, node.lineno)
+        if key in self._enclosing_memo:
+            return self._enclosing_memo[key]
+        funcs = self._file_funcs.get(sf.path)
+        if funcs is None:
+            funcs = [
+                fi for fi in self.index.funcs.values() if fi.sf is sf
+            ]
+            self._file_funcs[sf.path] = funcs
+        best = None
+        for fi in funcs:
+            n = fi.node
+            if n.lineno <= node.lineno <= (n.end_lineno or n.lineno):
+                if best is None or n.lineno > best.node.lineno:
+                    best = fi
+        self._enclosing_memo[key] = best
+        return best
+
+    def _as_fnval(self, expr, env, sf):
+        if isinstance(expr, ast.Name):
+            v = env.get(expr.id)
+            if isinstance(v, tuple) and v and v[0] == "FN":
+                return v
+            cands = [
+                fi for fi in self.index.by_name.get(expr.id, ())
+                if fi.sf is sf
+            ]
+            if len(cands) == 1:
+                return self._fnval(expr.id, cands[0].node)
+        if isinstance(expr, tuple) and expr and expr[0] == "FN":
+            return expr
+        return None
+
+    def _apply_fnval(self, fn, arg_states, env, sf, depth, kw_states=None):
+        node = self._node_of_fnval(fn)
+        if node is None or depth >= _MAX_DEPTH:
+            self._degraded = True
+            return UNK
+        if isinstance(node, ast.Lambda):
+            params = [
+                a.arg for a in node.args.posonlyargs + node.args.args
+            ]
+            inner = dict(env)
+            inner.update(dict(zip(params, arg_states)))
+            return self._eval(node.body, inner, sf, depth + 1)
+        inner = dict(env)  # closure environment; params shadow it
+        inner.update(
+            self._seed_params(node, None, arg_states, kw_states or {})
+        )
+        rets = self._run_function(node, inner, sf, depth + 1)
+        return join_all([s for _, s in rets]) if rets else REP
+
+    def _node_of_fnval(self, fn):
+        # every fnval is minted by self._fnval, which registered the
+        # def node — O(1), no repo walk
+        return self._fnval_nodes.get(fn[2])
+
+    # -- lax control-flow forms --
+
+    def _eval_scan(self, call, env, sf, depth, arg_states):
+        fn = self._as_fnval(call.args[0], env, sf) if call.args else None
+        init = arg_states[1] if len(arg_states) > 1 else REP
+        for kw in call.keywords:
+            if kw.arg == "init":
+                init = self._eval(kw.value, env, sf, depth)
+        xs = arg_states[2] if len(arg_states) > 2 else REP
+        for kw in call.keywords:
+            if kw.arg == "xs":
+                xs = self._eval(kw.value, env, sf, depth)
+        if fn is None:
+            return ("T", collapse(join(init, xs)), UNK)
+        carry = init
+        ys = REP
+        for _ in range(3):  # carry fixpoint on a 4-point lattice
+            out = self._apply_fnval(
+                fn, [carry, collapse(xs)], env, sf, depth
+            )
+            if not is_scalar(out) and out[0] == "T" and len(out) == 3:
+                new_carry, ys = out[1], out[2]
+            else:
+                new_carry, ys = collapse(out), collapse(out)
+            joined = join(carry, new_carry)
+            if joined == carry:
+                break
+            carry = joined
+        return ("T", carry, ys)
+
+    def _eval_while_loop(self, call, env, sf, depth, arg_states):
+        cond = (
+            self._as_fnval(call.args[0], env, sf) if call.args else None
+        )
+        body = (
+            self._as_fnval(call.args[1], env, sf)
+            if len(call.args) > 1
+            else None
+        )
+        carry = arg_states[2] if len(arg_states) > 2 else REP
+        if body is None:
+            return collapse(carry) if is_scalar(carry) else carry
+        for _ in range(3):
+            if cond is not None:
+                # the cond body runs every round too: collectives
+                # inside it must pass the same checks (its boolean
+                # result does not feed the carry)
+                self._apply_fnval(cond, [carry], env, sf, depth)
+            out = self._apply_fnval(body, [carry], env, sf, depth)
+            joined = join(carry, out)
+            if joined == carry:
+                break
+            carry = joined
+        return carry
+
+    def _eval_fori_loop(self, call, env, sf, depth, arg_states):
+        body = (
+            self._as_fnval(call.args[2], env, sf)
+            if len(call.args) > 2
+            else None
+        )
+        carry = arg_states[3] if len(arg_states) > 3 else REP
+        if body is None:
+            return collapse(carry) if is_scalar(carry) else carry
+        for _ in range(3):
+            out = self._apply_fnval(body, [REP, carry], env, sf, depth)
+            joined = join(carry, out)
+            if joined == carry:
+                break
+            carry = joined
+        return carry
+
+    # -- the collective checks (replication-dependent half) --
+
+    def _check_collective(self, call, tail, arg_states, env, sf) -> None:
+        if tail == "psum" and call.args:
+            operand = call.args[0]
+            is_literal = isinstance(operand, ast.Constant)
+            if not is_literal and collapse(arg_states[0]) == REP:
+                self.report(
+                    sf, call.lineno,
+                    "psum of a provably-replicated operand double-counts "
+                    "by the axis size — every shard contributes the same "
+                    "value; reduce one shard's contribution, use the value "
+                    "directly, or multiply by axis size explicitly "
+                    "(`psum(1, axes)` over a literal is the sanctioned "
+                    "device-count idiom)",
+                )
+        if tail == "all_gather":
+            if call.args and collapse(arg_states[0]) == REP:
+                self.report(
+                    sf, call.lineno,
+                    "all_gather of a provably-replicated operand stacks D "
+                    "identical copies for one collective launch — use the "
+                    "value directly (every shard already holds it)",
+                )
+            for kw in call.keywords:
+                if kw.arg == "axis" and (
+                    (
+                        isinstance(kw.value, ast.Constant)
+                        and isinstance(kw.value.value, str)
+                    )
+                    or (
+                        isinstance(kw.value, ast.Name)
+                        and kw.value.id
+                        in _module_str_consts(sf)
+                    )
+                ):
+                    self.report(
+                        sf, kw.value.lineno,
+                        "all_gather's `axis=` is the insertion POSITION "
+                        "(an int); the mesh axis name goes in the second "
+                        "positional (`axis_name`) — a string here always "
+                        "misindexes the gathered dimension",
+                    )
+
+
+# ---- context-free axis-name check -----------------------------------------
+
+
+def check_axis_names(
+    files: list[SourceFile],
+    declared: set[str],
+    report,
+    index: dataflow.ModuleIndex,
+) -> None:
+    """Every collective whose axis operand RESOLVES to string names must
+    use names some mesh declares — the wrong-axis class. Runtime axis
+    parameters (`axes` threaded through the engine) are skipped, not
+    guessed."""
+    if not declared:
+        return
+    for sf in files:
+        consts = _module_str_consts(sf)
+        for node in index.walk(sf):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = _tail(dotted_name(node.func))
+            if tail not in COLLECTIVES:
+                continue
+            pos = COLLECTIVES[tail]
+            axis_expr = None
+            if len(node.args) > pos:
+                axis_expr = node.args[pos]
+            else:
+                for kw in node.keywords:
+                    if kw.arg == "axis_name":
+                        axis_expr = kw.value
+            if axis_expr is None:
+                continue
+            names = resolve_axis_operand(axis_expr, consts)
+            if names is None:
+                continue
+            for name in names:
+                if name not in declared:
+                    report(
+                        sf, axis_expr.lineno,
+                        f"collective `{tail}` uses axis name {name!r}, "
+                        "which no mesh in the linted set declares "
+                        f"(declared: {sorted(declared)}) — an unbound "
+                        "axis deadlocks or miscounts on hardware",
+                    )
+
+
+# ---- rule entry -----------------------------------------------------------
+
+
+def _shard_map_calls(index, sf: SourceFile):
+    for node in index.walk(sf):
+        if not isinstance(node, ast.Call):
+            continue
+        tail = _tail(dotted_name(node.func))
+        if tail in ("shard_map", "_shard_map"):
+            kws = {kw.arg for kw in node.keywords}
+            if "in_specs" in kws and "out_specs" in kws:
+                yield node
+
+
+def check_files(ctx: Context, scoped: list[SourceFile]) -> list[Violation]:
+    """The spmd-collective family over `scoped` (dedup across the many
+    analysis paths that can reach one call site)."""
+    seen: set = set()
+    out: list[Violation] = []
+
+    def report(sf, lineno, message):
+        key = (sf.path, lineno, message)
+        if key in seen:
+            return
+        seen.add(key)
+        out.append(Violation(RULE, sf.path, lineno, message))
+
+    analyzer = Analyzer(ctx, report)
+    for sf in scoped:
+        for call in _shard_map_calls(analyzer.index, sf):
+            analyzer.analyze_region(sf, call)
+    check_axis_names(
+        scoped,
+        declared_axis_names(ctx.files, analyzer.index),
+        report,
+        analyzer.index,
+    )
+    out.sort(key=lambda v: (v.path, v.line))
+    return out

@@ -1,0 +1,246 @@
+"""Seeded SPMD mutants: the analyzer must catch each bug class.
+
+The PR-10 lesson extended to the SPMD layer: an analyzer that has
+never caught anything is an assertion, not a tool. Each mutant below
+re-introduces one real SPMD bug class into a miniature mesh-sharded
+scoring module (BASE below — psum statistics, a pmax/pmin bound pair,
+an all_gather candidate election, a declared collective budget), and
+the layer that owns that class MUST report it:
+
+- `dropped-psum`: the global-mean psum deleted — the shard-local sum
+  ships as if it were global. Caught by the AST rule (the value flows
+  to a `P()` out_specs leaf still provably sharded) AND by the
+  collective budget (psum count drifts down);
+- `wrong-axis`: a collective moved onto an axis name no mesh declares
+  — the deadlock/miscount class. Caught by the AST rule's unbound-axis
+  check;
+- `replicated-double-count`: a second psum wrapped around the already-
+  replicated global sum — counts it D times. Caught by the AST rule's
+  replicated-psum check (and the budget drifts too);
+- `extra-gather-over-budget`: a gratuitous extra all_gather of a
+  shard-local value — AST-silent by construction (gathering varying
+  data is a legitimate shape), so ONLY the collective budget catches
+  it: the per-round latency-tax class the budget exists for.
+
+`check_spmd_mutants` runs on every full-repo lint (folded in next to
+the contracts layer): the unmutated BASE must be clean on both layers,
+and every mutant must be caught by EVERY layer it declares — a
+survived mutant is itself a lint violation, the analyzer lost its
+teeth for that class. tests/test_bench_smoke.py asserts the harness
+one mutant at a time by name.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+from kubernetes_scheduler_tpu.analysis.core import Violation
+
+RULE = "spmd-mutant"
+
+MUTANTS_PATH = "kubernetes_scheduler_tpu/analysis/spmd_mutants.py"
+
+# the miniature sharded surface every mutant perturbs: one psum-based
+# global statistic, a pmax bound, an axis_index/all_gather candidate
+# election, and a two-leaf replicated output discharged the sanctioned
+# way — with its own declared collective budget
+BASE = '''\
+"""SPMD mutant base: a miniature mesh-sharded scoring surface."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+NODE_AXIS = "node"
+
+# NOTE: psum(1, axis) of a literal constant-folds at trace time (the
+# axis size is static), so only the data psum appears in the jaxpr
+BUDGET = {"psum": 1, "pmax": 1, "pmin": 1, "all_gather": 1,
+          "axis_index": 1}
+
+
+def make_mesh():
+    return Mesh(np.asarray(jax.devices()), (NODE_AXIS,))
+
+
+def make_score_fn(mesh):
+    def body(x, w):
+        n_dev = jax.lax.psum(1, NODE_AXIS)
+        total = jax.lax.psum(x.sum(), NODE_AXIS)
+        mean = total / (n_dev * x.shape[0])
+        hi = jax.lax.pmax(x.max(), NODE_AXIS)
+        lo = jax.lax.pmin(x.min(), NODE_AXIS)
+        scaled = (x - mean) * w.sum() / jnp.maximum(hi - lo, 1e-6)
+        n_local = x.shape[0]
+        offset = jax.lax.axis_index(NODE_AXIS).astype(jnp.int32) * n_local
+        local_arg = jnp.argmax(scaled).astype(jnp.int32) + offset
+        cand = jax.lax.all_gather(
+            jnp.stack([scaled.max(), local_arg.astype(jnp.float32)]),
+            NODE_AXIS,
+        )
+        best = cand[jnp.argmax(cand[:, 0]), 1].astype(jnp.int32)
+        return best, mean
+
+    kw = (
+        "check_vma"
+        if "check_vma" in __import__("inspect").signature(
+            _shard_map
+        ).parameters
+        else "check_rep"
+    )
+    return _shard_map(
+        body, mesh=mesh, in_specs=(P(NODE_AXIS), P()),
+        out_specs=(P(), P()), **{kw: False},
+    )
+'''
+
+# name -> (literal pattern, replacement, layers that MUST catch it)
+SPMD_MUTANTS = {
+    "dropped-psum": (
+        "        total = jax.lax.psum(x.sum(), NODE_AXIS)\n",
+        "        total = x.sum()\n",
+        ("ast", "budget"),
+    ),
+    "wrong-axis": (
+        "        hi = jax.lax.pmax(x.max(), NODE_AXIS)\n",
+        '        hi = jax.lax.pmax(x.max(), "nodez")\n',
+        ("ast",),
+    ),
+    "replicated-double-count": (
+        "        mean = total / (n_dev * x.shape[0])\n",
+        "        total = jax.lax.psum(total, NODE_AXIS)\n"
+        "        mean = total / (n_dev * x.shape[0])\n",
+        ("ast", "budget"),
+    ),
+    "extra-gather-over-budget": (
+        "        best = cand[jnp.argmax(cand[:, 0]), 1].astype(jnp.int32)\n",
+        "        extra = jax.lax.all_gather(scaled.min(), NODE_AXIS)\n"
+        "        best = cand[jnp.argmax(cand[:, 0]), 1].astype(jnp.int32)\n"
+        "        best = best + extra.astype(jnp.int32).min() * 0\n",
+        ("budget",),
+    ),
+}
+
+
+def mutate(name: str) -> str:
+    pattern, replacement, _ = SPMD_MUTANTS[name]
+    mutated = BASE.replace(pattern, replacement)
+    if mutated == BASE:
+        raise ValueError(
+            f"mutant {name!r}: pattern no longer matches the BASE "
+            "module — the harness drifted from its own source"
+        )
+    return mutated
+
+
+def _ast_findings(source: str, workdir: str) -> list:
+    """The spmd-collective family's findings on `source` (written to a
+    scratch module so the normal lint path runs unchanged)."""
+    from kubernetes_scheduler_tpu.analysis.core import run_lint
+
+    path = os.path.join(workdir, "spmd_mutant_mod.py")
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(source)
+    return [
+        v
+        for v in run_lint([path], rules=["spmd-collective"])
+        if not v.waived
+    ]
+
+
+def _budget_findings(source: str, workdir: str) -> list:
+    """Trace the module's surface and diff against its own declared
+    BUDGET (the same walk the repo-level gate runs against
+    COLLECTIVE_BUDGET.json). A module that fails to trace counts as
+    caught — the mutation broke the program outright."""
+    import importlib.util
+
+    import jax
+
+    from kubernetes_scheduler_tpu.analysis.contracts import (
+        COLLECTIVE_KINDS,
+        collective_counts,
+    )
+
+    path = os.path.join(workdir, "spmd_mutant_traced.py")
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(source)
+    spec = importlib.util.spec_from_file_location("_spmd_mutant_mod", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    fn = mod.make_score_fn(mod.make_mesh())
+    n = 16 * max(1, jax.device_count())
+    x = jax.ShapeDtypeStruct((n,), "float32")
+    w = jax.ShapeDtypeStruct((4,), "float32")
+    try:
+        counts = collective_counts(fn, x, w)
+    except Exception as e:  # noqa: BLE001 — a broken trace IS a catch
+        return [Violation(
+            RULE, "spmd_mutant_traced.py", 1, f"trace failed: {e}",
+        )]
+    return [
+        Violation(
+            RULE, "spmd_mutant_traced.py", 1,
+            f"{kind}: traced {counts.get(kind, 0)} != budgeted "
+            f"{mod.BUDGET.get(kind, 0)}",
+        )
+        for kind in COLLECTIVE_KINDS
+        if counts.get(kind, 0) != mod.BUDGET.get(kind, 0)
+    ]
+
+
+def run_spmd_mutant(name: str, workdir: str | None = None) -> dict:
+    """{"ast": [findings], "budget": [findings]} for one mutant."""
+    source = mutate(name)
+    with tempfile.TemporaryDirectory() as tmp:
+        wd = workdir or tmp
+        return {
+            "ast": _ast_findings(source, wd),
+            "budget": _budget_findings(source, wd),
+        }
+
+
+def check_spmd_mutants() -> list[Violation]:
+    """The lint entry point: [] when the unmutated base is clean on
+    both layers and every mutant is caught by every layer it declares.
+    A survived mutant means the SPMD analyzer (or the budget walk)
+    lost its teeth for that bug class — a checker regression, not a
+    code bug."""
+    out: list[Violation] = []
+    with tempfile.TemporaryDirectory() as tmp:
+        base_ast = _ast_findings(BASE, tmp)
+        base_budget = _budget_findings(BASE, tmp)
+        for v in base_ast + base_budget:
+            out.append(Violation(
+                RULE, MUTANTS_PATH, 1,
+                "the UNMUTATED spmd-mutant base module is dirty "
+                f"(every catch would be vacuous): {v.message}",
+            ))
+        if out:
+            return out
+        for name, (_, _, expect) in SPMD_MUTANTS.items():
+            try:
+                got = run_spmd_mutant(name, workdir=tmp)
+            except Exception as e:  # noqa: BLE001
+                out.append(Violation(
+                    RULE, MUTANTS_PATH, 1,
+                    f"seeded SPMD mutant `{name}` harness error: {e}",
+                ))
+                continue
+            for layer in expect:
+                if not got[layer]:
+                    out.append(Violation(
+                        RULE, MUTANTS_PATH, 1,
+                        f"seeded SPMD mutant `{name}` SURVIVED the "
+                        f"{layer} layer — the analyzer lost its teeth "
+                        "for this bug class (see "
+                        f"SPMD_MUTANTS[{name!r}])",
+                    ))
+    return out

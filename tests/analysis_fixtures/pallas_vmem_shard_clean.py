@@ -1,0 +1,54 @@
+"""graftlint fixture: pallas-vmem per-shard block dims under shard_map
+(clean half — never imported, only parsed).
+
+The lane-aligned counterpart: 1024 global nodes over 8 shards gives a
+128-lane per-shard axis, and a non-dividing split (`n_res // 3`) stays
+UNRESOLVABLE — skipped, not guessed: the floor division's value is not
+the true dimension when the split is ragged, and shard_map would have
+rejected the layout first."""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+N_NODES = 1024
+MESH_DEVICES = 8
+
+
+def _score_kernel(x_ref, out_ref):
+    out_ref[...] = x_ref[...] * 2.0
+
+
+def rebound_launch(x):
+    # a rebound name is UNRESOLVABLE, skipped not guessed: a
+    # flow-insensitive last-wins value (64) would have checked the
+    # first, correctly 128-aligned BlockSpec against the wrong dim
+    n_loc = N_NODES // MESH_DEVICES
+    first = pl.pallas_call(
+        _score_kernel,
+        out_shape=jax.ShapeDtypeStruct((8, n_loc), jnp.float32),
+        grid=(1, 1),
+        in_specs=[pl.BlockSpec((8, n_loc), lambda i, j: (i, j))],
+        out_specs=pl.BlockSpec((8, n_loc), lambda i, j: (i, j)),
+    )(x)
+    n_loc = n_loc // 2
+    return pl.pallas_call(
+        _score_kernel,
+        out_shape=jax.ShapeDtypeStruct((8, n_loc), jnp.float32),
+        grid=(1, 1),
+        in_specs=[pl.BlockSpec((8, n_loc), lambda i, j: (i, j))],
+        out_specs=pl.BlockSpec((8, n_loc), lambda i, j: (i, j)),
+    )(first)
+
+
+def sharded_launch(x, n_res):
+    # per-shard node axis: 1024 // 8 = 128 — lane-aligned
+    n_local = N_NODES // MESH_DEVICES
+    ragged = n_res // 3  # runtime operand: unresolvable, skipped
+    return pl.pallas_call(
+        _score_kernel,
+        out_shape=jax.ShapeDtypeStruct((8, n_local), jnp.float32),
+        grid=(1, 1),
+        in_specs=[pl.BlockSpec((8, n_local), lambda i, j: (i, j))],
+        out_specs=pl.BlockSpec((8, ragged), lambda i, j: (i, j)),
+    )(x)

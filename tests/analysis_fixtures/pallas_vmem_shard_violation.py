@@ -1,0 +1,32 @@
+"""graftlint fixture: pallas-vmem per-shard block dims under shard_map
+(violating half — never imported, only parsed).
+
+A kernel invoked inside a shard_map body tiles the PER-SHARD node
+axis: the global node count divided by the mesh size BEFORE tiling.
+The rule must resolve the floor division and check the per-shard
+dimension — here 512 // 8 = 64, not a multiple of 128, which forces a
+ragged relayout on every grid step on hardware while "working" under
+the interpreter."""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+N_NODES = 512
+MESH_DEVICES = 8
+
+
+def _score_kernel(x_ref, out_ref):
+    out_ref[...] = x_ref[...] * 2.0
+
+
+def sharded_launch(x):
+    # per-shard node axis: 512 // 8 = 64 — NOT lane-aligned
+    n_local = N_NODES // MESH_DEVICES
+    return pl.pallas_call(
+        _score_kernel,
+        out_shape=jax.ShapeDtypeStruct((8, n_local), jnp.float32),
+        grid=(1, 1),
+        in_specs=[pl.BlockSpec((8, n_local), lambda i, j: (i, j))],
+        out_specs=pl.BlockSpec((8, N_NODES // MESH_DEVICES), lambda i, j: (i, j)),
+    )(x)

@@ -1,0 +1,130 @@
+"""spmd-collective clean fixture: the sanctioned SPMD idioms stay
+quiet.
+
+Mirrors the real sharded engine's patterns — psum of sharded partial
+sums, `psum(1, axes)` as the device-count idiom, the all_gather
+candidate election of a genuinely varying local best, the pcast-varying
+carry, and the pmax-over-equal discharge that establishes the
+replication `out_specs` declares. AST-only: never imported, only
+parsed.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+NODE_AXIS = "node"
+
+
+def make_mesh():
+    return Mesh(np.asarray(jax.devices()), (NODE_AXIS,))
+
+
+def make_stats_fn(mesh):
+    def body(x, w):
+        # psum of a SHARDED partial sum: the canonical global reduction
+        total = jax.lax.psum(x.sum(), NODE_AXIS)
+        # psum of a literal is the sanctioned device-count idiom
+        n_dev = jax.lax.psum(1, NODE_AXIS)
+        mean = total / (n_dev * x.shape[0])
+        # global bounds via pmax/pmin of shard-local extrema
+        hi = jax.lax.pmax(x.max(), NODE_AXIS)
+        lo = jax.lax.pmin(x.min(), NODE_AXIS)
+        # the replicated pod weights scale shard-local columns — no
+        # collective needed, none used
+        scaled = (x - mean) * w.sum()
+        return scaled / jnp.maximum(hi - lo, 1e-6)
+
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(P(NODE_AXIS), P()),
+        out_specs=P(NODE_AXIS),
+    )
+
+
+def make_election_fn(mesh):
+    def body(x):
+        # the engine's candidate-election shape: gather the VARYING
+        # (shard-local) best with its global index, then pick
+        # identically on every shard
+        n_local = x.shape[0]
+        offset = jax.lax.axis_index(NODE_AXIS).astype(jnp.int32) * n_local
+        local_best = x.max()
+        local_arg = jnp.argmax(x).astype(jnp.int32) + offset
+        cand_s = jax.lax.all_gather(local_best, NODE_AXIS)
+        cand_i = jax.lax.all_gather(local_arg, NODE_AXIS)
+        chosen = cand_i[jnp.argmax(cand_s)]
+        # pmax over equal values is the identity: the sanctioned
+        # discharge that makes the declared replication provable
+        chosen = jax.lax.pmax(chosen, NODE_AXIS)
+        return chosen
+
+    return shard_map(body, mesh=mesh, in_specs=P(NODE_AXIS), out_specs=P())
+
+
+def make_cond_fn(mesh):
+    def body(x, w):
+        # lax.cond over a SHARDED operand: the branch bodies see x as
+        # sharded (operands start after the predicate and the two
+        # branch functions) — the psum inside is a legitimate global
+        # reduction, not a double-count
+        def reduce_all(v):
+            return jax.lax.psum(v.sum(), NODE_AXIS)
+
+        def reduce_weighted(v):
+            return jax.lax.psum((v * v).sum(), NODE_AXIS)
+
+        total = jax.lax.cond(
+            w.sum() > 0.0, reduce_all, reduce_weighted, x
+        )
+        return total
+
+    return shard_map(
+        body, mesh=mesh, in_specs=(P(NODE_AXIS), P()), out_specs=P(),
+    )
+
+
+def _global_kw_sum(*, v):
+    # a keyword-only SHARDED operand: the psum is a legitimate global
+    # reduction — the binding must ride the call's keyword, never fall
+    # through to an unmatched-parameter default
+    return jax.lax.psum(v.sum(), NODE_AXIS)
+
+
+def make_kwarg_fn(mesh):
+    def body(x):
+        return _global_kw_sum(v=x)
+
+    return shard_map(body, mesh=mesh, in_specs=P(NODE_AXIS), out_specs=P())
+
+
+def make_walrus_fn(mesh):
+    def body(x):
+        # a walrus-bound SHARDED partial sum: the later psum is a
+        # legitimate global reduction, not a double-count (the binding
+        # must be tracked, not defaulted to host-config/replicated)
+        total = jax.lax.psum((partial := x.sum()), NODE_AXIS)
+        scaled = jax.lax.psum(partial * 2.0, NODE_AXIS)
+        # axis_size is the same integer on every shard — dividing a
+        # replicated total by it stays replicated under out_specs P()
+        n_dev = jax.lax.axis_size(NODE_AXIS)
+        return (total + scaled) / n_dev
+
+    return shard_map(body, mesh=mesh, in_specs=P(NODE_AXIS), out_specs=P())
+
+
+def make_scan_fn(mesh):
+    def body(x, order):
+        def step(carry, i):
+            row = x * carry
+            best = jax.lax.all_gather(row.max(), NODE_AXIS).max()
+            return carry + best, best
+
+        carry, picks = jax.lax.scan(step, jnp.float32(0.0), order)
+        return picks
+
+    return shard_map(
+        body, mesh=mesh, in_specs=(P(NODE_AXIS), P()), out_specs=P(),
+    )

@@ -1,0 +1,64 @@
+"""spmd-collective violating fixture: every check in the family fires.
+
+A miniature mesh-sharded scoring pipeline with the four SPMD bug
+classes seeded: a psum of an already-replicated value (double-count),
+a collective on an axis name no mesh declares (wrong-axis), an
+all_gather of a replicated value (redundant collective) plus the
+axis=-name misuse, and an out_specs leaf declaring replication the
+body never establishes. AST-only: never imported, only parsed.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+NODE_AXIS = "node"
+
+
+def make_mesh():
+    return Mesh(np.asarray(jax.devices()), (NODE_AXIS,))
+
+
+def make_bad_stats_fn(mesh):
+    def body(x, w):
+        # x sharded along NODE_AXIS, w replicated (see in_specs below)
+        total = jax.lax.psum(x.sum(), NODE_AXIS)
+        # VIOLATION (replicated-psum): w is replicated — every shard
+        # contributes the same sum, so this counts it D times
+        wsum = jax.lax.psum(w.sum(), NODE_AXIS)
+        # VIOLATION (unbound-axis): "nodez" is declared by no mesh
+        hi = jax.lax.pmax(x.max(), "nodez")
+        # VIOLATION (replicated-gather): total is already identical on
+        # every shard; gathering stacks D copies for nothing
+        stacked = jax.lax.all_gather(total, NODE_AXIS)
+        # VIOLATION (gather-axis-misuse): axis= is the insertion
+        # position (an int), not the mesh axis name
+        cols = jax.lax.all_gather(x.max(), NODE_AXIS, axis=NODE_AXIS)
+        return total + wsum + hi + stacked.sum() + cols.sum()
+
+    return shard_map(
+        body, mesh=mesh, in_specs=(P(NODE_AXIS), P()), out_specs=P(),
+    )
+
+
+def make_unestablished_out_fn(mesh):
+    def body(x):
+        # VIOLATION (out-spec-replication): the local max is one
+        # shard's value, but out_specs declares it replicated — the
+        # discharge is hi = jax.lax.pmax(hi, NODE_AXIS)
+        hi = x.max()
+        return hi
+
+    return shard_map(body, mesh=mesh, in_specs=P(NODE_AXIS), out_specs=P())
+
+
+def make_varying_out_fn(mesh):
+    def body(x):
+        # VIOLATION (out-spec-replication, varying flavor): an
+        # axis_index-derived value is device-varying by construction
+        offset = jax.lax.axis_index(NODE_AXIS).astype(jnp.int32)
+        return jnp.argmax(x).astype(jnp.int32) + offset
+
+    return shard_map(body, mesh=mesh, in_specs=P(NODE_AXIS), out_specs=P())

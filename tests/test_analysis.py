@@ -81,6 +81,12 @@ def active(violations):
             4,
         ),
         (
+            "pallas-vmem",
+            "pallas_vmem_shard_violation.py",
+            "pallas_vmem_shard_clean.py",
+            2,
+        ),
+        (
             "metric-hygiene",
             "metric_hygiene_violation.py",
             "metric_hygiene_clean.py",
@@ -103,6 +109,12 @@ def active(violations):
             "capability_completeness_violation.py",
             "capability_completeness_clean.py",
             8,
+        ),
+        (
+            "spmd-collective",
+            "spmd_collective_violation.py",
+            "spmd_collective_clean.py",
+            5,
         ),
     ],
 )
@@ -422,6 +434,73 @@ def test_real_schedule_proto_parses():
     assert "same_as_last" in messages["Tensor"]
 
 
+def test_spmd_collective_covers_every_check():
+    """Each SPMD failure mode fires with a message teaching the fix —
+    double-counting psum, unbound axis, redundant gather of a
+    replicated value, the all_gather axis=-name misuse, and out_specs
+    replication the body never establishes (both the sharded and the
+    varying flavor) — and the REAL mesh-sharded engine lints clean
+    (what `make lint` enforces; the sanctioned pmax-over-equal
+    discharge and the `psum(1, axes)` device-count idiom are taught,
+    not waived)."""
+    msgs = [
+        v.message
+        for v in active(
+            lint_fixture("spmd_collective_violation.py", "spmd-collective")
+        )
+    ]
+    assert any("double-counts" in m for m in msgs)
+    assert any("'nodez'" in m and "no mesh" in m for m in msgs)
+    assert any("identical copies" in m for m in msgs)
+    assert any("insertion POSITION" in m for m in msgs)
+    assert any("provably sharded" in m for m in msgs)
+    assert any("provably varying" in m for m in msgs)
+    assert all("pmax-over-equal" in m for m in msgs if "out_specs" in m)
+    real = [
+        "kubernetes_scheduler_tpu/parallel/engine.py",
+        "kubernetes_scheduler_tpu/parallel/mesh.py",
+    ]
+    assert active(run_lint(real, rules=["spmd-collective"])) == []
+
+
+def test_spmd_analyzer_catches_dropped_auction_discharge(tmp_path):
+    """The out-spec check's teeth on the REAL engine source: deleting
+    the auction's pmax-over-equal discharge (the pcast-varying carry's
+    only re-replication point) must fire the out-spec-replication
+    finding on both sharded factories. (The greedy scan's picks are a
+    pure function of all-gathered values, so greedy's pmax is a
+    vma-checker aid, not load-bearing replication — the analyzer
+    rightly stays quiet when IT is dropped.)"""
+    import shutil
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    eng = os.path.join(
+        root, "kubernetes_scheduler_tpu", "parallel", "engine.py"
+    )
+    src = open(eng).read()
+    mutated = src.replace(
+        "    assigned = jax.lax.pmax(assigned, axes)\n", ""
+    )
+    assert mutated != src
+    work = tmp_path / "engine_mutant.py"
+    work.write_text(mutated)
+    mesh = os.path.join(
+        root, "kubernetes_scheduler_tpu", "parallel", "mesh.py"
+    )
+    shutil.copy(mesh, tmp_path / "mesh.py")
+    vs = active(
+        run_lint(
+            [str(work), str(tmp_path / "mesh.py")],
+            rules=["spmd-collective"],
+        )
+    )
+    assert any(
+        "out_specs declares a replicated output" in v.message
+        and "node_idx" in v.message
+        for v in vs
+    ), [v.format() for v in vs]
+
+
 # ---- waiver mechanics -----------------------------------------------------
 
 
@@ -460,13 +539,13 @@ def test_unknown_rule_rejected():
         run_lint(rules=["no-such-rule"])
 
 
-def test_registry_has_all_fifteen_families():
+def test_registry_has_all_sixteen_families():
     assert set(RULES) == {
         "jit-purity", "host-sync", "lock-discipline", "wire-schema",
         "dtype-shape", "timeout-hygiene", "pallas-vmem", "metric-hygiene",
         "sim-determinism", "span-hygiene", "donation-aliasing",
         "host-transfer", "tracer-leak", "lockset-race",
-        "capability-completeness",
+        "capability-completeness", "spmd-collective",
     }
 
 
@@ -636,6 +715,96 @@ def test_engine_contracts_clean_and_covering():
     }
     vs = contracts.check_contracts()
     assert vs == [], "\n".join(v.format() for v in vs)
+
+
+def test_spmd_traced_contracts_and_budget_clean():
+    """The sharded half of layer 2 (what `make lint` runs): every
+    declared sharded surface traces through shard_map on the virtual
+    8-device mesh to EXACTLY the dense spec, the divisibility formula
+    predicts both success and failure, the collective counts match the
+    checked-in COLLECTIVE_BUDGET.json, and the declared coverage
+    includes all four surfaces."""
+    from kubernetes_scheduler_tpu.analysis import contracts
+
+    assert set(contracts.SHARDED_CONTRACT_NAMES) == {
+        "sharded_schedule(greedy)", "sharded_schedule(auction)",
+        "sharded_windows(greedy)", "sharded_windows(auction)",
+    }
+    vs = contracts.check_sharded_contracts()
+    assert vs == [], "\n".join(v.format() for v in vs)
+
+
+def test_collective_budget_staleness_fails_loudly(tmp_path):
+    """Every budget-file failure mode is a finding, never a silent
+    pass: missing file, unparseable file, per-kind count drift, a
+    stale budgeted surface, and an unbudgeted new surface."""
+    import json
+
+    from kubernetes_scheduler_tpu.analysis.contracts import (
+        check_collective_budget,
+    )
+
+    traced = {"sharded_schedule(greedy)": {
+        "psum": 4, "pmax": 2, "pmin": 2, "all_gather": 2,
+        "axis_index": 2,
+    }}
+    missing = str(tmp_path / "nope.json")
+    vs = check_collective_budget(missing, traced=traced)
+    assert len(vs) == 1 and "missing" in vs[0].message
+
+    garbage = tmp_path / "garbage.json"
+    garbage.write_text("{not json")
+    vs = check_collective_budget(str(garbage), traced=traced)
+    assert len(vs) == 1 and "does not parse" in vs[0].message
+
+    doc = {"surfaces": {
+        "sharded_schedule(greedy)": {
+            "psum": 4, "pmax": 2, "pmin": 2, "all_gather": 1,
+            "axis_index": 2,
+        },
+        "ghost_surface": {"psum": 1},
+    }}
+    drifted = tmp_path / "drifted.json"
+    drifted.write_text(json.dumps(doc))
+    vs = check_collective_budget(str(drifted), traced=traced)
+    msgs = [v.message for v in vs]
+    assert any(
+        "all_gather: traced 2 != budgeted 1" in m for m in msgs
+    ), msgs
+    assert any("`ghost_surface`" in m and "stale" in m for m in msgs)
+
+    vs = check_collective_budget(
+        str(drifted),
+        traced={**traced, "sharded_schedule(new)": {"psum": 1}},
+    )
+    assert any("has no budget entry" in v.message for v in vs)
+
+    # a surface whose TRACE failed is exempt from the staleness check:
+    # the trace failure is its own finding, and "stale — regenerate"
+    # advice there would point at dropping the pin, not at the bug
+    vs = check_collective_budget(
+        str(drifted), traced=traced, failed={"ghost_surface"},
+    )
+    assert not any("`ghost_surface`" in v.message for v in vs), [
+        v.format() for v in vs
+    ]
+
+
+def test_checked_in_collective_budget_matches_traced_jaxprs():
+    """The acceptance pin: COLLECTIVE_BUDGET.json at the repo root
+    matches the traced jaxprs of every declared sharded surface, and
+    budgets every one of them (no ghosts, no gaps)."""
+    import json
+
+    from kubernetes_scheduler_tpu.analysis.contracts import (
+        COLLECTIVE_BUDGET_NAME,
+        traced_surface_counts,
+    )
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    doc = json.load(open(os.path.join(root, COLLECTIVE_BUDGET_NAME)))
+    traced = traced_surface_counts()
+    assert doc["surfaces"] == traced
 
 
 # ---- structural waivers (decorated defs, multi-line statements) -----------
@@ -1012,6 +1181,7 @@ def test_changed_vs_ref_maps_proto_to_bridge(monkeypatch):
         out = (
             "kubernetes_scheduler_tpu/bridge/schedule.proto\n"
             "kubernetes_scheduler_tpu/host/queue.py\n"
+            "COLLECTIVE_BUDGET.json\n"
             "README.md\n"
             if args[1] == "diff" else ""
         )
@@ -1025,6 +1195,8 @@ def test_changed_vs_ref_maps_proto_to_bridge(monkeypatch):
     assert "kubernetes_scheduler_tpu/bridge/server.py" in changed
     assert "kubernetes_scheduler_tpu/host/queue.py" in changed
     assert "README.md" not in changed
+    # a budget edit pulls the sharded surfaces it pins into scope
+    assert "kubernetes_scheduler_tpu/parallel/engine.py" in changed
 
 
 def test_changed_only_findings_subset_of_full(tmp_path, monkeypatch, capsys):
@@ -1054,6 +1226,34 @@ def test_changed_only_findings_subset_of_full(tmp_path, monkeypatch, capsys):
     # and the scoped run is non-trivial: the closure of the bridge
     # client reaches the host scheduler's waived boundary syncs
     assert any(p.startswith("kubernetes_scheduler_tpu/") for _, p, _ in changed)
+
+
+def test_changed_only_spmd_surfaces_wired():
+    """The new SPMD surfaces ride the changed-only machinery: a
+    parallel/ edit's closure contains the edited file, the contracts
+    SURFACE patterns match it (so a changed-only run re-traces the
+    sharded contracts + collective budget), and the spmd_mutants
+    harness file is itself on the surface. Changed-only ⊆ full-run is
+    already pinned family-independently above; this pins the surface
+    tuples the subset guarantee rides on for the sixteenth family."""
+    import fnmatch
+
+    from kubernetes_scheduler_tpu.analysis.contracts import SURFACE
+    from kubernetes_scheduler_tpu.analysis.core import (
+        reverse_dependency_closure,
+    )
+
+    engine_path = "kubernetes_scheduler_tpu/parallel/engine.py"
+    ctx = _full_ctx()
+    closure = reverse_dependency_closure(ctx, {engine_path})
+    assert engine_path in closure
+    for p in (
+        engine_path,
+        "kubernetes_scheduler_tpu/parallel/mesh.py",
+        "kubernetes_scheduler_tpu/analysis/spmd.py",
+        "kubernetes_scheduler_tpu/analysis/spmd_mutants.py",
+    ):
+        assert any(fnmatch.fnmatch(p, pat) for pat in SURFACE), p
 
 
 def test_changed_only_rejects_explicit_paths(capsys):

@@ -469,6 +469,84 @@ def test_lint_walltime_budget_e2e():
     )
 
 
+def test_spmd_lint_e2e(tmp_path):
+    """The SPMD layer's CI surface, end to end: one full-repo lint run
+    with all sixteen families + the contracts layer (sharded surfaces
+    traced through shard_map on the 8-device virtual mesh, the
+    COLLECTIVE_BUDGET.json gate, the seeded SPMD mutant harness) under
+    the existing wall-time budget, emitting a SARIF artifact that
+    validates and registers the new family; every seeded SPMD mutant
+    caught one by one, by the layer that owns its class; and
+    budget-file staleness failing loudly (a doctored budget must fail
+    the gate, and an exact copy must pass it)."""
+    import shutil
+
+    artifact = tmp_path / "spmd-lint.json"
+    env = {
+        **os.environ, "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+    }
+    proc = subprocess.run(
+        [sys.executable, "-m", "kubernetes_scheduler_tpu.analysis",
+         "--no-models", "--budget-seconds", "300",
+         "--json-artifact", str(artifact), "--format", "sarif"],
+        capture_output=True, text=True, timeout=400, cwd=REPO, env=env,
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    from kubernetes_scheduler_tpu.analysis.sarif import validate_sarif
+
+    doc = json.loads(proc.stdout)
+    validate_sarif(doc)
+    rule_ids = {r["id"] for r in doc["runs"][0]["tool"]["driver"]["rules"]}
+    assert "spmd-collective" in rule_ids
+    findings = json.loads(artifact.read_text())
+    assert all(f["waived"] for f in findings), [
+        f for f in findings if not f["waived"]
+    ]
+
+    # every seeded SPMD mutant caught one by one, by its declared layer
+    from kubernetes_scheduler_tpu.analysis.spmd_mutants import (
+        SPMD_MUTANTS,
+        check_spmd_mutants,
+        run_spmd_mutant,
+    )
+
+    assert set(SPMD_MUTANTS) == {
+        "dropped-psum", "wrong-axis", "replicated-double-count",
+        "extra-gather-over-budget",
+    }
+    for name, (_, _, expect) in SPMD_MUTANTS.items():
+        got = run_spmd_mutant(name)
+        for layer in expect:
+            assert got[layer], (name, layer)
+    # the extra-gather class is AST-silent by construction: only the
+    # budget gate has it — proof the budget adds teeth the AST lacks
+    assert run_spmd_mutant("extra-gather-over-budget")["ast"] == []
+    assert check_spmd_mutants() == []
+
+    # budget-file staleness fails loudly: a verbatim copy passes, a
+    # doctored count fails with a diff naming the drifted kind
+    from kubernetes_scheduler_tpu.analysis.contracts import (
+        COLLECTIVE_BUDGET_NAME,
+        check_collective_budget,
+        traced_surface_counts,
+    )
+
+    traced = traced_surface_counts()
+    committed = os.path.join(REPO, COLLECTIVE_BUDGET_NAME)
+    copy = tmp_path / "budget-copy.json"
+    shutil.copy(committed, copy)
+    assert check_collective_budget(str(copy), traced=traced) == []
+    doc = json.load(open(committed))
+    doc["surfaces"]["sharded_schedule(greedy)"]["all_gather"] += 1
+    stale = tmp_path / "budget-stale.json"
+    stale.write_text(json.dumps(doc))
+    vs = check_collective_budget(str(stale), traced=traced)
+    assert vs and any("all_gather" in v.message for v in vs), [
+        v.format() for v in vs
+    ]
+
+
 def test_model_check_e2e(tmp_path):
     """The `make model-check` CI surface, minus the shell: one run of
     the protocol-model layer — every shipped model's bounded state

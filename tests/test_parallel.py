@@ -1,0 +1,158 @@
+"""parallel/engine.py's dedicated tier-1 surface.
+
+Fast units pin the sharded factories' argument contracts (mesh-axis
+validation, assigner/knob clashes — errors that otherwise surface as
+shard_map tracebacks mid-dispatch), and the slow-marked e2e runs the
+sharded engine in a SUBPROCESS on an 8-device host-platform mesh (the
+multichip dryrun recipe: `XLA_FLAGS=--xla_force_host_platform_device_
+count=8` forced in the child's environment, independent of the parent
+harness) asserting sharded<->dense bitwise `node_idx` parity for the
+greedy, auction, and whole-backlog windows programs."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---- fast units: the factory argument contracts ---------------------------
+
+
+def test_mesh_specs_reject_unknown_axis():
+    from kubernetes_scheduler_tpu.parallel import (
+        make_mesh,
+        make_sharded_schedule_fn,
+    )
+
+    with pytest.raises(ValueError, match="lacks axes"):
+        make_sharded_schedule_fn(make_mesh(8), node_axes="bogus")
+
+
+def test_unknown_assigner_and_normalizer_rejected():
+    from kubernetes_scheduler_tpu.parallel import (
+        make_mesh,
+        make_sharded_schedule_fn,
+    )
+
+    with pytest.raises(ValueError, match="unknown assigner"):
+        make_sharded_schedule_fn(make_mesh(8), assigner="bogus")
+
+
+def test_score_plugins_clash_with_other_scorers():
+    # name deliberately avoids conftest's slow-pattern substrings
+    # ("fused" would silently deselect this sub-second unit from tier-1)
+    from kubernetes_scheduler_tpu.parallel import (
+        make_mesh,
+        make_sharded_schedule_fn,
+    )
+
+    mesh = make_mesh(8)
+    plugins = (("balanced_cpu_diskio", 1.0),)
+    with pytest.raises(ValueError, match="score_plugins"):
+        make_sharded_schedule_fn(
+            mesh, score_plugins=plugins, score_fn=lambda s, p: None
+        )
+    with pytest.raises(ValueError, match="score_plugins"):
+        make_sharded_schedule_fn(mesh, score_plugins=plugins, fused=True)
+
+
+def test_knob_wrapper_clamps_rounds_to_int32():
+    """A wire int64 rounds value means 'run to convergence' — the
+    wrapper must clamp instead of letting OverflowError surface as a
+    gRPC INTERNAL."""
+    from kubernetes_scheduler_tpu.parallel.engine import _with_auction_knobs
+
+    seen = {}
+
+    def fake_jfn(snapshot, pods, rounds, price_frac):
+        seen["rounds"] = int(rounds)
+        seen["price_frac"] = float(price_frac)
+        return None
+
+    call = _with_auction_knobs(fake_jfn, 1024, 1.0)
+    call(None, None, auction_rounds=2**40, auction_price_frac=0.5)
+    assert seen["rounds"] == 2**31 - 1
+    assert seen["price_frac"] == 0.5
+
+
+# ---- the subprocess e2e (slow-marked by name) -----------------------------
+
+_E2E_SCRIPT = """
+import json
+
+import numpy as np
+import jax
+
+from kubernetes_scheduler_tpu import engine
+from kubernetes_scheduler_tpu.parallel import make_mesh, make_sharded_schedule_fn
+from kubernetes_scheduler_tpu.parallel.engine import make_sharded_windows_fn
+
+rng = np.random.default_rng(7)
+n, p, r = 64, 24, 3
+snapshot = engine.make_snapshot(
+    allocatable=rng.integers(4000, 16000, (n, r)).astype(np.float32),
+    requested=rng.integers(0, 4000, (n, r)).astype(np.float32),
+    disk_io=rng.uniform(0, 50, n),
+    cpu_pct=rng.uniform(0, 100, n),
+    mem_pct=rng.uniform(0, 100, n),
+)
+pods = engine.make_pod_batch(
+    request=rng.integers(100, 3000, (p, r)).astype(np.float32),
+    r_io=rng.uniform(0, 40, p),
+    priority=rng.integers(0, 10, p),
+)
+mesh = make_mesh(8)
+out = {"devices": jax.device_count()}
+for name in ("greedy", "auction"):
+    dense = engine.schedule_batch(snapshot, pods, assigner=name)
+    sharded = make_sharded_schedule_fn(mesh, assigner=name)(snapshot, pods)
+    out[name] = {
+        "parity": np.asarray(sharded.node_idx).tolist()
+        == np.asarray(dense.node_idx).tolist(),
+        "n_assigned": int(sharded.n_assigned),
+    }
+windows = engine.stack_windows(pods, 8)
+# the established pairing (tests/test_engine.py): the sharded windows
+# scan ALWAYS evaluates (anti)affinity dynamically against live counts
+# and normalizes with global bounds, which corresponds to the dense
+# scan's affinity_aware=True + normalizer="none" configuration
+dense_w = engine.schedule_windows(
+    snapshot, windows, assigner="greedy", affinity_aware=True,
+    normalizer="none",
+)
+sharded_w = make_sharded_windows_fn(mesh, normalizer="min_max")(
+    snapshot, windows
+)
+out["windows"] = {
+    "parity": np.asarray(sharded_w.node_idx).tolist()
+    == np.asarray(dense_w.node_idx).tolist(),
+    "n_assigned": int(sharded_w.n_assigned),
+}
+print(json.dumps(out))
+"""
+
+
+def test_sharded_engine_subprocess_parity_e2e():
+    """The multichip dryrun recipe as a pinned test: a fresh process
+    with an 8-device host-platform topology runs the sharded engine
+    end to end; node_idx parity with the dense path must be BITWISE
+    for greedy, auction, and the whole-backlog windows program."""
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+    }
+    proc = subprocess.run(
+        [sys.executable, "-c", _E2E_SCRIPT],
+        capture_output=True, text=True, timeout=420, cwd=REPO, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    out = json.loads(proc.stdout.splitlines()[-1])
+    assert out["devices"] == 8, out
+    for name in ("greedy", "auction", "windows"):
+        assert out[name]["parity"], (name, out)
+        assert out[name]["n_assigned"] > 0, (name, out)

@@ -113,14 +113,20 @@ bench:
 	$(PY) bench.py
 
 # the bench path itself must not rot between rounds: the full bench.py
-# flow (engine headline, host loop incl. the pipelined and resident-
-# state/delta-upload variants, weighted multi-scorer) at toy sizes on
-# CPU — seconds of compute, all compiles. Same invocation
+# flow (engine headline, host loop incl. the pipelined, resident-
+# state/delta-upload, and mesh-SHARDED resident variants, weighted
+# multi-scorer) at toy sizes on CPU — seconds of compute, all
+# compiles. The forced 8-device host-platform topology (the multichip
+# dryrun recipe) gives the sharded rows a real mesh; same invocation
 # tests/test_bench_smoke.py wraps as a slow-marked test.
 bench-smoke:
-	env JAX_PLATFORMS=cpu BENCH_NODES=64 BENCH_PODS=128 BENCH_WINDOW=32 \
+	env JAX_PLATFORMS=cpu \
+	  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	  BENCH_NODES=64 BENCH_PODS=128 BENCH_WINDOW=32 \
 	  BENCH_REPS=2 BENCH_BASELINE_PODS=8 BENCH_LOOP_NODES=32 \
-	  BENCH_LOOP_PODS=64 BENCH_LOOP_SAMPLES=3 $(PY) bench.py
+	  BENCH_LOOP_PODS=64 BENCH_LOOP_SAMPLES=3 \
+	  BENCH_SHARDED_NODES=256 BENCH_SHARDED_PODS=96 \
+	  BENCH_CHURN_NODES=8 $(PY) bench.py
 
 # flight-recorder round trip on CPU: record a short sim-driven run (the
 # config pins the device path — tiny cycles would otherwise route to
@@ -223,7 +229,10 @@ obs-smoke:
 # change. tests/test_bench_smoke.py wraps the same flow as a
 # slow-marked test.
 PERF_GATE_DIR ?= /tmp/yoda-perf-gate
-PERF_GATE_ENV = env JAX_PLATFORMS=cpu BENCH_LOOP_NODES=32 BENCH_LOOP_PODS=64
+PERF_GATE_ENV = env JAX_PLATFORMS=cpu \
+  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  BENCH_LOOP_NODES=32 BENCH_LOOP_PODS=64 \
+  BENCH_SHARDED_NODES=64 BENCH_CHURN_NODES=8
 perf-gate:
 	rm -rf $(PERF_GATE_DIR)
 	mkdir -p $(PERF_GATE_DIR)

@@ -529,13 +529,51 @@ def _scenario_rate(name: str, short: str) -> dict:
     return out
 
 
+class _ChurnAdvisor:
+    """Metric-churn wrapper over a StaticAdvisor: every fetch perturbs a
+    FIXED-SIZE rotating slice of nodes' utilization series. The churn
+    size is independent of the cluster size, so the resident-delta
+    payload it induces (changed util rows) is too — the workload the
+    flat-bytes gate measures: per-cycle host->device delta bytes must
+    not grow with node count."""
+
+    def __init__(self, base, node_names, churn_nodes: int, seed: int = 7):
+        from kubernetes_scheduler_tpu.host.advisor import NodeUtil
+
+        self._NodeUtil = NodeUtil
+        self._base = base
+        self._names = list(node_names)
+        self._k = min(churn_nodes, len(self._names))
+        self._pos = 0
+        self._rng = np.random.default_rng(seed)
+
+    def fetch(self):
+        utils = dict(self._base.fetch())
+        for i in range(self._k):
+            name = self._names[(self._pos + i) % len(self._names)]
+            u = utils[name]
+            utils[name] = self._NodeUtil(
+                cpu_pct=float(min(u.cpu_pct + self._rng.uniform(0.1, 2.0), 100.0)),
+                mem_pct=u.mem_pct,
+                disk_io=float(min(u.disk_io + self._rng.uniform(0.01, 0.5), 50.0)),
+                net_up=u.net_up,
+                net_down=u.net_down,
+            )
+        self._pos = (self._pos + self._k) % max(len(self._names), 1)
+        self._base.utils = utils  # churn accumulates across cycles
+        return utils
+
+
 def loop_rate(
     *,
     n_pods: int | None = None,
+    n_nodes: int | None = None,
     max_windows: int = DEFAULT_LOOP_WINDOWS,
     pipeline_depth: int = 0,
     force_device: bool = False,
     resident: bool = False,
+    sharded: bool = False,
+    churn_nodes: int = 0,
     metric_suffix: str = "",
     trace_path: str | None = None,
     span_path: str | None = None,
@@ -568,7 +606,8 @@ def loop_rate(
     from kubernetes_scheduler_tpu.sim.host_gen import gen_host_cluster, gen_host_pods
     from kubernetes_scheduler_tpu.utils.config import SchedulerConfig
 
-    n_nodes = int(os.environ.get("BENCH_LOOP_NODES", 4000))
+    if n_nodes is None:
+        n_nodes = int(os.environ.get("BENCH_LOOP_NODES", 4000))
     if n_pods is None:
         # BENCH_LOOP_PODS names the DEFAULT (8-window) backlog size; the
         # deep variant scales it so an override keeps the configurations
@@ -587,12 +626,18 @@ def loop_rate(
     # paying the real per-cycle costs (snapshot re-sum over every
     # running pod, cold pod-side caches for newly arrived pods).
     nodes, advisor = gen_host_cluster(n_nodes, seed=0)
+    if churn_nodes:
+        advisor = _ChurnAdvisor(
+            advisor, [nd.name for nd in nodes], churn_nodes
+        )
     running: list = []
     extra = (
         {"adaptive_dispatch": False, "min_device_work": 1}
         if force_device
         else {}
     )
+    if sharded:
+        extra["sharded_engine"] = True
     if fused_kernel is not None:
         # the fused/unfused A-B knob (host_loop_*_fused): everything
         # else identical, only the feature gate moves
@@ -769,7 +814,123 @@ def loop_rate(
             delta_bytes_saved=saved,
             snapshot_upload_bytes=(deltas + fulls) * snap_bytes - saved,
         )
+    if sharded:
+        # mesh-sharded observability: the per-cycle routed delta payload
+        # (summed over shards — the total host->device bytes a delta
+        # cycle ships) and its worst single shard. The flat-bytes gate
+        # compares shard_delta_bytes_per_cycle across node scales.
+        delta_cycles = [c for c in cycles if c.shard_delta_bytes]
+        per_cycle = [float(sum(c.shard_delta_bytes)) for c in delta_cycles]
+        out["mesh_devices"] = int(getattr(sched.engine, "n_shards", 1))
+        out["sharded_cycles"] = int(sum(c.sharded_cycles for c in cycles))
+        out["shard_delta_bytes_per_cycle"] = (
+            round(float(np.mean(per_cycle)), 1) if per_cycle else 0.0
+        )
+        out["shard_delta_bytes_max_shard"] = (
+            int(max(max(c.shard_delta_bytes) for c in delta_cycles))
+            if delta_cycles
+            else 0
+        )
     return out
+
+
+def _sharded_loop_rate() -> list[dict]:
+    """The 100k-node mesh-sharded host loop (host_loop_100000nodes):
+    config.sharded_engine + resident_state on a metric-churn workload
+    (a fixed-size rotating slice of nodes changes utilization every
+    fetch — the workload whose resident deltas must stay FLAT as the
+    cluster grows). Emits the 100k row plus a reference row at a tenth
+    the nodes; the 100k row carries flat_bytes_ratio = its per-cycle
+    routed delta payload over the reference's — the gate is <= 2x
+    (asserted at compressed scale in tests/test_bench_smoke.py; at
+    real scale the ratio rides the artifact)."""
+    n_nodes = int(os.environ.get("BENCH_SHARDED_NODES", 100_000))
+    n_pods = int(
+        os.environ.get("BENCH_LOOP_PODS", 1024 * DEFAULT_LOOP_WINDOWS)
+    )
+    churn = int(os.environ.get("BENCH_CHURN_NODES", 256))
+    kw = dict(
+        n_pods=n_pods, max_windows=1, pipeline_depth=1, force_device=True,
+        resident=True, sharded=True, churn_nodes=churn,
+    )
+    ref = loop_rate(
+        n_nodes=max(n_nodes // 10, 8), metric_suffix="_sharded_ref", **kw
+    )
+    out = loop_rate(n_nodes=n_nodes, **kw)
+    out["ref_shard_delta_bytes_per_cycle"] = ref[
+        "shard_delta_bytes_per_cycle"
+    ]
+    if ref["shard_delta_bytes_per_cycle"]:
+        out["flat_bytes_ratio"] = round(
+            out["shard_delta_bytes_per_cycle"]
+            / ref["shard_delta_bytes_per_cycle"],
+            3,
+        )
+    return [ref, out]
+
+
+def _sharded_throughput() -> dict:
+    """The 100k-node engine headline (scheduling_throughput_100000nodes):
+    the whole 50k-pod backlog as ONE mesh-sharded device program
+    (make_sharded_windows_fn — the node axis sharded over every visible
+    device, capacity/affinity carries threaded between windows on
+    device), measured pipelined like tpu_rate. The ROADMAP's "millions
+    of users" scale step: 100k nodes x 50k pending pods in one
+    device-resident assignment problem."""
+    import jax
+    from kubernetes_scheduler_tpu.engine import stack_windows
+    from kubernetes_scheduler_tpu.parallel import (
+        make_mesh,
+        make_sharded_windows_fn,
+        sharded_device_count,
+    )
+    from kubernetes_scheduler_tpu.sim import gen_cluster, gen_pods
+    from kubernetes_scheduler_tpu.utils.padding import pad_pod_batch
+
+    n_nodes = int(os.environ.get("BENCH_SHARDED_NODES", 100_000))
+    n_pods = int(os.environ.get("BENCH_SHARDED_PODS", 50_000))
+    window = min(WINDOW, max(8, n_pods))
+    d = sharded_device_count()
+    n_nodes -= n_nodes % d  # keep the node axis mesh-divisible
+    mesh = make_mesh(d)
+    snapshot = gen_cluster(n_nodes, seed=0)
+    pods = gen_pods(n_pods, seed=1)
+    n_padded = -(-n_pods // window) * window
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from kubernetes_scheduler_tpu.parallel.mesh import NODE_AXIS
+
+    node = NamedSharding(mesh, P(NODE_AXIS))
+    rep = NamedSharding(mesh, P())
+    snapshot = jax.device_put(
+        snapshot, type(snapshot)(*[node] * len(snapshot))
+    )
+    pods_w_host = stack_windows(pad_pod_batch(pods, n_padded), window)
+    pods_w = jax.device_put(
+        pods_w_host, type(pods_w_host)(*[rep] * len(pods_w_host))
+    )
+    fn = make_sharded_windows_fn(
+        mesh, assigner="auction", normalizer="none", fused=FUSED,
+        auction_price_frac=PRICE_FRAC,
+    )
+    out = fn(snapshot, pods_w)
+    assigned = int(out.n_assigned)
+    if assigned == 0:
+        raise RuntimeError("sharded benchmark scheduled zero pods")
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        out = fn(snapshot, pods_w)
+    if int(out.n_assigned) <= 0:
+        raise RuntimeError("timed sharded run scheduled zero pods")
+    dt = time.perf_counter() - t0
+    rate = REPS * n_pods / dt
+    return {
+        "metric": f"scheduling_throughput_{n_nodes}nodes",
+        "value": round(rate, 1),
+        "unit": "pods/s",
+        "mesh_devices": d,
+        "pods": n_pods,
+        "assigned": assigned,
+    }
 
 
 _PROBE_SRC = (
@@ -849,18 +1010,39 @@ def main():
         # (e.g. an interpreter-mode kernel sneaking onto the CPU path)
         # fails the build loudly, per stage, with numbers attached
         out_dir = sys.argv[sys.argv.index("--perf-gate-spans") + 1]
+        n_pods = int(
+            os.environ.get("BENCH_LOOP_PODS", 1024 * DEFAULT_LOOP_WINDOWS)
+        )
         print(
             json.dumps(
                 loop_rate(
-                    n_pods=int(
-                        os.environ.get(
-                            "BENCH_LOOP_PODS", 1024 * DEFAULT_LOOP_WINDOWS
-                        )
-                    ),
+                    n_pods=n_pods,
                     max_windows=1,
                     pipeline_depth=1,
                     force_device=True,
                     metric_suffix="_perfgate",
+                    span_path=out_dir,
+                )
+            ),
+            flush=True,
+        )
+        # the mesh-sharded resident drain writes into the SAME span
+        # directory, so the committed baseline (and the gate diffing
+        # against it) covers the sharded path's stage costs too —
+        # a regression in the shard_map program or the routed delta
+        # fold moves engine_step/delta_derive like any other
+        print(
+            json.dumps(
+                loop_rate(
+                    n_pods=n_pods,
+                    n_nodes=int(os.environ.get("BENCH_SHARDED_NODES", 4000)),
+                    max_windows=1,
+                    pipeline_depth=1,
+                    force_device=True,
+                    resident=True,
+                    sharded=True,
+                    churn_nodes=int(os.environ.get("BENCH_CHURN_NODES", 64)),
+                    metric_suffix="_perfgate_sharded",
                     span_path=out_dir,
                 )
             ),
@@ -874,6 +1056,12 @@ def main():
         print(json.dumps(pipe))
         print(json.dumps(_fused_loop_rate()))
         print(json.dumps(_resident_loop_rate()))
+        # the mesh-sharded resident loop at the 100k-node scale (plus
+        # its tenth-scale flat-bytes reference) and the 100k x 50k
+        # sharded engine headline
+        for row in _sharded_loop_rate():
+            print(json.dumps(row), flush=True)
+        print(json.dumps(_sharded_throughput()), flush=True)
         print(json.dumps(_replay_loop_rate()))
         tel, attrib = _telemetry_loop_rate(pipe)
         print(json.dumps(tel))
@@ -943,6 +1131,12 @@ def main():
         # device-resident cluster state with epoch-validated delta
         # uploads, measured against the same cluster/backlog shape
         print(json.dumps(_resident_loop_rate()), flush=True)
+        # the mesh-sharded resident loop at the 100k-node scale (with
+        # the flat-bytes reference) and the sharded engine headline:
+        # 100k nodes x 50k pods in one device-resident program
+        for row in _sharded_loop_rate():
+            print(json.dumps(row), flush=True)
+        print(json.dumps(_sharded_throughput()), flush=True)
         # flight recorder on, then replay-from-trace: perf from a
         # captured workload + bitwise binding parity (binding_diffs=0)
         print(json.dumps(_replay_loop_rate()), flush=True)

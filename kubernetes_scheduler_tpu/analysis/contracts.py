@@ -74,6 +74,9 @@ SURFACE = (
     "kubernetes_scheduler_tpu/analysis/contracts.py",
     "kubernetes_scheduler_tpu/analysis/spmd.py",
     "kubernetes_scheduler_tpu/analysis/spmd_mutants.py",
+    # the sharded-resident delta router lives host-side; its edits can
+    # drift the stacked-delta layout the sharded appliers trace against
+    "kubernetes_scheduler_tpu/host/snapshot.py",
 )
 
 
@@ -297,6 +300,13 @@ COLLECTIVE_KINDS = ("psum", "pmax", "pmin", "all_gather", "axis_index")
 SHARDED_CONTRACT_NAMES = (
     "sharded_schedule(greedy)", "sharded_schedule(auction)",
     "sharded_windows(greedy)", "sharded_windows(auction)",
+    # the sharded-RESIDENT surfaces (parallel/engine.ShardedEngine's
+    # production path): the fused megakernel step fed by retained
+    # per-shard kernel-layout buffers, and the per-shard donated folds
+    "sharded_schedule(fused)",
+    "sharded_apply_delta",
+    "sharded_build_layout",
+    "sharded_apply_layout_delta",
 )
 
 
@@ -343,6 +353,92 @@ def sharded_surfaces(mesh) -> dict:
             mesh, assigner="auction"
         ),
     }
+
+
+def sharded_resident_surfaces(mesh) -> dict:
+    """name -> built sharded-RESIDENT surface: the programs
+    parallel/engine.ShardedEngine dispatches per cycle — the fused
+    megakernel step taking retained per-shard kernel-layout buffers
+    (built at the production knobs: auction assigner, normalizer
+    "none", the sharded fused contract), and the donated per-shard
+    delta/layout folds plus the one-per-upload layout build."""
+    from kubernetes_scheduler_tpu.parallel.engine import (
+        make_sharded_apply_delta_fn,
+        make_sharded_apply_layout_fn,
+        make_sharded_build_layout_fn,
+        make_sharded_schedule_fn,
+    )
+
+    return {
+        "sharded_schedule(fused)": make_sharded_schedule_fn(
+            mesh, assigner="auction", normalizer="none", fused=True,
+            resident_layout=True,
+        ),
+        "sharded_apply_delta": make_sharded_apply_delta_fn(mesh),
+        "sharded_build_layout": make_sharded_build_layout_fn(mesh),
+        "sharded_apply_layout_delta": make_sharded_apply_layout_fn(mesh),
+    }
+
+
+def _stacked_delta_spec(g, d: int):
+    """Spec of a stacked per-shard delta (parallel/engine.
+    stack_shard_deltas): every dense-delta leaf with a leading [D]
+    shard axis, rows in shard-local coordinates, node_mask reshaped
+    [D, n_local]. k=8 is _rows_padded's floor bucket."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubernetes_scheduler_tpu import engine
+
+    def sds(shape, dtype):
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+    n, r, s, k = g["n"], g["r"], g["s"], 8
+    return engine.SnapshotDelta(
+        req_rows=sds((d, k), jnp.int32),
+        req_vals=sds((d, k, r), jnp.float32),
+        util_rows=sds((d, k), jnp.int32),
+        util_vals=sds((d, k, 5), jnp.float32),
+        dom_rows=sds((d, k), jnp.int32),
+        dom_vals=sds((d, k, s, 4), jnp.float32),
+        node_mask=sds((d, n // d), jnp.bool_),
+    )
+
+
+def sharded_layout_spec(g, d: int):
+    """The declared sharded kernel-layout padding formula: each shard
+    TILE-pads ITS n_local columns, so the global column axis is
+    D * roundup(n/D, TILE_N) — NOT the dense roundup(n, TILE_N)."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubernetes_scheduler_tpu import engine
+    from kubernetes_scheduler_tpu.ops import pallas_fused
+
+    def sds(shape, dtype):
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+    nn = d * (-(-(g["n"] // d) // pallas_fused.TILE_N) * pallas_fused.TILE_N)
+    return engine.FusedLayout(
+        node_ft=sds((3, nn), jnp.float32),
+        alloc_t=sds((g["r"], nn), jnp.float32),
+        reqd_t=sds((g["r"], nn), jnp.float32),
+    )
+
+
+def _resident_surface_args(name: str, mesh, g) -> tuple:
+    """Trace-time argument specs for one sharded-resident surface."""
+    d = int(mesh.size)
+    snap, pods, _ = _sharded_inputs(g)
+    if name == "sharded_schedule(fused)":
+        return (snap, pods, sharded_layout_spec(g, d))
+    if name == "sharded_apply_delta":
+        return (snap, _stacked_delta_spec(g, d))
+    if name == "sharded_build_layout":
+        return (snap,)
+    if name == "sharded_apply_layout_delta":
+        return (sharded_layout_spec(g, d), _stacked_delta_spec(g, d))
+    raise KeyError(name)
 
 
 def collective_counts(fn, *args) -> dict:
@@ -400,6 +496,10 @@ def traced_surface_counts(mesh=None) -> dict:
     for name, fn in sharded_surfaces(mesh).items():
         args = (snap, pods_w) if "windows" in name else (snap, pods)
         out[name] = collective_counts(fn, *args)
+    for name, fn in sharded_resident_surfaces(mesh).items():
+        out[name] = collective_counts(
+            fn, *_resident_surface_args(name, mesh, g)
+        )
     return out
 
 
@@ -539,6 +639,7 @@ def check_sharded_contracts() -> list[Violation]:
     divisor = node_axis_divisor(mesh)
     try:
         surfaces = sharded_surfaces(mesh)
+        resident = sharded_resident_surfaces(mesh)
     except Exception as e:  # noqa: BLE001
         return [Violation(
             RULE, PARALLEL_PATH, 1,
@@ -584,6 +685,40 @@ def check_sharded_contracts() -> list[Violation]:
                     RULE, PARALLEL_PATH, 1,
                     f"{tag} sharded/dense drift: {msg.replace('declared', 'dense')}",
                 ))
+        # sharded-RESIDENT surfaces: the fused step must present the
+        # dense ScheduleResult spec; the donated folds must be spec-
+        # preserving leaf for leaf (like apply_snapshot_delta/
+        # apply_layout_delta); the layout build must honor the declared
+        # per-shard padding formula
+        lay_want = sharded_layout_spec(g, divisor)
+        resident_want = {
+            "sharded_schedule(fused)": (
+                dense["batch"], engine.ScheduleResult._fields,
+            ),
+            "sharded_apply_delta": (snap, engine.SnapshotArrays._fields),
+            "sharded_build_layout": (lay_want, engine.FusedLayout._fields),
+            "sharded_apply_layout_delta": (
+                lay_want, engine.FusedLayout._fields,
+            ),
+        }
+        for name, fn in resident.items():
+            want, fnames = resident_want[name]
+            try:
+                got = jax.eval_shape(
+                    fn, *_resident_surface_args(name, mesh, g)
+                )
+            except Exception as e:  # noqa: BLE001
+                out.append(Violation(
+                    RULE, PARALLEL_PATH, 1,
+                    f"{name} {tag}: eval_shape through shard_map "
+                    f"failed: {e}",
+                ))
+                continue
+            for msg in _leaf_mismatches(name, got, want, fnames):
+                out.append(Violation(
+                    RULE, PARALLEL_PATH, 1,
+                    f"{tag} sharded-resident drift: {msg}",
+                ))
     # the divisibility formula must also predict FAILURE: a node count
     # the formula rejects must actually fail to trace (D == 1 divides
     # everything — nothing to predict)
@@ -613,6 +748,17 @@ def check_sharded_contracts() -> list[Violation]:
         args = (snap, pods_w) if "windows" in name else (snap, pods)
         try:
             traced[name] = collective_counts(fn, *args)
+        except Exception as e:  # noqa: BLE001
+            failed.add(name)
+            out.append(Violation(
+                BUDGET_RULE, PARALLEL_PATH, 1,
+                f"tracing `{name}` for the collective budget failed: {e}",
+            ))
+    for name, fn in resident.items():
+        try:
+            traced[name] = collective_counts(
+                fn, *_resident_surface_args(name, mesh, g0)
+            )
         except Exception as e:  # noqa: BLE001
             failed.add(name)
             out.append(Violation(

@@ -106,6 +106,7 @@ class EngineService:
         span_path: str | None = None,
         profile_path: str | None = None,
         step_slo_ms: float = 0.0,
+        mesh_devices: int = 0,
     ):
         # serve a custom engine (e.g. models.learned.LearnedEngine) on
         # the dense branch instead of the module-level heuristic engine;
@@ -178,6 +179,22 @@ class EngineService:
             "resident_applies_total",
             "Resident-state cluster uploads applied (delta vs full)",
             labels=("upload",),
+        )
+        # mesh-sharded serving (--mesh-devices > 1): the sidecar twins
+        # of the host's sharded counters — RPCs served by the sharded
+        # program, and each applied delta's routed per-shard payload
+        # split (what each mesh shard's rows cost on the wire)
+        self.mesh_devices = int(mesh_devices)
+        self.metrics_sharded = observe.Counter(
+            "sharded_cycles_total",
+            "RPCs served by this sidecar's mesh-sharded engine",
+            labels=("rpc",),
+        )
+        self.metrics_shard_bytes = observe.Counter(
+            "shard_delta_bytes_total",
+            "Routed SnapshotDelta payload bytes per owning node shard "
+            "(mesh-sharded resident sessions)",
+            labels=("shard",),
         )
         self.metrics_sessions = observe.Gauge(
             "resident_sessions_count",
@@ -322,6 +339,8 @@ class EngineService:
             self.metrics_resident,
             self.metrics_sessions,
             self.metrics_gang_masked,
+            self.metrics_sharded,
+            self.metrics_shard_bytes,
             self.metrics_slo,
         ]
         out = []
@@ -408,6 +427,34 @@ class EngineService:
             with self._lock:
                 self.resident_deltas_served += 1
             self.metrics_resident.inc(upload="delta")
+            if self.mesh_devices > 1 and (
+                delta.node_mask.shape[0] % self.mesh_devices == 0
+            ):
+                # per-shard routed payload split of the delta just
+                # applied — the sidecar twin of the host's
+                # shard_delta_bytes{shard} accounting, measured the
+                # SAME way (prev-mask probe for mask-only shards;
+                # steady-state mask bytes excluded — the retained mask
+                # plane ships nothing, ShardedEngine._fold_delta)
+                import numpy as _np
+
+                from kubernetes_scheduler_tpu.engine import snapshot_nbytes
+                from kubernetes_scheduler_tpu.host.snapshot import (
+                    shard_snapshot_delta,
+                )
+
+                prev_mask = _np.asarray(st["snapshot"].node_mask, bool)
+                mask_changed = not _np.array_equal(
+                    prev_mask, _np.asarray(delta.node_mask, bool)
+                )
+                for shard, routed in shard_snapshot_delta(
+                    delta, self.mesh_devices, prev_node_mask=prev_mask
+                ).items():
+                    self.metrics_shard_bytes.inc(
+                        snapshot_nbytes(routed)
+                        - (0 if mask_changed else routed.node_mask.nbytes),
+                        shard=str(shard),
+                    )
         else:
             snapshot = codec.unpack_fields(
                 engine.SnapshotArrays, request.snapshot, cache=snap_cache
@@ -501,6 +548,7 @@ class EngineService:
                         lambda: fn(snapshot, pods, **_auction_kw(request)),
                         tid,
                     )
+                    self.metrics_sharded.inc(rpc="schedule_batch")
                 else:
                     kw = _auction_kw(request)
                     sp = _score_plugins(request)
@@ -594,6 +642,7 @@ class EngineService:
                         ),
                         tid,
                     )
+                    self.metrics_sharded.inc(rpc="schedule_windows")
                 else:
                     kw = _auction_kw(request)
                     sp = _score_plugins(request)
@@ -704,6 +753,7 @@ def make_server(
     span_path: str | None = None,
     profile_path: str | None = None,
     step_slo_ms: float = 0.0,
+    mesh_devices: int = 0,
 ) -> tuple[grpc.Server, int, EngineService]:
     """Build (server, bound_port, service). Device access stays
     single-writer regardless of max_workers (EngineService._device_lock
@@ -720,6 +770,7 @@ def make_server(
         span_path=span_path,
         profile_path=profile_path,
         step_slo_ms=step_slo_ms,
+        mesh_devices=mesh_devices,
     )
     handlers = grpc.method_handlers_generic_handler(
         SERVICE,
@@ -1000,6 +1051,7 @@ def main(argv=None):
         span_path=args.span_path,
         profile_path=args.profile_path,
         step_slo_ms=args.step_slo_ms,
+        mesh_devices=args.mesh_devices if sharded_fn is not None else 0,
     )
     exporter = None
     if args.metrics_port:

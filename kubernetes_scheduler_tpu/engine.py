@@ -484,16 +484,13 @@ class SnapshotDelta(NamedTuple):
     node_mask: jnp.ndarray  # [n] bool (cheap; shipped whole every delta)
 
 
-@functools.partial(jax.jit, donate_argnums=(0,))
-def apply_snapshot_delta(
+def _apply_delta_rows(
     snapshot: SnapshotArrays, delta: SnapshotDelta
 ) -> SnapshotArrays:
-    """Fold a SnapshotDelta into the device-resident snapshot in place:
-    the snapshot tree is DONATED, so in the common case no [n, r] matrix
-    crosses the host<->device boundary and XLA reuses the resident
-    buffers for the output. Callers must drop every reference to the
-    donated tree and hold only the returned one (graftlint's dtype-shape
-    family flags a donated leaf that is re-read)."""
+    """The row-scatter body shared by the dense `apply_snapshot_delta`
+    and the mesh-sharded per-shard applier (parallel/engine.py's
+    make_sharded_apply_delta_fn): ONE definition, so a sharded shard's
+    fold is bitwise the dense fold restricted to its rows."""
     return snapshot._replace(
         requested=snapshot.requested.at[delta.req_rows].set(
             delta.req_vals, mode="drop"
@@ -527,6 +524,19 @@ def apply_snapshot_delta(
         ),
         node_mask=delta.node_mask,
     )
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def apply_snapshot_delta(
+    snapshot: SnapshotArrays, delta: SnapshotDelta
+) -> SnapshotArrays:
+    """Fold a SnapshotDelta into the device-resident snapshot in place:
+    the snapshot tree is DONATED, so in the common case no [n, r] matrix
+    crosses the host<->device boundary and XLA reuses the resident
+    buffers for the output. Callers must drop every reference to the
+    donated tree and hold only the returned one (graftlint's dtype-shape
+    family flags a donated leaf that is re-read)."""
+    return _apply_delta_rows(snapshot, delta)
 
 
 def apply_snapshot_delta_np(snapshot: SnapshotArrays, delta: SnapshotDelta):
@@ -625,15 +635,12 @@ def build_fused_layout(snapshot: SnapshotArrays) -> FusedLayout:
     return FusedLayout(node_ft=node_ft, alloc_t=alloc_t, reqd_t=reqd_t)
 
 
-@functools.partial(jax.jit, donate_argnums=(0,))
-def apply_layout_delta(layout: FusedLayout, delta: SnapshotDelta) -> FusedLayout:
-    """Fold a SnapshotDelta into the retained kernel-layout buffers in
-    place (donated, like apply_snapshot_delta): changed `requested` rows
-    become column writes into reqd_t, utilization rows become u/v cell
-    writes (the same divisor expressions utilization_stats applies, on
-    the same row values — bitwise what a re-prep would produce), and the
-    node-mask row is refreshed whole. `allocatable` never rides a delta,
-    so alloc_t passes through untouched."""
+def _apply_layout_rows(layout: FusedLayout, delta: SnapshotDelta) -> FusedLayout:
+    """The kernel-layout fold body shared by the dense
+    `apply_layout_delta` and the mesh-sharded per-shard applier — the
+    delta's row space and the layout's column space are whatever the
+    caller shards them to (dense: global; sharded: one shard's slice),
+    so the per-shard fold is bitwise the dense fold on its columns."""
     from kubernetes_scheduler_tpu.ops.stats import (
         CPU_DIVISOR,
         DISK_IO_DIVISOR,
@@ -661,6 +668,18 @@ def apply_layout_delta(layout: FusedLayout, delta: SnapshotDelta) -> FusedLayout
         delta.req_vals.T, mode="drop"
     )
     return FusedLayout(node_ft=node_ft, alloc_t=layout.alloc_t, reqd_t=reqd_t)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def apply_layout_delta(layout: FusedLayout, delta: SnapshotDelta) -> FusedLayout:
+    """Fold a SnapshotDelta into the retained kernel-layout buffers in
+    place (donated, like apply_snapshot_delta): changed `requested` rows
+    become column writes into reqd_t, utilization rows become u/v cell
+    writes (the same divisor expressions utilization_stats applies, on
+    the same row values — bitwise what a re-prep would produce), and the
+    node-mask row is refreshed whole. `allocatable` never rides a delta,
+    so alloc_t passes through untouched."""
+    return _apply_layout_rows(layout, delta)
 
 
 class ResidentMismatch(RuntimeError):
@@ -860,17 +879,15 @@ class LocalEngine:
         a full upload regardless of what the host sends."""
         self._resident = None
 
-    def schedule_resident(
-        self, snapshot, pods, *, delta=None, epoch=0, **kw
-    ) -> "ScheduleResult":
-        """Schedule against device-resident cluster state. `snapshot` is
-        ALWAYS the full host build (the fallback payload); when `delta`
-        is given and matches the retained epoch/shape it is applied by
-        the jitted donated-buffer apply_snapshot_delta instead — no
-        [n, r] matrix crosses the host<->device boundary. Any mismatch
-        (engine restart, epoch desync, layout churn) transparently
-        degrades to a full upload of `snapshot`; `resident_used_delta`
-        reports which path served the call."""
+    def _resident_dispatch(self, snapshot, delta, epoch: int, kw: dict):
+        """Shared resident front half of schedule_resident and
+        schedule_windows_resident (ONE implementation, so the two
+        surfaces cannot drift on accept/fold/flush or layout-injection
+        semantics — the same factoring ShardedEngine uses): fold an
+        applicable delta into the retained state, flush to a full
+        upload otherwise, and on fused paths inject the retained
+        kernel layout (built on first need, delta-folded thereafter).
+        Returns (state, kw)."""
         st = self._resident
         if delta is not None and st is not None and st.accepts(delta, epoch):
             new_snap = apply_snapshot_delta(st.snapshot, delta)
@@ -892,9 +909,23 @@ class LocalEngine:
             if st.layout is None:
                 st.layout = build_fused_layout(st.snapshot)
             kw = dict(kw, layout=st.layout)
+        return st, kw
+
+    def schedule_resident(
+        self, snapshot, pods, *, delta=None, epoch=0, **kw
+    ) -> "ScheduleResult":
+        """Schedule against device-resident cluster state. `snapshot` is
+        ALWAYS the full host build (the fallback payload); when `delta`
+        is given and matches the retained epoch/shape it is applied by
+        the jitted donated-buffer apply_snapshot_delta instead — no
+        [n, r] matrix crosses the host<->device boundary. Any mismatch
+        (engine restart, epoch desync, layout churn) transparently
+        degrades to a full upload of `snapshot`; `resident_used_delta`
+        reports which path served the call."""
+        st, kw = self._resident_dispatch(snapshot, delta, epoch, kw)
         return self._maybe_profile(
             lambda: schedule_batch(
-                self._resident.snapshot, self._consts.swap(pods), **kw
+                st.snapshot, self._consts.swap(pods), **kw
             )
         )
 
@@ -940,23 +971,16 @@ class LocalEngine:
         capacity/affinity carries stay internal to the call; the
         retained state remains the PRE-backlog snapshot, exactly as the
         host's delta base accounting assumes."""
-        st = self._resident
-        if delta is not None and st is not None and st.accepts(delta, epoch):
-            new_snap = apply_snapshot_delta(st.snapshot, delta)
-            st.snapshot = new_snap
-            if st.layout is not None:
-                # keep the kernel-layout twin current for interleaved
-                # single-window fused cycles (the scan itself re-preps —
-                # its per-window `requested` carry cannot ride a layout)
-                st.layout = apply_layout_delta(st.layout, delta)
-            st.epoch = epoch
-            self.resident_used_delta = True
-        else:
-            self._resident = ResidentState(jax.device_put(snapshot), epoch)
-            self.resident_used_delta = False
+        # shared front half with schedule_resident; on fused paths the
+        # injected layout makes the scan reuse the retained node_ft/
+        # alloc_t and rebuild only the reqd_t leaf per window from its
+        # capacity carry (prep_requested) — the PR-8 "scan still
+        # re-preps" cost is gone; bitwise the re-prep path (PARITY
+        # round 15)
+        st, kw = self._resident_dispatch(snapshot, delta, epoch, kw)
         return self._maybe_profile(
             lambda: schedule_windows(
-                self._resident.snapshot,
+                st.snapshot,
                 self._consts.swap(pods_windows),
                 **kw,
             )
@@ -1629,6 +1653,7 @@ def schedule_windows(
     auction_rounds: int = 1024,
     auction_price_frac: float = 1.0,
     score_plugins: tuple | None = None,
+    layout: FusedLayout | None = None,
 ) -> WindowsResult:
     """Schedule many windows in ONE device program: lax.scan over the
     window axis, carrying node capacity AND (anti)affinity domain counts
@@ -1650,15 +1675,35 @@ def schedule_windows(
     near-ties). Skipping normalization saves a [p, n] pass per window;
     pass "min_max"/"softmax" to reproduce schedule_batch's configuration
     exactly.
+
+    layout: optional FusedLayout (fused=True only) carried THROUGH the
+    scan: node_ft and alloc_t are static across a backlog (utilization
+    series and allocatable never change mid-dispatch), so every window
+    reuses the retained buffers and only reqd_t — the one leaf the
+    capacity carry moves — is rebuilt per window (prep_requested, the
+    same expression prep_node_operands applies). Resident multi-window
+    cycles thus skip the full per-window prep_node_operands the PR-8
+    scan still paid; bindings are bitwise the re-prep path's
+    (tests/test_pallas.py pins it).
     """
+    if layout is not None and not fused:
+        raise ValueError("layout requires fused=True (kernel-layout buffers)")
 
     def cycle(snap, w):
+        lay = None
+        if layout is not None:
+            from kubernetes_scheduler_tpu.ops.pallas_fused import (
+                prep_requested,
+            )
+
+            lay = layout._replace(reqd_t=prep_requested(snap.requested))
         return schedule_batch(
             snap, w, policy=policy, assigner=assigner, normalizer=normalizer,
             fused=fused, affinity_aware=affinity_aware, soft=soft,
             auction_rounds=auction_rounds,
             auction_price_frac=auction_price_frac,
             score_plugins=score_plugins,
+            layout=lay,
         )
 
     return run_windows_scan(snapshot, pods_windows, cycle)

@@ -99,6 +99,13 @@ def summarize(metrics, totals: dict | None = None) -> dict:
             "delta_bytes_saved": sum(
                 getattr(m, "delta_bytes_saved", 0) for m in cycles
             ),
+            "sharded_cycles": sum(
+                getattr(m, "sharded_cycles", 0) for m in cycles
+            ),
+            "shard_delta_bytes": sum(
+                sum(getattr(m, "shard_delta_bytes", ()) or ())
+                for m in cycles
+            ),
             "gangs_admitted": sum(
                 getattr(m, "gangs_admitted", 0) for m in cycles
             ),
@@ -135,6 +142,11 @@ def summarize(metrics, totals: dict | None = None) -> dict:
         "delta_uploads_total": totals.get("delta_uploads", 0),
         "full_uploads_total": totals.get("full_uploads", 0),
         "delta_bytes_saved_total": totals.get("delta_bytes_saved", 0),
+        # mesh-sharded engine (config.sharded_engine): device cycles
+        # served shard-local across the mesh — the per-shard routed
+        # byte split rides the {shard}-labeled shard_delta_bytes_total
+        # counter (Scheduler.ctr_shard_bytes) beside this aggregate
+        "sharded_cycles_total": totals.get("sharded_cycles", 0),
         # gang co-scheduling (config.gang_scheduling; ops/gang.py):
         # all-or-nothing admissions, unit deferrals, and the tentative
         # placements the rule rescinded — deferred/admitted is the
@@ -168,6 +180,7 @@ _HELP = {
     "delta_uploads_total": "Resident-state cycles served by a SnapshotDelta applied on the engine",
     "full_uploads_total": "Resident-state cycles that shipped the full snapshot (first upload, churn, or flush)",
     "delta_bytes_saved_total": "Snapshot payload bytes delta uploads avoided shipping to the engine",
+    "sharded_cycles_total": "Device cycles served by the mesh-sharded engine (config.sharded_engine)",
     "gangs_admitted_total": "Gangs whose every member bound in one cycle (all-or-nothing admission)",
     "gangs_deferred_total": "Gangs requeued as a unit (members missing, partial device fit, or a scalar-fallback cycle)",
     "gang_pods_masked_total": "Tentative placements rescinded by the gang all-or-nothing rule",
@@ -218,6 +231,7 @@ SHIPPED_METRICS = (
     "delta_uploads_total",
     "full_uploads_total",
     "delta_bytes_saved_total",
+    "sharded_cycles_total",
     "gangs_admitted_total",
     "gangs_deferred_total",
     "gang_pods_masked_total",
@@ -238,6 +252,10 @@ SHIPPED_METRICS = (
     "cycle_duration_seconds",
     "engine_step_duration_seconds",
     "snapshot_uploads_total",
+    # mesh-sharded resident engine: routed delta payload per owning
+    # shard (host labels shard index; the sharded sidecar's twin does
+    # too)
+    "shard_delta_bytes_total",
     # SLO watchdog (config.cycle_slo_ms; host labels by driver path,
     # the sidecar's own breach counter labels by rpc)
     "slo_breaches_total",

@@ -150,6 +150,14 @@ class CycleMetrics:
     delta_uploads: int = 0
     full_uploads: int = 0
     delta_bytes_saved: int = 0
+    # mesh-sharded engine (config.sharded_engine): device cycles served
+    # by the sharded engine, and — for resident delta cycles — the
+    # per-shard routed SnapshotDelta payload bytes (tuple indexed by
+    # shard; empty when the cycle shipped no routed delta). The
+    # {shard}-labeled byte counter and the flat-bytes bench gate read
+    # these.
+    sharded_cycles: int = 0
+    shard_delta_bytes: tuple = ()
     # gang co-scheduling (config.gang_scheduling; ops/gang.py): gangs
     # whose every member bound this cycle, gangs deferred as a unit
     # (short of members in the window, partial device fit, or a scalar-
@@ -215,6 +223,13 @@ class Scheduler:
     ):
         self.config = config
         self.advisor = advisor
+        if config.sharded_engine and config.policy == "learned":
+            # before the learned block: failing here must not pay a
+            # checkpoint load it immediately discards
+            raise ValueError(
+                "sharded_engine has no learned-policy path yet; use a "
+                "sharded sidecar with --learned-checkpoint instead"
+            )
         if config.policy == "learned":
             from kubernetes_scheduler_tpu.models.learned import (
                 LearnedEngine,
@@ -248,6 +263,17 @@ class Scheduler:
                 )
                 state, model, _ = init_train_state(_jax.random.key(0))
                 engine = LearnedEngine(state.params, model=model)
+        if engine is None and config.sharded_engine:
+            # the mesh-sharded in-process engine: node axis over every
+            # visible device (parallel/engine.ShardedEngine picks the
+            # largest divisor of 8, matching the builder's node-bucket
+            # multiple); both drivers dispatch through the same
+            # _dispatch_resident/_dispatch_windows surfaces unchanged
+            from kubernetes_scheduler_tpu.parallel.engine import (
+                ShardedEngine,
+            )
+
+            engine = ShardedEngine()
         self.engine = engine or LocalEngine()
         # auction knobs ride only engines whose call surface takes them
         # (LocalEngine's **kw and RemoteEngine's explicit params both do;
@@ -354,6 +380,8 @@ class Scheduler:
             "delta_uploads": 0,
             "full_uploads": 0,
             "delta_bytes_saved": 0,
+            "sharded_cycles": 0,
+            "shard_delta_bytes": 0,
             "gangs_admitted": 0,
             "gangs_deferred": 0,
             "gang_pods_masked": 0,
@@ -429,6 +457,12 @@ class Scheduler:
             "Snapshot uploads to the engine (resident delta vs full)",
             labels=("upload",),
         )
+        self.ctr_shard_bytes = Counter(
+            "shard_delta_bytes_total",
+            "Routed SnapshotDelta payload bytes per owning node shard "
+            "(mesh-sharded resident engine)",
+            labels=("shard",),
+        )
         self.ctr_slo = Counter(
             "slo_breaches_total",
             "Cycles that blew the configured cycle_slo_ms latency budget",
@@ -436,7 +470,7 @@ class Scheduler:
         )
         self.prom_collectors = (
             self.hist_cycle, self.hist_engine, self.ctr_uploads,
-            self.ctr_slo,
+            self.ctr_shard_bytes, self.ctr_slo,
         )
         # SLO watchdog state (config.cycle_slo_ms): run totals, the last
         # breach's identity (trace id + flight-recorder seq — the two
@@ -479,6 +513,11 @@ class Scheduler:
         return armer(int(cycles), out_dir)
 
     def _record(self, m: CycleMetrics) -> None:
+        # mesh-sharded engine: a device cycle (engine_seconds only
+        # accrues after a successful force) through a sharded engine is
+        # a sharded cycle, whatever dispatch surface served it
+        if m.engine_seconds > 0 and getattr(self.engine, "n_shards", 0):
+            m.sharded_cycles = 1
         path = self._cycle_path(m)
         self.hist_cycle.observe(m.cycle_seconds, path=path)
         if m.engine_seconds > 0:
@@ -487,6 +526,9 @@ class Scheduler:
             self.ctr_uploads.inc(m.delta_uploads, upload="delta")
         if m.full_uploads:
             self.ctr_uploads.inc(m.full_uploads, upload="full")
+        for shard, nbytes in enumerate(m.shard_delta_bytes):
+            if nbytes:
+                self.ctr_shard_bytes.inc(nbytes, shard=str(shard))
         with self._metrics_lock:
             self.metrics.append(m)
             self.totals["cycles"] += 1
@@ -503,6 +545,8 @@ class Scheduler:
             self.totals["delta_uploads"] += m.delta_uploads
             self.totals["full_uploads"] += m.full_uploads
             self.totals["delta_bytes_saved"] += m.delta_bytes_saved
+            self.totals["sharded_cycles"] += m.sharded_cycles
+            self.totals["shard_delta_bytes"] += sum(m.shard_delta_bytes)
             self.totals["gangs_admitted"] += m.gangs_admitted
             self.totals["gangs_deferred"] += m.gangs_deferred
             self.totals["gang_pods_masked"] += m.gang_pods_masked
@@ -2282,6 +2326,14 @@ class Scheduler:
             m.delta_bytes_saved += saved
         else:
             m.full_uploads += 1
+        # mesh-sharded engine (config.sharded_engine): which shards this
+        # cycle's delta actually reached, read AFTER the force like
+        # resident_used_delta (the 1-deep pipeline completes a cycle
+        # before the next dispatch overwrites the engine's attributes)
+        if used_delta and getattr(self.engine, "n_shards", 0):
+            per_shard = getattr(self.engine, "shard_delta_bytes", ())
+            if per_shard:
+                m.shard_delta_bytes = tuple(int(b) for b in per_shard)
 
     def _apply_assignments(self, window, nodes, idx, m: CycleMetrics) -> None:
         """Apply engine results: bind assigned pods, requeue the rest.

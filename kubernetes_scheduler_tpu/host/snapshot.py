@@ -326,6 +326,76 @@ def snapshot_delta(
     return delta
 
 
+def shard_snapshot_delta(
+    delta: SnapshotDelta, n_shards: int, *, prev_node_mask=None
+) -> dict:
+    """Route a SnapshotDelta to the node shards that own its rows (the
+    mesh-sharded resident engine, parallel/engine.ShardedEngine).
+
+    Returns {shard index: SnapshotDelta} with rows in SHARD-LOCAL
+    coordinates — each shard's node slice is [i*n_local, (i+1)*n_local)
+    and its pad sentinel is n_local (its own axis length), matching
+    _rows_padded's convention. The global delta's own pad sentinel (n)
+    falls outside every slice and drops out naturally.
+
+    Shards with no changed rows ship NOTHING (absent key): their
+    retained buffers are already current, so per-cycle host->device
+    payload scales with the change, not the cluster — the flat-bytes
+    property the 100k-node gate pins. Exception: when `prev_node_mask`
+    (the mask the engine currently retains) is given, a shard whose
+    mask slice changed emits even with no changed rows — the mask rides
+    whole on every dense delta precisely because it must stay current.
+
+    Each emitted shard's node_mask is its local slice of the new mask."""
+    mask = np.asarray(delta.node_mask, bool)
+    n = int(mask.shape[0])
+    if n_shards <= 0 or n % n_shards:
+        raise ValueError(
+            f"node axis {n} does not divide into {n_shards} shards"
+        )
+    n_local = n // n_shards
+    prev = (
+        None if prev_node_mask is None else np.asarray(prev_node_mask, bool)
+    )
+    out: dict[int, SnapshotDelta] = {}
+    for i in range(n_shards):
+        lo, hi = i * n_local, (i + 1) * n_local
+
+        def pick(rows, vals):
+            r = np.asarray(rows)
+            sel = (r >= lo) & (r < hi)
+            return r[sel] - lo, np.asarray(vals, np.float32)[sel]
+
+        rr, rv = pick(delta.req_rows, delta.req_vals)
+        ur, uv = pick(delta.util_rows, delta.util_vals)
+        dr, dv = pick(delta.dom_rows, delta.dom_vals)
+        mask_changed = prev is not None and not np.array_equal(
+            prev[lo:hi], mask[lo:hi]
+        )
+        if not (len(rr) or len(ur) or len(dr) or mask_changed):
+            continue
+
+        def repad(rows, vals, trailing):
+            padded = _rows_padded(rows, n_local)
+            out_vals = np.zeros((len(padded),) + trailing, np.float32)
+            out_vals[: len(rows)] = vals
+            return padded, out_vals
+
+        req_rows, req_vals = repad(rr, rv, (rv.shape[1],))
+        util_rows, util_vals = repad(ur, uv, (5,))
+        dom_rows, dom_vals = repad(dr, dv, dv.shape[1:])
+        out[i] = SnapshotDelta(
+            req_rows=req_rows,
+            req_vals=req_vals,
+            util_rows=util_rows,
+            util_vals=util_vals,
+            dom_rows=dom_rows,
+            dom_vals=dom_vals,
+            node_mask=mask[lo:hi],
+        )
+    return out
+
+
 FLAG_PLAIN = 1   # no constraint family beyond score + resource fit
 FLAG_SOFT = 2    # carries preferred (soft) score terms
 

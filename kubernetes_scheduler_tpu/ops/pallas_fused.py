@@ -232,8 +232,17 @@ def prep_node_operands(u, v, node_mask, alloc, reqd, *, tile_n: int = TILE_N):
         tile_n,
     )
     alloc_t = _pad_axis(alloc.astype(jnp.float32).T, 1, tile_n)
-    reqd_t = _pad_axis(reqd.astype(jnp.float32).T, 1, tile_n)
+    reqd_t = prep_requested(reqd, tile_n=tile_n)
     return node_ft, alloc_t, reqd_t
+
+
+def prep_requested(reqd, *, tile_n: int = TILE_N) -> jnp.ndarray:
+    """reqd_t alone — the one kernel-layout leaf that changes along a
+    windows scan's capacity carry. The multi-window scan rebuilds just
+    this leaf per window and reuses the retained node_ft/alloc_t
+    (engine.schedule_windows with a layout); sharing the expression with
+    prep_node_operands keeps the carried layout bitwise the re-prep."""
+    return _pad_axis(reqd.astype(jnp.float32).T, 1, tile_n)
 
 
 @functools.partial(

@@ -11,6 +11,12 @@ without any O(N^2) attention term (SURVEY.md §2, §5).
 
 from kubernetes_scheduler_tpu.parallel.mesh import NODE_AXIS, make_mesh
 from kubernetes_scheduler_tpu.parallel.engine import (
+    ShardedEngine,
+    make_sharded_apply_delta_fn,
+    make_sharded_apply_layout_fn,
+    make_sharded_build_layout_fn,
     make_sharded_schedule_fn,
     make_sharded_windows_fn,
+    sharded_device_count,
+    stack_shard_deltas,
 )

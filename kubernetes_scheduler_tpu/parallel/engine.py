@@ -32,9 +32,12 @@ except ImportError:  # older jax: experimental module, check_rep spelling
     from jax.experimental.shard_map import shard_map as _shard_map
 
 from kubernetes_scheduler_tpu.engine import (
+    FusedLayout,
     PodBatch,
+    ResidentState,
     ScheduleResult,
     SnapshotArrays,
+    SnapshotDelta,
     compute_feasibility,
     compute_free_capacity,
 )
@@ -60,7 +63,7 @@ from kubernetes_scheduler_tpu.ops.score import (
     balanced_diskio_m,
 )
 from kubernetes_scheduler_tpu.ops.stats import CPU_DIVISOR, DISK_IO_DIVISOR, UtilizationStats
-from kubernetes_scheduler_tpu.parallel.mesh import NODE_AXIS
+from kubernetes_scheduler_tpu.parallel.mesh import NODE_AXIS, make_mesh
 
 _VMA_KW = (
     "check_vma"
@@ -523,8 +526,29 @@ def _mesh_specs(mesh: Mesh, node_axes):
     return axes, node, rep, snap_specs, pod_specs
 
 
+def _delta_specs(axes) -> SnapshotDelta:
+    """Partition specs of a stacked per-shard SnapshotDelta (see
+    stack_shard_deltas): every leaf carries a leading shard axis, so
+    each shard's block is its own row delta in shard-local coordinates."""
+    return SnapshotDelta(**{f: P(axes) for f in SnapshotDelta._fields})
+
+
+def _layout_specs(axes) -> FusedLayout:
+    """Partition specs of a mesh-sharded engine.FusedLayout: the
+    kernel-layout buffers shard on their node/column axis (axis 1)."""
+    col = P(None, axes)
+    return FusedLayout(node_ft=col, alloc_t=col, reqd_t=col)
+
+
+def _local_delta(delta: SnapshotDelta) -> SnapshotDelta:
+    """Strip the leading shard axis off a stacked delta inside a
+    shard_map body (each shard sees its own [1, ...] block)."""
+    return SnapshotDelta(*[leaf[0] for leaf in delta])
+
+
 def _window_pipeline(snapshot, pods, policy, normalizer, soft, axes,
-                     score_fn=None, fused=False, score_plugins=None):
+                     score_fn=None, fused=False, score_plugins=None,
+                     layout=None):
     """Scores + static feasibility + normalization for one window on one
     shard — the shared front half of the sharded single-window and
     multi-window programs (they must not diverge).
@@ -562,8 +586,11 @@ def _window_pipeline(snapshot, pods, policy, normalizer, soft, axes,
     if fused:
         from kubernetes_scheduler_tpu.engine import _fused_masked_scores
 
+        # layout: this shard's retained kernel-layout buffers
+        # (ShardedEngine resident cycles) — the per-shard twin of the
+        # dense resident layout pass; None re-preps per call
         raw = _fused_masked_scores(
-            snapshot, pods_local, include_pod_affinity=False
+            snapshot, pods_local, include_pod_affinity=False, layout=layout
         )
         feasible = raw > NEG * 0.5
         norm = raw
@@ -646,7 +673,10 @@ def _with_auction_knobs(jfn, rounds0: int, price_frac0: float):
     bounds — an OverflowError here would surface as a gRPC INTERNAL."""
     int32_max = jnp.iinfo(jnp.int32).max
 
-    def call(snapshot, pods, *, auction_rounds=None, auction_price_frac=None):
+    def call(
+        snapshot, pods, *extra,
+        auction_rounds=None, auction_price_frac=None,
+    ):
         r = auction_rounds if auction_rounds is not None else rounds0
         f = (
             auction_price_frac
@@ -657,6 +687,7 @@ def _with_auction_knobs(jfn, rounds0: int, price_frac0: float):
             snapshot, pods,
             jnp.asarray(min(int(r), int32_max), jnp.int32),
             jnp.asarray(f, jnp.float32),
+            *extra,
         )
 
     return call
@@ -675,6 +706,7 @@ def make_sharded_schedule_fn(
     auction_price_frac: float = 1.0,
     fused: bool = False,
     score_plugins: tuple | None = None,
+    resident_layout: bool = False,
 ):
     """Build a jitted shard_map'd schedule function for `mesh`.
 
@@ -709,7 +741,17 @@ def make_sharded_schedule_fn(
     For a whole backlog in one dispatch use make_sharded_windows_fn,
     which threads the capacity AND (anti)affinity carries across
     windows exactly like engine.schedule_windows does on one device.
+
+    resident_layout=True (fused only) makes the returned function take a
+    third operand: a mesh-sharded engine.FusedLayout (leaves sharded on
+    their node/column axis — build with make_sharded_build_layout_fn,
+    fold deltas with make_sharded_apply_layout_fn) so resident cycles
+    feed each shard's retained kernel-layout buffers straight into the
+    megakernel instead of re-prepping per call — the ShardedEngine
+    production path.
     """
+    if resident_layout and not fused:
+        raise ValueError("resident_layout=True requires fused=True")
     if assigner not in ("greedy", "auction"):
         raise ValueError(f"unknown assigner {assigner!r}")
     if score_plugins and (fused or score_fn is not None):
@@ -732,11 +774,13 @@ def make_sharded_schedule_fn(
     )
 
     def body(
-        snapshot: SnapshotArrays, pods: PodBatch, rounds, price_frac
+        snapshot: SnapshotArrays, pods: PodBatch, rounds, price_frac,
+        *extra,
     ) -> ScheduleResult:
         raw, norm, feasible = _window_pipeline(
             snapshot, pods, policy, normalizer, soft, axes, score_fn,
             fused, score_plugins,
+            layout=extra[0] if resident_layout else None,
         )
         free0 = compute_free_capacity(snapshot)
         if assigner == "greedy":
@@ -757,11 +801,14 @@ def make_sharded_schedule_fn(
             n_assigned=(node_idx >= 0).sum().astype(jnp.int32),
         )
 
+    in_specs: tuple = (snap_specs, pod_specs, P(), P())
+    if resident_layout:
+        in_specs = in_specs + (_layout_specs(axes),)
     # the Pallas kernel's out_shape carries no vma annotation, so the
     # fused variant runs with the varying-manual-axes checker off (the
     # non-fused paths keep it: pcast/pmax provability is its value)
     fn = shard_map(
-        body, mesh=mesh, in_specs=(snap_specs, pod_specs, P(), P()),
+        body, mesh=mesh, in_specs=in_specs,
         out_specs=out_specs, check_vma=not fused,
     )
     return _with_auction_knobs(
@@ -876,3 +923,465 @@ def make_sharded_windows_fn(
     return _with_auction_knobs(
         jax.jit(fn), auction_rounds, auction_price_frac
     )
+
+
+# ---- sharded resident state: per-shard retained buffers + delta folds -----
+
+
+def stack_shard_deltas(
+    delta: SnapshotDelta, routed: dict, n_shards: int
+) -> SnapshotDelta:
+    """Stack per-shard routed deltas (host.snapshot.shard_snapshot_delta)
+    into ONE SnapshotDelta whose every leaf carries a leading [D] shard
+    axis — the operand layout the shard_map'd appliers consume (each
+    shard receives exactly its block, so per-device host->device bytes
+    scale with that shard's change, not the cluster).
+
+    Shards that shipped nothing contribute all-sentinel row blocks (the
+    row bucket is the max emitted shard's, keeping the stack
+    rectangular and the jitted appliers' shapes stable); the node-mask
+    plane is ALWAYS the full current mask reshaped [D, n_local] — it is
+    cheap, and every shard's slice must be current after the fold,
+    exactly like the dense applier's whole-mask refresh."""
+    import numpy as np
+
+    mask = np.asarray(delta.node_mask, bool)
+    n = mask.shape[0]
+    if n_shards <= 0 or n % n_shards:
+        raise ValueError(f"node axis {n} does not divide {n_shards} shards")
+    n_local = n // n_shards
+    r = int(np.asarray(delta.req_vals).shape[1])
+    s = int(np.asarray(delta.dom_vals).shape[1])
+
+    def stack(rows_attr: str, vals_attr: str, val_shape: tuple):
+        k = max(
+            (np.asarray(getattr(d, rows_attr)).shape[0] for d in routed.values()),
+            default=8,
+        )
+        rows = np.full((n_shards, k), n_local, np.int32)
+        vals = np.zeros((n_shards, k) + val_shape, np.float32)
+        for i, d in routed.items():
+            rr = np.asarray(getattr(d, rows_attr))
+            rows[i, : rr.shape[0]] = rr
+            vals[i, : rr.shape[0]] = np.asarray(getattr(d, vals_attr))
+        return rows, vals
+
+    req_rows, req_vals = stack("req_rows", "req_vals", (r,))
+    util_rows, util_vals = stack("util_rows", "util_vals", (5,))
+    dom_rows, dom_vals = stack("dom_rows", "dom_vals", (s, 4))
+    return SnapshotDelta(
+        req_rows=req_rows,
+        req_vals=req_vals,
+        util_rows=util_rows,
+        util_vals=util_vals,
+        dom_rows=dom_rows,
+        dom_vals=dom_vals,
+        node_mask=mask.reshape(n_shards, n_local),
+    )
+
+
+def make_sharded_apply_delta_fn(mesh: Mesh, node_axes=NODE_AXIS):
+    """shard_map'd donated-buffer SnapshotDelta fold: each shard
+    scatters ITS routed row block (stack_shard_deltas layout) into its
+    retained snapshot slice via engine._apply_delta_rows — the ONE
+    definition the dense apply_snapshot_delta jits, so a shard's fold
+    is bitwise the dense fold restricted to its rows. Zero collectives
+    (the budget pins it); the snapshot tree is DONATED like the dense
+    applier's, so no [n_local, r] matrix crosses host<->device."""
+    from kubernetes_scheduler_tpu.engine import _apply_delta_rows
+
+    axes, _, _, snap_specs, _ = _mesh_specs(mesh, node_axes)
+
+    def body(snapshot: SnapshotArrays, delta: SnapshotDelta) -> SnapshotArrays:
+        return _apply_delta_rows(snapshot, _local_delta(delta))
+
+    fn = shard_map(
+        body, mesh=mesh, in_specs=(snap_specs, _delta_specs(axes)),
+        out_specs=snap_specs,
+    )
+    return jax.jit(fn, donate_argnums=(0,))
+
+
+def make_sharded_build_layout_fn(mesh: Mesh, node_axes=NODE_AXIS):
+    """Per-shard engine.build_fused_layout: each shard preps ITS node
+    columns into kernel-layout buffers (FusedLayout leaves sharded on
+    their column axis, per-shard TILE padding). u/v are per-node divisor
+    expressions (ops/stats.py) — the global mean/variance never enter
+    the prep — so the shard-local prep is bitwise the dense prep
+    restricted to the shard's columns. Zero collectives; ONE prep per
+    full resident upload, after which deltas land straight in layout."""
+    from kubernetes_scheduler_tpu.ops.pallas_fused import prep_node_operands
+    from kubernetes_scheduler_tpu.ops.stats import (
+        CPU_DIVISOR,
+        DISK_IO_DIVISOR,
+    )
+
+    axes, _, _, snap_specs, _ = _mesh_specs(mesh, node_axes)
+
+    def body(snapshot: SnapshotArrays) -> FusedLayout:
+        u = snapshot.disk_io / DISK_IO_DIVISOR
+        v = snapshot.cpu_pct / CPU_DIVISOR
+        node_ft, alloc_t, reqd_t = prep_node_operands(
+            u, v, snapshot.node_mask,
+            snapshot.allocatable, snapshot.requested,
+        )
+        return FusedLayout(node_ft=node_ft, alloc_t=alloc_t, reqd_t=reqd_t)
+
+    fn = shard_map(
+        body, mesh=mesh, in_specs=(snap_specs,),
+        out_specs=_layout_specs(axes),
+    )
+    return jax.jit(fn)
+
+
+def make_sharded_apply_layout_fn(mesh: Mesh, node_axes=NODE_AXIS):
+    """shard_map'd donated-buffer kernel-layout fold: the per-shard twin
+    of engine.apply_layout_delta, sharing its body
+    (engine._apply_layout_rows) so a shard's fold writes the exact
+    float32 values the dense fold writes to its columns. Zero
+    collectives; the layout tree is DONATED."""
+    from kubernetes_scheduler_tpu.engine import _apply_layout_rows
+
+    axes, *_ = _mesh_specs(mesh, node_axes)
+    lay = _layout_specs(axes)
+
+    def body(layout: FusedLayout, delta: SnapshotDelta) -> FusedLayout:
+        return _apply_layout_rows(layout, _local_delta(delta))
+
+    fn = shard_map(
+        body, mesh=mesh, in_specs=(lay, _delta_specs(axes)), out_specs=lay,
+    )
+    return jax.jit(fn, donate_argnums=(0,))
+
+
+def sharded_device_count(n_devices: int | None = None) -> int:
+    """The automatic ShardedEngine mesh size: the largest divisor of 8
+    that the visible device count covers. The host pads node buckets to
+    multiples of 8 (utils/padding.bucket_size), so any mesh size in
+    {8, 4, 2, 1} divides every snapshot's node axis — a 6-device host
+    runs a 4-shard mesh rather than failing the divisibility check
+    every cycle."""
+    have = len(jax.devices()) if n_devices is None else n_devices
+    for d in (8, 4, 2):
+        if d <= have:
+            return d
+    return 1
+
+
+class _ShardedResident(ResidentState):
+    """ResidentState whose snapshot/layout leaves are mesh-sharded jax
+    arrays, plus: the host-side node-mask copy the delta router needs
+    (a shard whose mask slice changed must receive a delta even when
+    none of its rows moved), and the DEVICE-resident [D, n_local] mask
+    plane the stacked deltas reuse — the mask is invariant across delta
+    cycles (any real mask change is static churn and flushes to full),
+    so re-shipping n bytes of it every delta would make per-cycle
+    host->device bytes grow with the cluster; the retained plane costs
+    zero transfer and is rebuilt on the rare belt-and-braces mask edit."""
+
+    __slots__ = ("node_mask_host", "mask_plane")
+
+    def __init__(self, snapshot, epoch: int, node_mask_host, mask_plane):
+        super().__init__(snapshot, epoch)
+        self.node_mask_host = node_mask_host
+        self.mask_plane = mask_plane
+
+
+class ShardedEngine:
+    """In-process mesh-sharded engine with LocalEngine's call surface.
+
+    The production form of the sharded factories above: the host
+    scheduler swaps it in behind config.sharded_engine and every
+    dispatch runs the scheduling cycle shard-local with the budgeted
+    collectives — the snapshot's node axis sharded over the mesh, pods
+    replicated. Resident state (config.resident_state) is PER-SHARD:
+    one full upload builds each shard's retained snapshot slice (and,
+    on fused paths, its kernel-layout FusedLayout slice); later cycles
+    route each SnapshotDelta to the shards that own its rows
+    (host.snapshot.shard_snapshot_delta), so per-cycle host->device
+    bytes scale with the change — flat as the cluster grows — and the
+    donated shard_map'd appliers fold them in place.
+
+    Not served here: gang masking (the host's all-or-nothing backstop
+    — ops.gang.mask_partial_gangs_np, test-pinned bitwise-equal to the
+    device op — re-masks every reply, so supports_gangs() is False and
+    decisions still match the dense engine), device preemption (the
+    host falls back to in-host evaluation), and the fused min-max
+    epilogue (the sharded min-max bounds are global pmax/pmin values a
+    shard-local epilogue cannot see — supports_fused_min_max() is
+    False, so min_max configurations ride the unfused sharded path
+    with globally-reduced bounds, bitwise the dense normalize)."""
+
+    def __init__(self, mesh: Mesh | None = None, *, node_axes=NODE_AXIS):
+        from jax.sharding import NamedSharding
+
+        self.mesh = mesh if mesh is not None else make_mesh(
+            sharded_device_count()
+        )
+        self.node_axes = node_axes
+        axes = node_axes if isinstance(node_axes, tuple) else (node_axes,)
+        self._node_sharding = NamedSharding(self.mesh, P(axes))
+        node = self._node_sharding
+        self._snap_shardings = SnapshotArrays(
+            **{f: node for f in SnapshotArrays._fields}
+        )
+        # built-on-demand programs keyed by their static knobs, and the
+        # lazily-built apply/build companions (one per engine, like the
+        # jit caches they wrap)
+        self._fns: dict = {}
+        self._apply_fn = None
+        self._build_layout_fn = None
+        self._apply_layout_fn = None
+        self._resident: _ShardedResident | None = None
+        # mirrors LocalEngine.resident_used_delta: which path served the
+        # LAST resident call; the host reads it after forcing the result
+        self.resident_used_delta = False
+        # per-shard routed SnapshotDelta payload bytes of the last delta
+        # cycle (empty tuple on full uploads) — the host folds it into
+        # CycleMetrics.shard_delta_bytes for the {shard}-labeled counter
+        self.shard_delta_bytes: tuple = ()
+
+    # ---- capability surface -------------------------------------------
+
+    @property
+    def n_shards(self) -> int:
+        return int(self.mesh.size)
+
+    def supports_resident(self) -> bool:
+        return True
+
+    def supports_windows_resident(self) -> bool:
+        return True
+
+    def supports_gangs(self) -> bool:
+        # raw placements come back; the host backstop re-masks (bitwise-
+        # equal to the device op) and the recorder journals the masked
+        # vector (Scheduler._trace_node_idx)
+        return False
+
+    def supports_fused_min_max(self) -> bool:
+        return False
+
+    def healthy(self) -> bool:
+        return True
+
+    def close(self) -> None:
+        pass
+
+    # ---- program cache ------------------------------------------------
+
+    def _check_divisible(self, snapshot) -> None:
+        n = int(snapshot.node_mask.shape[0])
+        if n % self.n_shards:
+            raise ValueError(
+                f"node axis {n} is not divisible by the {self.n_shards}-"
+                "shard mesh (host node buckets are multiples of 8, so "
+                "this means a hand-built snapshot bypassed the builder)"
+            )
+
+    @staticmethod
+    def _knobs(kw: dict) -> dict:
+        return {
+            k: kw[k]
+            for k in ("auction_rounds", "auction_price_frac")
+            if k in kw
+        }
+
+    def _program(self, kind: str, kw: dict, *, resident_layout=False):
+        """The jitted sharded program for this call's static options.
+        `affinity_aware` is absorbed: the sharded assigners ALWAYS
+        evaluate (anti)affinity dynamically against live counts, which
+        is exact in both of the dense path's modes (the host only
+        passes False when static counts are provably equivalent)."""
+        key = (
+            kind,
+            kw.get("policy", "balanced_cpu_diskio"),
+            kw.get("assigner", "greedy" if kind == "schedule" else "auction"),
+            kw.get("normalizer", "min_max" if kind == "schedule" else "none"),
+            bool(kw.get("soft", False)),
+            bool(kw.get("fused", False)),
+            kw.get("score_plugins") or None,
+            resident_layout,
+        )
+        fn = self._fns.get(key)
+        if fn is None:
+            build = dict(
+                policy=key[1], assigner=key[2], normalizer=key[3],
+                soft=key[4], fused=key[5], node_axes=self.node_axes,
+            )
+            if key[6]:
+                build["score_plugins"] = key[6]
+            if kind == "schedule":
+                if resident_layout:
+                    build["resident_layout"] = True
+                fn = make_sharded_schedule_fn(self.mesh, **build)
+            else:
+                fn = make_sharded_windows_fn(self.mesh, **build)
+            self._fns[key] = fn
+        return fn
+
+    def _apply(self):
+        if self._apply_fn is None:
+            self._apply_fn = make_sharded_apply_delta_fn(
+                self.mesh, self.node_axes
+            )
+        return self._apply_fn
+
+    def _build_layout(self):
+        if self._build_layout_fn is None:
+            self._build_layout_fn = make_sharded_build_layout_fn(
+                self.mesh, self.node_axes
+            )
+        return self._build_layout_fn
+
+    def _apply_layout(self):
+        if self._apply_layout_fn is None:
+            self._apply_layout_fn = make_sharded_apply_layout_fn(
+                self.mesh, self.node_axes
+            )
+        return self._apply_layout_fn
+
+    # ---- plain (non-resident) dispatch --------------------------------
+
+    def schedule_batch(self, snapshot, pods, **kw) -> ScheduleResult:
+        self._check_divisible(snapshot)
+        return self._program("schedule", kw)(
+            snapshot, pods, **self._knobs(kw)
+        )
+
+    def schedule_batch_async(self, snapshot, pods, **kw):
+        from kubernetes_scheduler_tpu.engine import PendingSchedule
+
+        return PendingSchedule(self.schedule_batch(snapshot, pods, **kw))
+
+    def schedule_windows(self, snapshot, pods_windows, **kw):
+        self._check_divisible(snapshot)
+        return self._program("windows", kw)(
+            snapshot, pods_windows, **self._knobs(kw)
+        )
+
+    # ---- resident cluster state (per-shard delta uploads) -------------
+
+    def invalidate_resident(self) -> None:
+        self._resident = None
+
+    def _fold_delta(self, st: _ShardedResident, delta, epoch: int) -> None:
+        """Route + apply one accepted delta: per-shard row deltas on the
+        host, donated shard_map folds on device, the layout twin kept in
+        lockstep when built. The donated trees are dead after each call
+        — rebind before anything can read them (LocalEngine's rule)."""
+        import numpy as np
+
+        from kubernetes_scheduler_tpu.engine import snapshot_nbytes
+        from kubernetes_scheduler_tpu.host.snapshot import (
+            shard_snapshot_delta,
+        )
+
+        routed = shard_snapshot_delta(
+            delta, self.n_shards, prev_node_mask=st.node_mask_host
+        )
+        new_mask = np.array(np.asarray(delta.node_mask), bool)
+        mask_changed = not np.array_equal(st.node_mask_host, new_mask)
+        if mask_changed:
+            # belt-and-braces: a mask edit that somehow escaped the
+            # static-churn flush rebuilds the device plane (n bytes,
+            # rare); steady-state delta cycles reuse the retained plane
+            # and ship ZERO mask bytes
+            st.mask_plane = jax.device_put(
+                new_mask.reshape(self.n_shards, -1), self._node_sharding
+            )
+        stacked = stack_shard_deltas(
+            delta, routed, self.n_shards
+        )._replace(node_mask=st.mask_plane)
+        st.snapshot = self._apply()(st.snapshot, stacked)
+        if st.layout is not None:
+            st.layout = self._apply_layout()(st.layout, stacked)
+        st.epoch = epoch
+        st.node_mask_host = new_mask
+        # per-shard transfer accounting: row planes always ship; the
+        # mask slice only on the rare rebuild
+        self.shard_delta_bytes = tuple(
+            (
+                snapshot_nbytes(routed[i])
+                - (0 if mask_changed else routed[i].node_mask.nbytes)
+            )
+            if i in routed
+            else 0
+            for i in range(self.n_shards)
+        )
+        self.resident_used_delta = True
+
+    def _upload_full(self, snapshot, epoch: int) -> _ShardedResident:
+        import numpy as np
+
+        self._check_divisible(snapshot)
+        # full upload into PRIVATE per-shard buffers: leaves are forced
+        # through host numpy first — jax.device_put of an already-
+        # device-backed array with a matching sharding is an identity
+        # (no copy), and the donated appliers would then delete the
+        # CALLER's buffers on the next delta fold. The host builder
+        # hands numpy anyway, so the force is free on the real path.
+        # graftlint: disable=host-sync -- deliberate one-time materialization; full uploads ship the whole snapshot by definition
+        snapshot = type(snapshot)(*[np.asarray(a) for a in snapshot])
+        mask = np.array(snapshot.node_mask, bool)
+        st = _ShardedResident(
+            jax.device_put(snapshot, self._snap_shardings),
+            epoch,
+            mask,
+            jax.device_put(
+                mask.reshape(self.n_shards, -1), self._node_sharding
+            ),
+        )
+        self._resident = st
+        self.resident_used_delta = False
+        return st
+
+    def _resident_dispatch(self, snapshot, delta, epoch):
+        """Shared single-window/backlog resident front half: fold the
+        delta into the retained per-shard state or flush to a full
+        upload, mirroring LocalEngine.schedule_resident's degrade
+        semantics (any mismatch costs a full upload, never the cycle)."""
+        st = self._resident
+        self.shard_delta_bytes = ()
+        if delta is not None and st is not None and st.accepts(delta, epoch):
+            self._fold_delta(st, delta, epoch)
+            return st
+        return self._upload_full(snapshot, epoch)
+
+    def schedule_resident(
+        self, snapshot, pods, *, delta=None, epoch=0, **kw
+    ) -> ScheduleResult:
+        st = self._resident_dispatch(snapshot, delta, epoch)
+        if kw.get("fused"):
+            if st.layout is None:
+                st.layout = self._build_layout()(st.snapshot)
+            return self._program("schedule", kw, resident_layout=True)(
+                st.snapshot, pods, st.layout, **self._knobs(kw)
+            )
+        return self._program("schedule", kw)(
+            st.snapshot, pods, **self._knobs(kw)
+        )
+
+    def schedule_resident_async(
+        self, snapshot, pods, *, delta=None, epoch=0, **kw
+    ):
+        from kubernetes_scheduler_tpu.engine import PendingSchedule
+
+        return PendingSchedule(
+            self.schedule_resident(
+                snapshot, pods, delta=delta, epoch=epoch, **kw
+            )
+        )
+
+    def schedule_windows_resident(
+        self, snapshot, pods_windows, *, delta=None, epoch=0, **kw
+    ):
+        """Multi-window twin on the same per-shard epoch sequence. The
+        sharded windows scan re-preps its kernel operands per window
+        (its capacity carry is per-shard and cheap at n_local columns);
+        the retained layout is still delta-folded so interleaved
+        single-window fused cycles stay current."""
+        st = self._resident_dispatch(snapshot, delta, epoch)
+        return self._program("windows", kw)(
+            st.snapshot, pods_windows, **self._knobs(kw)
+        )

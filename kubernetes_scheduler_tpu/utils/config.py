@@ -160,6 +160,19 @@ class SchedulerConfig:
     # cycles (max_windows_per_cycle > 1 with a deep queue) always upload
     # in full — only the schedule_batch surface is resident.
     resident_state: bool = False
+    # mesh-sharded engine (parallel/engine.ShardedEngine): shard the
+    # snapshot's node axis across every visible device (the largest
+    # divisor of 8 the host has — node buckets are multiples of 8) and
+    # run each cycle shard-local with the budgeted collectives
+    # (COLLECTIVE_BUDGET.json). Composes with resident_state: each shard
+    # retains ITS snapshot slice (and kernel-layout slice on fused
+    # paths), and every SnapshotDelta is routed to the shards owning its
+    # rows (host.snapshot.shard_snapshot_delta) — per-cycle host->device
+    # bytes scale with the change, flat as the cluster grows (the
+    # 100k-node scale step). Decisions are bitwise the dense engine's
+    # (PARITY.md round 15); only in-process engines are built from this
+    # knob — a remote sidecar's mesh is its own --mesh-devices flag.
+    sharded_engine: bool = False
     # gang co-scheduling (ops/gang.py, arXiv:2511.08373): pods labeled
     # scv/gang + scv/gang-size bind all-or-nothing — the engine rescinds
     # every placement of a gang that did not fully fit, and the host

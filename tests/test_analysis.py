@@ -720,15 +720,19 @@ def test_engine_contracts_clean_and_covering():
 def test_spmd_traced_contracts_and_budget_clean():
     """The sharded half of layer 2 (what `make lint` runs): every
     declared sharded surface traces through shard_map on the virtual
-    8-device mesh to EXACTLY the dense spec, the divisibility formula
-    predicts both success and failure, the collective counts match the
-    checked-in COLLECTIVE_BUDGET.json, and the declared coverage
-    includes all four surfaces."""
+    8-device mesh to EXACTLY the dense spec (the resident appliers
+    spec-preserving, the layout build honoring the per-shard padding
+    formula), the divisibility formula predicts both success and
+    failure, the collective counts match the checked-in
+    COLLECTIVE_BUDGET.json, and the declared coverage includes the
+    four schedule surfaces plus the four sharded-RESIDENT surfaces."""
     from kubernetes_scheduler_tpu.analysis import contracts
 
     assert set(contracts.SHARDED_CONTRACT_NAMES) == {
         "sharded_schedule(greedy)", "sharded_schedule(auction)",
         "sharded_windows(greedy)", "sharded_windows(auction)",
+        "sharded_schedule(fused)", "sharded_apply_delta",
+        "sharded_build_layout", "sharded_apply_layout_delta",
     }
     vs = contracts.check_sharded_contracts()
     assert vs == [], "\n".join(v.format() for v in vs)

@@ -17,6 +17,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 SMOKE_ENV = {
     "JAX_PLATFORMS": "cpu",
+    # the sharded rows need a real mesh: the multichip dryrun topology
+    # (conftest sets the same flag for in-process tests; the subprocess
+    # gets it explicitly so `make bench-smoke` parity holds)
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
     "BENCH_NODES": "64",
     "BENCH_PODS": "128",
     "BENCH_WINDOW": "32",
@@ -28,6 +32,11 @@ SMOKE_ENV = {
     # real bench uses for stable p50/p99 would multiply this test's
     # wall time for percentiles nobody reads at toy sizes
     "BENCH_LOOP_SAMPLES": "3",
+    # compressed mesh-sharded rows (host_loop_256nodes + its tenth-
+    # scale flat-bytes reference, scheduling_throughput_256nodes)
+    "BENCH_SHARDED_NODES": "256",
+    "BENCH_SHARDED_PODS": "96",
+    "BENCH_CHURN_NODES": "8",
 }
 
 
@@ -57,6 +66,9 @@ def test_bench_smoke_e2e():
         "host_loop_32nodes_pipelined",
         "host_loop_32nodes_fused",
         "host_loop_32nodes_resident",
+        "host_loop_256nodes",
+        "host_loop_25nodes_sharded_ref",
+        "scheduling_throughput_256nodes",
         "host_loop_32nodes_replay",
         "host_loop_32nodes_telemetry",
         "host_loop_32nodes_attribution",
@@ -90,6 +102,26 @@ def test_bench_smoke_e2e():
     assert 0.0 < res["delta_hit_rate"] <= 1.0, res
     assert res["snapshot_upload_bytes"] > 0, res
     assert res["delta_bytes_saved"] > 0, res
+    # the mesh-sharded resident loop: every device cycle went through
+    # the 8-shard mesh, the delta path actually routed per-shard
+    # payloads, and the flat-bytes evidence (per-cycle routed bytes vs
+    # the tenth-scale reference) is in-data — the <=2x gate itself is
+    # asserted with controlled workloads in
+    # test_sharded_flat_bytes_gate_e2e
+    sha = metrics["host_loop_256nodes"]
+    assert sha["pods_bound"] > 0, sha
+    assert sha["fallback_cycles"] == 0, sha
+    assert sha["mesh_devices"] == 8, sha
+    assert sha["sharded_cycles"] == sha["cycles"], sha
+    assert sha["delta_uploads"] > 0, sha
+    assert sha["shard_delta_bytes_per_cycle"] > 0, sha
+    assert sha["ref_shard_delta_bytes_per_cycle"] > 0, sha
+    assert sha["flat_bytes_ratio"] > 0, sha
+    ref = metrics["host_loop_25nodes_sharded_ref"]
+    assert ref["pods_bound"] > 0 and ref["fallback_cycles"] == 0, ref
+    st = metrics["scheduling_throughput_256nodes"]
+    assert st["mesh_devices"] == 8 and st["assigned"] > 0, st
+    assert st["value"] > 0, st
     # the flight-recorder metric: replay reproduced the recorded
     # bindings bitwise (the acceptance gate) on a recorded workload
     rep = metrics["host_loop_32nodes_replay"]
@@ -130,6 +162,39 @@ def test_bench_smoke_e2e():
     assert 0.0 < gang["gang_admit_rate"] <= 1.0, gang
 
 
+def test_sharded_flat_bytes_gate_e2e():
+    """The flat-bytes acceptance gate at compressed scale: on a
+    metric-churn workload (fixed-size rotating utilization churn), the
+    mesh-sharded resident loop's per-cycle routed delta payload at 8x
+    the nodes must stay within 2x the small-scale figure — per-cycle
+    host->device bytes scale with the CHANGE (churned rows + window
+    binds), not the cluster. Runs in-process on the harness's 8-device
+    topology; the pod count stays below the small scale's node count so
+    neither scale is node-capped on bind rows (the 100k-vs-10k shape)."""
+    import bench
+
+    kw = dict(
+        n_pods=48, max_windows=1, pipeline_depth=1, force_device=True,
+        resident=True, sharded=True, churn_nodes=16,
+    )
+    small = bench.loop_rate(n_nodes=64, metric_suffix="_fb_small", **kw)
+    big = bench.loop_rate(n_nodes=512, metric_suffix="_fb_big", **kw)
+    for row in (small, big):
+        assert row["fallback_cycles"] == 0, row
+        assert row["delta_uploads"] > 0, row
+        assert row["mesh_devices"] == 8, row
+        assert row["shard_delta_bytes_per_cycle"] > 0, row
+    ratio = (
+        big["shard_delta_bytes_per_cycle"]
+        / small["shard_delta_bytes_per_cycle"]
+    )
+    assert ratio <= 2.0, (
+        f"per-cycle routed delta bytes grew {ratio:.2f}x over an 8x "
+        f"node-count increase — the sharded resident path lost its "
+        f"flat-bytes property ({small=} {big=})"
+    )
+
+
 def test_perf_gate_e2e(tmp_path):
     """The `make perf-gate` flow as a test: a fresh telemetry-shaped
     drain's span directory diffed against the COMMITTED
@@ -141,7 +206,9 @@ def test_perf_gate_e2e(tmp_path):
     spans_dir = str(tmp_path / "spans")
     env = {
         **os.environ, "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
         "BENCH_LOOP_NODES": "32", "BENCH_LOOP_PODS": "64",
+        "BENCH_SHARDED_NODES": "64", "BENCH_CHURN_NODES": "8",
     }
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"),
@@ -149,9 +216,19 @@ def test_perf_gate_e2e(tmp_path):
         capture_output=True, text=True, timeout=560, cwd=REPO, env=env,
     )
     assert proc.returncode == 0, proc.stderr[-2000:] + proc.stdout[-500:]
-    metric = json.loads(proc.stdout.splitlines()[-1])
-    assert metric["metric"] == "host_loop_32nodes_perfgate"
+    rows = [
+        json.loads(line)
+        for line in proc.stdout.splitlines()
+        if line.startswith("{") and "metric" in line
+    ]
+    metrics = {r["metric"]: r for r in rows if "metric" in r}
+    metric = metrics["host_loop_32nodes_perfgate"]
     assert metric["spans_written"] > 0, metric
+    # the sharded drain contributes its stage spans to the SAME gate
+    # directory (the committed baseline covers them)
+    sharded = metrics["host_loop_64nodes_perfgate_sharded"]
+    assert sharded["spans_written"] > 0, sharded
+    assert sharded["fallback_cycles"] == 0, sharded
 
     def spans_diff(base, cand):
         # the `make perf-gate` thresholds: coarse floors (>20 ms AND

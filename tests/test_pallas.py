@@ -142,6 +142,37 @@ def test_fused_windows_match_unfused():
     assert int(got.n_assigned) == int(base.n_assigned)
 
 
+def test_fused_windows_layout_carry_bitwise():
+    """The layout-carrying windows scan (resident multi-window cycles:
+    retained node_ft/alloc_t reused every window, only reqd_t rebuilt
+    from the capacity carry via prep_requested) must be BITWISE the
+    re-prep path — node_idx AND free_after — and reject a layout
+    without fused=True."""
+    import jax
+
+    from kubernetes_scheduler_tpu.engine import (
+        build_fused_layout,
+        schedule_windows,
+        stack_windows,
+    )
+    from kubernetes_scheduler_tpu.sim import gen_cluster, gen_pods
+
+    snap = gen_cluster(64, seed=5)
+    pods = stack_windows(gen_pods(32, seed=6), 8)
+    base = schedule_windows(snap, pods, fused=True)
+    layout = build_fused_layout(jax.device_put(snap))
+    got = schedule_windows(snap, pods, fused=True, layout=layout)
+    np.testing.assert_array_equal(
+        np.asarray(got.node_idx), np.asarray(base.node_idx)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.free_after), np.asarray(base.free_after)
+    )
+    assert int(got.n_assigned) == int(base.n_assigned)
+    with pytest.raises(ValueError, match="layout requires fused"):
+        schedule_windows(snap, pods, fused=False, layout=layout)
+
+
 # tile-boundary property sweep (the shapes that break tiled kernels:
 # exactly at and one off the TILE multiples, with the small tiles the
 # interpreter can afford), crossed with the resource-axis widths the

@@ -2,18 +2,23 @@
 
 Fast units pin the sharded factories' argument contracts (mesh-axis
 validation, assigner/knob clashes — errors that otherwise surface as
-shard_map tracebacks mid-dispatch), and the slow-marked e2e runs the
-sharded engine in a SUBPROCESS on an 8-device host-platform mesh (the
-multichip dryrun recipe: `XLA_FLAGS=--xla_force_host_platform_device_
-count=8` forced in the child's environment, independent of the parent
-harness) asserting sharded<->dense bitwise `node_idx` parity for the
-greedy, auction, and whole-backlog windows programs."""
+shard_map tracebacks mid-dispatch) and the resident delta ROUTING
+(host.snapshot.shard_snapshot_delta: owner-shard emission, shard-local
+coordinates, empty shards shipping nothing, the stacked per-shard
+apply bitwise the dense fold). The slow-marked e2es run in a
+SUBPROCESS on an 8-device host-platform mesh (the multichip dryrun
+recipe: `XLA_FLAGS=--xla_force_host_platform_device_count=8` forced in
+the child's environment, independent of the parent harness) asserting
+sharded<->dense bitwise `node_idx` parity for the greedy, auction, and
+whole-backlog windows programs — and, for the ShardedEngine, across
+full/delta/flush-on-churn RESIDENT cycles against LocalEngine."""
 
 import json
 import os
 import subprocess
 import sys
 
+import numpy as np
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -79,6 +84,129 @@ def test_knob_wrapper_clamps_rounds_to_int32():
     assert seen["price_frac"] == 0.5
 
 
+# ---- delta routing units (fast: names avoid the slow patterns) ------------
+
+
+def _routing_delta(n=64, r=3, s=2, touch=()):
+    """A SnapshotDelta whose REAL changed rows are exactly `touch`
+    (global indices), padded with the dense sentinel n like
+    host.snapshot.snapshot_delta emits."""
+    from kubernetes_scheduler_tpu.engine import SnapshotDelta
+    from kubernetes_scheduler_tpu.host.snapshot import _rows_padded
+
+    touch = np.asarray(sorted(touch), np.int32)
+    rows = _rows_padded(touch, n)
+    req_vals = np.zeros((len(rows), r), np.float32)
+    req_vals[: len(touch)] = np.arange(
+        len(touch) * r, dtype=np.float32
+    ).reshape(len(touch), r) + 1.0
+    util_vals = np.zeros((len(rows), 5), np.float32)
+    util_vals[: len(touch)] = 0.5
+    dom_vals = np.zeros((len(rows), s, 4), np.float32)
+    return SnapshotDelta(
+        req_rows=rows,
+        req_vals=req_vals,
+        util_rows=rows.copy(),
+        util_vals=util_vals,
+        dom_rows=_rows_padded(np.asarray([], np.int32), n),
+        dom_vals=np.zeros((8, s, 4), np.float32),
+        node_mask=np.ones(n, bool),
+    )
+
+
+def test_delta_routing_owner_shards_only():
+    """Rows in shards {0, 3, 7} of an 8-shard mesh produce exactly
+    those per-shard deltas — empty shards ship nothing — with rows in
+    shard-local coordinates and values carried verbatim."""
+    from kubernetes_scheduler_tpu.host.snapshot import shard_snapshot_delta
+
+    n, d = 64, 8  # n_local = 8
+    touch = (1, 7, 3 * 8 + 2, 7 * 8 + 5)  # shards 0, 0, 3, 7
+    delta = _routing_delta(n=n, touch=touch)
+    routed = shard_snapshot_delta(delta, d)
+    assert sorted(routed) == [0, 3, 7]
+    sh0 = routed[0]
+    assert sorted(sh0.req_rows[sh0.req_rows < 8].tolist()) == [1, 7]
+    sh3 = routed[3]
+    assert sh3.req_rows[sh3.req_rows < 8].tolist() == [2]
+    # values ride with their rows: shard 3's single row carries the
+    # third touched row's payload
+    got = sh3.req_vals[list(sh3.req_rows).index(2)]
+    want = delta.req_vals[list(delta.req_rows).index(3 * 8 + 2)]
+    assert np.array_equal(got, want)
+    sh7 = routed[7]
+    assert sh7.req_rows[sh7.req_rows < 8].tolist() == [5]
+    # pad sentinel is the SHARD's axis length, and each shard's mask is
+    # its local slice
+    for i, sh in routed.items():
+        assert (sh.req_rows[sh.req_rows >= 8] == 8).all()
+        assert sh.node_mask.shape == (8,)
+
+
+def test_delta_routing_mask_change_emits_rowless_shard():
+    """A shard whose node-mask slice changed must emit even with no
+    changed rows (its retained mask would otherwise go stale)."""
+    from kubernetes_scheduler_tpu.host.snapshot import shard_snapshot_delta
+
+    delta = _routing_delta(n=64, touch=(1,))  # rows only in shard 0
+    prev = np.ones(64, bool)
+    prev[5 * 8 + 3] = False  # shard 5's retained mask differs
+    routed = shard_snapshot_delta(delta, 8, prev_node_mask=prev)
+    assert sorted(routed) == [0, 5]
+    # shard 5 ships only sentinels + its (current) mask slice
+    sh5 = routed[5]
+    assert (sh5.req_rows == 8).all() and (sh5.util_rows == 8).all()
+    assert sh5.node_mask.all()
+    # without the prev mask, shard 5 ships nothing
+    assert sorted(shard_snapshot_delta(delta, 8)) == [0]
+
+
+def test_delta_routing_rejects_indivisible_axis():
+    from kubernetes_scheduler_tpu.host.snapshot import shard_snapshot_delta
+
+    with pytest.raises(ValueError, match="does not divide"):
+        shard_snapshot_delta(_routing_delta(n=64), 7)
+
+
+def test_stacked_shard_apply_matches_dense_fold():
+    """The routed-and-stacked per-shard fold must be BITWISE the dense
+    apply_snapshot_delta on the same snapshot/delta (the appliers share
+    one body — this pins the routing/stacking around it)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kubernetes_scheduler_tpu import engine
+    from kubernetes_scheduler_tpu.host.snapshot import shard_snapshot_delta
+    from kubernetes_scheduler_tpu.parallel import (
+        make_mesh,
+        make_sharded_apply_delta_fn,
+        stack_shard_deltas,
+    )
+    from kubernetes_scheduler_tpu.parallel.mesh import NODE_AXIS
+
+    rng = np.random.default_rng(11)
+    n, r, s, d = 64, 3, 2, 8
+    snap = engine.make_snapshot(
+        allocatable=rng.uniform(1000, 4000, (n, r)).astype(np.float32),
+        requested=rng.uniform(0, 900, (n, r)).astype(np.float32),
+        disk_io=rng.uniform(0, 50, n).astype(np.float32),
+        cpu_pct=rng.uniform(0, 100, n).astype(np.float32),
+        mem_pct=rng.uniform(0, 100, n).astype(np.float32),
+        domain_counts=np.zeros((n, s), np.float32),
+    )
+    snap = type(snap)(*[np.asarray(a) for a in snap])
+    delta = _routing_delta(n=n, r=r, s=s, touch=(0, 9, 30, 63))
+    dense = engine.apply_snapshot_delta(snap, delta)
+    mesh = make_mesh(d)
+    node = NamedSharding(mesh, P(NODE_AXIS))
+    snap_dev = jax.device_put(snap, type(snap)(*[node] * len(snap)))
+    routed = shard_snapshot_delta(delta, d)
+    stacked = stack_shard_deltas(delta, routed, d)
+    got = make_sharded_apply_delta_fn(mesh)(snap_dev, stacked)
+    for name, a, b in zip(type(snap)._fields, got, dense):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), name
+
+
 # ---- the subprocess e2e (slow-marked by name) -----------------------------
 
 _E2E_SCRIPT = """
@@ -134,6 +262,137 @@ out["windows"] = {
 }
 print(json.dumps(out))
 """
+
+
+_RESIDENT_E2E_SCRIPT = """
+import json
+
+import numpy as np
+
+from kubernetes_scheduler_tpu import engine
+from kubernetes_scheduler_tpu.host.snapshot import snapshot_delta
+from kubernetes_scheduler_tpu.parallel import ShardedEngine
+
+rng = np.random.default_rng(5)
+n, p, r = 64, 24, 3
+# the static block the delta protocol keys on: a churn step BUMPS this
+# (allocatable edits are never delta-expressible -> flush to full) and
+# later cycles diff against the bumped value
+cur = {"alloc": rng.integers(4000, 16000, (n, r)).astype(np.float32)}
+
+
+def mksnap(seed):
+    g = np.random.default_rng(seed)
+    s = engine.make_snapshot(
+        allocatable=cur["alloc"],
+        requested=g.integers(0, 4000, (n, r)).astype(np.float32),
+        disk_io=g.uniform(0, 50, n),
+        cpu_pct=g.uniform(0, 100, n),
+        mem_pct=g.uniform(0, 100, n),
+    )
+    # numpy leaves, like the real host builder (private device buffers
+    # on upload — nothing the donated folds consume can alias)
+    return type(s)(*[np.asarray(x) for x in s])
+
+
+pods = engine.make_pod_batch(
+    request=rng.integers(100, 3000, (p, r)).astype(np.float32),
+    r_io=rng.uniform(0, 40, p),
+    priority=rng.integers(0, 10, p),
+)
+se, le = ShardedEngine(), engine.LocalEngine()
+out = {"devices": se.n_shards, "cycles": []}
+for kw in (
+    dict(assigner="auction", normalizer="none", fused=True),
+    dict(assigner="greedy", normalizer="min_max"),
+):
+    se.invalidate_resident()
+    le.invalidate_resident()
+    prev, epoch = None, 0
+    plan = ["full", "delta", "delta", "churn", "delta"]
+    for step in plan:
+        epoch += 1
+        if step == "churn":
+            # static-block churn (allocatable moves): snapshot_delta
+            # returns None and both engines must flush to full
+            cur["alloc"] = cur["alloc"] + np.float32(1.0)
+        snap = mksnap(100 + epoch)
+        delta = (
+            snapshot_delta(prev, snap) if prev is not None else None
+        )
+        if step == "churn":
+            assert delta is None, "churn step still delta-expressible"
+        rs = se.schedule_resident(snap, pods, delta=delta, epoch=epoch, **kw)
+        rl = le.schedule_resident(snap, pods, delta=delta, epoch=epoch, **kw)
+        out["cycles"].append({
+            "step": step,
+            "kw": kw.get("assigner"),
+            "delta_sent": delta is not None,
+            "used_delta": [se.resident_used_delta, le.resident_used_delta],
+            "parity": np.asarray(rs.node_idx).tolist()
+            == np.asarray(rl.node_idx).tolist(),
+            "assigned": int(rs.n_assigned),
+            "shard_bytes": list(se.shard_delta_bytes),
+        })
+        prev = snap
+
+# windows-resident on the same epoch sequence, fused (the layout-carry
+# scan on the dense side vs the sharded re-prep scan)
+wpods = engine.stack_windows(
+    engine.make_pod_batch(
+        request=rng.integers(100, 3000, (32, r)).astype(np.float32),
+        r_io=rng.uniform(0, 40, 32),
+        priority=rng.integers(0, 10, 32),
+    ),
+    8,
+)
+snap = mksnap(999)
+delta = snapshot_delta(prev, snap)
+kw = dict(assigner="greedy", normalizer="none", fused=True)
+ws = se.schedule_windows_resident(snap, wpods, delta=delta, epoch=epoch + 1, **kw)
+wl = le.schedule_windows_resident(snap, wpods, delta=delta, epoch=epoch + 1, **kw)
+out["windows"] = {
+    "parity": np.asarray(ws.node_idx).tolist()
+    == np.asarray(wl.node_idx).tolist(),
+    "used_delta": [se.resident_used_delta, le.resident_used_delta],
+    "assigned": int(ws.n_assigned),
+}
+print(json.dumps(out))
+"""
+
+
+def test_sharded_resident_parity_subprocess_e2e():
+    """ShardedEngine vs LocalEngine across full/delta/flush-on-churn
+    resident cycles (both assigners, fused and unfused), plus the
+    windows-resident surface: node_idx must be BITWISE identical every
+    cycle, the delta/full path choice must agree, and delta cycles must
+    report per-shard routed bytes."""
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+    }
+    proc = subprocess.run(
+        [sys.executable, "-c", _RESIDENT_E2E_SCRIPT],
+        capture_output=True, text=True, timeout=540, cwd=REPO, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    out = json.loads(proc.stdout.splitlines()[-1])
+    assert out["devices"] == 8, out
+    assert len(out["cycles"]) == 10
+    for cyc in out["cycles"]:
+        assert cyc["parity"], cyc
+        assert cyc["assigned"] > 0, cyc
+        assert cyc["used_delta"][0] == cyc["used_delta"][1], cyc
+        # the delta/full choice matches the plan: full + churn flush,
+        # deltas apply
+        want_delta = cyc["step"] == "delta"
+        assert cyc["used_delta"][0] == want_delta, cyc
+        if want_delta:
+            assert sum(cyc["shard_bytes"]) > 0, cyc
+    win = out["windows"]
+    assert win["parity"] and win["assigned"] > 0, win
+    assert win["used_delta"] == [True, True], win
 
 
 def test_sharded_engine_subprocess_parity_e2e():

@@ -83,16 +83,42 @@ def _value_is_traced(value: ast.AST, traced: set[str]) -> bool:
     return False
 
 
+def _pallas_kernel_names(sf) -> set[str]:
+    """Function names dispatched as Pallas kernels in this module
+    (pl.pallas_call's first argument, unwrapping functools.partial).
+    A kernel's WHOLE CALLING CONVENTION is mutating its Ref arguments —
+    `out_ref[...] = value` IS the kernel's return surface, not a tracer
+    escaping into host state — so kernels are exempt from the
+    store-onto-argument check."""
+    from kubernetes_scheduler_tpu.analysis.rules.pallas_vmem import (
+        _kernel_names,
+    )
+
+    names: set[str] = set()
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call) and (
+            dotted_name(node.func) or ""
+        ).rsplit(".", 1)[-1] == "pallas_call":
+            names.update(_kernel_names(node))
+    return names
+
+
 def check(ctx: Context) -> list[Violation]:
     out: list[Violation] = []
     index = dataflow.get_index(ctx)
     scoped = {id(sf) for sf in ctx.scoped(SCOPE)}
     reachable = index.jit_reachable()
+    kernel_cache: dict[int, set[str]] = {}
     for qname in sorted(reachable):
         fi = index.funcs[qname]
         if id(fi.sf) not in scoped:
             continue
         fn = fi.node
+        kernels = kernel_cache.get(id(fi.sf))
+        if kernels is None:
+            kernels = kernel_cache[id(fi.sf)] = _pallas_kernel_names(fi.sf)
+        if fn.name in kernels:
+            continue
         params = _params(fn)
         # every param is abstract under trace; so is anything derived
         traced = params | dataflow.jax_tainted_names(fn)

@@ -162,6 +162,12 @@ def cmd_scheduler_kube(args, cfg) -> int:
         controller_replicas=source.controller_replicas,
         engine=engine,
     )
+    if sched.mirror is not None:
+        # streaming ingestion (config.snapshot_mirror): the informer's
+        # node/pod watch events feed the mirror directly; relists reseed
+        from kubernetes_scheduler_tpu.kube.source import attach_mirror
+
+        attach_mirror(cache, sched)
     # exporter FIRST: a standby replica blocks in acquire_blocking below,
     # and it must serve /healthz + /metrics for its whole standby life
     # (the deploy manifest's readinessProbe) — upstream kube-scheduler
@@ -430,6 +436,8 @@ def cmd_scenario(args) -> int:
         overrides["pipeline_depth"] = 1
     if args.gang_off:
         overrides["gang_scheduling"] = False
+    if args.mirror:
+        overrides["snapshot_mirror"] = True
     cfg = scenarios.scenario_config(overrides)
     summary = scenarios.run(
         args.name,
@@ -717,6 +725,12 @@ def build_parser() -> argparse.ArgumentParser:
     zr.add_argument(
         "--gang-off", action="store_true",
         help="disable gang co-scheduling (gang labels ignored)",
+    )
+    zr.add_argument(
+        "--mirror", action="store_true",
+        help="streaming state ingestion (snapshot_mirror): the world "
+        "drives informer-style events through the event-sourced "
+        "snapshot mirror instead of per-cycle rebuilds",
     )
     zr.set_defaults(fn=cmd_scenario)
 

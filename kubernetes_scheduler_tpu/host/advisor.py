@@ -77,6 +77,59 @@ class NodeUtil:
 Transport = Callable[[str, dict], dict]
 
 
+def _util_tuple(u: NodeUtil) -> tuple:
+    """Value identity of one node's utilization record — the coalescing
+    comparisons must see in-place NodeUtil mutation, so they compare
+    values, never object identity."""
+    return (u.disk_io, u.cpu_pct, u.mem_pct, u.net_up, u.net_down)
+
+
+def util_delta(last: dict, snap: dict[str, NodeUtil]) -> dict[str, NodeUtil]:
+    """Changed-node diff of a utilization snapshot against `last` (a
+    {name: value-tuple} map, UPDATED in place): nodes whose series moved
+    since the previous call, plus nodes that vanished (reported as a
+    zeros record — the builder's missing-node semantics). The shared
+    body of every fetch_changed implementation."""
+    changed: dict[str, NodeUtil] = {}
+    for name, u in snap.items():
+        t = _util_tuple(u)
+        if last.get(name) != t:
+            changed[name] = u
+            last[name] = t
+    if len(last) > len(snap):
+        for name in [k for k in last if k not in snap]:
+            del last[name]
+            changed[name] = NodeUtil()
+    return changed
+
+
+class CoalescingAdvisor:
+    """Changed-only fetch over any advisor: `fetch_changed()` returns
+    {node: NodeUtil} for nodes whose series moved since the previous
+    call (first call returns everything), feeding the snapshot mirror's
+    utilization events (host/mirror.py) so an idle cluster's state
+    fetch applies ZERO rows. The diff itself is O(nodes) of tuple
+    compares per call — advisors that can do better (BackgroundAdvisor
+    diffs in its refresh thread; bench churn advisors know exactly what
+    they perturbed) expose their own fetch_changed and are not wrapped
+    (Scheduler wraps only advisors lacking the surface). Unknown
+    attributes (stale_served, close) delegate to the inner advisor so
+    exporters keep reading through the wrapper."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self._last: dict[str, tuple] = {}
+
+    def fetch(self) -> dict[str, NodeUtil]:
+        return self.inner.fetch()
+
+    def fetch_changed(self) -> dict[str, NodeUtil]:
+        return util_delta(self._last, self.inner.fetch())
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
 def _urllib_transport(url: str, form: dict) -> dict:
     data = urllib.parse.urlencode(form).encode()
     with urllib.request.urlopen(url, data=data, timeout=10) as resp:
@@ -187,6 +240,12 @@ class BackgroundAdvisor:
         self._lock = threading.Lock()
         self._snap: dict[str, NodeUtil] | None = None
         self._ts: float = float("-inf")
+        # changed-node coalescing (fetch_changed): the refresh thread
+        # diffs each scrape against the last-seen value map and
+        # accumulates the changed records, so the CYCLE path drains them
+        # in O(changed) — an idle cluster's state fetch applies nothing
+        self._last_tuples: dict[str, tuple] = {}
+        self._pending_changed: dict[str, NodeUtil] = {}
         self.stale_served = 0
         self._stop = threading.Event()
         # serializes scrapes: the cycle-path staleness fallback must
@@ -207,12 +266,18 @@ class BackgroundAdvisor:
                 )
                 self._thread.start()
 
+    def _store(self, snap: dict[str, NodeUtil]) -> None:
+        """Adopt one fresh scrape: diff against the last-seen values
+        (off the cycle path when called from the refresh thread) and
+        accumulate the changed records for fetch_changed."""
+        with self._lock:
+            self._pending_changed.update(util_delta(self._last_tuples, snap))
+            self._snap = snap
+            self._ts = self._clock()
+
     def _refresh_once(self) -> None:
         with self._refresh_lock:
-            snap = self.inner.fetch()
-            with self._lock:
-                self._snap = snap
-                self._ts = self._clock()
+            self._store(self.inner.fetch())
 
     def _run(self) -> None:
         while not self._stop.is_set():
@@ -245,10 +310,19 @@ class BackgroundAdvisor:
             if snap is not None and now - ts <= self.max_staleness:
                 return snap
             inner_snap = self.inner.fetch()
-            with self._lock:
-                self._snap = inner_snap
-                self._ts = self._clock()
-                return self._snap
+            self._store(inner_snap)
+            return inner_snap
+
+    def fetch_changed(self) -> dict[str, NodeUtil]:
+        """Changed-node records since the previous fetch_changed call —
+        the snapshot mirror's utilization event feed. Same staleness/
+        outage contract as fetch() (it runs first); the drain itself is
+        O(changed): idle cycles return {} on one dict swap."""
+        self.fetch()
+        with self._lock:
+            out = self._pending_changed
+            self._pending_changed = {}
+        return out
 
     def close(self) -> None:
         self._stop.set()

@@ -252,6 +252,12 @@ SHIPPED_METRICS = (
     "cycle_duration_seconds",
     "engine_step_duration_seconds",
     "snapshot_uploads_total",
+    # streaming state ingestion (host/mirror.SnapshotMirror): events
+    # applied by kind, flush-to-full rebuilds, and verification
+    # mismatches (the mirror<->rebuild bitwise cross-check)
+    "events_applied_total",
+    "mirror_full_rebuilds_total",
+    "mirror_verify_failures_total",
     # mesh-sharded resident engine: routed delta payload per owning
     # shard (host labels shard index; the sharded sidecar's twin does
     # too)
@@ -429,6 +435,13 @@ SHIPPED_SPANS = (
     "state_fetch",
     "snapshot_build",
     "delta_derive",
+    # streaming ingestion (config.snapshot_mirror): advisor changed-node
+    # drain applied as mirror events, and the mirror's O(events) emit —
+    # the stage that REPLACES snapshot_build + delta_derive on the hot
+    # path (those names survive for mirror-off runs and the ~0-cost
+    # delta_derive evidence under the mirror)
+    "event_apply",
+    "mirror_emit",
     "engine_step",
     "bind",
     "recorder_write",

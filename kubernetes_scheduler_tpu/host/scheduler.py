@@ -342,6 +342,41 @@ class Scheduler:
             extended_resources=list(config.extended_resources),
             gang_scheduling=config.gang_scheduling,
         )
+        # event-driven cycle triggering (config.cycle_trigger="event"):
+        # queue pushes and mirror events notify the trigger the host
+        # loops sleep on; "tick" (default) keeps the fixed-poll waits
+        if config.cycle_trigger not in ("tick", "event"):
+            raise ValueError(
+                f"unknown cycle_trigger {config.cycle_trigger!r}; "
+                "expected 'tick' or 'event'"
+            )
+        from kubernetes_scheduler_tpu.host.mirror import (
+            CycleTrigger,
+            SnapshotMirror,
+        )
+
+        self.trigger = (
+            CycleTrigger() if config.cycle_trigger == "event" else None
+        )
+        # streaming state ingestion (config.snapshot_mirror): the
+        # event-sourced mirror replaces the per-cycle build_snapshot/
+        # snapshot_delta pair on the hot path; the advisor is wrapped
+        # for changed-node fetches unless it already coalesces
+        self.mirror = None
+        if config.snapshot_mirror:
+            self.mirror = SnapshotMirror(
+                self.builder,
+                verify_interval=config.mirror_verify_interval,
+                on_dirty=(
+                    self.trigger.notify if self.trigger is not None else None
+                ),
+            )
+            if not hasattr(self.advisor, "fetch_changed"):
+                from kubernetes_scheduler_tpu.host.advisor import (
+                    CoalescingAdvisor,
+                )
+
+                self.advisor = CoalescingAdvisor(self.advisor)
         if config.adaptive_dispatch:
             from kubernetes_scheduler_tpu.utils.adaptive import AdaptiveDispatch
 
@@ -471,7 +506,7 @@ class Scheduler:
         self.prom_collectors = (
             self.hist_cycle, self.hist_engine, self.ctr_uploads,
             self.ctr_shard_bytes, self.ctr_slo,
-        )
+        ) + (self.mirror.collectors if self.mirror is not None else ())
         # SLO watchdog state (config.cycle_slo_ms): run totals, the last
         # breach's identity (trace id + flight-recorder seq — the two
         # handles that find the cycle in the span timeline and journal),
@@ -573,6 +608,9 @@ class Scheduler:
             # handling (requeue/backoff), not kill the informer thread
             pass
         self.queue.push(pod)
+        if self.trigger is not None:
+            # event-driven loops wake on arrival instead of the next tick
+            self.trigger.notify()
 
     # ---- one cycle -----------------------------------------------------
 
@@ -603,6 +641,61 @@ class Scheduler:
             if self._engine_windows_ok
             else 1
         )
+
+    def _mirror_state(self) -> tuple[list, list, dict]:
+        """Cluster state off the event-sourced mirror (config.
+        snapshot_mirror): the full list/fetch callables run ONCE to
+        seed; afterwards the per-cycle state fetch reduces to draining
+        the advisor's changed-node records and applying them as
+        utilization events (span event_apply) — O(events), not
+        O(nodes). Pod/node events arrive out of band (informer hooks,
+        ScenarioWorld, the scheduler's own post-bind self-apply)."""
+        mir = self.mirror
+        if not mir.seeded:
+            mir.seed(
+                self.list_nodes(),
+                self.list_running_pods(),
+                self.advisor.fetch(),
+            )
+        else:
+            fetch_changed = getattr(self.advisor, "fetch_changed", None)
+            if fetch_changed is not None:
+                t_e = time.perf_counter()
+                changed = fetch_changed()
+                if changed:
+                    mir.apply_util_events(changed)
+                self._span("event_apply", t_e, events=len(changed))
+        return mir.state()
+
+    def _cycle_snapshot(
+        self, window, nodes, running, utils, *, ephemeral: bool,
+    ):
+        """(snapshot, mirror delta | None) for one dispatch — the ONE
+        place the two state paths fork: mirror.emit serves the
+        persistent arrays plus a ready-made delta in O(events) (span
+        mirror_emit); the classic build_snapshot path (span
+        snapshot_build) covers mirror-off and ephemeral builds (a
+        reservation-concatenated running list is throwaway and must
+        never touch the mirror's state)."""
+        t_build = time.perf_counter()
+        plain = self._window_flags(window)[0]
+        if self.mirror is not None and not ephemeral:
+            snapshot, delta, rebuilt = self.mirror.emit(
+                window,
+                pending_all_plain=plain,
+                prev=self._resident_prev if self._resident_ok else None,
+            )
+            self._span(
+                "mirror_emit", t_build,
+                rebuilt=rebuilt, delta=delta is not None,
+            )
+            return snapshot, delta
+        snapshot = self.builder.build_snapshot(
+            nodes, utils, running, pending_pods=window,
+            ephemeral=ephemeral, pending_all_plain=plain,
+        )
+        self._span("snapshot_build", t_build)
+        return snapshot, None
 
     def _begin_cycle(
         self, m: CycleMetrics, t0: float, window: list | None = None,
@@ -647,9 +740,12 @@ class Scheduler:
 
         t_fetch = time.perf_counter()
         try:
-            nodes = self.list_nodes()
-            running = self.list_running_pods()
-            utils = self.advisor.fetch()
+            if self.mirror is not None:
+                nodes, running, utils = self._mirror_state()
+            else:
+                nodes = self.list_nodes()
+                running = self.list_running_pods()
+                utils = self.advisor.fetch()
         except Exception:
             # a cluster-source or advisor outage (API server blip,
             # Prometheus restart) must not LOSE the popped window: requeue
@@ -860,6 +956,14 @@ class Scheduler:
         # the 404/409 drop path inside _bind still marks immediately
         if self._cycle_bound:
             self.queue.mark_scheduled_many(self._cycle_bound)
+            if self.mirror is not None:
+                # the assume-cache equivalent: this cycle's binds enter
+                # the mirror as pod events NOW (every driver path —
+                # device, backlog, scalar), so the next emit's delta
+                # carries their rows; a later informer echo of the SAME
+                # Pod object coalesces by identity in the mirror
+                for pod in self._cycle_bound:
+                    self.mirror.apply_pod_event("BOUND", pod)
 
         # PostFilter parity: unschedulable pods may preempt strictly-
         # lower-priority running pods (ops/preempt.py). A failure here
@@ -1243,11 +1347,8 @@ class Scheduler:
         speculative prebuild respects this through the layout
         fingerprint: a selector minted between prebuild and here
         discards the prebuilt batch.)"""
-        t_build = time.perf_counter()
-        snapshot = self.builder.build_snapshot(
-            nodes, utils, running, pending_pods=window,
-            ephemeral=ephemeral,
-            pending_all_plain=self._window_flags(window)[0],
+        snapshot, mirror_delta = self._cycle_snapshot(
+            window, nodes, running, utils, ephemeral=ephemeral
         )
         pods_batch = None
         spec = self._spec_batch
@@ -1268,7 +1369,6 @@ class Scheduler:
             window, nodes, running, pods_batch, snapshot,
             record=not ephemeral,
         )
-        self._span("snapshot_build", t_build)
         self._set_engine_trace_id()
         tctx = None
         if self.recorder is not None:
@@ -1281,7 +1381,7 @@ class Scheduler:
             self._trace_cycle.append(tctx)
         infl = self._dispatch_resident(
             snapshot, pods_batch, kw, ephemeral=ephemeral, use_async=use_async,
-            tctx=tctx,
+            tctx=tctx, mirror_delta=mirror_delta,
         )
         if infl is not None:
             infl.trace_ctx = tctx
@@ -1325,7 +1425,7 @@ class Scheduler:
 
     def _dispatch_resident(
         self, snapshot, pods_batch, kw, *, ephemeral: bool, use_async: bool,
-        tctx: dict | None = None,
+        tctx: dict | None = None, mirror_delta=None,
     ) -> "_InFlight | None":
         """Resident-state dispatch (config.resident_state): ship a
         SnapshotDelta against the engine-retained snapshot when the
@@ -1344,7 +1444,9 @@ class Scheduler:
         supports = getattr(self.engine, "supports_resident", None)
         if supports is None or not supports():
             return None
-        delta, epoch, saved = self._derive_resident_delta(snapshot, tctx)
+        delta, epoch, saved = self._derive_resident_delta(
+            snapshot, tctx, mirror_delta=mirror_delta
+        )
         t_eng = time.perf_counter()
         submit = (
             getattr(self.engine, "schedule_resident_async", None)
@@ -2180,15 +2282,12 @@ class Scheduler:
         from kubernetes_scheduler_tpu.utils.padding import pad_pod_batch
 
         bw = self.config.batch_window
-        t_build = time.perf_counter()
-        snapshot = self.builder.build_snapshot(
-            nodes, utils, running, pending_pods=window, ephemeral=ephemeral,
-            pending_all_plain=self._window_flags(window)[0],
+        snapshot, mirror_delta = self._cycle_snapshot(
+            window, nodes, running, utils, ephemeral=ephemeral
         )
         pods_batch = self.builder.build_pod_batch(
             window, recs=self._window_recs(window)
         )
-        self._span("snapshot_build", t_build)
         n_padded = -(-len(window) // bw) * bw
         p_have = int(np.asarray(pods_batch.request).shape[0])
         if p_have < n_padded:
@@ -2215,6 +2314,7 @@ class Scheduler:
             self._trace_cycle.append(tctx)
         res, t_eng = self._dispatch_windows(
             snapshot, windows, kw, m, ephemeral=ephemeral, tctx=tctx,
+            mirror_delta=mirror_delta,
         )
         idx = np.asarray(res.node_idx).reshape(-1)
         t_done = time.perf_counter()
@@ -2238,7 +2338,7 @@ class Scheduler:
 
     def _dispatch_windows(
         self, snapshot, windows, kw, m: CycleMetrics,
-        *, ephemeral: bool, tctx: dict | None,
+        *, ephemeral: bool, tctx: dict | None, mirror_delta=None,
     ):
         """Backlog engine dispatch, resident-aware: with
         config.resident_state and an engine serving the windows-resident
@@ -2263,7 +2363,9 @@ class Scheduler:
         if not resident:
             t_eng = time.perf_counter()
             return self.engine.schedule_windows(snapshot, windows, **kw), t_eng
-        delta, epoch, saved = self._derive_resident_delta(snapshot, tctx)
+        delta, epoch, saved = self._derive_resident_delta(
+            snapshot, tctx, mirror_delta=mirror_delta
+        )
         t_eng = time.perf_counter()
         res = self.engine.schedule_windows_resident(
             snapshot, windows, delta=delta, epoch=epoch, **kw
@@ -2275,19 +2377,28 @@ class Scheduler:
         return res, t_eng
 
     def _derive_resident_delta(
-        self, snapshot, tctx: dict | None
+        self, snapshot, tctx: dict | None, mirror_delta=None,
     ) -> tuple:
         """(delta, epoch, bytes_saved) for a resident dispatch, with the
         trace context filled — ONE derivation shared by the single-
         window and backlog dispatchers so the two resident surfaces
-        cannot drift on delta-base, epoch, or recorder-chain semantics."""
+        cannot drift on delta-base, epoch, or recorder-chain semantics.
+
+        With the snapshot mirror on, the delta was emitted WITH the
+        snapshot (already validated against the engine-retained base by
+        identity, flush rules applied) — the O(nodes) row diff never
+        runs; the delta_derive span survives at ~0 as the before/after
+        evidence in `spans report`."""
         from kubernetes_scheduler_tpu.engine import snapshot_nbytes
         from kubernetes_scheduler_tpu.host.snapshot import snapshot_delta
 
         t_d = time.perf_counter()
-        delta = None
-        if self._resident_ok and self._resident_prev is not None:
-            delta = snapshot_delta(self._resident_prev, snapshot)
+        if self.mirror is not None:
+            delta = mirror_delta
+        else:
+            delta = None
+            if self._resident_ok and self._resident_prev is not None:
+                delta = snapshot_delta(self._resident_prev, snapshot)
         self._span("delta_derive", t_d, sent=delta is not None)
         epoch = self._resident_epoch + 1
         saved = 0

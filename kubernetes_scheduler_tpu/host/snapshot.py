@@ -400,6 +400,28 @@ FLAG_PLAIN = 1   # no constraint family beyond score + resource fit
 FLAG_SOFT = 2    # carries preferred (soft) score terms
 
 
+def selector_key(term) -> tuple:
+    """The canonical selector identity (matchLabels, matchExpressions,
+    topology key, namespace scope) WITHOUT minting an id — the probe the
+    snapshot mirror uses to detect selector drift (host/mirror.py), and
+    the key _selector_id interns. One definition, so the drift check and
+    the interner cannot disagree on what "the same selector" means."""
+    exprs = tuple(
+        sorted(
+            (e.key, e.operator, tuple(sorted(e.values)))
+            for e in getattr(term, "match_expressions", None) or []
+        )
+    )
+    namespaces = getattr(term, "namespaces", None)
+    ns_key = None if namespaces is None else tuple(sorted(set(namespaces)))
+    return (
+        tuple(sorted(term.match_labels.items())),
+        exprs,
+        term.topology_key,
+        ns_key,
+    )
+
+
 def pod_flags(pod: Pod) -> int:
     """Per-pod dispatch flags, memoized on the pod object (specs are
     immutable in k8s): the per-cycle eligibility scans probe EVERY
@@ -1012,20 +1034,8 @@ class SnapshotBuilder:
         dicts/dataclasses per probe."""
         from kubernetes_scheduler_tpu.host.types import MatchExpression
 
-        exprs = tuple(
-            sorted(
-                (e.key, e.operator, tuple(sorted(e.values)))
-                for e in getattr(term, "match_expressions", None) or []
-            )
-        )
-        namespaces = getattr(term, "namespaces", None)
-        ns_key = None if namespaces is None else tuple(sorted(set(namespaces)))
-        key = (
-            tuple(sorted(term.match_labels.items())),
-            exprs,
-            term.topology_key,
-            ns_key,
-        )
+        key = selector_key(term)
+        exprs = key[1]
         if key not in self.selectors:
             self.selectors[key] = len(self.selectors)
             self._selector_parsed[key] = (

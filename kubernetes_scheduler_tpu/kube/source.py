@@ -42,9 +42,17 @@ class InformerCache:
         watch_timeout: float = 60.0,
         resync_interval: float = 300.0,
         volumes: bool = True,
+        on_event=None,
     ):
         self.client = client
         self.watch_timeout = watch_timeout
+        # streaming-ingestion hook (host/mirror.SnapshotMirror):
+        # on_event(resource, etype, obj) fires AFTER the store update,
+        # outside the cache lock, with the CONVERTED object the store
+        # now holds (Node/Pod; None on RESYNC — a full relist replaced
+        # the store and the consumer must reseed). Only the node and
+        # assigned-pod streams emit: they are the snapshot's inputs.
+        self.on_event = on_event
         # volumes=False skips the PVC/PV loops (no list+watch streams, no
         # resident stores) for deployments that disable volume topology
         self.volumes = volumes
@@ -185,21 +193,41 @@ class InformerCache:
             apply=self._apply_node_event,
         )
 
+    def _emit(self, resource: str, etype: str, obj) -> None:
+        if self.on_event is None:
+            return
+        try:
+            self.on_event(resource, etype, obj)
+        except Exception:
+            # a consumer bug must never kill an informer loop. A missed
+            # pod/node event is bounded by the periodic RESYNC relist
+            # (resync_interval), which reseeds the consumer from the
+            # fresh stores — the mirror's verify pass cannot catch it
+            # (it cross-checks against the mirror's OWN lists)
+            log.exception("informer on_event hook failed (%s)", resource)
+
     def _replace_nodes(self, items: list[dict]) -> None:
         fresh = {o["metadata"]["name"]: node_from_api(o) for o in items}
         with self._lock:
+            first = not self._synced["nodes"].is_set()
             self._nodes = fresh
+        if not first:
+            self._emit("nodes", "RESYNC", None)
 
     def _apply_node_event(self, ev: dict) -> None:
         obj = ev.get("object") or {}
         name = (obj.get("metadata") or {}).get("name")
         if not name:
             return
+        etype = ev.get("type")
+        node = None
         with self._lock:
-            if ev.get("type") == "DELETED":
-                self._nodes.pop(name, None)
-            elif ev.get("type") in ("ADDED", "MODIFIED"):
-                self._nodes[name] = node_from_api(obj)
+            if etype == "DELETED":
+                node = self._nodes.pop(name, None)
+            elif etype in ("ADDED", "MODIFIED"):
+                node = self._nodes[name] = node_from_api(obj)
+        if node is not None:
+            self._emit("nodes", etype, node)
 
     # -- assigned-pod loop ----------------------------------------------
 
@@ -222,18 +250,27 @@ class InformerCache:
                 pod_from_api(o)
             )
         with self._lock:
+            first = not self._synced["pods"].is_set()
             self._pods = fresh
+        if not first:
+            self._emit("pods", "RESYNC", None)
 
     def _apply_pod_event(self, ev: dict) -> None:
         obj = ev.get("object") or {}
         meta = obj.get("metadata") or {}
         key = f"{meta.get('namespace', 'default')}/{meta.get('name')}"
         finished = (obj.get("status") or {}).get("phase") in FINISHED_PHASES
+        etype = ev.get("type")
+        pod = None
+        deleted = False
         with self._lock:
-            if ev.get("type") == "DELETED" or finished:
-                self._pods.pop(key, None)
-            elif ev.get("type") in ("ADDED", "MODIFIED"):
-                self._pods[key] = pod_from_api(obj)
+            if etype == "DELETED" or finished:
+                pod = self._pods.pop(key, None)
+                deleted = True
+            elif etype in ("ADDED", "MODIFIED"):
+                pod = self._pods[key] = pod_from_api(obj)
+        if pod is not None:
+            self._emit("pods", "DELETED" if deleted else etype, pod)
 
     # -- PDB loop --------------------------------------------------------
 
@@ -961,6 +998,57 @@ class _Feeder(threading.Thread):
             self.stop_evt.wait(0.02)   # yield between bounded streams
 
 
+def _idle_wait(sched, feeder: "_Feeder", idle_sleep: float) -> None:
+    """One idle wait of the serving loop: with config.cycle_trigger=
+    "event" the scheduler's CycleTrigger is the wake source (queue
+    pushes notify it from Scheduler.submit — including the feeder's —
+    and mirror events do too, so a utilization shift alone can start a
+    cycle); otherwise the feeder's wake event, the tick-polling
+    default. Either way idle_sleep is the watchdog timeout — the loop
+    re-checks on silence."""
+    trigger = getattr(sched, "trigger", None)
+    if trigger is not None:
+        trigger.wait(idle_sleep)
+    else:
+        feeder.wake.wait(timeout=idle_sleep)
+        feeder.wake.clear()
+
+
+def attach_mirror(cache: InformerCache, sched) -> None:
+    """Wire an InformerCache's node/pod streams into a mirror-enabled
+    Scheduler (config.snapshot_mirror): watch events become mirror row
+    updates, and a RESYNC (periodic full relist — the missed-event
+    backstop) reseeds the mirror from the cache's fresh stores (the
+    next emit flushes to a full rebuild). Utilization events ride the
+    advisor's fetch_changed drain on the cycle path, not this hook.
+
+    Eventual-consistency bound: an event landing between the seed's
+    cache-store reads and the mirror becoming seeded is dropped (and
+    the mirror's verify pass cannot see it — it cross-checks against
+    the mirror's own lists); the next RESYNC reconciles, so staleness
+    is bounded by the cache's resync_interval — the same bound the
+    informer pattern itself gives the pre-mirror list reads."""
+    mirror = getattr(sched, "mirror", None)
+    if mirror is None:
+        raise ValueError(
+            "scheduler has no snapshot mirror (set config.snapshot_mirror)"
+        )
+
+    def on_event(resource: str, etype: str, obj) -> None:
+        if not mirror.seeded:
+            return  # the scheduler's first cycle seeds from the cache
+        if etype == "RESYNC":
+            mirror.seed(
+                cache.nodes(), cache.running_pods(), dict(mirror.utils)
+            )
+        elif resource == "nodes":
+            mirror.apply_node_event(etype, obj)
+        elif resource == "pods":
+            mirror.apply_pod_event(etype, obj)
+
+    cache.on_event = on_event
+
+
 def run_kube_loop(
     sched,
     source: KubeClusterSource,
@@ -1027,8 +1115,7 @@ def run_kube_loop(
             ):
                 if exit_when_idle and feeder.idle_rounds >= 1:
                     return cycles
-                feeder.wake.wait(timeout=idle_sleep)
-                feeder.wake.clear()
+                _idle_wait(sched, feeder, idle_sleep)
                 continue
             try:
                 m = sched.run_cycle()
@@ -1049,8 +1136,7 @@ def run_kube_loop(
                 # only backoff pods remain: wait a full idle period (new
                 # arrivals cut it short via the feeder's wake event)
                 # rather than spinning empty cycles at 20Hz
-                feeder.wake.wait(timeout=idle_sleep)
-                feeder.wake.clear()
+                _idle_wait(sched, feeder, idle_sleep)
     finally:
         feeder.stop_evt.set()
         # any exit (stop(), max_cycles) with a prefetched window in hand

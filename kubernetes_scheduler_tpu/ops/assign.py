@@ -40,6 +40,14 @@ NEG = -1.0e30
 # saved HBM traffic. Read once at import (never inside a trace).
 _BID_KERNEL_MODE = os.environ.get("YODA_AUCTION_BID_KERNEL", "auto")
 
+# greedy scan-kernel routing (ops/pallas_fused.fused_greedy_scan): the
+# same dial for the greedy assigner's per-pod capacity update — the
+# scan body's [n, r] capacity broadcast + one-hot delta per pod step
+# round-trips HBM P times; the kernel carries free capacity in VMEM
+# for the whole window. Auto-gated to TPU backends like the bid kernel
+# (the CPU interpreter keeps the XLA scan); no-affinity windows only.
+_GREEDY_KERNEL_MODE = os.environ.get("YODA_GREEDY_KERNEL", "auto")
+
 # element budgets for trading dense compare-and-reduce formulations
 # against scatter forms (TPU scatters serialize per update, dense forms
 # vectorize but cost O(elements) work); overridable in tests to pin
@@ -260,6 +268,7 @@ def greedy_assign(
     priority: jnp.ndarray,
     pod_mask: jnp.ndarray,
     affinity: AffinityState | None = None,
+    greedy_kernel: bool | None = None,
 ) -> AssignResult:
     """Sequential-greedy assignment as a lax.scan.
 
@@ -270,9 +279,38 @@ def greedy_assign(
     node_free:   [n, r] free capacity (allocatable - requested)
     priority:    [p] int priority (sort.go semantics)
     pod_mask:    [p] bool
+
+    greedy_kernel routes the no-affinity scan through the fused Pallas
+    step kernel (ops/pallas_fused.fused_greedy_scan): the free-capacity
+    carry stays resident in VMEM for the whole window instead of the
+    scan body's per-step [n, r] HBM round-trip + one-hot delta.
+    Decisions and free_after are bitwise identical (first-max ties —
+    pinned in tests/test_pallas.py). None = auto (TPU backends only;
+    YODA_GREEDY_KERNEL=on/off overrides). Affinity windows keep the
+    XLA scan: their per-step masks depend on carried [n, S] count
+    state the kernel does not fold.
     """
     order = _priority_order(priority, pod_mask)
     p = scores.shape[0]
+    if greedy_kernel is None:
+        greedy_kernel = _GREEDY_KERNEL_MODE == "on" or (
+            _GREEDY_KERNEL_MODE == "auto" and jax.default_backend() == "tpu"
+        )
+    if greedy_kernel and affinity is None:
+        from kubernetes_scheduler_tpu.ops.pallas_fused import (
+            fused_greedy_scan,
+        )
+
+        sj = jnp.where(feasible & pod_mask[:, None], scores, NEG)
+        picks, free_after = fused_greedy_scan(
+            sj[order], pod_request[order].astype(jnp.float32), node_free
+        )
+        node_idx = jnp.full((p,), -1, jnp.int32).at[order].set(picks)
+        return AssignResult(
+            node_idx=node_idx,
+            free_after=free_after.astype(node_free.dtype),
+            n_assigned=(node_idx >= 0).sum().astype(jnp.int32),
+        )
     added0 = (
         None if affinity is None else jnp.zeros_like(affinity.domain_counts)
     )

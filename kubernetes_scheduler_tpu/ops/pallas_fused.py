@@ -418,6 +418,113 @@ def fused_score_row_stats(
     return jnp.stack([highest, lowest])
 
 
+def _greedy_kernel(sj_ref, req_ref, free0_ref, picks_ref, free_ref,
+                   *, n_res: int, pp: int):
+    """One pod step of the greedy scan: capacity mask + row argmax +
+    the per-pod capacity decrement, with free capacity CARRIED in the
+    revisited free_ref output block across grid steps — the scan's
+    whole [n, r] free matrix stays in VMEM for the entire window
+    instead of round-tripping HBM once per pod (the XLA scan body
+    additionally materializes a [n, r] one-hot delta per step).
+
+    sj_ref:    [1, NN] this pod's feasibility-masked scores (NEG where
+               infeasible — pod_mask and `feasible` folded by the host)
+    req_ref:   [1, R_pad] this pod's request row (resource axis padded
+               to the lane tile; only the first n_res lanes are read)
+    free0_ref: [n_res, NN] initial free capacity, resource-major
+    picks_ref: [1, PP] int32 — pod i's chosen GLOBAL column, -1 = none
+               (revisited; initialized on the first step)
+    free_ref:  [n_res, NN] — the carried free capacity AND the final
+               free_after output
+
+    Tie semantics replicate jnp.argmax(row) exactly (first column of
+    the row maximum); the capacity update subtracts only the chosen
+    column, which is bitwise the XLA body's `free - onehot(choice)*req`
+    (x - 0 == x for every non-chosen cell, and the chosen column sees
+    the identical single subtraction).
+    """
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        free_ref[...] = free0_ref[...]
+        picks_ref[...] = jnp.full(picks_ref.shape, -1, jnp.int32)
+
+    sj = sj_ref[...]                                       # [1, NN]
+    mask = sj > NEG * 0.5
+    for r in range(n_res):
+        req = req_ref[0, r]
+        mask = mask & (
+            (req <= free_ref[r, :][None, :]) | (req == 0.0)
+        )
+    row = jnp.where(mask, sj, NEG)
+    mx = row.max()
+    found = mask.any()
+    iota = jax.lax.broadcasted_iota(jnp.int32, row.shape, 1)
+    choice = jnp.where(row == mx, iota, jnp.int32(2**31 - 1)).min()
+    pick = jnp.where(found, choice, jnp.int32(-1))
+    pods = jax.lax.broadcasted_iota(jnp.int32, (1, pp), 1)
+    picks_ref[...] = jnp.where(pods == i, pick, picks_ref[...])
+    upd = mask & (iota == choice) & found                  # [1, NN]
+    free = free_ref[...]
+    req_col = jnp.stack(
+        [req_ref[0, r] for r in range(n_res)]
+    )[:, None]                                             # [n_res, 1]
+    free_ref[...] = jnp.where(upd, free - req_col, free)
+
+
+def fused_greedy_scan(
+    sj: jnp.ndarray,
+    pod_request: jnp.ndarray,
+    node_free: jnp.ndarray,
+    *,
+    tile_n: int = 128,
+    interpret: bool | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(picks [p] int32, free_after [n, r]) — the sequential greedy
+    scan (ops/assign.greedy_assign's no-affinity body) as ONE Pallas
+    kernel with the free-capacity carry resident in VMEM.
+
+    sj:          [p, n] feasibility-masked scores IN SCAN ORDER (the
+                 caller permutes by priority and un-permutes the picks,
+                 exactly like the lax.scan body's order/ordering)
+    pod_request: [p, r] requests in the same order
+    node_free:   [n, r] initial free capacity
+
+    Bitwise-identical picks and free_after to the XLA scan body (pinned
+    in tests/test_pallas.py); like fused_auction_bid this is a TPU
+    bandwidth optimization — the CPU interpreter path exists for
+    parity tests, not speed."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    p, n = sj.shape
+    n_res = pod_request.shape[1]
+    sj_pad = _pad2(sj, tile_n, tile_n, value=NEG)
+    pp, nn = sj_pad.shape
+    req_rows = _pad_axis(pod_request.astype(jnp.float32), 1, tile_n)
+    req_rows = _pad_axis(req_rows, 0, tile_n)
+    free_t = _pad_axis(node_free.astype(jnp.float32).T, 1, tile_n)
+    picks, free_after_t = pl.pallas_call(
+        functools.partial(_greedy_kernel, n_res=n_res, pp=pp),
+        out_shape=(
+            jax.ShapeDtypeStruct((1, pp), jnp.int32),
+            jax.ShapeDtypeStruct((n_res, nn), jnp.float32),
+        ),
+        grid=(pp,),
+        in_specs=[
+            pl.BlockSpec((1, nn), lambda i: (i, 0)),
+            pl.BlockSpec((1, req_rows.shape[1]), lambda i: (i, 0)),
+            pl.BlockSpec((n_res, nn), lambda i: (0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, pp), lambda i: (0, 0)),
+            pl.BlockSpec((n_res, nn), lambda i: (0, 0)),
+        ),
+        interpret=interpret,
+    )(sj_pad, req_rows, free_t)
+    return picks[0, :p], free_after_t[:, :n].T
+
+
 def _bid_kernel(sj_ref, price_ref, act_ref, req_ref, free_ref,
                 bid_ref, has_ref, best_ref, *, n_res: int, tile_n: int):
     """One (TILE_P, TILE_N) block of one auction round's bidding:

@@ -69,6 +69,14 @@ class ScenarioWorld:
         self.submitted += 1
         self.scheduler.submit(pod)
 
+    def _mirror(self):
+        """The scheduler's snapshot mirror when streaming ingestion is
+        on (config.snapshot_mirror) — ScenarioWorld plays the informer's
+        role then, delivering node/pod events instead of relying on the
+        per-cycle list reads the mirror replaced. Bind events need no
+        delivery: the scheduler self-applies its own binds."""
+        return getattr(self.scheduler, "mirror", None)
+
     def fail_node(self, name: str) -> int:
         """Remove a node mid-run; its running pods are killed and
         resubmitted (the informer would deliver exactly this as a node
@@ -80,9 +88,16 @@ class ScenarioWorld:
         self.nodes.remove(nd)
         self.downed[name] = nd
         self.node_failures += 1
+        mirror = self._mirror()
+        if mirror is not None:
+            mirror.apply_node_event("DELETED", nd)
         displaced = [p for p in self.running if p.node_name == name]
         for pod in displaced:
             self.running.remove(pod)
+            if mirror is not None:
+                # the pod DELETE the informer would stream; the
+                # controller's re-create is the submit below
+                mirror.apply_pod_event("DELETED", pod)
             pod.node_name = None
             self.resubmitted += 1
             self.scheduler.submit(pod)
@@ -94,6 +109,9 @@ class ScenarioWorld:
             return False
         self.nodes.append(nd)
         self.node_restores += 1
+        mirror = self._mirror()
+        if mirror is not None:
+            mirror.apply_node_event("ADDED", nd)
         return True
 
     def absorb_bindings(self) -> None:

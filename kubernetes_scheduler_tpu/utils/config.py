@@ -173,6 +173,29 @@ class SchedulerConfig:
     # (PARITY.md round 15); only in-process engines are built from this
     # knob — a remote sidecar's mesh is its own --mesh-devices flag.
     sharded_engine: bool = False
+    # streaming state ingestion (host/mirror.SnapshotMirror): informer
+    # pod/node/utilization events apply directly to a persistent
+    # host-side numpy mirror of the snapshot arrays, and each cycle
+    # emits a ready-made SnapshotDelta in O(events since last cycle)
+    # instead of rebuilding from the full lists (snapshot_build) and
+    # row-diffing whole matrices (delta_derive) — an idle cluster costs
+    # ~0 and the 100k-node host ceiling moves off the cycle path.
+    # build_snapshot remains the flush-to-full path (node churn,
+    # selector/port layout drift) and the verification path:
+    # mirror_verify_interval > 0 cross-checks the mirror against a full
+    # rebuild every N emits, BITWISE, resyncing loudly on mismatch
+    # (mirror_verify_failures_total). Off by default; mirror-on and
+    # mirror-off bindings are bit-identical (PARITY.md round 16).
+    snapshot_mirror: bool = False
+    mirror_verify_interval: int = 256
+    # cycle triggering: "tick" (default) keeps the fixed-poll idle waits
+    # of the host loops; "event" arms a CycleTrigger the loops sleep on
+    # — queue pushes and mirror events wake a cycle immediately, the
+    # poll interval degrades to a watchdog timeout (no lost wakeups:
+    # the trigger latches notifies that land between the work check and
+    # the wait). Scheduling decisions are unaffected — only WHEN cycles
+    # run changes.
+    cycle_trigger: str = "tick"
     # gang co-scheduling (ops/gang.py, arXiv:2511.08373): pods labeled
     # scv/gang + scv/gang-size bind all-or-nothing — the engine rescinds
     # every placement of a gang that did not fully fit, and the host

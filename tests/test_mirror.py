@@ -1,0 +1,456 @@
+"""Streaming state ingestion (host/mirror.SnapshotMirror): event-driven
+mirror parity against the per-cycle rebuild, flush-to-full rules, delta
+semantics, advisor coalescing, and the event-driven cycle trigger.
+
+The PARITY round-16 guarantee lives here: mirror-on and mirror-off
+bindings are BITWISE identical across serial/pipelined x full/resident,
+and the mirror's periodic cross-check (verify_interval) never fires on
+any of these workloads.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kubernetes_scheduler_tpu.engine import apply_snapshot_delta_np
+from kubernetes_scheduler_tpu.host.advisor import (
+    BackgroundAdvisor,
+    CoalescingAdvisor,
+    NodeUtil,
+    StaticAdvisor,
+)
+from kubernetes_scheduler_tpu.host.mirror import CycleTrigger, SnapshotMirror
+from kubernetes_scheduler_tpu.host.scheduler import RecordingBinder, Scheduler
+from kubernetes_scheduler_tpu.sim.host_gen import gen_host_cluster, gen_host_pods
+from kubernetes_scheduler_tpu.utils.config import SchedulerConfig
+
+
+class _ChurnAdvisor:
+    """Deterministically perturbs a rotating slice of nodes per fetch,
+    with the coalescing surface (fetch_changed) reporting exactly the
+    perturbed slice."""
+
+    def __init__(self, base, names, k=3):
+        self.utils = dict(base.fetch())
+        self.names = list(names)
+        self.k = k
+        self.i = 0
+        self._changed: dict = {}
+
+    def fetch(self):
+        self._changed = {}
+        for j in range(self.k):
+            nm = self.names[(self.i + j) % len(self.names)]
+            u = self.utils[nm]
+            nu = NodeUtil(
+                cpu_pct=u.cpu_pct + 0.25, mem_pct=u.mem_pct,
+                disk_io=u.disk_io, net_up=u.net_up, net_down=u.net_down,
+            )
+            self.utils[nm] = nu
+            self._changed[nm] = nu
+        self.i += self.k
+        return self.utils
+
+    def fetch_changed(self):
+        self.fetch()
+        return dict(self._changed)
+
+
+def _mk_sched(
+    nodes, advisor, running, *, mirror, verify_interval=1, **overrides
+):
+    from kubernetes_scheduler_tpu.sim.scenarios import SimClock
+
+    overrides.setdefault("max_windows_per_cycle", 1)
+    cfg = SchedulerConfig(
+        batch_window=32,
+        normalizer="none",
+        adaptive_dispatch=False,
+        min_device_work=1,
+        snapshot_mirror=mirror,
+        mirror_verify_interval=verify_interval,
+        **overrides,
+    )
+    clock = SimClock()
+    sched = Scheduler(
+        cfg,
+        advisor=advisor,
+        binder=RecordingBinder(),
+        list_nodes=lambda: nodes,
+        list_running_pods=lambda: running,
+        # virtual queue clock: retry backoffs resolve per-cycle, so the
+        # mirror-on/off runs pop IDENTICAL windows regardless of how
+        # fast each host path drains (wall-clock backoffs would diverge)
+        queue_clock=clock,
+    )
+    sched._test_clock = clock
+    return sched
+
+
+def _drain(sched, nodes, running, *, events=None, max_cycles=60):
+    """Drain the queue, feeding binds back as running pods; `events` is
+    {cycle index: fn(sched, nodes, running)} fired between cycles (the
+    informer-event injection point)."""
+    seen = 0
+    for c in range(max_cycles):
+        if events and c in events:
+            events[c](sched, nodes, running)
+        sched._test_clock.advance(1.0)
+        if len(sched.queue) == 0 and sched._prefetched is None:
+            break
+        sched.run_cycle()
+        for b in sched.binder.bindings[seen:]:
+            running.append(b.pod)
+        seen = len(sched.binder.bindings)
+    sched.drain_pipeline()
+    return [(b.pod.namespace, b.pod.name, b.node_name)
+            for b in sched.binder.bindings]
+
+
+def _run_workload(*, mirror: bool, constraints=False, flap=False, **overrides):
+    nodes, base = gen_host_cluster(48, seed=0, constraints=constraints)
+    advisor = _ChurnAdvisor(base, [nd.name for nd in nodes])
+    running: list = []
+    sched = _mk_sched(nodes, advisor, running, mirror=mirror, **overrides)
+    for pod in gen_host_pods(220, seed=1, constraints=constraints):
+        sched.submit(pod)
+    events = None
+    if flap:
+        def fail(sched, nodes, running):
+            nd = nodes.pop(3)
+            fail.node = nd
+            displaced = [p for p in running if p.node_name == nd.name]
+            for p in displaced:
+                running.remove(p)
+                if sched.mirror is not None:
+                    sched.mirror.apply_pod_event("DELETED", p)
+                p.node_name = None
+                sched.submit(p)
+            if sched.mirror is not None:
+                sched.mirror.apply_node_event("DELETED", nd)
+
+        def restore(sched, nodes, running):
+            nodes.append(fail.node)
+            if sched.mirror is not None:
+                sched.mirror.apply_node_event("ADDED", fail.node)
+
+        events = {2: fail, 4: restore}
+    bindings = _drain(sched, nodes, running, events=events)
+    return sched, bindings
+
+
+@pytest.mark.parametrize(
+    "overrides",
+    [
+        {},                                            # serial, full uploads
+        {"pipeline_depth": 1},                         # pipelined
+        {"pipeline_depth": 1, "resident_state": True},  # pipelined resident
+    ],
+    ids=["serial", "pipelined", "resident"],
+)
+def test_mirror_binding_parity_pod_churn(overrides):
+    a, ba = _run_workload(mirror=False, **overrides)
+    b, bb = _run_workload(mirror=True, **overrides)
+    assert ba and ba == bb
+    assert not b.mirror.ctr_verify_failures._series  # every emit verified
+    if overrides.get("resident_state"):
+        # the delta/full split must MATCH: the mirror flushes to full on
+        # exactly the cycles snapshot_delta would have returned None
+        assert (
+            a.totals["delta_uploads"], a.totals["full_uploads"],
+        ) == (b.totals["delta_uploads"], b.totals["full_uploads"])
+        assert b.totals["delta_uploads"] > 0
+
+
+@pytest.mark.parametrize(
+    "overrides",
+    [{}, {"pipeline_depth": 1, "resident_state": True}],
+    ids=["serial", "resident"],
+)
+def test_mirror_binding_parity_node_flap(overrides):
+    a, ba = _run_workload(mirror=False, flap=True, **overrides)
+    b, bb = _run_workload(mirror=True, flap=True, **overrides)
+    assert ba and ba == bb
+    assert not b.mirror.ctr_verify_failures._series
+    # the flap forced flush-to-full rebuilds beyond the seed build
+    assert b.mirror.ctr_rebuilds._series[()] >= 3
+
+
+def test_mirror_binding_parity_selector_drift():
+    # constraint traffic: anti-affinity terms mint selectors as pods
+    # arrive — every mint must flush, and decisions must not move
+    a, ba = _run_workload(mirror=False, constraints=True)
+    b, bb = _run_workload(mirror=True, constraints=True)
+    assert ba and ba == bb
+    assert not b.mirror.ctr_verify_failures._series
+
+
+def test_mirror_idle_emit_zero_row_delta():
+    nodes, advisor = gen_host_cluster(16, seed=0)
+    running: list = []
+    sched = _mk_sched(
+        nodes, CoalescingAdvisor(advisor), running, mirror=True,
+        resident_state=True, pipeline_depth=1,
+    )
+    for pod in gen_host_pods(8, seed=1):
+        sched.submit(pod)
+    _drain(sched, nodes, running)
+    mir = sched.mirror
+    prev, _, _ = mir.emit([], pending_all_plain=True, prev=None)
+    snap, delta, rebuilt = mir.emit([], pending_all_plain=True, prev=prev)
+    assert not rebuilt
+    n = int(np.asarray(snap.node_mask).shape[0])
+    # zero-row delta: every row index is the out-of-range pad sentinel
+    assert (np.asarray(delta.req_rows) == n).all()
+    assert (np.asarray(delta.util_rows) == n).all()
+    assert (np.asarray(delta.dom_rows) == n).all()
+    # unchanged leaves are served by identity across idle emits
+    assert snap.requested is prev.requested
+    assert snap.disk_io is prev.disk_io
+
+
+def test_mirror_delta_reproduces_snapshot_bitwise():
+    nodes, base = gen_host_cluster(24, seed=0)
+    advisor = _ChurnAdvisor(base, [nd.name for nd in nodes])
+    running: list = []
+    sched = _mk_sched(nodes, advisor, running, mirror=True)
+    for pod in gen_host_pods(40, seed=1):
+        sched.submit(pod)
+    _drain(sched, nodes, running)
+    mir = sched.mirror
+    prev, _, _ = mir.emit([], pending_all_plain=True, prev=None)
+    # events: utilization churn + a pod removal
+    mir.apply_util_events(advisor.fetch_changed())
+    victim = running[len(running) // 2]
+    mir.apply_pod_event("DELETED", victim)
+    snap, delta, rebuilt = mir.emit([], pending_all_plain=True, prev=prev)
+    assert not rebuilt and delta is not None
+    folded = apply_snapshot_delta_np(prev, delta)
+    for name in snap._fields:
+        a, b = np.asarray(getattr(folded, name)), np.asarray(getattr(snap, name))
+        assert np.array_equal(a, b), name
+    # the removal really changed the row (not a vacuous delta)
+    assert (np.asarray(delta.req_rows) < len(nodes)).any()
+
+
+def test_mirror_flush_reasons():
+    nodes, advisor = gen_host_cluster(16, seed=0)
+    running: list = []
+    sched = _mk_sched(nodes, CoalescingAdvisor(advisor), running, mirror=True)
+    for pod in gen_host_pods(8, seed=1):
+        sched.submit(pod)
+    _drain(sched, nodes, running)
+    mir = sched.mirror
+    base_rebuilds = mir.ctr_rebuilds._series[()]
+    # node event -> flush
+    mir.apply_node_event("MODIFIED", nodes[0])
+    _, delta, rebuilt = mir.emit([], pending_all_plain=True, prev=None)
+    assert rebuilt and delta is None
+    assert mir.ctr_rebuilds._series[()] == base_rebuilds + 1
+    # selector-minting window -> flush
+    from kubernetes_scheduler_tpu.host.types import Pod, PodAffinityTerm
+
+    pod = Pod(
+        name="drift", namespace="d",
+        pod_affinity=[
+            PodAffinityTerm(
+                match_labels={"nonesuch": "x"},
+                topology_key="kubernetes.io/hostname",
+                anti=True,
+            )
+        ],
+    )
+    _, delta, rebuilt = mir.emit([pod], pending_all_plain=False, prev=None)
+    assert rebuilt
+    assert mir.ctr_rebuilds._series[()] == base_rebuilds + 2
+
+
+def test_mirror_bound_pod_event_dedups_by_identity():
+    nodes, advisor = gen_host_cluster(8, seed=0)
+    running: list = []
+    sched = _mk_sched(nodes, CoalescingAdvisor(advisor), running, mirror=True)
+    for pod in gen_host_pods(4, seed=1):
+        sched.submit(pod)
+    _drain(sched, nodes, running)
+    mir = sched.mirror
+    n_running = len(mir.running)
+    # the informer echoing the scheduler's own bind (same object) no-ops
+    mir.apply_pod_event("MODIFIED", running[0])
+    assert len(mir.running) == n_running
+    assert mir.verify()
+
+
+def test_mirror_binding_parity_windows_backlog():
+    """The deep-backlog path (_run_backlog -> schedule_windows, with
+    the windows-resident delta surface) consumes mirror emits too."""
+    kw = dict(max_windows_per_cycle=4, resident_state=True)
+    a, ba = _run_workload(mirror=False, **kw)
+    b, bb = _run_workload(mirror=True, **kw)
+    assert ba and ba == bb
+    assert not b.mirror.ctr_verify_failures._series
+    assert (
+        a.totals["delta_uploads"], a.totals["full_uploads"],
+    ) == (b.totals["delta_uploads"], b.totals["full_uploads"])
+
+
+def test_mirror_binding_parity_sharded_resident():
+    """The acceptance matrix's sharded column: the mesh-sharded resident
+    engine consumes mirror-emitted deltas unchanged (shard_snapshot_delta
+    routes them inside ShardedEngine) — bindings bitwise mirror-on vs
+    mirror-off on the 8-device topology."""
+    a, ba = _run_workload(
+        mirror=False, pipeline_depth=1, resident_state=True,
+        sharded_engine=True,
+    )
+    b, bb = _run_workload(
+        mirror=True, pipeline_depth=1, resident_state=True,
+        sharded_engine=True,
+    )
+    assert ba and ba == bb
+    assert not b.mirror.ctr_verify_failures._series
+    assert b.totals["sharded_cycles"] > 0
+    assert b.totals["delta_uploads"] > 0
+    assert b.totals["shard_delta_bytes"] > 0  # routed mirror deltas
+
+
+# ---- advisor coalescing ---------------------------------------------------
+
+
+def test_coalescing_advisor_reports_only_changes():
+    utils = {"a": NodeUtil(cpu_pct=1.0), "b": NodeUtil(cpu_pct=2.0)}
+    adv = CoalescingAdvisor(StaticAdvisor(utils))
+    first = adv.fetch_changed()
+    assert set(first) == {"a", "b"}
+    assert adv.fetch_changed() == {}
+    utils["a"].cpu_pct = 5.0  # in-place mutation is seen (value compare)
+    assert set(adv.fetch_changed()) == {"a"}
+    del utils["b"]  # a vanished node degrades to a zeros record
+    changed = adv.fetch_changed()
+    assert set(changed) == {"b"} and changed["b"].cpu_pct == 0.0
+
+
+def test_background_advisor_fetch_changed_accumulates_off_cycle():
+    utils = {"a": NodeUtil(cpu_pct=1.0)}
+    clock = [0.0]
+    adv = BackgroundAdvisor(
+        StaticAdvisor(utils), interval=5.0, max_staleness=60.0,
+        clock=lambda: clock[0], start_thread=False,
+    )
+    assert set(adv.fetch_changed()) == {"a"}  # first drain: everything
+    assert adv.fetch_changed() == {}          # no refresh since
+    utils["a"] = NodeUtil(cpu_pct=9.0)
+    adv._refresh_once()                       # the background thread's diff
+    changed = adv.fetch_changed()
+    assert set(changed) == {"a"} and changed["a"].cpu_pct == 9.0
+    assert adv.fetch_changed() == {}
+
+
+# ---- event-driven cycle trigger -------------------------------------------
+
+
+def test_cycle_trigger_no_lost_wakeup():
+    trig = CycleTrigger()
+    trig.notify()  # lands BEFORE the wait — must not be lost
+    t0 = time.perf_counter()
+    assert trig.wait(5.0) is True
+    assert time.perf_counter() - t0 < 1.0
+    # drained: a second wait times out (the watchdog path)
+    assert trig.wait(0.02) is False
+
+
+def test_cycle_trigger_cross_thread_wakeup():
+    trig = CycleTrigger()
+
+    def poke():
+        time.sleep(0.05)
+        trig.notify()
+
+    t = threading.Thread(target=poke)
+    t.start()
+    t0 = time.perf_counter()
+    assert trig.wait(5.0) is True
+    assert time.perf_counter() - t0 < 2.0
+    t.join()
+
+
+def test_scheduler_submit_and_mirror_events_notify_trigger():
+    nodes, advisor = gen_host_cluster(8, seed=0)
+    running: list = []
+    sched = _mk_sched(
+        nodes, CoalescingAdvisor(advisor), running, mirror=True,
+        cycle_trigger="event",
+    )
+    assert sched.trigger is not None
+    before = sched.trigger.notifies
+    for pod in gen_host_pods(2, seed=1):
+        sched.submit(pod)
+    assert sched.trigger.notifies == before + 2
+    _drain(sched, nodes, running)
+    before = sched.trigger.notifies
+    sched.mirror.apply_util_events({nodes[0].name: NodeUtil(cpu_pct=42.0)})
+    assert sched.trigger.notifies == before + 1
+    # trigger mode never changes decisions: watchdog timeout still fires
+    assert sched.trigger.wait(0.01) in (True, False)
+
+
+def test_bad_cycle_trigger_rejected():
+    nodes, advisor = gen_host_cluster(4, seed=0)
+    with pytest.raises(ValueError, match="cycle_trigger"):
+        _mk_sched(nodes, advisor, [], mirror=False, cycle_trigger="nope")
+
+
+# ---- scenario harness integration -----------------------------------------
+
+
+@pytest.mark.parametrize("name", ["burst", "node-flap", "anti-affinity-pack"])
+def test_scenario_mirror_matches_rebuild(tmp_path, name):
+    """Mirror-on and mirror-off scenario runs produce the same journaled
+    bindings (ScenarioWorld drives node/pod events through the mirror)."""
+    from kubernetes_scheduler_tpu.sim import scenarios
+
+    def binds(mirror, sub):
+        journal = str(tmp_path / f"{name}-{sub}")
+        cfg = scenarios.scenario_config(
+            {"snapshot_mirror": True, "mirror_verify_interval": 1}
+            if mirror
+            else {}
+        )
+        scenarios.run(name, n_nodes=16, seed=0, trace_path=journal, config=cfg)
+        from kubernetes_scheduler_tpu.trace.recorder import read_journal
+
+        out = []
+        for rec in read_journal(journal):
+            out.extend(tuple(b) for b in rec.get("bindings") or ())
+        return out
+
+    off = binds(False, "off")
+    on = binds(True, "on")
+    assert off and off == on
+
+
+def test_scenario_mirror_replay_pin_e2e(tmp_path):
+    """PARITY round 16: a mirror-on scenario journal replays with zero
+    binding diffs (mirror-emitted deltas satisfy the recorder chain)."""
+    from kubernetes_scheduler_tpu.sim import scenarios
+    from kubernetes_scheduler_tpu.trace.replay import replay_journal
+
+    journal = str(tmp_path / "flap-mirror")
+    cfg = scenarios.scenario_config(
+        {
+            "snapshot_mirror": True,
+            "mirror_verify_interval": 1,
+            "resident_state": True,
+            "pipeline_depth": 1,
+        }
+    )
+    summary = scenarios.run(
+        "node-flap", n_nodes=16, seed=0, trace_path=journal, config=cfg
+    )
+    assert summary["pods_bound"] > 0
+    assert summary["fallback_cycles"] == 0
+    assert summary["delta_uploads"] > 0  # mirror deltas actually shipped
+    report = replay_journal(journal)
+    assert report.replayed > 0
+    assert report.binding_diffs == 0, report.to_dict()

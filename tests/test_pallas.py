@@ -412,6 +412,76 @@ def test_auction_bid_kernel_bitwise():
         )
 
 
+def test_greedy_scan_kernel_bitwise():
+    """fused_greedy_scan vs the XLA lax.scan body: bitwise-identical
+    node_idx AND free_after under capacity contention, priority order,
+    exact ties, zero requests, masked pods, and tile-boundary shapes."""
+    from kubernetes_scheduler_tpu.ops.assign import greedy_assign
+
+    rng = np.random.default_rng(5)
+    shapes = (
+        (17, 130, 3),    # ragged both axes
+        (64, 256, 5),    # aligned
+        (128, 128, 1),   # exact single tiles
+        (129, 127, 7),   # +-1 off the tile
+        (3, 8, 2),       # tiny
+    )
+    for p, n, r in shapes:
+        scores = rng.uniform(0, 10, (p, n)).astype(np.float32)
+        # exact ties exercise first-max argmax semantics
+        scores[:, n // 2] = scores[:, n // 3]
+        scores[p // 2] = scores[p // 3]
+        feasible = rng.uniform(size=(p, n)) < 0.7
+        feasible[-1] = False  # an all-infeasible pod
+        req = rng.uniform(0, 4, (p, r)).astype(np.float32)
+        req[rng.uniform(size=(p, r)) < 0.3] = 0.0
+        free = rng.uniform(1, 6, (n, r)).astype(np.float32)
+        prio = rng.integers(-3, 3, p).astype(np.int32)
+        mask = rng.uniform(size=p) < 0.9
+        args = (
+            jnp.asarray(scores), jnp.asarray(feasible), jnp.asarray(req),
+            jnp.asarray(free), jnp.asarray(prio), jnp.asarray(mask),
+        )
+        base = greedy_assign(*args, greedy_kernel=False)
+        got = greedy_assign(*args, greedy_kernel=True)
+        np.testing.assert_array_equal(
+            np.asarray(got.node_idx), np.asarray(base.node_idx)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got.free_after), np.asarray(base.free_after)
+        )
+        assert int(got.n_assigned) == int(base.n_assigned)
+
+
+def test_greedy_scan_kernel_capacity_sequencing():
+    """The scan's defining property through the kernel: one-slot nodes
+    admit exactly one pod, in priority order, capacity decremented
+    between steps."""
+    from kubernetes_scheduler_tpu.ops.assign import greedy_assign
+
+    p, n = 6, 4
+    scores = jnp.tile(jnp.asarray([4.0, 3.0, 2.0, 1.0]), (p, 1))
+    feasible = jnp.ones((p, n), bool)
+    req = jnp.ones((p, 1), jnp.float32)
+    free = jnp.ones((n, 1), jnp.float32)  # one pod per node, 4 slots
+    prio = jnp.asarray([0, 5, 3, 1, 2, 4], jnp.int32)
+    mask = jnp.ones(p, bool)
+    base = greedy_assign(
+        scores, feasible, req, free, prio, mask, greedy_kernel=False
+    )
+    got = greedy_assign(
+        scores, feasible, req, free, prio, mask, greedy_kernel=True
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.node_idx), np.asarray(base.node_idx)
+    )
+    # two pods (the lowest-priority ones) must be unassigned
+    assert int(got.n_assigned) == 4
+    np.testing.assert_array_equal(
+        np.asarray(got.free_after), np.zeros((n, 1), np.float32)
+    )
+
+
 def test_fused_rejects_incompatible_options():
     from kubernetes_scheduler_tpu.engine import (
         check_fused_contract,

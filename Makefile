@@ -5,7 +5,7 @@ PY ?= python
 IMAGE_REPO ?= registry.example.com/yoda-tpu
 TAG ?= latest
 
-.PHONY: local test test-fast bench trace-smoke obs-smoke scenario-smoke perf-gate perf-baseline lint lint-fast lint-sarif collective-baseline model-check native native-asan native-tsan proto clean build push
+.PHONY: local test test-fast bench trace-smoke obs-smoke scenario-smoke chaos-smoke perf-gate perf-baseline lint lint-fast lint-sarif collective-baseline model-check native native-asan native-tsan proto clean build push
 
 # "make local" in the reference = fmt + vet + compile. Here: byte-compile
 # the package, build the native library, lint, run the fast tests.
@@ -167,6 +167,26 @@ scenario-smoke:
 	  gang-mix --nodes 32 --trace $(SCENARIO_SMOKE_DIR)/gang-mix
 	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_scheduler_tpu trace replay \
 	  $(SCENARIO_SMOKE_DIR)/gang-mix
+
+# chaos smoke: the compound-storm chaos program (sim/faults.py) at
+# compressed scale — deterministic fault injection at every boundary
+# at once (advisor flap past the stale TTL, engine crash-restart,
+# informer partition, journal ENOSPC, added latency, mirror
+# corruption) — run with --require-recovery, which exits 1 unless the
+# run ends FULLY recovered: every degradation-ladder rung back at top,
+# both circuit breakers closed. The emitted journal is then
+# replay-pinned (`trace replay` exits non-zero on ANY binding diff) —
+# chaos runs are as deterministic as clean ones.
+# tests/test_bench_smoke.py wraps the same flow as a slow-marked test.
+CHAOS_SMOKE_DIR ?= /tmp/yoda-chaos-smoke
+chaos-smoke:
+	rm -rf $(CHAOS_SMOKE_DIR)
+	mkdir -p $(CHAOS_SMOKE_DIR)
+	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_scheduler_tpu scenario run \
+	  compound-storm --nodes 24 --require-recovery \
+	  --trace $(CHAOS_SMOKE_DIR)/compound-storm
+	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_scheduler_tpu trace replay \
+	  $(CHAOS_SMOKE_DIR)/compound-storm
 
 # end-to-end telemetry round trip on CPU: a sidecar with its own
 # /metrics + span files, a short sim-driven host run with spans + the

@@ -684,6 +684,164 @@ def _scenario_rate(name: str, short: str) -> dict:
     return out
 
 
+def _chaos_loop_rate() -> dict:
+    """The chaos host-loop metric (host_loop_*_chaos): the SAME
+    pipelined drain shape as host_loop_*_pipelined, under a
+    deterministic RPC-flap FaultPlan (sim/faults.py) on the engine
+    boundary — the clock is the CYCLE COUNTER, so the flap pattern is
+    identical run over run. Reported beside the clean drain: the
+    degraded-cycle rate, the circuit breaker's open/half-open/closed
+    transition counts, and the recovery latency (wall time from a
+    degradation episode's first degraded cycle back to every ladder
+    rung at top with the breaker closed) p50/p99 over episodes. The
+    plan quiesces with a recovery tail, so the row also asserts the
+    run ENDS recovered — a chaos drain that stays degraded is a
+    failure, not a number."""
+    from kubernetes_scheduler_tpu.host.scheduler import Scheduler
+    from kubernetes_scheduler_tpu.sim.faults import (
+        FaultInjector,
+        FaultPlan,
+        FaultWindow,
+        FaultyEngine,
+    )
+    from kubernetes_scheduler_tpu.engine import LocalEngine
+    from kubernetes_scheduler_tpu.sim.host_gen import (
+        gen_host_cluster,
+        gen_host_pods,
+    )
+    from kubernetes_scheduler_tpu.utils.config import SchedulerConfig
+
+    n_nodes = int(os.environ.get("BENCH_LOOP_NODES", 4000))
+    n_pods = int(
+        os.environ.get("BENCH_LOOP_PODS", 1024 * DEFAULT_LOOP_WINDOWS)
+    )
+    # window sized for enough cycles that the flap pattern and the
+    # recovery tail are both visible at any BENCH_* scale
+    window = max(8, n_pods // 16)
+    cycles_per_drain = -(-n_pods // window)
+    samples = int(os.environ.get("BENCH_LOOP_SAMPLES", "0")) or 3
+    measured = samples * cycles_per_drain
+    # flap over the middle of the measured cycles; quiesce with a tail
+    flap_start = max(2, measured // 4)
+    flap_end = max(flap_start + 4, (2 * measured) // 3)
+    # flap first (retry/fallback churn), then a solid outage long
+    # enough to trip the breaker (threshold 2) so the open ->
+    # half-open -> closed arc is in the transition counts every run
+    outage_start = float(flap_end) + 2.0
+    plan = FaultPlan((
+        FaultWindow(
+            boundary="engine", kind="flap",
+            start=float(flap_start), end=float(flap_end), period=2,
+        ),
+        FaultWindow(
+            boundary="engine", kind="error",
+            start=outage_start, end=outage_start + 3.0,
+        ),
+    ))
+    cycle_clock = [0.0]
+    injector = FaultInjector(plan, clock=lambda: cycle_clock[0])
+    nodes, advisor = gen_host_cluster(n_nodes, seed=0)
+    running: list = []
+    sched = Scheduler(
+        SchedulerConfig(
+            batch_window=window,
+            max_windows_per_cycle=1,
+            pipeline_depth=1,
+            adaptive_dispatch=False,
+            min_device_work=1,
+            normalizer="none",
+            breaker_failure_threshold=2,
+            breaker_recovery_window_s=3.0,
+        ),
+        advisor=advisor,
+        engine=FaultyEngine(LocalEngine(), injector),
+        list_nodes=lambda: nodes,
+        list_running_pods=lambda: running,
+        queue_clock=lambda: cycle_clock[0],
+    )
+    cycles = []
+    episodes = []  # recovery latency (seconds) per degradation episode
+    episode_t0 = None
+
+    def drain(measure: bool):
+        nonlocal episode_t0
+        seen = len(sched.binder.bindings)
+        for _ in range(64):
+            if len(sched.queue) == 0 and sched._prefetched is None:
+                break
+            m = sched.run_cycle()
+            if measure:
+                cycle_clock[0] += 1.0
+                cycles.append(m)
+                recovered = (
+                    sched.ladder.fully_recovered()
+                    and sched.engine_breaker.state() == "closed"
+                )
+                if not recovered and episode_t0 is None:
+                    episode_t0 = time.perf_counter()
+                elif recovered and episode_t0 is not None:
+                    episodes.append(time.perf_counter() - episode_t0)
+                    episode_t0 = None
+            for b in sched.binder.bindings[seen:]:
+                running.append(b.pod)
+            seen = len(sched.binder.bindings)
+
+    for pod in gen_host_pods(n_pods, seed=1):
+        sched.submit(pod)
+    drain(measure=False)  # warmup: compiles, no injected clock ticks
+    for seed in range(2, 2 + samples):
+        for pod in gen_host_pods(n_pods, seed=seed):
+            sched.submit(pod)
+        drain(measure=True)
+    # recovery tail: the sample drains already advanced the cycle
+    # clock through BOTH fault windows (measured cycles span the plan
+    # by construction), so these trailing drains idle-advance past the
+    # plan's end and give the half-open probe + ladder climb traffic
+    # to land on
+    for tail_seed in (90, 91):
+        cycle_clock[0] = max(cycle_clock[0], plan.last_end()) + 4.0
+        for pod in gen_host_pods(window, seed=tail_seed):
+            sched.submit(pod)
+        drain(measure=True)
+    # an episode still open at the end never recovered: count it
+    # separately instead of poisoning the percentiles (float('inf')
+    # would serialize as bare `Infinity` — invalid JSON on the one
+    # line that reports the failure)
+    unrecovered = int(episode_t0 is not None)
+    bound = sum(c.pods_bound for c in cycles)
+    lat = [c.cycle_seconds for c in cycles]
+    degraded = sum(1 for c in cycles if c.degraded or c.used_fallback)
+    rec_ms = sorted(1e3 * e for e in episodes)
+    out = {
+        "metric": f"host_loop_{n_nodes}nodes_chaos",
+        "cycles": len(cycles),
+        "pods_bound": bound,
+        "pods_per_sec": round(bound / max(sum(lat), 1e-9), 1),
+        "cycle_p50_ms": round(1e3 * float(np.percentile(lat, 50)), 2),
+        "fallback_cycles": int(sum(c.used_fallback for c in cycles)),
+        "degraded_cycles": degraded,
+        "degraded_cycle_rate": round(degraded / max(len(cycles), 1), 4),
+        "faults_injected": injector.summary(),
+        "breaker_transitions": dict(
+            sched.engine_breaker.transition_counts
+        ),
+        "breaker_state": sched.engine_breaker.state(),
+        "recovery_episodes": len(episodes),
+        "unrecovered_episodes": unrecovered,
+        "recovery_latency_ms_p50": (
+            round(float(np.percentile(rec_ms, 50)), 2) if rec_ms else 0.0
+        ),
+        "recovery_latency_ms_p99": (
+            round(float(np.percentile(rec_ms, 99)), 2) if rec_ms else 0.0
+        ),
+        "recovered": (
+            sched.ladder.fully_recovered()
+            and sched.engine_breaker.state() == "closed"
+        ),
+    }
+    return out
+
+
 class _ChurnAdvisor:
     """Metric-churn wrapper over a StaticAdvisor: every fetch perturbs a
     FIXED-SIZE rotating slice of nodes' utilization series. The churn
@@ -1276,6 +1434,7 @@ def main():
         print(json.dumps(attrib))
         print(json.dumps(_scenario_rate("burst", "burst")))
         print(json.dumps(_scenario_rate("gang-mix", "gang")))
+        print(json.dumps(_chaos_loop_rate()), flush=True)
         return
     if "--suite" in sys.argv:
         from kubernetes_scheduler_tpu.sim.cluster_gen import BENCH_CONFIGS
@@ -1364,6 +1523,10 @@ def main():
         # gang-heavy mix (all-or-nothing admit rate)
         print(json.dumps(_scenario_rate("burst", "burst")), flush=True)
         print(json.dumps(_scenario_rate("gang-mix", "gang")), flush=True)
+        # the chaos drain beside the clean pipelined one: the same
+        # backlog shape under a deterministic engine RPC-flap plan —
+        # degraded-cycle rate, breaker transitions, recovery latency
+        print(json.dumps(_chaos_loop_rate()), flush=True)
     except Exception as e:  # pragma: no cover - diagnostic path
         print(json.dumps({"diag": "host_loop_failed", "error": str(e)[-200:]}),
               flush=True)

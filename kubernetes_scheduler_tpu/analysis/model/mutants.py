@@ -197,6 +197,52 @@ def mutant_unfenced_replica_bind() -> ProtocolModel:
     )
 
 
+# ---- degradation-ladder mutants ------------------------------------------
+
+
+def mutant_ladder_skips_rung() -> ProtocolModel:
+    """A failure path that drops a subsystem TWO rungs in one event —
+    the silent multi-rung skip the one-rung demote contract forbids
+    (caught by `never-skips-a-rung` via the ghost variable)."""
+    from kubernetes_scheduler_tpu.analysis.model.protocols import (
+        _LADDER_BOTTOM,
+        _BRK_THRESHOLD,
+    )
+
+    m = protocols.degradation_ladder_model()
+
+    def skip_effect(s):
+        new_rung = min(s["rung"] + 2, _LADDER_BOTTOM)
+        fails = min(s["fails"] + 1, _BRK_THRESHOLD)
+        opens = s["breaker"] == "half" or fails >= _BRK_THRESHOLD
+        return {
+            "fails": fails,
+            "breaker": "open" if opens else s["breaker"],
+            "rung": new_rung,
+            "probed": False,
+            "skipped": s["skipped"] or (new_rung - s["rung"] > 1),
+        }
+
+    return _swap(m, "attempt_fail", effect=skip_effect)
+
+
+def mutant_promote_without_probe() -> ProtocolModel:
+    """Recovery that climbs a rung without re-probing the degraded
+    path (the guard dropped) — optimistic promotion re-enters the
+    failure it degraded away from (caught by `recovery-re-probes`)."""
+    m = protocols.degradation_ladder_model()
+    old = next(t for t in m.transitions if t.name == "recover")
+    return replace_transition(
+        m, "recover",
+        dataclasses.replace(
+            old,
+            guard=lambda s: (
+                s["rung"] > 0 and not s["fault"] and s["breaker"] != "open"
+            ),
+        ),
+    )
+
+
 # ---- harness -------------------------------------------------------------
 
 # name -> factory; ordered, so reports and tests stay deterministic
@@ -209,6 +255,8 @@ MUTANTS = {
     "fail-keeps-resident-commit": mutant_fail_keeps_resident_commit,
     "dispatch-scores-stale-batch": mutant_dispatch_scores_stale_batch,
     "unfenced-replica-bind": mutant_unfenced_replica_bind,
+    "ladder-skips-rung": mutant_ladder_skips_rung,
+    "promote-without-probe": mutant_promote_without_probe,
 }
 
 

@@ -58,6 +58,8 @@ _SERVER = "kubernetes_scheduler_tpu/bridge/server.py"
 _SCHED = "kubernetes_scheduler_tpu/host/scheduler.py"
 _QUEUE = "kubernetes_scheduler_tpu/host/queue.py"
 _SNAP = "kubernetes_scheduler_tpu/host/snapshot.py"
+_RESIL = "kubernetes_scheduler_tpu/host/resilience.py"
+_FAULTS = "kubernetes_scheduler_tpu/sim/faults.py"
 
 # ---- model 1: RemoteEngine client session / sidecar session state --------
 
@@ -827,6 +829,201 @@ def replica_bind_model() -> ProtocolModel:
     )
 
 
+# ---- model 5: the degradation ladder + circuit breaker -------------------
+#
+# Abstracts host/resilience.py as wired by host/scheduler.py: one
+# subsystem's rung (0 = top, abstract depth 3 so a rung SKIP is
+# expressible), the probe-before-promote recovery discipline, and the
+# engine circuit breaker (closed/open/half-open, threshold 2) whose
+# open state must imply a degraded rung (Scheduler._on_breaker_transition
+# demotes when the breaker opens). Faults are budget-bounded environment
+# churn (sim/faults.FaultInjector windows). Ghost variables: `skipped`
+# can only become True if a demote ever moves more than one rung at a
+# time; `unprobed_climb` only if a promote fires without a recorded
+# probe — the two silent-recovery bug classes the ladder exists to
+# forbid.
+
+_LADDER_BOTTOM = 2
+_BRK_THRESHOLD = 2
+
+
+def degradation_ladder_model() -> ProtocolModel:
+    def fail_effect(s):
+        new_rung = min(s["rung"] + 1, _LADDER_BOTTOM)
+        fails = min(s["fails"] + 1, _BRK_THRESHOLD)
+        opens = s["breaker"] == "half" or fails >= _BRK_THRESHOLD
+        return {
+            "fails": fails,
+            "breaker": "open" if opens else s["breaker"],
+            "rung": new_rung,
+            "probed": False,
+            "skipped": s["skipped"] or (new_rung - s["rung"] > 1),
+        }
+
+    def recover_effect(s):
+        return {
+            "rung": s["rung"] - 1,
+            "probed": False,
+            "fails": 0,
+            "breaker": "closed" if s["breaker"] == "half" else s["breaker"],
+            "unprobed_climb": s["unprobed_climb"] or not s["probed"],
+        }
+
+    t = (
+        Transition(
+            name="attempt_fail",
+            process="host",
+            guard=lambda s: s["fault"] and s["breaker"] != "open",
+            effect=fail_effect,
+            reads=frozenset(
+                {"fault", "breaker", "fails", "rung", "skipped", "probed"}
+            ),
+            writes=frozenset(
+                {"fails", "breaker", "rung", "probed", "skipped"}
+            ),
+            anchors=(
+                Anchor(_SCHED, "Scheduler._engine_failure",
+                       calls=("record_failure", "demote")),
+                Anchor(_RESIL, "CircuitBreaker.record_failure",
+                       must_contain=("OPEN",)),
+                Anchor(_RESIL, "DegradationLadder.demote",
+                       must_contain=("d + 1",)),
+                Anchor(_SCHED, "Scheduler._on_breaker_transition",
+                       calls=("demote",)),
+            ),
+        ),
+        Transition(
+            name="probe",
+            process="host",
+            guard=lambda s: (
+                s["rung"] > 0 and not s["probed"] and s["breaker"] != "open"
+            ),
+            effect=lambda s: {"probed": True},
+            reads=frozenset({"rung", "probed", "breaker"}),
+            writes=frozenset({"probed"}),
+            anchors=(
+                Anchor(_RESIL, "DegradationLadder.probe",
+                       must_contain=("_probed",)),
+                Anchor(_SCHED, "Scheduler._ladder_cycle_end",
+                       calls=("probe", "promote")),
+            ),
+        ),
+        Transition(
+            name="recover",
+            process="host",
+            guard=lambda s: (
+                s["rung"] > 0 and s["probed"] and not s["fault"]
+                and s["breaker"] != "open"
+            ),
+            effect=recover_effect,
+            reads=frozenset(
+                {"rung", "probed", "fault", "breaker", "fails",
+                 "unprobed_climb"}
+            ),
+            writes=frozenset(
+                {"rung", "probed", "fails", "breaker", "unprobed_climb"}
+            ),
+            anchors=(
+                Anchor(_RESIL, "DegradationLadder.promote",
+                       must_contain=("_probed",)),
+                Anchor(_RESIL, "CircuitBreaker.record_success"),
+            ),
+        ),
+        Transition(
+            name="half_open",
+            process="env",
+            guard=lambda s: s["breaker"] == "open",
+            effect=lambda s: {"breaker": "half"},
+            reads=frozenset({"breaker"}),
+            writes=frozenset({"breaker"}),
+            anchors=(
+                Anchor(_RESIL, "CircuitBreaker.allow",
+                       must_contain=("HALF_OPEN",)),
+            ),
+        ),
+        Transition(
+            name="fault_hit",
+            process="env",
+            guard=lambda s: not s["fault"] and s["fault_budget"] > 0,
+            effect=lambda s: {
+                "fault": True, "fault_budget": s["fault_budget"] - 1,
+            },
+            reads=frozenset({"fault", "fault_budget"}),
+            writes=frozenset({"fault", "fault_budget"}),
+            anchors=(
+                Anchor(_FAULTS, "FaultInjector.check",
+                       must_contain=("active",)),
+            ),
+        ),
+        Transition(
+            name="fault_clear",
+            process="env",
+            guard=lambda s: s["fault"],
+            effect=lambda s: {"fault": False},
+            reads=frozenset({"fault"}),
+            writes=frozenset({"fault"}),
+            anchors=(
+                Anchor(_FAULTS, "FaultInjector.quiesced",
+                       must_contain=("last_end",)),
+            ),
+        ),
+    )
+    return ProtocolModel(
+        name="degradation-ladder",
+        description=(
+            "the degradation-ladder state machine + engine circuit "
+            "breaker under budget-bounded faults: one-rung demotes with "
+            "recorded reasons, probe-before-promote recovery, and the "
+            "breaker-open-implies-degraded coupling"
+        ),
+        init={
+            "rung": 0, "probed": False, "breaker": "closed", "fails": 0,
+            "fault": False, "fault_budget": 2,
+            "skipped": False, "unprobed_climb": False,
+        },
+        transitions=t,
+        invariants=(
+            Invariant(
+                "never-skips-a-rung",
+                lambda s: not s["skipped"],
+                "every demote moves exactly ONE rung with a recorded "
+                "reason — a multi-rung drop is a silent skip the event "
+                "log (and operators) never see",
+            ),
+            Invariant(
+                "recovery-re-probes",
+                lambda s: not s["unprobed_climb"],
+                "a subsystem may only climb a rung after its degraded "
+                "path was explicitly re-probed — optimistic un-probed "
+                "promotion re-enters the failure it degraded away from",
+            ),
+            Invariant(
+                "breaker-open-implies-degraded",
+                lambda s: s["breaker"] == "closed" or s["rung"] >= 1,
+                "an open (or probing half-open) engine breaker means "
+                "the engine subsystem is NOT at its top rung — the "
+                "ladder and the breaker must never disagree about an "
+                "outage",
+            ),
+        ),
+        convergences=(
+            Convergence(
+                "outage-recovers",
+                trigger=lambda s: (
+                    s["rung"] > 0 and not s["fault"]
+                    and s["fault_budget"] == 0
+                ),
+                goal=lambda s: s["rung"] == 0 and s["breaker"] == "closed",
+                description=(
+                    "once the faults stop, every path climbs back to "
+                    "the top rung with the breaker closed — no probe/"
+                    "demote livelock, no rung stuck degraded forever"
+                ),
+            ),
+        ),
+    )
+
+
 # ---- registry ------------------------------------------------------------
 
 
@@ -838,6 +1035,7 @@ def build_models() -> tuple[ProtocolModel, ...]:
         gang_queue_model(front=False),
         pipeline_slot_model(),
         replica_bind_model(),
+        degradation_ladder_model(),
     )
 
 

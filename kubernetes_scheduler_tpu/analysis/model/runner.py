@@ -46,6 +46,8 @@ SURFACE = (
     "kubernetes_scheduler_tpu/host/scheduler.py",
     "kubernetes_scheduler_tpu/host/queue.py",
     "kubernetes_scheduler_tpu/host/snapshot.py",
+    "kubernetes_scheduler_tpu/host/resilience.py",
+    "kubernetes_scheduler_tpu/sim/faults.py",
     "kubernetes_scheduler_tpu/analysis/model/*.py",
 )
 

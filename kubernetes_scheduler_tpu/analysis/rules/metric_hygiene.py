@@ -43,10 +43,11 @@ SCOPE = ("kubernetes_scheduler_tpu/**/*.py", "kubernetes_scheduler_tpu/*.py")
 
 # the unit vocabulary: `_total` for counters, real units for everything
 # else. `_count` covers live-object gauges (resident_sessions_count);
-# `_mean`/`_per_sec` are shipped derived-statistic names.
+# `_mean`/`_per_sec` are shipped derived-statistic names; `_rung` is
+# the degradation ladder's position unit (host/resilience.py — 0 = top).
 UNIT_SUFFIXES = (
     "_total", "_seconds", "_bytes", "_ratio", "_per_sec", "_count",
-    "_mean", "_info",
+    "_mean", "_info", "_rung",
 )
 
 _COLLECTOR_CTORS = {"Histogram", "Counter", "Gauge"}

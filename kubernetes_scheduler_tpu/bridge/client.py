@@ -22,6 +22,11 @@ from kubernetes_scheduler_tpu import engine
 from kubernetes_scheduler_tpu.bridge import codec
 from kubernetes_scheduler_tpu.bridge import schedule_pb2 as pb
 from kubernetes_scheduler_tpu.bridge.server import MAX_MESSAGE_BYTES, SERVICE
+from kubernetes_scheduler_tpu.host.observe import Counter
+from kubernetes_scheduler_tpu.host.resilience import (
+    BackoffPolicy,
+    CircuitBreaker,
+)
 
 log = logging.getLogger("yoda_tpu.bridge.client")
 
@@ -33,6 +38,11 @@ _RETRYABLE = (
 
 class EngineUnavailable(RuntimeError):
     """The sidecar could not serve the cycle (after retries)."""
+
+
+def _noop_state(state) -> None:
+    """Connectivity-subscription callback for _kick_reconnect (module
+    level so subscribe/unsubscribe always see the same object)."""
 
 
 # gang co-scheduling tensors (ops/gang.py), stripped off the wire when
@@ -88,16 +98,49 @@ class RemoteEngine:
         deadline_seconds: float = 30.0,
         retries: int = 1,
         decisions_only: bool = False,
+        breaker: CircuitBreaker | None = None,
     ):
         self.target = target
         self.deadline_seconds = deadline_seconds
         self.retries = retries
         self.decisions_only = decisions_only
+        # unified resilience (host/resilience.py): the circuit breaker
+        # gating EVERY RPC on this client (schedule, preempt, health) —
+        # a down sidecar costs one half-open probe per recovery window
+        # instead of a deadline timeout per call — and the deterministic-
+        # jitter backoff between in-call retries (replacing the old bare
+        # min(0.1 * 2**attempt, 1.0) sleep). The breaker is injectable
+        # so the host can share one instance across clients of the same
+        # sidecar.
+        self.breaker = breaker or CircuitBreaker(f"bridge:{target}")
+        self._backoff = BackoffPolicy(
+            initial=0.1, max_delay=1.0, multiplier=2.0
+        )
+        # transport-down vs deadline-exceeded health failures, counted
+        # SEPARATELY (a saturated-but-alive sidecar and a dead one need
+        # different operator responses); "breaker-open" counts probes
+        # the breaker answered without touching the wire. Exported via
+        # the host exporter (Scheduler folds engine `collectors` into
+        # prom_collectors).
+        self.ctr_health_failures = Counter(
+            "engine_health_failures_total",
+            "Sidecar health-probe failures by kind (transport-down vs "
+            "deadline-exceeded vs answered-by-open-breaker)",
+            labels=("kind",),
+        )
+        self.collectors = (self.ctr_health_failures,)
         self._channel = grpc.insecure_channel(
             target,
             options=[
                 ("grpc.max_receive_message_length", MAX_MESSAGE_BYTES),
                 ("grpc.max_send_message_length", MAX_MESSAGE_BYTES),
+                # cap the channel's reconnect backoff: grpc's default
+                # grows to ~2 minutes, so a client that rode out a
+                # sidecar outage could keep failing long AFTER the
+                # sidecar recovered — the circuit breaker's half-open
+                # probe cadence (seconds) is the recovery clock here,
+                # and the transport must not out-wait it
+                ("grpc.max_reconnect_backoff_ms", 5000),
             ],
         )
         self._schedule = self._channel.unary_unary(
@@ -623,6 +666,15 @@ class RemoteEngine:
         return codec.unpack_fields(PreemptResult, reply.result)
 
     def _call_with_retry(self, method, request, *, profile_ok: bool = True):
+        if not self.breaker.allow():
+            # open breaker: fail the cycle in microseconds instead of a
+            # deadline timeout — the scheduler's scalar fallback serves
+            # it, and ONE half-open probe per recovery window retests
+            # the sidecar
+            raise EngineUnavailable(
+                f"sidecar {self.target} circuit open (one probe per "
+                f"{self.breaker.recovery_window_s:g}s window)"
+            )
         last_err = None
         metadata = self._call_metadata(profile_ok=profile_ok)
         # the kwarg is attached only when telemetry context exists:
@@ -633,17 +685,25 @@ class RemoteEngine:
             try:
                 reply = method(request, timeout=self.deadline_seconds, **kw)
                 self.last_engine_seconds = reply.engine_seconds
+                self.breaker.record_success()
                 return reply
             except grpc.RpcError as e:
                 last_err = e
                 if e.code() == grpc.StatusCode.UNIMPLEMENTED:
                     # version-skewed sidecar without this RPC: callers
                     # (host backlog mode) degrade to the per-window
-                    # surface rather than treating it as an outage
+                    # surface rather than treating it as an outage —
+                    # the sidecar ANSWERED, so the breaker reads it as
+                    # alive
+                    self.breaker.record_success()
                     raise NotImplementedError(
                         f"sidecar {self.target} does not serve this RPC"
                     ) from e
                 if e.code() not in _RETRYABLE:
+                    # an explicit rejection (INVALID_ARGUMENT epoch
+                    # mismatch, FAILED_PRECONDITION cache miss) is a
+                    # live sidecar speaking — not an outage
+                    self.breaker.record_success()
                     raise EngineUnavailable(
                         f"sidecar rejected cycle: {e.code().name}: {e.details()}"
                     ) from e
@@ -651,8 +711,16 @@ class RemoteEngine:
                     "sidecar %s unavailable (attempt %d/%d): %s",
                     self.target, attempt + 1, self.retries + 1, e.code().name,
                 )
+                if e.code() == grpc.StatusCode.UNAVAILABLE:
+                    self._kick_reconnect()
                 if attempt < self.retries:
-                    time.sleep(min(0.1 * 2**attempt, 1.0))
+                    # deterministic-jitter exponential backoff
+                    # (host/resilience.BackoffPolicy): same growth as
+                    # the old bare sleep, de-phased across targets
+                    time.sleep(
+                        self._backoff.delay(attempt, key=self.target)
+                    )
+        self.breaker.record_failure()
         raise EngineUnavailable(
             f"sidecar {self.target} unreachable after {self.retries + 1} attempts"
         ) from last_err
@@ -670,18 +738,68 @@ class RemoteEngine:
             engine.ScheduleResult, reply.result, defaults=defaults
         )
 
+    def _kick_reconnect(self) -> None:
+        """Nudge the channel to actually re-dial after a transport
+        failure. grpc-python's fail-fast RPCs on a TRANSIENT_FAILURE
+        channel return immediately WITHOUT requesting a new connection
+        — observed on this grpc build: a client created while the
+        sidecar was down keeps failing for minutes after the sidecar
+        recovers, while a fresh client connects instantly. A
+        try_to_connect subscription (immediately unsubscribed) forces
+        the re-dial, so the breaker's half-open probe cadence — not the
+        transport's stuck state — is the recovery clock."""
+        cb = _noop_state
+        try:
+            self._channel.subscribe(cb, try_to_connect=True)
+            self._channel.unsubscribe(cb)
+        except Exception:
+            log.debug("reconnect kick failed", exc_info=True)
+
+    def _health_failed(self, e: grpc.RpcError) -> None:
+        """Classify one health-probe failure — deadline-exceeded (the
+        sidecar exists but could not answer in time: saturation, GC,
+        device wedge) vs transport-down (connection refused/reset: the
+        process or network is gone) — count them SEPARATELY and feed
+        the breaker. Previously both were swallowed identically, so
+        dashboards could not tell a saturated sidecar from a dead one
+        and the outage never tripped the breaker."""
+        kind = (
+            "deadline"
+            if e.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+            else "transport"
+        )
+        self.ctr_health_failures.inc(kind=kind)
+        self.breaker.record_failure()
+        if kind == "transport":
+            self._kick_reconnect()
+        log.debug(
+            "sidecar %s health probe failed (%s): %s",
+            self.target, kind, e.code().name,
+        )
+
     def healthy(self, *, timeout: float = 2.0) -> bool:
+        if not self.breaker.allow():
+            self.ctr_health_failures.inc(kind="breaker-open")
+            return False
         try:
             reply = self._health(pb.HealthRequest(), timeout=timeout)
-            return reply.status == "SERVING"
-        except grpc.RpcError:
+        except grpc.RpcError as e:
+            self._health_failed(e)
             return False
+        self.breaker.record_success()
+        return reply.status == "SERVING"
 
     def health_info(self, *, timeout: float = 2.0) -> pb.HealthReply | None:
-        try:
-            return self._health(pb.HealthRequest(), timeout=timeout)
-        except grpc.RpcError:
+        if not self.breaker.allow():
+            self.ctr_health_failures.inc(kind="breaker-open")
             return None
+        try:
+            reply = self._health(pb.HealthRequest(), timeout=timeout)
+        except grpc.RpcError as e:
+            self._health_failed(e)
+            return None
+        self.breaker.record_success()
+        return reply
 
     def close(self) -> None:
         if self._async_pool is not None:

@@ -438,7 +438,13 @@ def cmd_scenario(args) -> int:
         overrides["gang_scheduling"] = False
     if args.mirror:
         overrides["snapshot_mirror"] = True
-    cfg = scenarios.scenario_config(overrides)
+    # a chaos program's own config knobs (sim/faults.py: mirror/
+    # resident/stale-TTL/breaker settings its fault plan targets) are
+    # the baseline; explicit flags win on conflict
+    cls = scenarios.SCENARIOS.get(args.name)
+    merged = dict(getattr(cls, "config_overrides", {}) or {}) if cls else {}
+    merged.update(overrides)
+    cfg = scenarios.scenario_config(merged)
     summary = scenarios.run(
         args.name,
         n_nodes=args.nodes,
@@ -447,8 +453,24 @@ def cmd_scenario(args) -> int:
         trace_path=args.trace_path,
         span_path=args.span_path,
         config=cfg,
+        faults=not args.no_faults,
     )
     print(json.dumps(summary))
+    if args.require_recovery and not summary.get("recovered", True):
+        print(
+            "scenario did not fully recover: "
+            + json.dumps(
+                {
+                    "degradation_rungs": summary.get("degradation_rungs"),
+                    "breaker_state": summary.get("breaker_state"),
+                    "advisor_breaker_state": summary.get(
+                        "advisor_breaker_state"
+                    ),
+                }
+            ),
+            file=sys.stderr,
+        )
+        return 1
     return 0
 
 
@@ -731,6 +753,17 @@ def build_parser() -> argparse.ArgumentParser:
         help="streaming state ingestion (snapshot_mirror): the world "
         "drives informer-style events through the event-sourced "
         "snapshot mirror instead of per-cycle rebuilds",
+    )
+    zr.add_argument(
+        "--no-faults", action="store_true",
+        help="run a chaos program's traffic WITHOUT its fault plan "
+        "(the clean A/B twin of the same seeded run)",
+    )
+    zr.add_argument(
+        "--require-recovery", action="store_true",
+        help="exit 1 unless the run ends fully recovered (every "
+        "degradation-ladder rung at top, breakers closed) — the "
+        "chaos-smoke gate",
     )
     zr.set_defaults(fn=cmd_scenario)
 

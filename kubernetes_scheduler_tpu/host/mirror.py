@@ -603,6 +603,9 @@ class SnapshotMirror:
 
     def _rebuild(self, window: list, pending_all_plain: bool) -> SnapshotArrays:
         self.ctr_rebuilds.inc()
+        # survives the adopt's reason reset: the degradation ladder
+        # records WHY the mirror dropped to its rebuild rung
+        self.last_rebuild_reason = self._flush_reason
         log.debug("mirror: full rebuild (%s)", self._flush_reason)
         snap = self.builder.build_snapshot(
             self.nodes, self.utils, self.running,
@@ -747,3 +750,25 @@ class SnapshotMirror:
             if not self.seeded or self._flush:
                 return True
             return self._verify_locked(window or [], window is None)
+
+    def inject_corruption(
+        self, *, leaf: str = "net_up", row: int = 0, delta: float = 1.0
+    ) -> bool:
+        """Fault-injection surface (sim/faults.py chaos scenarios):
+        perturb ONE cell of a mutable mirror leaf WITHOUT marking its
+        row dirty — exactly the silent-drift class the periodic bitwise
+        verify cross-check exists to catch (the corrupt value would
+        ride emitted snapshots but never the delta). Goes through the
+        copy-on-write path, so already-emitted (journaled / engine-
+        retained) snapshots are never mutated — replay parity holds;
+        the NEXT verify pass must detect, count, and resync. Returns
+        False when there is nothing to corrupt (unseeded, or a flush is
+        already pending and the corruption would be rebuilt away)."""
+        with self._lock:
+            if not self.seeded or self._flush or leaf not in self._leaves:
+                return False
+            arr = self._writable(leaf)
+            if arr.size == 0:
+                return False
+            arr[row % arr.shape[0]] += np.float32(delta)
+            return True

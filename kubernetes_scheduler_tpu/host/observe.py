@@ -115,6 +115,12 @@ def summarize(metrics, totals: dict | None = None) -> dict:
             "gang_pods_masked": sum(
                 getattr(m, "gang_pods_masked", 0) for m in cycles
             ),
+            "advisor_stale_cycles": sum(
+                1 for m in cycles if getattr(m, "advisor_stale", False)
+            ),
+            "degraded_cycles": sum(
+                1 for m in cycles if getattr(m, "degraded", ())
+            ),
         }
     return {
         "cycles_total": totals["cycles"],
@@ -154,6 +160,13 @@ def summarize(metrics, totals: dict | None = None) -> dict:
         "gangs_admitted_total": totals.get("gangs_admitted", 0),
         "gangs_deferred_total": totals.get("gangs_deferred", 0),
         "gang_pods_masked_total": totals.get("gang_pods_masked", 0),
+        # resilience layer (host/resilience.py): cycles served the
+        # last-good utilization snapshot under the advisor stale-TTL
+        # grace mode, and cycles that ran with ANY degradation-ladder
+        # subsystem below its top rung — the composed-degradation
+        # health signal chaos runs assert bounds on
+        "advisor_stale_cycles_total": totals.get("advisor_stale_cycles", 0),
+        "degraded_cycles_total": totals.get("degraded_cycles", 0),
         "scheduling_pods_per_sec": bound / total_s if total_s > 0 else 0.0,
         "bind_latency_p50_seconds": _quantile(lat, 0.50),
         "bind_latency_p99_seconds": _quantile(lat, 0.99),
@@ -207,6 +220,15 @@ _HELP = {
     "spans_dropped_total": (
         "Cycle span sets the recorder failed to encode/write "
         "(the scheduling loop never pays for these)"
+    ),
+    # resilience layer (host/resilience.py; sim/faults.py chaos runs)
+    "advisor_stale_cycles_total": (
+        "Cycles served the last-good utilization snapshot under the "
+        "advisor stale-TTL grace mode (config.advisor_stale_ttl_s)"
+    ),
+    "degraded_cycles_total": (
+        "Cycles that ran with any degradation-ladder subsystem below "
+        "its top rung"
     ),
 }
 
@@ -265,6 +287,16 @@ SHIPPED_METRICS = (
     # SLO watchdog (config.cycle_slo_ms; host labels by driver path,
     # the sidecar's own breach counter labels by rpc)
     "slo_breaches_total",
+    # resilience layer (host/resilience.py): stale-grace cycle counts,
+    # composed-degradation cycle counts, the per-subsystem ladder rung
+    # gauge, circuit-breaker state transitions (labeled by breaker +
+    # state entered), and the bridge client's health-probe failure
+    # split (transport-down vs deadline-exceeded)
+    "advisor_stale_cycles_total",
+    "degraded_cycles_total",
+    "degradation_rung",
+    "breaker_transitions_total",
+    "engine_health_failures_total",
     # sidecar exporter (bridge/server.EngineService)
     "device_step_duration_seconds",
     "rpcs_served_total",
@@ -382,6 +414,14 @@ class Counter:
         with self._lock:
             self._series[key] = self._series.get(key, 0) + n
 
+    def value(self, **labels) -> float:
+        """Current count for one label tuple (label-free counters:
+        value()) — the public read surface for summaries and tests, so
+        nothing couples to the internal series layout."""
+        key = tuple(str(labels[name]) for name in self.labels)
+        with self._lock:
+            return self._series.get(key, 0)
+
     def render(self, prefix: str = PREFIX) -> list[str]:
         name = f"{prefix}_{self.name}"
         out = [f"# HELP {name} {self.help}", f"# TYPE {name} counter"]
@@ -396,27 +436,34 @@ class Counter:
 
 class Gauge:
     """Set-at-render scalar sample (the sidecar sets it from live state
-    inside its render callback)."""
+    inside its render callback). With `labels`, one sample per label
+    tuple (the degradation ladder's `degradation_rung{subsystem}`
+    surface); label-free construction keeps the legacy single-sample
+    shape."""
 
-    def __init__(self, name: str, help: str):
+    def __init__(self, name: str, help: str, *, labels: tuple = ()):
         self.name = name
         self.help = help
-        self._value = 0.0
+        self.labels = tuple(labels)
+        # label values -> current sample; label-free gauges live under ()
+        self._series: dict[tuple, float] = {(): 0.0} if not labels else {}
         self._lock = threading.Lock()
 
-    def set(self, value: float) -> None:
+    def set(self, value: float, **labels) -> None:
+        key = tuple(str(labels[name]) for name in self.labels)
         with self._lock:
-            self._value = value
+            self._series[key] = value
 
     def render(self, prefix: str = PREFIX) -> list[str]:
         name = f"{prefix}_{self.name}"
+        out = [f"# HELP {name} {self.help}", f"# TYPE {name} gauge"]
         with self._lock:
-            value = self._value
-        return [
-            f"# HELP {name} {self.help}",
-            f"# TYPE {name} gauge",
-            f"{name} {value}",
-        ]
+            series = dict(self._series)
+        for key in sorted(series):
+            out.append(
+                f"{name}{_fmt_labels(self.labels, key)} {series[key]}"
+            )
+        return out
 
 
 # ---- per-cycle spans (Chrome trace events, merged across the bridge) ------

@@ -134,6 +134,16 @@ class CycleMetrics:
     # and used the yoda formula instead — a POLICY change under
     # degradation, distinct from benign same-policy fallback
     policy_mismatch: bool = False
+    # advisor stale-TTL grace (config.advisor_stale_ttl_s): this cycle
+    # was served the LAST-GOOD cluster state because the advisor fetch
+    # failed (or was held by the outage backoff) — scheduling flowed on
+    # marked-stale utilization instead of stalling the window
+    advisor_stale: bool = False
+    # degradation ladder (host/resilience.DegradationLadder): the
+    # subsystems sitting below their top rung when this cycle
+    # completed — journaled with the cycle, so chaos runs are
+    # replay-auditable ("which cycles ran degraded, and on what")
+    degraded: tuple = ()
     # pipelined loop (config.pipeline_depth >= 1): host work done while
     # the engine call was in flight (the overlap win — next-cycle pop,
     # record warming, speculative pod-batch build), and speculative-state
@@ -420,6 +430,8 @@ class Scheduler:
             "gangs_admitted": 0,
             "gangs_deferred": 0,
             "gang_pods_masked": 0,
+            "advisor_stale_cycles": 0,
+            "degraded_cycles": 0,
         }
         # resident cluster state (config.resident_state): the last full
         # snapshot the engine confirmed retaining (the delta base), the
@@ -517,6 +529,137 @@ class Scheduler:
         self.slo_breaches = 0
         self.last_slo_breach: dict | None = None
         self._slo_profile_pending = 0
+        # resilience layer (host/resilience.py): the degradation-ladder
+        # state machine (single owner of every subsystem's rung), the
+        # circuit breakers guarding the engine dispatch and advisor
+        # fetch, and the shared deterministic-jitter backoff policy the
+        # advisor outage path retries on. All of it observes and gates —
+        # with no failures the breakers stay closed, every rung stays at
+        # top, and the loop is bit-identical to the pre-resilience
+        # scheduler (PARITY round 17).
+        from kubernetes_scheduler_tpu.host.resilience import (
+            BackoffPolicy,
+            CircuitBreaker,
+            DegradationLadder,
+        )
+
+        # the retry/backoff clock of record is the QUEUE's clock (the
+        # injectable queue_clock; the scenario harness's virtual
+        # SimClock) — the breakers and the advisor backoff hold read it
+        # LIVE through the queue so virtual-clock runs are
+        # tick-deterministic and test clock pokes stay coherent
+        self._clock = lambda: self.queue._clock()
+        self.ladder = DegradationLadder()
+        self.ctr_breaker = Counter(
+            "breaker_transitions_total",
+            "Circuit-breaker state transitions (state entered), by "
+            "breaker (engine dispatch vs advisor fetch)",
+            labels=("breaker", "state"),
+        )
+        # ONE breaker governs the engine path. An engine that owns a
+        # breaker (RemoteEngine: one per sidecar target, gating its own
+        # RPCs) is adopted and retuned with the config knobs + queue
+        # clock + transition hook — two stacked breakers would each
+        # need their half-open windows to line up before a probe could
+        # reach the wire. Engines without one (local/sharded) get a
+        # scheduler-owned breaker, and the dispatch gate below is the
+        # only enforcement point.
+        eng_brk = getattr(self.engine, "breaker", None)
+        self._engine_owns_breaker = isinstance(eng_brk, CircuitBreaker)
+        if self._engine_owns_breaker:
+            self.engine_breaker = eng_brk.configure(
+                failure_threshold=config.breaker_failure_threshold,
+                recovery_window_s=config.breaker_recovery_window_s,
+                clock=self._clock,
+                on_transition=self._on_breaker_transition,
+            )
+        else:
+            self.engine_breaker = CircuitBreaker(
+                "engine",
+                failure_threshold=config.breaker_failure_threshold,
+                recovery_window_s=config.breaker_recovery_window_s,
+                clock=self._clock,
+                on_transition=self._on_breaker_transition,
+            )
+        self.advisor_breaker = CircuitBreaker(
+            "advisor",
+            failure_threshold=config.breaker_failure_threshold,
+            recovery_window_s=config.breaker_recovery_window_s,
+            clock=self._clock,
+            on_transition=self._on_breaker_transition,
+        )
+        self._backoff = BackoffPolicy()
+        # advisor outage bookkeeping: consecutive failures, the
+        # backoff-held next-attempt time, and the last-good UTILIZATION
+        # snapshot the stale-TTL grace mode serves (utils only — the
+        # node/running lists are re-read LIVE under grace, so the
+        # scheduler's own binds stay visible and capacity is never
+        # double-booked against a frozen running set)
+        self._advisor_fails = 0
+        self._advisor_retry_at = float("-inf")
+        self._last_good_utils: tuple | None = None  # (utils, ts)
+        # kernel-rung latch: has this config ever served a fused cycle?
+        # (only then is coming back unfused a capability downgrade)
+        self._kernel_fused_seen = False
+        self.prom_collectors = (
+            self.prom_collectors
+            + (self.ctr_breaker,)
+            + self.ladder.collectors
+            # engines owning exported collectors (RemoteEngine's
+            # engine_health_failures_total) ride the host exporter too
+            + tuple(getattr(self.engine, "collectors", ()))
+        )
+
+    def _on_breaker_transition(self, name: str, state: str) -> None:
+        """Breaker state change hook: count the transition and keep the
+        ladder coupled — an OPEN engine breaker implies the engine
+        subsystem sits below its top rung (the `degradation-ladder`
+        protocol model's breaker-open-implies-degraded invariant).
+        Everything but the advisor breaker IS the engine breaker (an
+        adopted bridge-client breaker keeps its per-target name)."""
+        self.ctr_breaker.inc(breaker=name, state=state)
+        if name != "advisor" and state == "open":
+            self.ladder.demote(
+                "engine", reason="breaker-open",
+                seq=self.totals["cycles"],
+            )
+
+    def _engine_failure(self, reason: str) -> None:
+        """One engine-dispatch failure: feed the breaker and walk the
+        ladder down — engine (remote->local), plus sharded->dense when
+        the failed engine was the mesh-sharded one (its fallback is the
+        dense scalar path). With a SHARED client-owned breaker the
+        client already recorded the terminal outcome per call — a
+        second record here would restart the open window every cycle
+        and recovery would never come."""
+        if not self._engine_owns_breaker:
+            self.engine_breaker.record_failure()
+        seq = self.totals["cycles"]
+        self.ladder.demote("engine", reason=reason, seq=seq)
+        if getattr(self.engine, "n_shards", 0):
+            self.ladder.demote("sharding", reason=reason, seq=seq)
+
+    def _ladder_cycle_end(self, m: CycleMetrics) -> None:
+        """Completion-stage ladder bookkeeping: a clean device cycle IS
+        the recovery probe for the engine-side rungs (the dispatch
+        re-attempted the degraded path and it served), so probe+promote
+        climb them back; the policy rung follows policy_mismatch."""
+        seq = self.totals["cycles"]
+        lad = self.ladder
+        device_ok = m.engine_seconds > 0 and not m.used_fallback
+        if device_ok:
+            if not self._engine_owns_breaker:
+                # a shared client breaker already recorded per call
+                self.engine_breaker.record_success()
+            for sub in ("engine", "sharding"):
+                if lad.depth(sub) > 0:
+                    lad.probe(sub, seq=seq)
+                    lad.promote(sub, seq=seq)
+        if m.policy_mismatch:
+            lad.demote("policy", reason="no-scalar-mirror", seq=seq)
+        elif device_ok and lad.depth("policy") > 0:
+            lad.probe("policy", seq=seq)
+            lad.promote("policy", seq=seq)
 
     def _cycle_path(self, m: CycleMetrics) -> str:
         """The histogram `path` label: which driver served the cycle."""
@@ -553,6 +696,10 @@ class Scheduler:
         # a sharded cycle, whatever dispatch surface served it
         if m.engine_seconds > 0 and getattr(self.engine, "n_shards", 0):
             m.sharded_cycles = 1
+        # degradation-ladder audit: the rungs below top as this cycle
+        # lands (journaled with the cycle's metrics; the same-mutation
+        # precedent as the sharded_cycles attribution above)
+        m.degraded = self.ladder.degraded()
         path = self._cycle_path(m)
         self.hist_cycle.observe(m.cycle_seconds, path=path)
         if m.engine_seconds > 0:
@@ -585,6 +732,8 @@ class Scheduler:
             self.totals["gangs_admitted"] += m.gangs_admitted
             self.totals["gangs_deferred"] += m.gangs_deferred
             self.totals["gang_pods_masked"] += m.gang_pods_masked
+            self.totals["advisor_stale_cycles"] += int(m.advisor_stale)
+            self.totals["degraded_cycles"] += int(bool(m.degraded))
 
     def metrics_snapshot(self) -> tuple[list[CycleMetrics], dict]:
         """Point-in-time copy for exporters (safe against the scheduling
@@ -667,6 +816,59 @@ class Scheduler:
                 self._span("event_apply", t_e, events=len(changed))
         return mir.state()
 
+    def _advisor_ready(self) -> bool:
+        """May this cycle attempt a state fetch? False while the
+        deterministic backoff hold from the last failure is pending or
+        the advisor breaker is open (its half-open probe is the ONE
+        fetch attempt per recovery window)."""
+        if self._clock() < self._advisor_retry_at:
+            return False
+        return self.advisor_breaker.allow()
+
+    def _advisor_failed(self) -> None:
+        """One failed fetch attempt: feed the breaker and arm the next
+        attempt at the shared BackoffPolicy's deterministic-jitter
+        exponential delay (never a fixed per-cycle hammer)."""
+        self.advisor_breaker.record_failure()
+        self._advisor_retry_at = self._clock() + self._backoff.delay(
+            self._advisor_fails, key="advisor"
+        )
+        self._advisor_fails += 1
+
+    def _advisor_recovered(self, state: tuple) -> None:
+        """A successful fetch: clear the outage bookkeeping and adopt
+        this cycle's utilization as the stale-grace fallback payload."""
+        if self._advisor_fails or self.advisor_breaker.state() != "closed":
+            self.advisor_breaker.record_success()
+        self._advisor_fails = 0
+        self._advisor_retry_at = float("-inf")
+        self._last_good_utils = (state[2], self._clock())
+
+    def _stale_state(self) -> tuple | None:
+        """(nodes, running, utils) for a grace-mode cycle: LIVE cluster
+        lists (the scheduler's own binds must stay visible — serving a
+        frozen running set would double-book node capacity) joined with
+        the last-good utilization while the stale TTL
+        (config.advisor_stale_ttl_s) still covers it. None when the TTL
+        is off/expired or the cluster source itself is down (then the
+        requeue outage path owns the cycle)."""
+        ttl = self.config.advisor_stale_ttl_s
+        lg = self._last_good_utils
+        if ttl <= 0 or lg is None or self._clock() - lg[1] > ttl:
+            return None
+        try:
+            if self.mirror is not None:
+                # the mirror's lists are event-sourced and live; its
+                # utilization is simply frozen at the last applied
+                # advisor events — exactly the grace semantics
+                if not self.mirror.seeded:
+                    return None
+                return self.mirror.state()
+            return self.list_nodes(), self.list_running_pods(), lg[0]
+        except Exception:
+            log.exception("stale-grace cluster-list fetch failed")
+            return None
+
     def _cycle_snapshot(
         self, window, nodes, running, utils, *, ephemeral: bool,
     ):
@@ -689,6 +891,21 @@ class Scheduler:
                 "mirror_emit", t_build,
                 rebuilt=rebuilt, delta=delta is not None,
             )
+            # ladder: a flush-to-full rebuild IS the mirror->rebuild
+            # rung (verify resync, churn); a mirror-served emit while
+            # degraded is the recovery probe that climbs back
+            seq = self.totals["cycles"]
+            if rebuilt:
+                self.ladder.demote(
+                    "mirror",
+                    reason=getattr(
+                        self.mirror, "last_rebuild_reason", "flush"
+                    ),
+                    seq=seq,
+                )
+            elif self.ladder.depth("mirror") > 0:
+                self.ladder.probe("mirror", seq=seq)
+                self.ladder.promote("mirror", seq=seq)
             return snapshot, delta
         snapshot = self.builder.build_snapshot(
             nodes, utils, running, pending_pods=window,
@@ -739,28 +956,50 @@ class Scheduler:
                 return None
 
         t_fetch = time.perf_counter()
-        try:
-            if self.mirror is not None:
-                nodes, running, utils = self._mirror_state()
-            else:
-                nodes = self.list_nodes()
-                running = self.list_running_pods()
-                utils = self.advisor.fetch()
-        except Exception:
-            # a cluster-source or advisor outage (API server blip,
-            # Prometheus restart) must not LOSE the popped window: requeue
-            # it with backoff and surface a failed, fallback-marked cycle
-            # (the reference's PreScore error path makes pods retriable
-            # the same way, scheduler.go:106-109)
-            log.exception("cycle state fetch failed; requeueing window")
-            for pod in window:
-                self.queue.requeue_unschedulable(pod)
-            m.pods_unschedulable = len(window)
-            m.fetch_failed = True
-            m.cycle_seconds = time.perf_counter() - t0
-            self._record(m)
-            self._flush_spans(t0, m)
-            return None
+        state = None
+        if self._advisor_ready():
+            try:
+                if self.mirror is not None:
+                    state = self._mirror_state()
+                else:
+                    state = (
+                        self.list_nodes(),
+                        self.list_running_pods(),
+                        self.advisor.fetch(),
+                    )
+            except Exception:
+                # a cluster-source or advisor outage (API server blip,
+                # Prometheus restart): feed the advisor breaker and arm
+                # the deterministic-jitter backoff hold, so retry
+                # attempts pace out instead of paying the fetch timeout
+                # every cycle
+                log.exception("cycle state fetch failed")
+                self._advisor_failed()
+        if state is not None:
+            self._advisor_recovered(state)
+            nodes, running, utils = state
+        else:
+            # outage (or a backoff hold between retry attempts): the
+            # stale-TTL grace mode serves the last-good cluster state,
+            # marked, so scheduling keeps flowing on slightly stale
+            # utilization (config.advisor_stale_ttl_s)
+            stale = self._stale_state()
+            if stale is None:
+                # past the TTL (or grace off): the outage must not LOSE
+                # the popped window — requeue it with backoff and
+                # surface a failed cycle (the reference's PreScore error
+                # path makes pods retriable the same way,
+                # scheduler.go:106-109)
+                for pod in window:
+                    self.queue.requeue_unschedulable(pod)
+                m.pods_unschedulable = len(window)
+                m.fetch_failed = True
+                m.cycle_seconds = time.perf_counter() - t0
+                self._record(m)
+                self._flush_spans(t0, m)
+                return None
+            nodes, running, utils = stale
+            m.advisor_stale = True
         self._span("state_fetch", t_fetch)
 
         # VolumeRestrictions (ReadWriteOncePod): at most one pod
@@ -832,6 +1071,20 @@ class Scheduler:
             use_device = self._dispatch.decide(cells)
         else:
             use_device = cells >= self.config.min_device_work
+        if use_device and self.config.feature_gates.tpu_batch_score:
+            # breaker open: the engine is not dispatched at all — the
+            # scalar path serves this window, so the outage costs one
+            # probe per recovery window instead of a timeout per call.
+            # Scheduler-owned breakers enforce HERE via allow() (one
+            # half-open probe per window takes the device path below);
+            # a breaker SHARED with the bridge client is only peek()ed
+            # — the client's allow() at send time is the consuming
+            # gate, and eating its probe here would fail every probe
+            # cycle spuriously.
+            if self._engine_owns_breaker:
+                use_device = self.engine_breaker.peek()
+            else:
+                use_device = self.engine_breaker.allow()
         t_path = time.perf_counter()
         backlog = (
             len(window) > self.config.batch_window and self._engine_windows_ok
@@ -903,6 +1156,7 @@ class Scheduler:
                                     "this chunk only"
                                 )
                                 m.used_fallback = True
+                                self._engine_failure("chunk-failed")
                                 self._run_scalar(
                                     chunk, nodes, run_now, utils, m
                                 )
@@ -927,6 +1181,7 @@ class Scheduler:
                     self.config.policy,
                 )
                 m.used_fallback = True
+                self._engine_failure("engine-cycle-failed")
                 self._invalidate_resident()
                 self._run_scalar(window, nodes, running, utils, m)
                 # a failed device cycle is a device observation priced at
@@ -991,6 +1246,10 @@ class Scheduler:
                 # delta base that predates the kills
                 self._invalidate_resident()
 
+        # resilience completion stage: breaker outcome + ladder
+        # probe/promote climbs, BEFORE _record so the cycle journals
+        # the rungs it actually ended on
+        self._ladder_cycle_end(m)
         m.cycle_seconds = time.perf_counter() - t0
         self._record(m)
         seq = None
@@ -1201,6 +1460,7 @@ class Scheduler:
                 self.config.policy,
             )
             m.used_fallback = True
+            self._engine_failure("engine-dispatch-failed")
             self._invalidate_resident()
             self._discard_speculative(m)
             self._run_scalar(
@@ -1230,6 +1490,7 @@ class Scheduler:
                 self.config.policy,
             )
             m.used_fallback = True
+            self._engine_failure("engine-force-failed")
             self._invalidate_resident()
             self._discard_speculative(m)
             self._run_scalar(
@@ -1477,6 +1738,12 @@ class Scheduler:
     def _invalidate_resident(self) -> None:
         """Flush the resident-state contract: the next resident dispatch
         uploads in full (engine failure, preemption, epoch desync)."""
+        if self.config.resident_state:
+            # ladder: resident -> full until a delta applies again
+            self.ladder.demote(
+                "resident", reason="resident-flush",
+                seq=self.totals["cycles"],
+            )
         self._resident_ok = False
         self._resident_prev = None
         inval = getattr(self.engine, "invalidate_resident", None)
@@ -1763,7 +2030,13 @@ class Scheduler:
         # unreachable sidecar degrades to the in-host evaluation (same
         # tensors, CPU jax), never to no-preemption
         res = None
-        if hasattr(self.engine, "preempt"):
+        # breaker state() (never allow()): preemption must not consume
+        # the half-open recovery probe the next cycle's schedule
+        # dispatch is entitled to — while the breaker is anything but
+        # closed, the pass runs in-host outright
+        if hasattr(self.engine, "preempt") and (
+            self.engine_breaker.state() == "closed"
+        ):
             try:
                 res = self.engine.preempt(snapshot, pend, victims, k_cap=k_cap)
             except NotImplementedError:
@@ -1775,6 +2048,12 @@ class Scheduler:
                 log.exception(
                     "engine preemption pass failed; running in-host"
                 )
+                if not self._engine_owns_breaker:
+                    # a shared client breaker already recorded the
+                    # terminal outcome inside the call (same guard as
+                    # _engine_failure — double-feeding would count one
+                    # outage twice toward the threshold)
+                    self.engine_breaker.record_failure()
         if res is None:
             from kubernetes_scheduler_tpu.engine import preempt_batch
 
@@ -2249,6 +2528,7 @@ class Scheduler:
                 )
             )
         )
+        self._ladder_kernel(fused)
         kw = dict(
             policy=self.config.policy,
             assigner=self.config.assigner,
@@ -2269,6 +2549,23 @@ class Scheduler:
                 auction_price_frac=self.config.auction_price_frac,
             )
         return kw
+
+    def _ladder_kernel(self, fused: bool) -> None:
+        """fused->unfused rung tracking: only a CAPABILITY downgrade —
+        a config that HAS served fused cycles coming back unfused
+        (mid-stream sidecar downgrade dropping the fused_min_max latch)
+        — demotes; configurations that never fuse (softmax, CPU-local
+        min_max, plugin scoring) are not degraded, they are simply not
+        on the fused path."""
+        lad = self.ladder
+        seq = self.totals["cycles"]
+        if fused:
+            self._kernel_fused_seen = True
+            if lad.depth("kernel") > 0:
+                lad.probe("kernel", seq=seq)
+                lad.promote("kernel", seq=seq)
+        elif self._kernel_fused_seen and lad.depth("kernel") == 0:
+            lad.demote("kernel", reason="capability-downgrade", seq=seq)
 
     def _run_backlog(
         self, window, nodes, running, utils, m: CycleMetrics,
@@ -2435,6 +2732,12 @@ class Scheduler:
         if used_delta:
             m.delta_uploads += 1
             m.delta_bytes_saved += saved
+            if self.ladder.depth("resident") > 0:
+                # the delta attempt was the recovery probe, and the
+                # engine confirmed applying it: climb back to the top
+                seq = self.totals["cycles"]
+                self.ladder.probe("resident", seq=seq)
+                self.ladder.promote("resident", seq=seq)
         else:
             m.full_uploads += 1
         # mesh-sharded engine (config.sharded_engine): which shards this

@@ -114,6 +114,14 @@ class InformerCache:
     def stop(self) -> None:
         self._stop.set()
 
+    def wrap_events(self, wrapper) -> None:
+        """Compose a wrapper around the on_event hook — the informer-
+        stream fault-injection surface (sim/faults.py chaos runs gate
+        delivery here: partition buffers, error drops) and a seam for
+        any other event-tap. `wrapper(inner)` receives the current
+        callback (possibly None) and returns the replacement."""
+        self.on_event = wrapper(self.on_event)
+
     def wait_synced(self, timeout: float = 30.0) -> bool:
         return all(ev.wait(timeout) for ev in self._synced.values())
 

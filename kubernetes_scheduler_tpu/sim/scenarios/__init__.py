@@ -23,8 +23,11 @@ def run(
     trace_path: str | None = None,
     span_path: str | None = None,
     config=None,
+    faults: bool = True,
 ) -> dict:
-    """Instantiate and run a registered scenario by name."""
+    """Instantiate and run a registered scenario by name. faults=False
+    runs a chaos program's traffic WITHOUT its fault plan (the clean
+    A/B twin)."""
     cls = SCENARIOS.get(name)
     if cls is None:
         raise ValueError(
@@ -36,4 +39,5 @@ def run(
         trace_path=trace_path,
         span_path=span_path,
         config=config,
+        faults=faults,
     )

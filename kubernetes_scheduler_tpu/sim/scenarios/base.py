@@ -63,11 +63,23 @@ class ScenarioWorld:
     resubmitted: int = 0
     node_failures: int = 0
     node_restores: int = 0
+    # chaos runs (sim/faults.InformerGate): mirror-bound event delivery
+    # routed through the informer-stream fault boundary — partitions
+    # buffer, errors drop, everything else passes through
+    informer_gate: object = None
     _seen_bindings: int = 0
 
     def submit(self, pod: Pod) -> None:
         self.submitted += 1
         self.scheduler.submit(pod)
+
+    def _deliver(self, apply, *args) -> None:
+        """One informer-style event delivery, through the fault gate
+        when a chaos plan installed one."""
+        if self.informer_gate is not None:
+            self.informer_gate.deliver(apply, *args)
+        else:
+            apply(*args)
 
     def _mirror(self):
         """The scheduler's snapshot mirror when streaming ingestion is
@@ -90,14 +102,14 @@ class ScenarioWorld:
         self.node_failures += 1
         mirror = self._mirror()
         if mirror is not None:
-            mirror.apply_node_event("DELETED", nd)
+            self._deliver(mirror.apply_node_event, "DELETED", nd)
         displaced = [p for p in self.running if p.node_name == name]
         for pod in displaced:
             self.running.remove(pod)
             if mirror is not None:
                 # the pod DELETE the informer would stream; the
                 # controller's re-create is the submit below
-                mirror.apply_pod_event("DELETED", pod)
+                self._deliver(mirror.apply_pod_event, "DELETED", pod)
             pod.node_name = None
             self.resubmitted += 1
             self.scheduler.submit(pod)
@@ -111,7 +123,7 @@ class ScenarioWorld:
         self.node_restores += 1
         mirror = self._mirror()
         if mirror is not None:
-            mirror.apply_node_event("ADDED", nd)
+            self._deliver(mirror.apply_node_event, "ADDED", nd)
         return True
 
     def absorb_bindings(self) -> None:
@@ -126,12 +138,29 @@ class ScenarioWorld:
 class Scenario:
     """One registered traffic program. Subclasses set `name`,
     `description`, optionally `smoke` (cheap enough for the
-    scenario-smoke gate) and override build_cluster()/tick()."""
+    scenario-smoke gate) and override build_cluster()/tick(). Chaos
+    programs additionally set `chaos = True`, declare their
+    SchedulerConfig knobs in `config_overrides`, and return a
+    sim/faults.FaultPlan from fault_plan() — the runner then wraps the
+    advisor/engine/journal boundaries and gates informer delivery, and
+    the summary grows the recovery audit (degraded cycle counts,
+    breaker states, ladder rungs, injected-fault counts, `recovered`)."""
 
     name = "?"
     description = ""
     ticks = 12
     smoke = False
+    # chaos programs: deterministic fault injection rides this run
+    chaos = False
+    # SchedulerConfig overrides merged into scenario_config() when the
+    # caller passes no explicit config (chaos programs pin the modes
+    # their fault plan targets: mirror on, resident on, stale TTL, ...)
+    config_overrides: dict = {}
+
+    def fault_plan(self):
+        """The sim/faults.FaultPlan for this program (None = no
+        injection — every pre-chaos scenario)."""
+        return None
 
     def __init__(self, *, n_nodes: int = 64, intensity: float = 1.0):
         self.n_nodes = int(n_nodes)
@@ -198,6 +227,7 @@ def run_scenario(
     span_path: str | None = None,
     config: SchedulerConfig | None = None,
     max_cycles_per_tick: int = 64,
+    faults: bool = True,
 ) -> dict:
     """Drive `scenario` through the host loop; returns the summary dict
     (one JSON-able line). With `trace_path`, every cycle lands in a
@@ -207,7 +237,11 @@ def run_scenario(
     same way a production run does."""
     rng = np.random.default_rng(seed)
     nodes, utils = scenario.build_cluster(rng)
-    cfg = config if config is not None else scenario_config()
+    cfg = (
+        config
+        if config is not None
+        else scenario_config(dict(scenario.config_overrides))
+    )
     if (trace_path is not None and cfg.trace_path is None) or (
         span_path is not None and cfg.span_path is None
     ):
@@ -219,21 +253,52 @@ def run_scenario(
             span_path=cfg.span_path or span_path,
         )
     clock = SimClock()
+    # chaos plan (sim/faults.py): wrap the boundaries the Scheduler/CLI
+    # already own — advisor fetch, engine dispatch, journal writes —
+    # and gate the world's informer-style event delivery. Everything
+    # keys off the virtual clock, so the same (scenario, seed) injects
+    # the same faults at the same ticks and the journal replay-pins.
+    plan = scenario.fault_plan() if faults else None
+    injector = None
+    gate = None
+    advisor = StaticAdvisor(utils)
+    engine = None
+    if plan is not None and plan.windows:
+        from kubernetes_scheduler_tpu.engine import LocalEngine
+        from kubernetes_scheduler_tpu.sim.faults import (
+            FaultInjector,
+            FaultyAdvisor,
+            FaultyEngine,
+            InformerGate,
+        )
+
+        injector = FaultInjector(plan, clock)
+        advisor = FaultyAdvisor(advisor, injector)
+        engine = FaultyEngine(LocalEngine(), injector)
+        gate = InformerGate(injector)
     world = ScenarioWorld(nodes=nodes, utils=utils, scheduler=None)
     sched = Scheduler(
         cfg,
-        advisor=StaticAdvisor(utils),
+        advisor=advisor,
         binder=RecordingBinder(),
+        engine=engine,
         list_nodes=lambda: world.nodes,
         list_running_pods=lambda: world.running,
         queue_clock=clock,
     )
     world.scheduler = sched
+    world.informer_gate = gate
+    if injector is not None:
+        injector.wrap_journal(sched.recorder)
 
     t0 = time.perf_counter()
     cycles = 0
     try:
         for t in range(scenario.ticks):
+            if gate is not None:
+                # a closed partition window flushes its buffered events
+                # at the tick boundary (the re-established watch)
+                gate.flush()
             scenario.tick(t, world, rng)
             clock.advance(1.0)
             for _ in range(max_cycles_per_tick):
@@ -247,6 +312,8 @@ def run_scenario(
                     # a deferred gang waiting for members — both need
                     # the clock to advance, i.e. the next tick
                     break
+        if gate is not None:
+            gate.flush()
         sched.drain_pipeline()
     finally:
         if sched.recorder is not None:
@@ -275,7 +342,37 @@ def run_scenario(
         "full_uploads": totals["full_uploads"],
         "seconds": round(dt, 3),
         "pods_per_sec": round(totals["pods_bound"] / max(dt, 1e-9), 1),
+        # resilience audit (host/resilience.py): how degraded the run
+        # got, whether it climbed all the way back, and what the
+        # breakers did — the chaos-scenario recovery gate reads these
+        "fetch_failures": totals["fetch_failures"],
+        "advisor_stale_cycles": totals["advisor_stale_cycles"],
+        "degraded_cycles": totals["degraded_cycles"],
+        "breaker_state": sched.engine_breaker.state(),
+        "breaker_transitions": dict(sched.engine_breaker.transition_counts),
+        "advisor_breaker_state": sched.advisor_breaker.state(),
+        "degradation_rungs": {
+            sub: info["rung"]
+            for sub, info in sched.ladder.snapshot().items()
+            if info["depth"] > 0
+        },
+        "recovered": (
+            sched.ladder.fully_recovered()
+            and sched.engine_breaker.state() == "closed"
+            and sched.advisor_breaker.state() == "closed"
+        ),
     }
+    if sched.recorder is not None:
+        out["trace_records_dropped"] = sched.recorder.records_dropped
+    if sched.mirror is not None:
+        out["mirror_full_rebuilds"] = int(sched.mirror.ctr_rebuilds.value())
+        out["mirror_verify_failures"] = int(
+            sched.mirror.ctr_verify_failures.value()
+        )
+    if injector is not None:
+        out["faults_injected"] = injector.summary()
+        if gate is not None:
+            out["informer_events_dropped"] = gate.dropped
     if trace_path is not None:
         out["journal"] = trace_path
     if span_path is not None:

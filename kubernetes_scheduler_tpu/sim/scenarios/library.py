@@ -234,6 +234,193 @@ class GangMixScenario(Scenario):
             world.submit(_mk_pod(rng, f"gangfill-t{t}-{i}"))
 
 
+# ---- chaos programs (sim/faults.py) ---------------------------------------
+#
+# Each chaos scenario is steady traffic PLUS a deterministic FaultPlan
+# on the virtual clock: the run must keep scheduling through the fault
+# windows (bounded degraded cycles, never a stall) and END fully
+# recovered — every degradation-ladder rung back at top, both breakers
+# closed — with the journal replay-pinned like every clean scenario.
+# The breaker knobs are tightened so open -> half-open -> closed fits
+# inside a handful of virtual ticks.
+
+_CHAOS_BREAKER = {
+    "breaker_failure_threshold": 2,
+    "breaker_recovery_window_s": 3.0,
+}
+
+
+class ChaosScenario(Scenario):
+    """Shared chaos shape: a steady half-intensity trickle every tick
+    (including the calm recovery tail — recovery probes need traffic
+    to ride), with the fault program declared in `windows()`."""
+
+    chaos = True
+    ticks = 18
+
+    def windows(self) -> tuple:
+        raise NotImplementedError
+
+    def fault_plan(self):
+        from kubernetes_scheduler_tpu.sim.faults import FaultPlan
+
+        return FaultPlan(self.windows())
+
+    def tick(self, t: int, world: ScenarioWorld, rng) -> None:
+        n = max(2, int(self.n_nodes * self.intensity / 2))
+        for i in range(n):
+            world.submit(_mk_pod(rng, f"{self.name}-t{t}-{i}"))
+
+
+def _w(boundary, kind, start, end, **kw):
+    from kubernetes_scheduler_tpu.sim.faults import FaultWindow
+
+    return FaultWindow(
+        boundary=boundary, kind=kind, start=float(start), end=float(end),
+        **kw,
+    )
+
+
+class AdvisorOutageScenario(ChaosScenario):
+    """Prometheus dies for 8 virtual seconds: the stale-TTL grace mode
+    serves last-good utilization (marked) until the TTL expires, then
+    the window-requeue outage path takes over with backoff-paced
+    retries; the advisor breaker opens and recovers by probe."""
+
+    name = "advisor-outage"
+    description = "advisor down past the stale TTL; grace then requeue"
+    ticks = 18
+    smoke = True
+    config_overrides = {"advisor_stale_ttl_s": 4.0, **_CHAOS_BREAKER}
+
+    def windows(self):
+        return (_w("advisor", "error", 3, 11),)
+
+
+class SidecarCrashRestartScenario(ChaosScenario):
+    """The engine process crashes and restarts: in-window dispatches
+    fail to the scalar path, the restarted engine lost its retained
+    resident state (full-resend recovery), and the ladder walks
+    engine->local->remote and resident->full->resident."""
+
+    name = "sidecar-crash-restart"
+    description = "engine crash-restart; resident state re-learned"
+    ticks = 16
+    config_overrides = {
+        "resident_state": True, "pipeline_depth": 1, **_CHAOS_BREAKER,
+    }
+
+    def windows(self):
+        return (_w("engine", "error", 4, 6, detail="crash"),)
+
+
+class RpcFlapScenario(ChaosScenario):
+    """The engine path flaps (fails every other virtual second): the
+    pipelined driver alternates device cycles with scalar fallbacks,
+    the breaker opens on failing phases and recovers by half-open
+    probe on good ones — the retry-storm shape the unified backoff
+    exists to de-phase."""
+
+    name = "rpc-flap"
+    description = "engine RPCs flap; breaker + fallback churn"
+    ticks = 18
+    smoke = True
+    config_overrides = {"pipeline_depth": 1, **_CHAOS_BREAKER}
+
+    def windows(self):
+        return (_w("engine", "flap", 3, 11, period=2),)
+
+
+class DiskFullJournalScenario(ChaosScenario):
+    """The flight-recorder disk fills for 6 virtual seconds: journal
+    appends fail, the recorder counts drops and keeps the loop
+    unharmed (never raises into a cycle), the delta chain re-anchors
+    with a full snapshot after the gap, and the journal still
+    replay-pins."""
+
+    name = "disk-full-journal"
+    description = "journal writes ENOSPC; recorder drops, loop unharmed"
+    ticks = 14
+    config_overrides = dict(_CHAOS_BREAKER)
+
+    def windows(self):
+        return (_w("journal", "error", 3, 9),)
+
+
+class MirrorCorruptionScenario(ChaosScenario):
+    """Silent mirror drift, injected: one cell of a mirror leaf is
+    perturbed without dirtying its row — the bitwise verify cross-check
+    (pinned to every emit here) must detect it, count
+    mirror_verify_failures_total, resync with a full rebuild, and climb
+    the mirror rung back."""
+
+    name = "mirror-corruption"
+    description = "mirror cell corrupted; verify detects and resyncs"
+    ticks = 14
+    corrupt_ticks = (4, 8)
+    config_overrides = {
+        "snapshot_mirror": True, "mirror_verify_interval": 1,
+        **_CHAOS_BREAKER,
+    }
+
+    def windows(self):
+        # the corruption itself goes through SnapshotMirror.
+        # inject_corruption (tick below); the plan carries a marker
+        # window so the run is audited as chaos
+        return (_w("mirror", "corrupt", min(self.corrupt_ticks),
+                   max(self.corrupt_ticks) + 1),)
+
+    def tick(self, t: int, world: ScenarioWorld, rng) -> None:
+        super().tick(t, world, rng)
+        if t in self.corrupt_ticks:
+            mirror = world.scheduler.mirror
+            if mirror is not None:
+                mirror.inject_corruption(leaf="net_up", row=t)
+
+
+class CompoundStormScenario(ChaosScenario):
+    """Everything at once: advisor flapping past the stale TTL, an
+    engine crash-restart, an informer partition over a node failure,
+    a full journal disk, added engine latency, and a mirror corruption
+    — the composed-degradation case none of the single-fault paths
+    exercise together. The gate: bounded degraded cycles, zero binding
+    diffs on replay, and FULL recovery (every rung top, breakers
+    closed) by scenario end."""
+
+    name = "compound-storm"
+    description = "advisor+engine+informer+journal+mirror faults at once"
+    ticks = 22
+    config_overrides = {
+        "resident_state": True, "pipeline_depth": 1,
+        "snapshot_mirror": True, "mirror_verify_interval": 1,
+        "advisor_stale_ttl_s": 4.0, **_CHAOS_BREAKER,
+    }
+
+    def windows(self):
+        return (
+            _w("advisor", "flap", 3, 9, period=2),
+            _w("engine", "error", 5, 7, detail="crash"),
+            _w("informer", "partition", 6, 9),
+            _w("journal", "error", 5, 8),
+            _w("engine", "latency", 9, 11, latency_s=0.005),
+            _w("mirror", "corrupt", 10, 11),
+        )
+
+    def tick(self, t: int, world: ScenarioWorld, rng) -> None:
+        super().tick(t, world, rng)
+        if t == 6 and world.nodes:
+            # node failure INSIDE the informer partition: the mirror
+            # learns about it only when the buffered events flush
+            world.fail_node(world.nodes[0].name)
+        if t == 12:
+            for name in list(world.downed):
+                world.restore_node(name)
+        if t == 10:
+            mirror = world.scheduler.mirror
+            if mirror is not None:
+                mirror.inject_corruption(leaf="net_up", row=3)
+
+
 SCENARIOS = {
     s.name: s
     for s in (
@@ -243,5 +430,11 @@ SCENARIOS = {
         ZoneFailureScenario,
         AntiAffinityPackScenario,
         GangMixScenario,
+        AdvisorOutageScenario,
+        SidecarCrashRestartScenario,
+        RpcFlapScenario,
+        DiskFullJournalScenario,
+        MirrorCorruptionScenario,
+        CompoundStormScenario,
     )
 }

@@ -263,6 +263,28 @@ class SchedulerConfig:
     # clocks, so watchdog-on/off bindings are bit-identical (PARITY.md).
     cycle_slo_ms: float = 0.0
     slo_profile_cycles: int = 0
+    # resilience layer (host/resilience.py). advisor_stale_ttl_s: on an
+    # advisor/cluster-source fetch failure, cycles are served the
+    # LAST-GOOD cluster state (marked CycleMetrics.advisor_stale,
+    # counted advisor_stale_cycles_total) for up to this many seconds
+    # before the window-requeue outage path engages — scheduling keeps
+    # flowing on slightly stale utilization instead of stalling. 0 =
+    # off; with the TTL never firing the loop is bit-identical to the
+    # pre-grace scheduler (PARITY round 17). Advisor retry attempts
+    # during an outage follow the shared deterministic-jitter
+    # exponential BackoffPolicy instead of hammering every cycle.
+    advisor_stale_ttl_s: float = 0.0
+    # circuit breakers (closed -> open -> half-open with recovery
+    # probes) guarding the engine dispatch and the advisor fetch:
+    # after breaker_failure_threshold consecutive failures the
+    # dependency is skipped outright for breaker_recovery_window_s
+    # seconds, then ONE probe per window until it succeeds — an outage
+    # costs one probe per window instead of a timeout per call. While
+    # the engine breaker is open, cycles route to the scalar fallback
+    # directly (the degradation ladder records engine->local with the
+    # breaker as the reason; degradation_rung{subsystem} on /metrics).
+    breaker_failure_threshold: int = 3
+    breaker_recovery_window_s: float = 8.0
     preemption: bool = True
     preemption_max_victims: int = 8
     # preemptors evaluated per pass, highest priority first: the

@@ -1,0 +1,42 @@
+"""graftlint fixture: boundary-call failures accounted for.
+
+Every broad handler around an external call either counts a metric,
+feeds the circuit breaker, bumps a counter attribute, or re-raises —
+and narrow catches routed into classification pass untouched.
+"""
+
+import urllib.request
+
+
+class Probe:
+    def __init__(self, breaker, ctr):
+        self.breaker = breaker
+        self.ctr = ctr
+        self.failures = 0
+
+    def health(self, url):
+        try:
+            return urllib.request.urlopen(url, timeout=2.0).read()
+        except Exception:
+            self.ctr.inc(kind="transport")
+            self.breaker.record_failure()
+            return None
+
+    def poll(self, stub):
+        try:
+            return stub.call(timeout=1.0)
+        except Exception:
+            self.failures += 1  # counter bump accounts for it
+            return None
+
+    def strict(self, stub):
+        try:
+            return stub.call(timeout=1.0)
+        except Exception:
+            raise  # re-raise: the caller's path owns the accounting
+
+    def narrow(self, stub, errors):
+        try:
+            return stub.call(timeout=1.0)
+        except ValueError:  # narrow catch: not a blanket swallow
+            return None

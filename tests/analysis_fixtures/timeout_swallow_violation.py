@@ -1,0 +1,23 @@
+"""graftlint fixture: boundary-call exception swallows (never imported).
+
+The RemoteEngine.healthy() bug class: an external call (timeout-
+disciplined, even) whose broad except handler swallows the failure
+without counting a metric or feeding the circuit breaker — the outage
+stays invisible to dashboards and the breaker never trips.
+"""
+
+import urllib.request
+
+
+class Probe:
+    def health(self, url):
+        try:
+            return urllib.request.urlopen(url, timeout=2.0).read()
+        except Exception:  # LINE 16: swallowed, no metric, no breaker
+            return None
+
+    def poll(self, stub):
+        try:
+            return stub.call(timeout=1.0)
+        except:  # LINE 22: bare swallow on a boundary call  # noqa: E722
+            pass

@@ -51,6 +51,12 @@ def active(violations):
         ("dtype-shape", "dtype_shape_violation.py", "dtype_shape_clean.py", 3),
         ("timeout-hygiene", "timeout_violation.py", "timeout_clean.py", 5),
         (
+            "timeout-hygiene",
+            "timeout_swallow_violation.py",
+            "timeout_swallow_clean.py",
+            2,
+        ),
+        (
             "donation-aliasing",
             "donation_aliasing_violation.py",
             "donation_aliasing_clean.py",

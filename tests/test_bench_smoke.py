@@ -76,6 +76,7 @@ def test_bench_smoke_e2e():
         "host_loop_32nodes_attribution",
         "scenario_burst_32nodes",
         "scenario_gang_32nodes",
+        "host_loop_32nodes_chaos",
     ):
         assert want in metrics, (want, sorted(metrics))
     for name in (
@@ -184,6 +185,55 @@ def test_bench_smoke_e2e():
     gang = metrics["scenario_gang_32nodes"]
     assert gang["gangs_admitted"] > 0, gang
     assert 0.0 < gang["gang_admit_rate"] <= 1.0, gang
+    # the chaos drain (RPC-flap + solid-outage plan beside the clean
+    # pipelined drain): faults actually injected, degraded cycles
+    # bounded, the breaker walked its full open -> half-open -> closed
+    # arc, recovery latency is in-data, and the run ENDED recovered
+    chaos = metrics["host_loop_32nodes_chaos"]
+    assert chaos["pods_bound"] > 0, chaos
+    assert chaos["faults_injected"], chaos
+    assert 0 < chaos["degraded_cycles"] < chaos["cycles"], chaos
+    assert chaos["breaker_transitions"].get("open", 0) >= 1, chaos
+    assert chaos["breaker_transitions"].get("closed", 0) >= 1, chaos
+    assert chaos["breaker_state"] == "closed", chaos
+    assert chaos["recovery_episodes"] > 0, chaos
+    assert chaos["unrecovered_episodes"] == 0, chaos
+    assert chaos["recovery_latency_ms_p99"] > 0, chaos
+    assert chaos["recovered"] is True, chaos
+
+
+def test_chaos_smoke_e2e(tmp_path):
+    """The `make chaos-smoke` flow as a test: the compound-storm chaos
+    program at compressed scale with --require-recovery (exit 1 unless
+    every degradation-ladder rung ends at top with the breakers
+    closed), its journal replay-pinned by `trace replay` (exit 1 on
+    ANY binding diff) — chaos runs are as deterministic as clean
+    ones."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+
+    def run(*argv):
+        return subprocess.run(
+            [sys.executable, "-m", "kubernetes_scheduler_tpu", *argv],
+            capture_output=True, text=True, timeout=420, cwd=REPO, env=env,
+        )
+
+    journal = str(tmp_path / "compound-storm")
+    rec = run(
+        "scenario", "run", "compound-storm", "--nodes", "24",
+        "--require-recovery", "--trace", journal,
+    )
+    assert rec.returncode == 0, rec.stderr[-2000:]
+    summary = json.loads(rec.stdout.splitlines()[-1])
+    assert summary["pods_bound"] > 0, summary
+    assert summary["recovered"] is True, summary
+    assert summary["degraded_cycles"] > 0, summary
+    assert summary["faults_injected"], summary
+    assert summary["trace_records_dropped"] > 0, summary  # disk-full bit
+    assert summary["mirror_verify_failures"] >= 1, summary
+    rep = run("trace", "replay", journal)
+    assert rep.returncode == 0, rep.stderr[-2000:] + rep.stdout[-500:]
+    report = json.loads(rep.stdout.splitlines()[-1])
+    assert report["binding_diffs"] == 0 and report["replayed"] > 0
 
 
 def test_sharded_flat_bytes_gate_e2e():
@@ -675,7 +725,7 @@ def test_model_check_e2e(tmp_path):
     assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
     assert wall < 60.0, f"model-check took {wall:.1f}s — smoke budget blown"
     doc = json.loads(artifact.read_text())
-    assert len(doc["models"]) == 5
+    assert len(doc["models"]) == 6
     assert all(m["exhausted"] and not m["violations"] for m in doc["models"])
     assert doc["mutants"] and all(
         d["caught"] for d in doc["mutants"].values()

@@ -1,0 +1,728 @@
+"""graftchaos: deterministic fault injection (sim/faults.py), the
+unified retry/backoff/breaker layer, and the degradation ladder
+(host/resilience.py) — plus the compound-downgrade coverage the PR-3/
+PR-13 interaction never had: capability downgrade + mirror resync +
+pipeline flush landing in the SAME cycle window."""
+
+import numpy as np
+import pytest
+
+from kubernetes_scheduler_tpu.engine import LocalEngine
+from kubernetes_scheduler_tpu.host import NodeUtil, Scheduler, StaticAdvisor
+from kubernetes_scheduler_tpu.host.resilience import (
+    BackoffPolicy,
+    CircuitBreaker,
+    DegradationLadder,
+)
+from kubernetes_scheduler_tpu.sim.faults import (
+    FaultError,
+    FaultInjector,
+    FaultPartition,
+    FaultPlan,
+    FaultTimeout,
+    FaultWindow,
+    FaultyAdvisor,
+    FaultyEngine,
+    InformerGate,
+)
+from kubernetes_scheduler_tpu.sim.scenarios import SCENARIOS, SimClock, run_scenario
+from kubernetes_scheduler_tpu.utils.config import SchedulerConfig
+from tests.test_pipeline import make_node, make_pod
+
+
+# ---- resilience primitives -------------------------------------------------
+
+
+def test_backoff_deterministic_jitter():
+    p = BackoffPolicy(initial=0.5, max_delay=8.0, multiplier=2.0)
+    # same (key, attempt) -> same delay, bit for bit; keys de-phase
+    assert p.delay(3, key="advisor") == p.delay(3, key="advisor")
+    assert p.delay(3, key="advisor") != p.delay(3, key="bridge:a")
+    # exponential growth under the cap, jitter only shaves (<= 25%)
+    for attempt in range(8):
+        base = min(0.5 * 2**attempt, 8.0)
+        d = p.delay(attempt, key="k")
+        assert 0.75 * base <= d <= base
+
+
+def test_breaker_lifecycle_single_probe_per_window():
+    clk = [0.0]
+    moves = []
+    b = CircuitBreaker(
+        "engine", failure_threshold=2, recovery_window_s=5.0,
+        clock=lambda: clk[0],
+        on_transition=lambda name, state: moves.append((name, state)),
+    )
+    assert b.allow() and b.state() == "closed"
+    b.record_failure()
+    assert b.state() == "closed" and b.allow()  # under threshold
+    b.record_failure()
+    assert b.state() == "open" and not b.allow()
+    clk[0] = 4.9
+    assert not b.allow()  # window not elapsed
+    clk[0] = 5.0
+    assert b.allow() and b.state() == "half-open"
+    assert not b.allow()  # ONE probe per window
+    b.record_failure()    # probe failed: re-open, window restarts
+    assert b.state() == "open" and not b.allow()
+    clk[0] = 10.5
+    assert b.allow()
+    b.record_success()
+    assert b.state() == "closed" and b.allow()
+    assert moves == [
+        ("engine", "open"), ("engine", "half-open"),
+        ("engine", "open"), ("engine", "half-open"), ("engine", "closed"),
+    ]
+    assert b.transition_counts == {"open": 2, "half-open": 2, "closed": 1}
+
+
+def test_breaker_leaked_probe_expires_and_peek_is_side_effect_free():
+    clk = [0.0]
+    b = CircuitBreaker(
+        "engine", failure_threshold=1, recovery_window_s=5.0,
+        clock=lambda: clk[0],
+    )
+    b.record_failure()
+    clk[0] = 5.0
+    assert b.allow()  # half-open probe issued...
+    # ...and its outcome never recorded (the caller's cycle took a path
+    # with no record_* — the wedged-half-open class): after a full
+    # recovery window the probe is presumed lost and a fresh one admits
+    clk[0] = 9.0
+    assert not b.allow()
+    clk[0] = 10.0
+    assert b.allow()
+    b.record_success()
+    assert b.state() == "closed"
+    # peek() predicts allow() without consuming the probe
+    b2 = CircuitBreaker(
+        "bridge:x", failure_threshold=1, recovery_window_s=5.0,
+        clock=lambda: clk[0],
+    )
+    b2.record_failure()
+    assert not b2.peek()
+    clk[0] = 20.0
+    assert b2.peek() and b2.peek()  # no side effects
+    assert b2.state() == "open"     # peek never transitions
+    assert b2.allow() and b2.state() == "half-open"
+    assert not b2.peek()            # fresh probe outstanding
+
+
+def test_ladder_one_rung_probe_promote_and_gauge():
+    lad = DegradationLadder()
+    assert lad.fully_recovered() and lad.degraded() == ()
+    # demote moves exactly one rung per call; bottom is sticky
+    assert lad.demote("engine", reason="outage", seq=3)
+    assert lad.rung("engine") == "local" and lad.depth("engine") == 1
+    assert not lad.demote("engine", reason="again", seq=4)  # already bottom
+    assert lad.degraded() == ("engine",)
+    assert lad.reasons["engine"] == "outage" and lad.entry_seq["engine"] == 3
+    # promote without probe is flagged but never climbs un-probed:
+    # the implicit probe event is recorded first
+    assert lad.promote("engine", seq=5)
+    actions = [e["action"] for e in lad.events]
+    assert actions == ["demote", "probe", "promote"]
+    assert lad.fully_recovered()
+    # the exported gauge carries one sample per subsystem
+    text = "\n".join(lad.gauge.render())
+    assert 'degradation_rung{subsystem="engine"} 0' in text
+    assert 'degradation_rung{subsystem="mirror"} 0' in text
+
+
+def test_fault_plan_windows_flap_and_kinds():
+    clk = [0.0]
+    plan = FaultPlan((
+        FaultWindow(boundary="engine", kind="flap", start=2, end=8, period=2),
+        FaultWindow(boundary="advisor", kind="error", start=4, end=6),
+        FaultWindow(boundary="engine", kind="timeout", start=10, end=11),
+        FaultWindow(boundary="informer", kind="partition", start=1, end=3),
+    ))
+    inj = FaultInjector(plan, clock=lambda: clk[0])
+    inj.check("engine")  # t=0: nothing active
+    clk[0] = 2.0  # flap phase 0: fails
+    with pytest.raises(FaultError):
+        inj.check("engine")
+    clk[0] = 3.0  # flap phase 1: passes
+    inj.check("engine")
+    clk[0] = 4.0
+    with pytest.raises(FaultError):
+        inj.check("engine")
+    with pytest.raises(FaultError):
+        inj.check("advisor")
+    clk[0] = 10.5
+    with pytest.raises(FaultTimeout):
+        inj.check("engine")
+    clk[0] = 1.5
+    with pytest.raises(FaultPartition):
+        inj.check("informer")
+    assert inj.summary() == {
+        "advisor:error": 1, "engine:flap": 2, "engine:timeout": 1,
+        "informer:partition": 1,
+    }
+    assert not inj.quiesced()
+    clk[0] = 11.0
+    assert inj.quiesced()
+    # declaration errors are loud
+    with pytest.raises(ValueError):
+        FaultWindow(boundary="nowhere", kind="error", start=0, end=1)
+    with pytest.raises(ValueError):
+        FaultWindow(boundary="engine", kind="gremlins", start=0, end=1)
+    with pytest.raises(ValueError):
+        FaultWindow(boundary="engine", kind="error", start=2, end=2)
+
+
+def test_informer_gate_partition_buffers_error_drops():
+    clk = [0.0]
+    plan = FaultPlan((
+        FaultWindow(boundary="informer", kind="partition", start=1, end=3),
+        FaultWindow(boundary="informer", kind="error", start=5, end=6),
+    ))
+    gate = InformerGate(FaultInjector(plan, clock=lambda: clk[0]))
+    got = []
+    gate.deliver(got.append, "a")
+    assert got == ["a"]
+    clk[0] = 1.5  # partition: buffered
+    gate.deliver(got.append, "b")
+    gate.deliver(got.append, "c")
+    assert got == ["a"] and gate.flush() == 0  # still partitioned
+    clk[0] = 3.0
+    assert gate.flush() == 2
+    assert got == ["a", "b", "c"]  # arrival order preserved
+    clk[0] = 5.5  # error: dropped outright
+    gate.deliver(got.append, "d")
+    assert got == ["a", "b", "c"] and gate.dropped == 1
+
+
+def test_faulty_advisor_and_engine_wrappers():
+    clk = [0.0]
+    plan = FaultPlan((
+        FaultWindow(boundary="advisor", kind="error", start=1, end=2),
+        FaultWindow(boundary="engine", kind="error", start=1, end=2),
+    ))
+    inj = FaultInjector(plan, clock=lambda: clk[0])
+    adv = FaultyAdvisor(StaticAdvisor({"n0": NodeUtil(cpu_pct=5.0)}), inj)
+    eng = FaultyEngine(LocalEngine(), inj)
+    assert adv.fetch()["n0"].cpu_pct == 5.0
+    assert adv.fetch_changed() == {"n0": adv.inner.utils["n0"]}
+    assert adv.fetch_changed() == {}  # coalescing: nothing moved
+    assert eng.supports_resident() in (True, False)  # delegation works
+    clk[0] = 1.0
+    with pytest.raises(FaultError):
+        adv.fetch()
+    with pytest.raises(FaultError):
+        eng.schedule_batch(None, None)
+    # health probes OBSERVE the outage instead of raising
+    assert eng.healthy() is False and eng.health_info() is None
+    assert inj.injected[("engine", "health-observed")] == 2
+
+
+# ---- satellite 1: health-probe classification + breaker feed ---------------
+
+
+def test_health_probe_classifies_and_feeds_breaker():
+    grpc = pytest.importorskip("grpc")
+    from kubernetes_scheduler_tpu.bridge.client import RemoteEngine
+
+    client = RemoteEngine("127.0.0.1:1", deadline_seconds=1.0)
+    try:
+        class _Rpc(grpc.RpcError):
+            def __init__(self, code):
+                self._code = code
+
+            def code(self):
+                return self._code
+
+            def details(self):
+                return ""
+
+        calls = {"n": 0}
+
+        def dead_health(request, timeout=None, **kw):
+            calls["n"] += 1
+            raise _Rpc(
+                grpc.StatusCode.DEADLINE_EXCEEDED
+                if calls["n"] == 1
+                else grpc.StatusCode.UNAVAILABLE
+            )
+
+        client._health = dead_health
+        client.breaker.failure_threshold = 2
+        assert client.healthy() is False       # deadline-exceeded
+        assert client.health_info() is None    # transport-down -> opens
+        assert client.breaker.state() == "open"
+        # open breaker: answered without touching the wire
+        assert client.healthy() is False and calls["n"] == 2
+        series = dict(client.ctr_health_failures._series)
+        assert series == {
+            ("deadline",): 1, ("transport",): 1, ("breaker-open",): 1,
+        }
+    finally:
+        client.close()
+
+
+def test_call_with_retry_blocked_by_open_breaker():
+    pytest.importorskip("grpc")
+    from kubernetes_scheduler_tpu.bridge.client import (
+        EngineUnavailable,
+        RemoteEngine,
+    )
+
+    client = RemoteEngine("127.0.0.1:1", deadline_seconds=1.0)
+    try:
+        client.breaker.record_failure()
+        client.breaker.record_failure()
+        client.breaker.record_failure()
+        assert client.breaker.state() == "open"
+        with pytest.raises(EngineUnavailable, match="circuit open"):
+            client._call_with_retry(lambda *a, **kw: None, None)
+    finally:
+        client.close()
+
+
+# ---- scheduler integration: stale grace, backoff hold, breaker -------------
+
+
+class _FlakyAdvisor:
+    def __init__(self, utils):
+        self.utils = utils
+        self.fail = False
+        self.calls = 0
+
+    def fetch(self):
+        self.calls += 1
+        if self.fail:
+            raise RuntimeError("prometheus down")
+        return self.utils
+
+
+def _mini_cluster():
+    nodes = [make_node("n0"), make_node("n1")]
+    utils = {
+        nd.name: NodeUtil(cpu_pct=10.0, disk_io=2.0) for nd in nodes
+    }
+    return nodes, utils
+
+
+def _mini_sched(advisor, nodes, clk, **cfg_kw):
+    cfg = SchedulerConfig(
+        batch_window=8, min_device_work=0, adaptive_dispatch=False,
+        normalizer="none", **cfg_kw,
+    )
+    return Scheduler(
+        cfg, advisor=advisor,
+        list_nodes=lambda: nodes, list_running_pods=lambda: [],
+        queue_clock=clk,
+    )
+
+
+def test_stale_ttl_grace_serves_lastgood_then_requeues():
+    nodes, utils = _mini_cluster()
+    adv = _FlakyAdvisor(utils)
+    clk = SimClock()
+    s = _mini_sched(adv, nodes, clk, advisor_stale_ttl_s=5.0)
+    s.submit(make_pod("a", cpu=100, annotations={"diskIO": "2"}))
+    m0 = s.run_cycle()
+    assert m0.pods_bound == 1 and not m0.advisor_stale
+    # outage inside the TTL: the cycle is SERVED (marked stale), the
+    # window never stalls
+    adv.fail = True
+    clk.advance(2.0)
+    s.submit(make_pod("b", cpu=100, annotations={"diskIO": "2"}))
+    m1 = s.run_cycle()
+    assert m1.pods_bound == 1 and m1.advisor_stale and not m1.fetch_failed
+    assert s.totals["advisor_stale_cycles"] == 1
+    # past the TTL: the outage path engages (requeue + backoff)
+    clk.advance(10.0)
+    s.submit(make_pod("c", cpu=100, annotations={"diskIO": "2"}))
+    m2 = s.run_cycle()
+    assert m2.fetch_failed and m2.pods_bound == 0
+    # recovery: fetch heals, the requeued pod binds
+    adv.fail = False
+    clk.advance(20.0)
+    m3 = s.run_cycle()
+    assert m3.pods_bound == 1 and not m3.fetch_failed and not m3.advisor_stale
+    assert s.advisor_breaker.state() == "closed"
+
+
+def test_stale_grace_sees_own_binds_never_overcommits():
+    """Grace-mode cycles read the LIVE cluster lists: pods the
+    scheduler binds during the outage must consume capacity in later
+    grace cycles (a frozen running snapshot would double-book)."""
+    # one small node: capacity for exactly two 1000m pods
+    nodes = [make_node("tiny", cpu=2000.0)]
+    utils = {"tiny": NodeUtil(cpu_pct=10.0, disk_io=2.0)}
+    adv = _FlakyAdvisor(utils)
+    clk = SimClock()
+    running: list = []
+    cfg = SchedulerConfig(
+        batch_window=1, max_windows_per_cycle=1, min_device_work=0,
+        adaptive_dispatch=False, normalizer="none",
+        advisor_stale_ttl_s=60.0,
+    )
+    s = Scheduler(
+        cfg, advisor=adv,
+        list_nodes=lambda: nodes, list_running_pods=lambda: list(running),
+        queue_clock=clk,
+    )
+
+    def cycle(name):
+        s.submit(make_pod(name, cpu=1000, annotations={"diskIO": "2"}))
+        m = s.run_cycle()
+        for b in s.binder.bindings[len(running):]:
+            running.append(b.pod)
+        clk.advance(1.0)
+        return m
+
+    assert cycle("warm").pods_bound == 1
+    adv.fail = True  # outage: every cycle below runs on stale utils
+    m1, m2 = cycle("g1"), cycle("g2")
+    assert m1.advisor_stale and m2.advisor_stale
+    # g1 bound (second slot); g2 must SEE g1 in the live running list
+    # and be rejected — with a frozen snapshot both would bind
+    assert m1.pods_bound == 1
+    assert m2.pods_bound == 0 and m2.pods_unschedulable == 1
+
+
+def test_advisor_outage_attempts_follow_backoff_not_every_cycle():
+    nodes, utils = _mini_cluster()
+    adv = _FlakyAdvisor(utils)
+    clk = SimClock()
+    s = _mini_sched(adv, nodes, clk)
+    adv.fail = True
+    # 12 cycles over 1.2 virtual seconds: the old loop would fetch
+    # every cycle; the backoff hold paces attempts (first failure arms
+    # a >= 0.375s hold, the next a longer one)
+    for i in range(12):
+        s.submit(make_pod(f"p{i}", cpu=100, annotations={"diskIO": "2"}))
+        m = s.run_cycle()
+        assert m.fetch_failed
+        clk.advance(0.1)
+    assert adv.calls <= 4
+    assert s.totals["fetch_failures"] == 12  # every cycle still surfaced
+
+
+class _FlakyEngine:
+    """LocalEngine wrapper with a host-controlled failure flag and a
+    dispatch counter (how often the device path was actually tried)."""
+
+    def __init__(self):
+        self.inner = LocalEngine()
+        self.fail = False
+        self.dispatches = 0
+
+    def schedule_batch(self, snapshot, pods, **kw):
+        self.dispatches += 1
+        if self.fail:
+            raise RuntimeError("device wedged")
+        return self.inner.schedule_batch(snapshot, pods, **kw)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+def test_engine_breaker_opens_skips_then_probes_back():
+    nodes, utils = _mini_cluster()
+    eng = _FlakyEngine()
+    clk = SimClock()
+    s = Scheduler(
+        SchedulerConfig(
+            batch_window=8, min_device_work=0, adaptive_dispatch=False,
+            normalizer="none", policy="least_allocated",
+            breaker_failure_threshold=2, breaker_recovery_window_s=4.0,
+        ),
+        advisor=StaticAdvisor(utils), engine=eng,
+        list_nodes=lambda: nodes, list_running_pods=lambda: [],
+        queue_clock=clk,
+    )
+
+    def cycle(i):
+        s.submit(make_pod(f"p{i}", cpu=100, annotations={"diskIO": "2"}))
+        m = s.run_cycle()
+        clk.advance(1.0)
+        return m
+
+    assert not cycle(0).used_fallback
+    eng.fail = True
+    m1, m2 = cycle(1), cycle(2)
+    assert m1.used_fallback and m2.used_fallback
+    assert s.engine_breaker.state() == "open"
+    assert s.ladder.rung("engine") == "local"
+    before = eng.dispatches
+    m3 = cycle(3)  # breaker open: scalar outright, engine NOT called
+    assert m3.used_fallback and eng.dispatches == before
+    # policy="least_allocated" has a scalar mirror: no policy mismatch,
+    # the policy rung never moves — only the engine rung is degraded
+    assert not m3.policy_mismatch and s.ladder.depth("policy") == 0
+    assert m3.degraded == ("engine",)
+    # the engine heals; the half-open probe (one per window) retests
+    eng.fail = False
+    clk.advance(4.0)
+    m4 = cycle(4)
+    assert not m4.used_fallback
+    assert s.engine_breaker.state() == "closed"
+    assert s.ladder.fully_recovered()
+    assert s.totals["degraded_cycles"] >= 3
+    # the transition counter saw the full open -> half-open -> closed arc
+    series = dict(s.ctr_breaker._series)
+    assert series[("engine", "open")] >= 1
+    assert series[("engine", "half-open")] >= 1
+    assert series[("engine", "closed")] >= 1
+
+
+# ---- chaos scenarios: determinism ------------------------------------------
+
+
+def test_chaos_scenario_deterministic_same_seed():
+    a = run_scenario(SCENARIOS["compound-storm"](n_nodes=16), seed=3)
+    b = run_scenario(SCENARIOS["compound-storm"](n_nodes=16), seed=3)
+    for key in (
+        "cycles", "pods_bound", "fallback_cycles", "fetch_failures",
+        "advisor_stale_cycles", "degraded_cycles", "faults_injected",
+        "mirror_verify_failures", "delta_uploads", "full_uploads",
+        "breaker_transitions",
+    ):
+        assert a[key] == b[key], key
+    assert a["recovered"] and b["recovered"]
+    c = run_scenario(SCENARIOS["compound-storm"](n_nodes=16), seed=4)
+    assert c["pods_bound"] != a["pods_bound"] or c["cycles"] != a["cycles"]
+
+
+def test_disk_full_journal_drops_records_but_replays(tmp_path):
+    from kubernetes_scheduler_tpu.trace.replay import replay_journal
+
+    journal = str(tmp_path / "disk-full")
+    s = run_scenario(
+        SCENARIOS["disk-full-journal"](n_nodes=16), seed=0,
+        trace_path=journal,
+    )
+    assert s["trace_records_dropped"] > 0  # the fault actually bit
+    assert s["recovered"]
+    report = replay_journal(journal)
+    assert report.replayed > 0 and report.binding_diffs == 0
+
+
+# ---- satellite 3: compound downgrade in ONE cycle window -------------------
+
+
+class _FailingHandle:
+    def result(self):
+        raise RuntimeError("sidecar replaced mid-stream")
+
+
+class _DowngradingEngine:
+    """Capability-downgrade emulation: armed, the next dispatch fails
+    like a replaced sidecar (the PR-3 class) and the engine comes back
+    CAPABILITY-DOWNGRADED — supports_resident() False for the next
+    `blind_calls` probes (the re-probe window) before re-learning. The
+    async surface fails at FORCE time (the pipelined completion stage,
+    where the in-flight window's speculative successor must flush)."""
+
+    def __init__(self):
+        self.inner = LocalEngine()
+        self.arm_failure = False
+        self.blind_calls = 0
+        # non-resident async never engages: the resident surface below
+        # is the one under test (the scheduler feature-probes getattr)
+        self.schedule_batch_async = None
+
+    def supports_resident(self):
+        if self.blind_calls > 0:
+            self.blind_calls -= 1
+            return False
+        return self.inner.supports_resident()
+
+    def _downgrade(self):
+        self.arm_failure = False
+        self.blind_calls = 2
+        self.inner.invalidate_resident()
+
+    def schedule_batch(self, snapshot, pods, **kw):
+        return self._dispatch(
+            self.inner.schedule_batch, snapshot, pods, **kw
+        )
+
+    def schedule_resident(self, snapshot, pods, **kw):
+        return self._dispatch(
+            self.inner.schedule_resident, snapshot, pods, **kw
+        )
+
+    def schedule_resident_async(self, snapshot, pods, **kw):
+        from kubernetes_scheduler_tpu.engine import PendingSchedule
+
+        if self.arm_failure:
+            self._downgrade()
+            return _FailingHandle()
+        return PendingSchedule(
+            self.inner.schedule_resident(snapshot, pods, **kw)
+        )
+
+    def _dispatch(self, fn, *a, **kw):
+        if self.arm_failure:
+            self._downgrade()
+            raise RuntimeError("sidecar replaced mid-stream")
+        return fn(*a, **kw)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+def _compound_downgrade_run(depth: int):
+    # enough nodes that a changed-rows delta beats the full snapshot
+    # under the bytes rule (the padded row floor dominates tiny meshes)
+    nodes = [make_node(f"n{i}") for i in range(48)]
+    utils = {
+        nd.name: NodeUtil(cpu_pct=10.0, disk_io=2.0) for nd in nodes
+    }
+    eng = _DowngradingEngine()
+    clk = SimClock()
+    running: list = []
+    s = Scheduler(
+        SchedulerConfig(
+            # one-pod windows (cap = batch_window x max_windows = 1): a
+            # second queued pod is a PREFETCHED successor window, so the
+            # failing cycle has real speculative state to flush
+            batch_window=1, max_windows_per_cycle=1,
+            min_device_work=0, adaptive_dispatch=False,
+            normalizer="none", resident_state=True, snapshot_mirror=True,
+            mirror_verify_interval=1, pipeline_depth=depth,
+            breaker_failure_threshold=2, breaker_recovery_window_s=2.0,
+        ),
+        advisor=StaticAdvisor(utils), engine=eng,
+        list_nodes=lambda: nodes, list_running_pods=lambda: running,
+        queue_clock=clk,
+    )
+
+    def cycle(i, *, extra=False):
+        s.submit(make_pod(f"p{i}", cpu=50, annotations={"diskIO": "1"}))
+        if extra:
+            s.submit(
+                make_pod(f"x{i}", cpu=50, annotations={"diskIO": "1"})
+            )
+        m = s.run_cycle()
+        for b in s.binder.bindings[len(running):]:
+            running.append(b.pod)
+        clk.advance(1.0)
+        return m
+
+    warm = [cycle(i) for i in range(3)]
+    assert s.totals["delta_uploads"] >= 1  # resident path engaged
+    verify_before = int(s.mirror.ctr_verify_failures._series.get((), 0))
+    # THE compound window: capability downgrade + engine failure AND a
+    # mirror corruption land in the SAME cycle (the extra pod is the
+    # successor window the pipelined driver prefetches in-flight)
+    eng.arm_failure = True
+    assert s.mirror.inject_corruption(leaf="net_up", row=1)
+    m = cycle(3, extra=True)
+    assert m.used_fallback  # engine failure -> scalar for this window
+    # mirror resync in the same window: the corrupt state was detected
+    # bitwise and rebuilt BEFORE it could serve a decision
+    assert int(s.mirror.ctr_verify_failures._series.get((), 0)) == (
+        verify_before + 1
+    )
+    if depth:
+        assert m.pipeline_flushes >= 1  # speculative state discarded
+    # all three subsystems sat degraded in the same window
+    assert {"engine", "mirror", "resident"} <= set(m.degraded)
+    # recovery: capability re-learned, delta path resumes, rungs climb
+    deltas_before = s.totals["delta_uploads"]
+    out = [cycle(i) for i in range(4, 10)]
+    # drain the straggler windows (one-pod window cap): the extra
+    # successor pod from the compound cycle is still queued behind them
+    for _ in range(8):
+        if len(s.queue) == 0 and s._prefetched is None:
+            break
+        out.append(s.run_cycle())
+        for b in s.binder.bindings[len(running):]:
+            running.append(b.pod)
+        clk.advance(1.0)
+    assert all(not mm.used_fallback for mm in out[1:])
+    assert s.totals["delta_uploads"] > deltas_before
+    assert s.ladder.fully_recovered(), s.ladder.snapshot()
+    assert s.engine_breaker.state() == "closed"
+    assert s.mirror.verify() is True
+    return [
+        (b.pod.name, b.node_name) for b in s.binder.bindings
+    ], warm + [m] + out
+
+
+def test_compound_downgrade_same_cycle_serial():
+    binds, metrics = _compound_downgrade_run(depth=0)
+    assert len(binds) == 11  # 10 per-cycle pods + the extra successor
+
+
+def test_compound_downgrade_same_cycle_pipelined():
+    binds_p, _ = _compound_downgrade_run(depth=1)
+    binds_s, _ = _compound_downgrade_run(depth=0)
+    # serial/pipelined parity holds THROUGH the compound failure window
+    assert binds_p == binds_s and len(binds_p) == 11
+
+
+def test_compound_downgrade_live_sidecar(tmp_path):
+    """The live-bridge variant (slow): a REAL capability downgrade —
+    the sidecar stops advertising field_cache/resident_state mid-stream
+    — composed with a mirror corruption resync and the pipelined
+    driver's flush, then full recovery once the sidecar upgrades
+    back."""
+    pytest.importorskip("grpc")
+    from kubernetes_scheduler_tpu.bridge.client import RemoteEngine
+    from kubernetes_scheduler_tpu.bridge.server import make_server
+    from kubernetes_scheduler_tpu.sim.host_gen import (
+        gen_host_cluster,
+        gen_host_pods,
+    )
+
+    server, port, service = make_server("127.0.0.1:0")
+    server.start()
+    client = RemoteEngine(f"127.0.0.1:{port}", deadline_seconds=60.0)
+    nodes, advisor = gen_host_cluster(48, seed=0)
+    running: list = []
+    s = Scheduler(
+        SchedulerConfig(
+            batch_window=32, max_windows_per_cycle=1,
+            min_device_work=0, adaptive_dispatch=False,
+            normalizer="none", resident_state=True, snapshot_mirror=True,
+            mirror_verify_interval=1, pipeline_depth=1,
+        ),
+        advisor=advisor, engine=client,
+        list_nodes=lambda: nodes, list_running_pods=lambda: running,
+    )
+
+    def drain(n_pods, seed):
+        for pod in gen_host_pods(n_pods, seed=seed):
+            s.submit(pod)
+        out = []
+        seen = len(s.binder.bindings)
+        for _ in range(32):
+            if len(s.queue) == 0 and s._prefetched is None:
+                break
+            out.append(s.run_cycle())
+            for b in s.binder.bindings[seen:]:
+                running.append(b.pod)
+            seen = len(s.binder.bindings)
+        return out
+
+    try:
+        m1 = drain(64, seed=1)
+        assert s.totals["delta_uploads"] >= 1
+        assert client._resident_cap is True
+        # the compound window: capability downgrade + mirror corruption
+        service.field_cache_enabled = False
+        service.resident_enabled = False
+        assert s.mirror.inject_corruption(leaf="net_up", row=2)
+        m2 = drain(64, seed=2)
+        # the client re-learned the downgrade (no livelock on rejected
+        # deltas), the mirror resynced, and every pod still bound
+        assert client._resident_cap is False
+        assert int(s.mirror.ctr_verify_failures._series.get((), 0)) >= 1
+        assert sum(m.pods_bound for m in m1 + m2) == 128
+        # the sidecar upgrades back: capabilities re-learned upward
+        service.field_cache_enabled = True
+        service.resident_enabled = True
+        client._invalidate_session()
+        m3 = drain(32, seed=3)
+        assert sum(m.pods_bound for m in m3) == 32
+        assert client._resident_cap is True
+        assert s.mirror.verify() is True
+    finally:
+        client.close()
+        server.stop(grace=None)

@@ -184,6 +184,8 @@ _EXPECTED_CATCH = {
     "fail-keeps-resident-commit": "failure-invalidates-resident",
     "dispatch-scores-stale-batch": "stale-spec-batch-never-scored",
     "unfenced-replica-bind": "no-double-bind",
+    "ladder-skips-rung": "never-skips-a-rung",
+    "promote-without-probe": "recovery-re-probes",
 }
 
 
@@ -297,7 +299,7 @@ def test_model_cli_json_artifact_and_exit_codes(tmp_path, capsys):
     doc = json.loads(art.read_text())
     assert {m["name"] for m in doc["models"]} == {
         "client-session", "gang-queue-front", "gang-queue-native",
-        "pipeline-slot", "replica-bind",
+        "pipeline-slot", "replica-bind", "degradation-ladder",
     }
     assert all(m["exhausted"] and not m["violations"]
                for m in doc["models"])

@@ -19,6 +19,9 @@ def test_registry_names_match_and_describe():
     assert set(SCENARIOS) == {
         "diurnal", "burst", "node-flap", "zone-failure",
         "anti-affinity-pack", "gang-mix",
+        # chaos programs (sim/faults.py): deterministic fault injection
+        "advisor-outage", "sidecar-crash-restart", "rpc-flap",
+        "disk-full-journal", "mirror-corruption", "compound-storm",
     }
     for name, cls in SCENARIOS.items():
         assert cls.name == name
@@ -26,6 +29,12 @@ def test_registry_names_match_and_describe():
         assert cls.ticks > 0
     # the scenario-smoke gate needs at least two cheap programs
     assert sum(1 for c in SCENARIOS.values() if c.smoke) >= 2
+    # every chaos program declares a non-empty fault plan
+    for cls in SCENARIOS.values():
+        if cls.chaos:
+            assert cls(n_nodes=8).fault_plan().windows
+        else:
+            assert cls(n_nodes=8).fault_plan() is None
 
 
 def test_unknown_scenario_rejected():
@@ -104,13 +113,20 @@ def test_gang_mix_exercises_the_gang_machinery():
 @pytest.mark.parametrize("name", sorted(SCENARIOS))
 def test_scenario_replay_pins_e2e(tmp_path, name):
     """The acceptance gate: every shipped scenario's journal replays
-    with zero binding diffs."""
+    with zero binding diffs — chaos programs included (fault injection
+    is deterministic on the virtual clock, and a chaos run must ALSO
+    end fully recovered: top rungs, breakers closed)."""
     journal = str(tmp_path / name)
     summary = run_scenario(
         SCENARIOS[name](n_nodes=16), seed=0, trace_path=journal
     )
     assert summary["pods_bound"] > 0
-    assert summary["fallback_cycles"] == 0
+    if SCENARIOS[name].chaos:
+        assert summary["recovered"], summary
+        # degradation is bounded: faulted cycles never dominate
+        assert summary["degraded_cycles"] <= summary["cycles"] // 2
+    else:
+        assert summary["fallback_cycles"] == 0
     report = replay_journal(journal)
     assert report.replayed > 0
     assert report.binding_diffs == 0, report.to_dict()

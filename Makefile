@@ -14,8 +14,10 @@ local: native lint
 	$(PY) -m pytest tests/ -x -q -m "not slow"
 
 # repo-native static analysis (kubernetes_scheduler_tpu/analysis):
-# sixteen AST rule families over the interprocedural dataflow core
-# (spmd-collective rides the replication-lattice interpreter in
+# eighteen AST rule families over the interprocedural dataflow core
+# (thread-race/determinism-taint ride the declared thread model in
+# analysis/threads.py with its seeded thread-mutant harness;
+# spmd-collective rides the replication-lattice interpreter in
 # analysis/spmd.py), plus the engine-contract layer (jax.eval_shape
 # traces of every engine entry point on CPU — the mesh-sharded
 # surfaces traced THROUGH shard_map on the virtual 8-device topology,

@@ -1152,9 +1152,11 @@ def loop_rate(
         out["mirror_events_per_cycle"] = round(
             sum(ev.values()) / max(len(cycles), 1), 2
         )
-        out["mirror_full_rebuilds"] = int(
-            sched.mirror.ctr_rebuilds._series.get((), 0)
-        )
+        out["mirror_full_rebuilds"] = int(sched.mirror.ctr_rebuilds.total())
+        out["mirror_rebuild_reasons"] = {
+            key[0]: int(n)
+            for key, n in sorted(sched.mirror.ctr_rebuilds.breakdown().items())
+        }
         out["mirror_verify_failures"] = int(
             sched.mirror.ctr_verify_failures._series.get((), 0)
         )

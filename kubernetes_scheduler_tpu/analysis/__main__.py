@@ -196,6 +196,25 @@ def main(argv=None) -> int:
 
         violations.extend(check_spmd_mutants())
 
+    # layer 2b: the thread/determinism mutant harness — pure AST, no
+    # tracing, so it rides every full-repo run; changed-only runs re-arm
+    # it when the closure touches the threaded layers or the analyzer
+    run_thread_mutants = full_repo
+    if args.changed_only:
+        from kubernetes_scheduler_tpu.analysis.thread_mutants import (
+            SURFACE as THREAD_SURFACE,
+        )
+
+        run_thread_mutants = run_thread_mutants or _surface_hit(
+            THREAD_SURFACE
+        )
+    if run_thread_mutants:
+        from kubernetes_scheduler_tpu.analysis.thread_mutants import (
+            check_thread_mutants,
+        )
+
+        violations.extend(check_thread_mutants())
+
     # layer 3: protocol models (analysis/model/) — bounded model
     # checking of the session/epoch/capability protocol, transition
     # anchor drift, and the mutation harness, reported as pseudo-rule

@@ -404,6 +404,18 @@ def reverse_dependency_closure(ctx: Context, changed: set[str]) -> set[str]:
             if p not in closure:
                 closure.add(p)
                 frontier.append(p)
+    # the declared thread model couples its root modules: a cross-file
+    # race pairs a write in one root's file with a read reachable from
+    # another root's, so a change to any thread-root module (or to the
+    # model itself) pulls EVERY root module into scope — the thread-race
+    # family must see both sides of each pair. Closure only grows, so
+    # changed-only stays a subset of the full run.
+    from kubernetes_scheduler_tpu.analysis.threads import THREAD_ROOTS
+
+    root_paths = {r.path for r in THREAD_ROOTS} & known
+    model_path = "kubernetes_scheduler_tpu/analysis/threads.py"
+    if closure & (root_paths | {model_path}):
+        closure |= root_paths
     return closure
 
 

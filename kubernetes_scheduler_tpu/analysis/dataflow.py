@@ -738,6 +738,24 @@ def _walk_locked(node, locks, held, muts, calls):
             _walk_locked(child, locks, child_held, muts, calls)
 
 
+def keyed_dict_attr(sub) -> str | None:
+    """'key' for a `self.__dict__["key"]` Subscript: the memoized-
+    property store IS an assignment to `self.key`, and conflating every
+    memo under one `__dict__` attr would couple unrelated caches to
+    whichever lock guards one of them."""
+    if (
+        isinstance(sub, ast.Subscript)
+        and isinstance(sub.value, ast.Attribute)
+        and sub.value.attr == "__dict__"
+        and isinstance(sub.value.value, ast.Name)
+        and sub.value.value.id == "self"
+        and isinstance(sub.slice, ast.Constant)
+        and isinstance(sub.slice.value, str)
+    ):
+        return sub.slice.value
+    return None
+
+
 def _self_attr_mutation(node) -> tuple[str, int] | None:
     """(attr, lineno) when `node` mutates a self attribute (assignment,
     augmented assignment, subscript store, or a mutating method call)."""
@@ -748,6 +766,9 @@ def _self_attr_mutation(node) -> tuple[str, int] | None:
         for t in targets:
             base = t
             if isinstance(base, ast.Subscript):
+                key = keyed_dict_attr(base)
+                if key is not None:
+                    return key, node.lineno
                 base = base.value
             if (
                 isinstance(base, ast.Attribute)
@@ -759,6 +780,9 @@ def _self_attr_mutation(node) -> tuple[str, int] | None:
         if node.func.attr in _MUTATORS:
             owner = node.func.value
             if isinstance(owner, ast.Subscript):
+                key = keyed_dict_attr(owner)
+                if key is not None:
+                    return key, node.lineno
                 owner = owner.value
             if (
                 isinstance(owner, ast.Attribute)

@@ -1,6 +1,6 @@
 """Rule registry: name -> check(ctx) -> list[Violation].
 
-Sixteen families. The first ten are the per-file era; donation-
+Eighteen families. The first ten are the per-file era; donation-
 aliasing, host-transfer, tracer-leak, and lockset-race ride the
 interprocedural dataflow core (analysis/dataflow.py) — call-graph,
 def-use, and lockset analyses a single-file AST scan cannot express —
@@ -11,12 +11,18 @@ analysis/model/ protocol checker, and spmd-collective runs the
 replication-lattice abstract interpreter (analysis/spmd.py) over the
 mesh-sharded engine's shard_map bodies — double-counting psums,
 unbound axis names, redundant gathers, out_specs replication drift.
+thread-race and determinism-taint ride the declared thread model
+(analysis/threads.py): cross-thread access pairs with no common
+lockset and no happens-before edge, check-then-act atomicity, and
+wall-clock/set-order/id-order taint flowing into replay-pinned journal
+and engine operands.
 The README's Static analysis table must name exactly this registry
 (checked both ways by the `docs-drift` runner check).
 """
 
 from kubernetes_scheduler_tpu.analysis.rules import (
     capability_completeness,
+    determinism_taint,
     donation_aliasing,
     dtype_shape,
     host_sync,
@@ -29,6 +35,7 @@ from kubernetes_scheduler_tpu.analysis.rules import (
     sim_determinism,
     span_hygiene,
     spmd_collective,
+    thread_race,
     timeout_hygiene,
     tracer_leak,
     wire_schema,
@@ -51,4 +58,6 @@ RULES = {
     lockset_race.RULE: lockset_race.check,
     capability_completeness.RULE: capability_completeness.check,
     spmd_collective.RULE: spmd_collective.check,
+    thread_race.RULE: thread_race.check,
+    determinism_taint.RULE: determinism_taint.check,
 }

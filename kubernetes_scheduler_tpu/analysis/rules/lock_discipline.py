@@ -91,6 +91,9 @@ def _self_attr_of_mutation(node: ast.AST) -> tuple[str, int] | None:
         for t in targets:
             base = t
             if isinstance(base, ast.Subscript):
+                key = dataflow.keyed_dict_attr(base)
+                if key is not None:
+                    return key, node.lineno
                 base = base.value
             if (
                 isinstance(base, ast.Attribute)
@@ -102,6 +105,9 @@ def _self_attr_of_mutation(node: ast.AST) -> tuple[str, int] | None:
         if node.func.attr in _MUTATORS:
             owner = node.func.value
             if isinstance(owner, ast.Subscript):
+                key = dataflow.keyed_dict_attr(owner)
+                if key is not None:
+                    return key, node.lineno
                 owner = owner.value
             if (
                 isinstance(owner, ast.Attribute)

@@ -297,13 +297,13 @@ class EngineService:
         """Capture the next `cycles` device steps under jax.profiler;
         each dump is named after the trace id it covers (step-<id>) so
         a profile pairs with its spans and flight-recorder record."""
-        if out_dir is None:
-            out_dir = self._profile_dir
-        if out_dir is None:
-            import tempfile
-
-            out_dir = tempfile.mkdtemp(prefix="yoda-sidecar-profile-")
         with self._lock:
+            if out_dir is None:
+                out_dir = self._profile_dir
+            if out_dir is None:
+                import tempfile
+
+                out_dir = tempfile.mkdtemp(prefix="yoda-sidecar-profile-")
             self._profile_dir = out_dir
             self._profile_left = int(cycles)
         return {"armed": int(cycles), "out_dir": out_dir}
@@ -731,11 +731,13 @@ class EngineService:
             fieldname: bool(getattr(self, attr))
             for fieldname, attr in CAPABILITY_SWITCHES.items()
         }
+        with self._lock:
+            served = self.cycles_served
         return pb.HealthReply(
             status="SERVING",
             device_count=len(devs),
             platform=devs[0].platform if devs else "none",
-            cycles_served=self.cycles_served,
+            cycles_served=served,
             **caps,
         )
 

@@ -179,8 +179,10 @@ class SnapshotMirror:
         )
         self.ctr_rebuilds = Counter(
             "mirror_full_rebuilds_total",
-            "Mirror flush-to-full rebuilds (node churn, selector/port "
-            "layout drift, verification resync)",
+            "Mirror flush-to-full rebuilds, labeled by the flush cause "
+            "(seed, node-churn, selector-drift, layout-drift, "
+            "port-churn, verify-mismatch)",
+            labels=("reason",),
         )
         self.ctr_verify_failures = Counter(
             "mirror_verify_failures_total",
@@ -209,7 +211,7 @@ class SnapshotMirror:
         """(nodes, running, utils) by REFERENCE — the running list stays
         the same (append-only between removals) object so the builder's
         prefix-identity caches hold across flush rebuilds."""
-        return self.nodes, self.running, self.utils
+        return self.nodes, self.running, self.utils  # graftlint: disable=thread-race -- intended bulk-sync read: the cycle adopts these references at a flush boundary while event writes serialize under self._lock; tearing only stales one cycle and the flush path rebuilds from scratch
 
     def _rebuild_by_node(self) -> None:
         with self._lock:
@@ -602,7 +604,7 @@ class SnapshotMirror:
                 self._mark_flush("port-churn")
 
     def _rebuild(self, window: list, pending_all_plain: bool) -> SnapshotArrays:
-        self.ctr_rebuilds.inc()
+        self.ctr_rebuilds.inc(reason=self._flush_reason or "seed")
         # survives the adopt's reason reset: the degradation ladder
         # records WHY the mirror dropped to its rebuild rung
         self.last_rebuild_reason = self._flush_reason

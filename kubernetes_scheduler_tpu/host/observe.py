@@ -275,8 +275,10 @@ SHIPPED_METRICS = (
     "engine_step_duration_seconds",
     "snapshot_uploads_total",
     # streaming state ingestion (host/mirror.SnapshotMirror): events
-    # applied by kind, flush-to-full rebuilds, and verification
-    # mismatches (the mirror<->rebuild bitwise cross-check)
+    # applied by kind, flush-to-full rebuilds labeled by flush cause
+    # (`reason`: seed / node-churn / selector-drift / layout-drift /
+    # port-churn / verify-mismatch), and verification mismatches (the
+    # mirror<->rebuild bitwise cross-check)
     "events_applied_total",
     "mirror_full_rebuilds_total",
     "mirror_verify_failures_total",
@@ -421,6 +423,20 @@ class Counter:
         key = tuple(str(labels[name]) for name in self.labels)
         with self._lock:
             return self._series.get(key, 0)
+
+    def total(self) -> float:
+        """Sum across every label tuple — what the label-free ancestor
+        of a counter reported before it grew labels (the bench rows sum
+        `mirror_full_rebuilds_total` over its `reason` breakdown)."""
+        with self._lock:
+            return sum(self._series.values())
+
+    def breakdown(self) -> dict:
+        """label-values tuple -> count snapshot (single-label counters:
+        {("seed",): 1, ...}); for bench rows and tests that assert the
+        per-reason split without reaching into `_series`."""
+        with self._lock:
+            return dict(self._series)
 
     def render(self, prefix: str = PREFIX) -> list[str]:
         name = f"{prefix}_{self.name}"
@@ -612,7 +628,9 @@ class SpanRecorder:
             self._writer.append(events)
         except Exception:
             log.exception("spans: cycle flush failed; dropping span set")
-            self.spans_dropped += 1
+            # the sidecar's recorder is shared by concurrent RPC workers
+            with self._id_lock:
+                self.spans_dropped += 1
 
     def close(self) -> None:
         self._writer.close()

@@ -11,6 +11,7 @@ constraint matching runs as integer tensor compares on device.
 from __future__ import annotations
 
 import logging
+import threading
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -516,6 +517,10 @@ class SnapshotBuilder:
     # membership changes cycle to cycle.
     _port_slots: int = 0
     _port_index: dict = field(default_factory=dict)  # port -> column offset
+    # guards the interned-layout memo, the ONE builder cache the feeder
+    # thread also touches (Scheduler.submit precomputes pod rows on the
+    # informer/submission path while a cycle may be probing the intern)
+    _names_lock: object = field(default_factory=threading.Lock)
     # CSI attach-limit capacity columns (upstream NodeVolumeLimits):
     # attachable-volumes-* keys seen in any node's status.allocatable,
     # grow-only so column layout (and compiles) stay stable
@@ -540,11 +545,15 @@ class SnapshotBuilder:
         """Interned tuple form — ONE object per distinct column layout,
         so pod_request_vector's per-pod cache hits on identity instead
         of tuple comparison (the accumulation loop probes it for every
-        running pod every cycle)."""
+        running pod every cycle). The intern is the one builder memo the
+        feeder thread also touches (Scheduler.submit precomputes pod
+        rows on the informer path), so it publishes under its own lock —
+        once per cycle and per submit, never per pod."""
         names = tuple(self.resource_names)
-        if names != self.__dict__.get("_names_interned"):
-            self.__dict__["_names_interned"] = names
-        return self.__dict__["_names_interned"]
+        with self._names_lock:
+            if names != self.__dict__.get("_names_interned"):
+                self.__dict__["_names_interned"] = names
+            return self.__dict__["_names_interned"]
 
     def _node_alloc_vec(
         self, nd: Node, names: tuple[str, ...], n_port0: int

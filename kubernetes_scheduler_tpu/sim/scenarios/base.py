@@ -365,7 +365,11 @@ def run_scenario(
     if sched.recorder is not None:
         out["trace_records_dropped"] = sched.recorder.records_dropped
     if sched.mirror is not None:
-        out["mirror_full_rebuilds"] = int(sched.mirror.ctr_rebuilds.value())
+        out["mirror_full_rebuilds"] = int(sched.mirror.ctr_rebuilds.total())
+        out["mirror_rebuild_reasons"] = {
+            key[0]: int(n)
+            for key, n in sorted(sched.mirror.ctr_rebuilds.breakdown().items())
+        }
         out["mirror_verify_failures"] = int(
             sched.mirror.ctr_verify_failures.value()
         )

@@ -1,0 +1,33 @@
+"""determinism-taint clean fixture: sorted() materialization before
+escape, order-insensitive folds, the injected clock, and declared
+timing fields (`wall_time`, `*_seconds`) as the sanctioned wall-clock
+surface."""
+
+import time
+
+JOURNAL = []
+
+
+def record_cycle(rec):
+    JOURNAL.append(rec)
+
+
+def emit(raw, clock):
+    tags = set(raw)
+    order = sorted(tags)
+    rec = {
+        "order": order,
+        "count": len(tags),
+        "wall_time": time.time(),
+        "elapsed_seconds": clock(),
+    }
+    record_cycle(rec)
+
+
+def schedule(engine, pending):
+    names = {p.name for p in pending}
+    engine.schedule_batch(sorted(names))
+
+
+def metrics(n):
+    return CycleMetrics(pods_in=n, engine_seconds=time.perf_counter())

@@ -1,0 +1,32 @@
+"""determinism-taint violating fixture: wall-clock reads, set iteration
+order, and id()-keyed ordering flowing into journal records, a
+CycleMetrics construction, and an engine operand."""
+
+import time
+
+JOURNAL = []
+
+
+def record_cycle(rec):
+    JOURNAL.append(rec)
+
+
+def emit(raw, nodes):
+    tags = set(raw)
+    order = list(tags)
+    rec = {
+        "started": time.time(),
+        "order": order,
+        "first_key": id(nodes[0]),
+    }
+    record_cycle(rec)
+
+
+def schedule(engine, pending):
+    names = {p.name for p in pending}
+    batch = [n for n in names]
+    engine.schedule_batch(batch)
+
+
+def metrics(n):
+    return CycleMetrics(pods_in=n, stamp=time.perf_counter())

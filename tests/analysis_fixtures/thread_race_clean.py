@@ -1,0 +1,75 @@
+"""thread-race clean fixture: the same worker/main shape with every
+pair discharged — a common lock, publish-before-start, an
+Event.set()/wait() pairing, a lock-covered latch, and a join before
+the shutdown read."""
+
+import threading
+
+_LOCK = threading.Lock()
+COUNTER = 0
+
+
+def bump():
+    global COUNTER
+    with _LOCK:
+        COUNTER = COUNTER + 1
+
+
+def reset():
+    global COUNTER
+    with _LOCK:
+        COUNTER = 0
+
+
+class Pump:
+    def __init__(self):
+        self.rows = []
+        self.total = 0
+        self.cache = None
+        self.limit = 0
+        self._lock = threading.Lock()
+        self._ready = threading.Event()
+        self._t = None
+
+    def start(self):
+        # published BEFORE start(): visible to the spawned worker
+        self.limit = 4
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+
+    def _run(self):
+        # write-then-set: the Event publishes `total` to the waiter
+        self.total = self.limit
+        self._ready.set()
+        for i in range(4):
+            self.ensure()
+            with self._lock:
+                self.rows.append(i)
+            bump()
+
+    def ensure(self):
+        with self._lock:
+            if self.cache is None:
+                self.cache = {}
+            return self.cache
+
+    def read(self):
+        self._ready.wait()
+        total = self.total
+        with self._lock:
+            n = len(self.rows)
+        return n, total
+
+    def close(self):
+        if self._t is not None:
+            self._t.join(timeout=1.0)
+        return self.rows
+
+
+def drive():
+    reset()
+    p = Pump()
+    p.start()
+    p.ensure()
+    n, total = p.read()
+    return n, total, p.close(), COUNTER

@@ -1,0 +1,57 @@
+"""thread-race violating fixture: a worker thread and the main thread
+share attributes with no common lockset and no happens-before edge —
+plus the classic lock-free check-then-act latch and an unguarded
+module global."""
+
+import threading
+
+COUNTER = 0
+
+
+def bump():
+    global COUNTER
+    COUNTER = COUNTER + 1
+
+
+def reset():
+    global COUNTER
+    COUNTER = 0
+
+
+class Pump:
+    def __init__(self):
+        self.rows = []
+        self.total = 0
+        self.cache = None
+        self._t = None
+
+    def start(self):
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+        # written AFTER start(): the worker can already be reading
+        self.total = 1
+
+    def _run(self):
+        for i in range(4):
+            self.ensure()
+            self.rows.append(i)
+            self.total += 1
+            bump()
+
+    def ensure(self):
+        # lock-free check-then-act: two threads both observe None
+        if self.cache is None:
+            self.cache = {}
+        return self.cache
+
+    def read(self):
+        return len(self.rows), self.total
+
+
+def drive():
+    reset()
+    p = Pump()
+    p.start()
+    p.ensure()
+    rows, total = p.read()
+    return rows, total, COUNTER

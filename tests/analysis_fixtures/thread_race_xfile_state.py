@@ -1,0 +1,19 @@
+"""Cross-file thread-race fixture, file A: the shared state class.
+Nothing in this file is threaded — the race only appears when file B's
+worker writes through `put` while file B's main thread reads through
+`dump`."""
+
+
+class Registry:
+    def __init__(self):
+        self.items = {}
+        self.sealed = False
+
+    def put(self, key, val):
+        self.items[key] = val
+
+    def freeze(self):
+        self.sealed = True
+
+    def dump(self):
+        return dict(self.items), self.sealed

@@ -1,0 +1,32 @@
+"""Cross-file thread-race fixture, file B: spawns a worker that writes
+file A's Registry while the main thread reads it — no lock, no
+happens-before edge. The finding must land on file A (where the
+accesses live) even though the threading is declared here."""
+
+import threading
+
+from thread_race_xfile_state import Registry
+
+
+class Loader:
+    def __init__(self):
+        self.reg = Registry()
+        self._t = None
+
+    def start(self):
+        self._t = threading.Thread(target=self._fill, daemon=True)
+        self._t.start()
+
+    def _fill(self):
+        for i in range(3):
+            self.reg.put("k%d" % i, i)
+        self.reg.freeze()
+
+    def read(self):
+        return self.reg.dump()
+
+
+def drive():
+    ld = Loader()
+    ld.start()
+    return ld.read()

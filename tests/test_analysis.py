@@ -122,6 +122,18 @@ def active(violations):
             "spmd_collective_clean.py",
             5,
         ),
+        (
+            "thread-race",
+            "thread_race_violation.py",
+            "thread_race_clean.py",
+            5,
+        ),
+        (
+            "determinism-taint",
+            "determinism_taint_violation.py",
+            "determinism_taint_clean.py",
+            4,
+        ),
     ],
 )
 def test_rule_fires_and_stays_quiet(rule, violating, clean, min_hits):
@@ -507,6 +519,187 @@ def test_spmd_analyzer_catches_dropped_auction_discharge(tmp_path):
     ), [v.format() for v in vs]
 
 
+# ---- thread model, races, determinism taint (families 17-18) --------------
+
+
+def test_thread_race_messages_teach_each_discharge():
+    """Every race shape fires with a message naming the attribute, both
+    sites, AND the discharge menu (lock / publish-before-start / Event
+    pairing / queue hand-off / join) — the finding teaches the fix."""
+    vs = active(lint_fixture("thread_race_violation.py", "thread-race"))
+    msgs = [v.message for v in vs]
+    assert any("`Pump.rows`" in m and "happens-before" in m for m in msgs)
+    assert any("`Pump.total`" in m and "written in `start`" in m for m in msgs)
+    assert any("check-then-act on `Pump.cache`" in m for m in msgs)
+    assert any("module global `COUNTER`" in m for m in msgs)
+    race_msgs = [m for m in msgs if "happens-before" in m]
+    assert all(
+        "Event.set()/wait()" in m and "Queue" in m and "join" in m
+        for m in race_msgs
+    )
+
+
+def test_thread_race_cross_file_pair():
+    """Write in thread A (file B's worker), read in thread B (file B's
+    main), state defined in file A: the interprocedural model must carry
+    thread identities across the import and anchor the finding where the
+    accesses live."""
+    paths = [
+        os.path.join(FIXTURES, "thread_race_xfile_state.py"),
+        os.path.join(FIXTURES, "thread_race_xfile_threads.py"),
+    ]
+    vs = active(run_lint(paths, rules=["thread-race"]))
+    assert vs, "cross-file race not detected"
+    assert all(v.path.endswith("thread_race_xfile_state.py") for v in vs)
+    assert any(
+        "`Registry.items`" in v.message
+        and "Loader._fill" in v.message
+        and "main" in v.message
+        for v in vs
+    ), [v.format() for v in vs]
+
+
+def test_thread_roots_verified_on_repo():
+    """The declared thread model resolves against the live tree: every
+    root's file, def, anchor fragments, and `reaches` edges hold."""
+    from kubernetes_scheduler_tpu.analysis import threads
+
+    assert threads.verify_thread_roots(_repo_index()) == []
+
+
+def test_thread_model_drift_fails_lint():
+    """Anchor drift is a FINDING, not a silent stale model: a root whose
+    def vanished, whose fragment no longer appears, and whose declared
+    dispatch edge is gone each fire."""
+    from kubernetes_scheduler_tpu.analysis import threads
+
+    index = _repo_index()
+    gone_def = threads.ThreadRoot(
+        name="drifted-def",
+        thread="w",
+        path="kubernetes_scheduler_tpu/host/scheduler.py",
+        func="Scheduler.no_such_method",
+        description="",
+    )
+    gone_frag = threads.ThreadRoot(
+        name="drifted-fragment",
+        thread="w",
+        path="kubernetes_scheduler_tpu/kube/source.py",
+        func="InformerCache._resource_loop",
+        must_contain=("self.frobnicate_quux(",),
+        description="",
+    )
+    gone_reach = threads.ThreadRoot(
+        name="drifted-reach",
+        thread="w",
+        path="kubernetes_scheduler_tpu/kube/source.py",
+        func="InformerCache._resource_loop",
+        reaches=("Scheduler.no_such_sink",),
+        description="",
+    )
+    for root in (gone_def, gone_frag, gone_reach):
+        vs = threads.verify_thread_roots(index, roots=(root,))
+        assert vs and all(v.rule == "thread-race" for v in vs), root.name
+        assert any(root.name in v.message for v in vs), root.name
+
+
+def test_thread_mutants_each_caught():
+    """The analyzer's teeth, one seeded mutant at a time: the unmutated
+    base is clean under both families, and each mutant is caught by the
+    family that owns its bug class, with the rendered evidence naming
+    the access pair (or tainted field) the mutation un-ordered."""
+    from kubernetes_scheduler_tpu.analysis import thread_mutants
+
+    assert thread_mutants.check_thread_mutants() == []
+    evidence_frag = {
+        "drop-mirror-lock": "`MiniMirror._dirty`",
+        "event-set-before-write": "`MiniMirror.published`",
+        "unsorted-dirty-iter": "set-order",
+        "wallclock-journal-field": "journal-record field `seq`",
+        "latch-check-then-act": "`MiniMirror.cache`",
+        "unjoined-shutdown-read": "read in `close`",
+    }
+    for name, (_, _, family) in thread_mutants.THREAD_MUTANTS.items():
+        got = thread_mutants.run_thread_mutant(name)
+        hits = got[family]
+        assert hits, f"mutant {name} survived {family}"
+        assert any(
+            evidence_frag[name] in v.message for v in hits
+        ), (name, [v.message for v in hits])
+
+
+def test_changed_only_thread_surfaces_wired():
+    """Families 17-18 ride the changed-only machinery: the thread-mutant
+    SURFACE patterns cover the analyzer files and every threaded layer,
+    and a closure touching any declared thread root pulls in ALL root
+    files (the model is whole-program — partial roots would under-report,
+    breaking changed-only ⊆ full-run)."""
+    import fnmatch
+
+    from kubernetes_scheduler_tpu.analysis.thread_mutants import SURFACE
+    from kubernetes_scheduler_tpu.analysis.threads import THREAD_ROOTS
+    from kubernetes_scheduler_tpu.analysis.core import (
+        reverse_dependency_closure,
+    )
+
+    for p in (
+        "kubernetes_scheduler_tpu/analysis/threads.py",
+        "kubernetes_scheduler_tpu/analysis/rules/thread_race.py",
+        "kubernetes_scheduler_tpu/analysis/rules/determinism_taint.py",
+        "kubernetes_scheduler_tpu/host/mirror.py",
+        "kubernetes_scheduler_tpu/kube/source.py",
+        "kubernetes_scheduler_tpu/bridge/server.py",
+        "kubernetes_scheduler_tpu/trace/spans.py",
+    ):
+        assert any(fnmatch.fnmatch(p, pat) for pat in SURFACE), p
+    ctx = _full_ctx()
+    closure = reverse_dependency_closure(
+        ctx, {"kubernetes_scheduler_tpu/host/mirror.py"}
+    )
+    for root in THREAD_ROOTS:
+        assert root.path in closure, root.path
+
+
+def test_determinism_taint_messages_name_the_fix():
+    vs = active(
+        lint_fixture("determinism_taint_violation.py", "determinism-taint")
+    )
+    msgs = [v.message for v in vs]
+    assert any("wall-clock" in m and "inject the clock" in m for m in msgs)
+    assert any("set-order" in m and "sorted" in m for m in msgs)
+    assert any("id-order" in m and "stable identity" in m for m in msgs)
+    assert any("engine operand" in m for m in msgs)
+    assert any("CycleMetrics" in m for m in msgs)
+
+
+def test_thread_race_regression_pins():
+    """The genuine findings this family surfaced stay fixed: the
+    sidecar's health/arm_profile reads take the service lock, the span
+    recorder's drop counter increments under its id lock, and the
+    snapshot builder's interned-names memo (the one cache the feeder
+    thread also touches) publishes under its own lock."""
+    import threading as _threading
+
+    src = open("kubernetes_scheduler_tpu/bridge/server.py").read()
+    assert "served = self.cycles_served" in src
+    src = open("kubernetes_scheduler_tpu/host/observe.py").read()
+    assert "with self._id_lock:\n                self.spans_dropped += 1" in src
+    from kubernetes_scheduler_tpu.host.snapshot import SnapshotBuilder
+
+    b = SnapshotBuilder()
+    assert isinstance(b._names_lock, type(_threading.Lock()))
+    # and the families stay quiet on the fixed files (no waiver creep)
+    vs = active(run_lint(
+        [
+            "kubernetes_scheduler_tpu/bridge/server.py",
+            "kubernetes_scheduler_tpu/host/observe.py",
+            "kubernetes_scheduler_tpu/host/snapshot.py",
+        ],
+        rules=["thread-race"],
+    ))
+    assert vs == [], [v.format() for v in vs]
+
+
 # ---- waiver mechanics -----------------------------------------------------
 
 
@@ -545,13 +738,14 @@ def test_unknown_rule_rejected():
         run_lint(rules=["no-such-rule"])
 
 
-def test_registry_has_all_sixteen_families():
+def test_registry_has_all_eighteen_families():
     assert set(RULES) == {
         "jit-purity", "host-sync", "lock-discipline", "wire-schema",
         "dtype-shape", "timeout-hygiene", "pallas-vmem", "metric-hygiene",
         "sim-determinism", "span-hygiene", "donation-aliasing",
         "host-transfer", "tracer-leak", "lockset-race",
         "capability-completeness", "spmd-collective",
+        "thread-race", "determinism-taint",
     }
 
 

@@ -589,38 +589,41 @@ def test_lint_artifact_and_sarif_e2e(tmp_path):
     validate_sarif(doc)
     rule_ids = {r["id"] for r in doc["runs"][0]["tool"]["driver"]["rules"]}
     assert {"donation-aliasing", "host-transfer", "tracer-leak",
-            "lockset-race"} <= rule_ids
+            "lockset-race", "thread-race", "determinism-taint"} <= rule_ids
 
 
 def test_lint_walltime_budget_e2e():
-    """The parse-once index gate: running ALL fifteen AST families over
-    the full repo must cost less than 2x the ten-family PR-8 baseline
-    measured in the SAME process (the four interprocedural families ride
-    the shared index instead of re-parsing/re-walking). Measured on
-    warm imports so the ratio is the analyses', not the interpreter's;
-    the absolute ceiling lives in the Makefile's LINT_BUDGET."""
+    """The parse-once index gate: running ALL eighteen AST families over
+    the full repo must cost less than 2x the sixteen-family PR-14
+    baseline measured in the SAME process (the thread-model and
+    determinism-taint families ride the shared index and its call graph
+    instead of re-parsing/re-walking). Measured on warm imports so the
+    ratio is the analyses', not the interpreter's; the absolute ceiling
+    lives in the Makefile's LINT_BUDGET."""
     import time
 
     from kubernetes_scheduler_tpu.analysis import run_lint
 
-    pr8_families = [
+    pr14_families = [
         "jit-purity", "host-sync", "lock-discipline", "wire-schema",
         "dtype-shape", "timeout-hygiene", "pallas-vmem", "metric-hygiene",
-        "sim-determinism", "span-hygiene",
+        "sim-determinism", "span-hygiene", "donation-aliasing",
+        "host-transfer", "tracer-leak", "lockset-race",
+        "capability-completeness", "spmd-collective",
     ]
-    run_lint(rules=pr8_families)  # warm imports/caches out of the timing
+    run_lint(rules=pr14_families)  # warm imports/caches out of the timing
     t0 = time.monotonic()
-    run_lint(rules=pr8_families)
+    run_lint(rules=pr14_families)
     t_base = time.monotonic() - t0
     t0 = time.monotonic()
-    vs = run_lint()  # all fifteen + docs-drift
+    vs = run_lint()  # all eighteen + docs-drift
     t_all = time.monotonic() - t0
     assert [v for v in vs if not v.waived] == []
     # generous noise floor for a loaded 1-CPU box: the gate is the
     # RATIO, and an index regression (each family re-walking every
     # tree) blows straight through 2x
     assert t_all < 2.0 * t_base + 0.75, (
-        f"15-family lint {t_all:.2f}s vs 10-family baseline "
+        f"18-family lint {t_all:.2f}s vs 16-family baseline "
         f"{t_base:.2f}s — the parse-once index contract is broken"
     )
 

@@ -175,7 +175,9 @@ def test_mirror_binding_parity_node_flap(overrides):
     assert ba and ba == bb
     assert not b.mirror.ctr_verify_failures._series
     # the flap forced flush-to-full rebuilds beyond the seed build
-    assert b.mirror.ctr_rebuilds._series[()] >= 3
+    assert b.mirror.ctr_rebuilds.total() >= 3
+    # the {reason} breakdown attributes them: node add/remove churn
+    assert b.mirror.ctr_rebuilds.value(reason="node-churn") >= 1
 
 
 def test_mirror_binding_parity_selector_drift():
@@ -243,12 +245,14 @@ def test_mirror_flush_reasons():
         sched.submit(pod)
     _drain(sched, nodes, running)
     mir = sched.mirror
-    base_rebuilds = mir.ctr_rebuilds._series[()]
+    base_rebuilds = mir.ctr_rebuilds.total()
+    base_node_churn = mir.ctr_rebuilds.value(reason="node-churn")
     # node event -> flush
     mir.apply_node_event("MODIFIED", nodes[0])
     _, delta, rebuilt = mir.emit([], pending_all_plain=True, prev=None)
     assert rebuilt and delta is None
-    assert mir.ctr_rebuilds._series[()] == base_rebuilds + 1
+    assert mir.ctr_rebuilds.total() == base_rebuilds + 1
+    assert mir.ctr_rebuilds.value(reason="node-churn") == base_node_churn + 1
     # selector-minting window -> flush
     from kubernetes_scheduler_tpu.host.types import Pod, PodAffinityTerm
 
@@ -264,7 +268,13 @@ def test_mirror_flush_reasons():
     )
     _, delta, rebuilt = mir.emit([pod], pending_all_plain=False, prev=None)
     assert rebuilt
-    assert mir.ctr_rebuilds._series[()] == base_rebuilds + 2
+    assert mir.ctr_rebuilds.total() == base_rebuilds + 2
+    assert mir.ctr_rebuilds.value(reason="selector-drift") >= 1
+    # the labeled series renders per-reason (both exporters share
+    # Counter.render); the seed build is attributed too
+    rendered = "\n".join(mir.ctr_rebuilds.render())
+    assert 'mirror_full_rebuilds_total{reason="seed"}' in rendered
+    assert 'mirror_full_rebuilds_total{reason="selector-drift"}' in rendered
 
 
 def test_mirror_bound_pod_event_dedups_by_identity():

@@ -5,7 +5,7 @@ PY ?= python
 IMAGE_REPO ?= registry.example.com/yoda-tpu
 TAG ?= latest
 
-.PHONY: local test test-fast bench trace-smoke obs-smoke scenario-smoke chaos-smoke perf-gate perf-baseline lint lint-fast lint-sarif collective-baseline model-check native native-asan native-tsan proto clean build push
+.PHONY: local test test-fast bench trace-smoke obs-smoke scenario-smoke chaos-smoke replica-smoke perf-gate perf-baseline lint lint-fast lint-sarif collective-baseline model-check native native-asan native-tsan proto clean build push
 
 # "make local" in the reference = fmt + vet + compile. Here: byte-compile
 # the package, build the native library, lint, run the fast tests.
@@ -189,6 +189,35 @@ chaos-smoke:
 	  --trace $(CHAOS_SMOKE_DIR)/compound-storm
 	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_scheduler_tpu trace replay \
 	  $(CHAOS_SMOKE_DIR)/compound-storm
+
+# replica smoke: the 2-replica conflict-storm scenario (partitioned
+# queue + first-bind-wins bind table, host/replica.py) at compressed
+# scale. The summary gate asserts the replica-bind protocol's whole
+# point: conflicts actually HAPPENED (bind_conflicts > 0), every loser
+# resolved (pods_bound == pods_submitted — requeued then retired,
+# never lost), and ZERO double binds. Then BOTH per-replica journals
+# are replay-pinned independently (`trace replay` exits non-zero on
+# ANY binding diff) — the fenced CAS sits downstream of the replayed
+# engine boundary, so conflict cycles replay bitwise too.
+# tests/test_bench_smoke.py wraps the same flow as a slow-marked test.
+REPLICA_SMOKE_DIR ?= /tmp/yoda-replica-smoke
+replica-smoke:
+	rm -rf $(REPLICA_SMOKE_DIR)
+	mkdir -p $(REPLICA_SMOKE_DIR)
+	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_scheduler_tpu scenario run \
+	  replica-conflict-storm --nodes 24 \
+	  --trace $(REPLICA_SMOKE_DIR)/storm > $(REPLICA_SMOKE_DIR)/summary.out
+	tail -n 1 $(REPLICA_SMOKE_DIR)/summary.out | $(PY) -c "import json,sys; \
+	  s = json.loads(sys.stdin.read()); \
+	  assert s['double_binds'] == 0, s; \
+	  assert s['bind_conflicts'] > 0, s; \
+	  assert s['pods_bound'] == s['pods_submitted'], s; \
+	  print('replica-smoke: conflicts resolved =', s['bind_conflicts'], \
+	        'double_binds = 0')"
+	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_scheduler_tpu trace replay \
+	  $(REPLICA_SMOKE_DIR)/storm/r0
+	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_scheduler_tpu trace replay \
+	  $(REPLICA_SMOKE_DIR)/storm/r1
 
 # end-to-end telemetry round trip on CPU: a sidecar with its own
 # /metrics + span files, a short sim-driven host run with spans + the

@@ -376,8 +376,12 @@ def _streaming_loop_rate() -> dict:
         base = loop_rate(
             metric_suffix="_streaming_off_probe", span_path=t_off, **kw
         )
+        # the sub-50ms cycle gate rides the streaming drain with its
+        # own alarm armed: the SLO watchdog counts breaches live while
+        # the row reports the p50 the gate reads
         out = loop_rate(
-            metric_suffix="_streaming", mirror=True, span_path=t_on, **kw
+            metric_suffix="_streaming", mirror=True, span_path=t_on,
+            slo_ms=50.0, **kw
         )
         rep_on = build_report(t_on)
         rep_off = build_report(t_off)
@@ -499,6 +503,130 @@ def _idle_streaming_rate() -> dict:
         "trigger_latency_p99_ms": round(
             1e3 * float(np.percentile(lats, 99)), 4
         ),
+    }
+
+
+def _drift_streaming_rate() -> dict:
+    """The layout-drift streaming metric (host_loop_*_streaming_drift):
+    a mirror-on resident drain where EVERY backlog drifts the layout —
+    one never-seen anti-affinity selector per round, plus a hostPort
+    remap (the oldest port pod retires, a fresh port arrives, live
+    count pinned at two). The pre-extension mirror flushed to a full
+    rebuild on every such round; with the in-place extension paths
+    (mirror_incremental_extensions_total{kind}) the recurring classes
+    are absorbed and the only surviving rebuilds are power-of-two
+    bucket/slot crossings — O(log drifts), ~0 per round post-warmup.
+    The row ends with an on-demand bitwise verify() cross-check, so
+    the absorbed rounds are proven equal to what a rebuild would have
+    served."""
+    from kubernetes_scheduler_tpu.host.scheduler import Scheduler
+    from kubernetes_scheduler_tpu.host.types import Pod, PodAffinityTerm
+    from kubernetes_scheduler_tpu.sim.host_gen import (
+        gen_host_cluster,
+        gen_host_pods,
+    )
+    from kubernetes_scheduler_tpu.utils.config import SchedulerConfig
+
+    n_nodes = int(os.environ.get("BENCH_LOOP_NODES", 4000))
+    rounds = int(os.environ.get("BENCH_DRIFT_ROUNDS", 12))
+    backlog = max(32, min(256, n_nodes // 4))
+    nodes, advisor = gen_host_cluster(n_nodes, seed=0, constraints=True)
+    running: list = []
+    sched = Scheduler(
+        SchedulerConfig(
+            batch_window=256, normalizer="none", adaptive_dispatch=False,
+            min_device_work=1, snapshot_mirror=True, resident_state=True,
+            pipeline_depth=1, max_windows_per_cycle=1,
+        ),
+        advisor=advisor,
+        list_nodes=lambda: nodes,
+        list_running_pods=lambda: running,
+    )
+
+    def drain():
+        seen = len(sched.binder.bindings)
+        for _ in range(64):
+            if len(sched.queue) == 0 and sched._prefetched is None:
+                break
+            sched.run_cycle()
+            for b in sched.binder.bindings[seen:]:
+                running.append(b.pod)
+            seen = len(sched.binder.bindings)
+
+    # warmup: constraint traffic mints the steady-state selector
+    # population (the generator's svc-app anti keys — enough to fill a
+    # real power-of-two bucket), two port pods warm the two hostPort
+    # slots the churn rounds then live inside, the mirror seeds, and
+    # the compiles are paid
+    port_live: list[str] = []
+    for pod in gen_host_pods(max(backlog, 128), seed=1, constraints=True):
+        sched.submit(pod)
+    for name, pt in (("port-w0", 19998), ("port-w1", 19999)):
+        sched.submit(Pod(name=name, namespace="bench", host_ports=[pt]))
+        port_live.append(name)
+    drain()
+    mir = sched.mirror
+    base_rebuilds = int(mir.ctr_rebuilds.total())
+    bound0 = len(sched.binder.bindings)
+    t0 = time.perf_counter()
+    for k in range(rounds):
+        if len(port_live) >= 2:
+            # the oldest port pod terminates (informer DELETE): live
+            # ports stay within the two allocated slots, so the fresh
+            # port below is a same-width REMAP, never slot growth
+            victim_name = port_live.pop(0)
+            victim = next(
+                (
+                    p for p in running
+                    if p.namespace == "bench" and p.name == victim_name
+                ),
+                None,
+            )
+            if victim is not None:
+                running.remove(victim)
+                mir.apply_pod_event("DELETED", victim)
+        sched.submit(
+            Pod(
+                name=f"drift-{k}", namespace="bench",
+                pod_affinity=[
+                    PodAffinityTerm(
+                        match_labels={"drift": str(k)},
+                        topology_key="kubernetes.io/hostname",
+                        anti=True,
+                    )
+                ],
+            )
+        )
+        port_name = f"port-{k}"
+        sched.submit(
+            Pod(name=port_name, namespace="bench", host_ports=[20000 + k])
+        )
+        port_live.append(port_name)
+        for pod in gen_host_pods(backlog, seed=100 + k):
+            sched.submit(pod)
+        drain()
+    elapsed = time.perf_counter() - t0
+    bound = len(sched.binder.bindings) - bound0
+    ext = {key[0]: int(v) for key, v in mir.ctr_extensions._series.items()}
+    reasons = {
+        key[0]: int(n)
+        for key, n in sorted(mir.ctr_rebuilds.breakdown().items())
+    }
+    return {
+        "metric": f"host_loop_{n_nodes}nodes_streaming_drift",
+        "drift_rounds": rounds,
+        "pods_bound": bound,
+        "pods_per_sec": round(bound / max(elapsed, 1e-9), 1),
+        "mirror_incremental_extensions": ext,
+        "mirror_full_rebuilds": int(mir.ctr_rebuilds.total()),
+        "mirror_rebuild_reasons": reasons,
+        # the headline: rebuilds actually paid across the drifting
+        # rounds (bucket/slot crossings only — NOT one per round)
+        "drift_rebuilds": int(mir.ctr_rebuilds.total()) - base_rebuilds,
+        "mirror_verify_failures": int(
+            mir.ctr_verify_failures._series.get((), 0)
+        ),
+        "final_verify_ok": bool(mir.verify()),
     }
 
 
@@ -902,6 +1030,7 @@ def loop_rate(
     scrape_metrics: bool = False,
     fused_kernel: bool | None = None,
     mirror: bool = False,
+    slo_ms: float = 0.0,
 ) -> dict:
     """END-TO-END host loop at the north-star scale: queue pop -> snapshot
     build -> device program -> binds, through host.Scheduler on a simulated
@@ -961,12 +1090,18 @@ def loop_rate(
     )
     if sharded:
         extra["sharded_engine"] = True
-    if mirror:
-        # streaming state ingestion: the event-sourced snapshot mirror
-        # replaces the per-cycle rebuild; the churn advisor's
-        # fetch_changed feeds utilization events and the scheduler
-        # self-applies its binds as pod events
-        extra["snapshot_mirror"] = True
+    # streaming state ingestion: the event-sourced snapshot mirror
+    # replaces the per-cycle rebuild; the churn advisor's fetch_changed
+    # feeds utilization events and the scheduler self-applies its binds
+    # as pod events. Pinned EXPLICITLY both ways: the config default is
+    # mirror-on, but the non-mirror rows exist to measure the rebuild
+    # loop the mirror is compared against
+    extra["snapshot_mirror"] = mirror
+    if slo_ms:
+        # the live SLO watchdog rides the measured drain: breaches are
+        # counted (slo_breaches_total{path}) and reported beside the
+        # percentile they gate — the <50ms claim with its own alarm on
+        extra["cycle_slo_ms"] = slo_ms
     if fused_kernel is not None:
         # the fused/unfused A-B knob (host_loop_*_fused): everything
         # else identical, only the feature gate moves
@@ -1105,6 +1240,9 @@ def loop_rate(
         ),
         "pipeline_flushes": int(sum(c.pipeline_flushes for c in cycles)),
     }
+    if slo_ms:
+        out["cycle_slo_ms"] = slo_ms
+        out["slo_breaches"] = int(sched.slo_breaches)
     if sched.recorder is not None:
         # the recorder's own wall time vs the drain's cycle time — the
         # direct <5%-overhead evidence (recording runs AFTER each
@@ -1212,7 +1350,221 @@ def _sharded_loop_rate() -> list[dict]:
             / ref["shard_delta_bytes_per_cycle"],
             3,
         )
-    return [ref, out]
+    # the combined scale row: streaming ingestion AND the mesh-sharded
+    # resident engine on the same drain — the mirror's O(events) emits
+    # feed shard-routed deltas, so the 100k-node cycle pays neither the
+    # full host rebuild nor the full upload
+    stream = loop_rate(
+        n_nodes=n_nodes, metric_suffix="_streaming", mirror=True, **kw
+    )
+    return [ref, out, stream]
+
+
+def _replica_loop_rate() -> list[dict]:
+    """Replicated scheduler fleet over the partitioned queue
+    (host_loop_*nodes_replicas): 1 vs 2 vs 4 FULL Schedulers, each
+    draining its crc32(namespace) partition against the shared
+    first-bind-wins BindTable (host/replica.py — the checked
+    `replica-bind` protocol).
+
+    Scaling phase: each fleet drains the SAME namespaced backlog
+    sequentially (ReplicaFleet.run_sequential); the reported aggregate
+    is total_bound / max(per-replica busy seconds) — N single-host
+    processes run their partitions in true parallel, one GIL cannot, so
+    the max-busy quotient is the honest deployment-topology number. The
+    per-cycle dispatch shape is held CONSTANT across fleet sizes
+    (max_windows_per_cycle tuned so every replica pops full windows):
+    scaling then measures the partitioned drain's parallelism, not
+    dispatch-shape effects.
+
+    Conflict phase: the deterministic 2-replica storm — the pipelined
+    prefetch slot holds replica 0's overlap window popped-but-unbound
+    across the round replica 1 binds its copies, so replica 0's bind
+    loses the CAS (bind_lose: requeue + 409-drop) and its next pop
+    retires the requeued copy via drop_bound. Every loser resolves,
+    zero double binds, requeue latency in-data."""
+    from kubernetes_scheduler_tpu.host.queue import namespace_partition
+    from kubernetes_scheduler_tpu.host.replica import ReplicaFleet
+    from kubernetes_scheduler_tpu.host.types import Container, Pod
+    from kubernetes_scheduler_tpu.sim.host_gen import (
+        gen_host_cluster,
+        gen_host_pods,
+    )
+    from kubernetes_scheduler_tpu.utils.config import SchedulerConfig
+
+    n_nodes = int(os.environ.get("BENCH_LOOP_NODES", 4000))
+    n_pods = int(os.environ.get("BENCH_REPLICA_PODS", 0)) or int(
+        os.environ.get("BENCH_LOOP_PODS", 1024 * DEFAULT_LOOP_WINDOWS)
+    )
+    samples = int(os.environ.get("BENCH_LOOP_SAMPLES", "0")) or 3
+    fleet_sizes = (1, 2, 4)
+    # window sizing: the LARGEST fleet must still pop full dispatches,
+    # so cap the per-cycle dispatch at (backlog / max_replicas) windows
+    # — at the default 8192-pod backlog that is 2 windows/cycle: r=1
+    # runs 4 cycles, r=2 runs 2/replica, r=4 runs 1/replica, all the
+    # same dispatch shape
+    max_windows = max(1, min(DEFAULT_LOOP_WINDOWS,
+                             n_pods // (max(fleet_sizes) * 1024)))
+    # one namespace per crc32 % 4 residue: round-robin over these four
+    # is exactly balanced at every fleet size (residues alternate mod 2,
+    # so the mod-2 split inherits the balance)
+    by_res: dict = {}
+    i = 0
+    while len(by_res) < 4:
+        ns = f"tenant-{i}"
+        by_res.setdefault(namespace_partition(ns, 4), ns)
+        i += 1
+    tenants = [by_res[r] for r in range(4)]
+
+    nodes, advisor = gen_host_cluster(n_nodes, seed=0)
+    rows: list = []
+    base_rate = None
+    double_binds = 0
+    for n_replicas in fleet_sizes:
+        running: list = []
+        fleet = ReplicaFleet(
+            SchedulerConfig(
+                batch_window=1024,
+                normalizer="none",
+                max_windows_per_cycle=max_windows,
+                adaptive_dispatch=False,
+                min_device_work=1,
+            ),
+            n_replicas=n_replicas,
+            advisor_factory=lambda i: advisor,
+            list_nodes=lambda: nodes,
+            list_running_pods=lambda: running,
+        )
+        cursors = [0] * n_replicas
+
+        def absorb():
+            # feed binds back as running pods (per-scheduler cursors:
+            # fleet.bindings concatenates, so a flat cursor would skew)
+            for k, sched in enumerate(fleet.schedulers):
+                bs = sched.binder.bindings
+                running.extend(b.pod for b in bs[cursors[k]:])
+                cursors[k] = len(bs)
+
+        def backlog(seed_):
+            # per-seed unique names: the bind table keys on
+            # namespace/name, and a re-run of "pod-0" would be fenced
+            # off as already-bound
+            for j, pod in enumerate(gen_host_pods(n_pods, seed=seed_)):
+                pod.name = f"{pod.name}-s{seed_}"
+                pod.namespace = tenants[j % 4]
+                fleet.submit(pod)
+
+        backlog(1)
+        fleet.run_sequential()  # warmup: compiles; populates `running`
+        absorb()
+        bound0 = fleet.evidence()["total_binds"]
+        agg_s = 0.0
+        busy = [0.0] * n_replicas
+        for s in range(2, 2 + samples):
+            backlog(s)
+            ev = fleet.run_sequential()
+            absorb()
+            agg_s += ev["aggregate_drain_seconds"]
+            busy = [a + b for a, b in zip(busy, ev["replica_busy_seconds"])]
+        ev = fleet.evidence()
+        bound = ev["total_binds"] - bound0
+        rate = bound / max(agg_s, 1e-9)
+        if base_rate is None:
+            base_rate = rate
+        double_binds = max(double_binds, ev["double_binds"])
+        rows.append({
+            "metric": f"host_loop_{n_nodes}nodes_replicas{n_replicas}",
+            "replicas": n_replicas,
+            "pods_bound": bound,
+            "aggregate_pods_per_sec": round(rate, 1),
+            "scaling_x": round(rate / max(base_rate, 1e-9), 2),
+            "aggregate_drain_seconds": round(agg_s, 3),
+            "replica_busy_seconds": [round(b, 3) for b in busy],
+            "binds_per_replica": ev["binds_per_replica"],
+            "double_binds": ev["double_binds"],
+        })
+
+    # -- conflict storm (deterministic; evidence for the headline row) --
+    ns0 = next(
+        f"tenant-{i}" for i in range(64)
+        if namespace_partition(f"tenant-{i}", 2) == 0
+    )
+    storm_running: list = []
+    storm = ReplicaFleet(
+        SchedulerConfig(
+            batch_window=32,
+            normalizer="none",
+            max_windows_per_cycle=1,
+            pipeline_depth=1,
+            adaptive_dispatch=False,
+            min_device_work=1,
+        ),
+        n_replicas=2,
+        advisor_factory=lambda i: advisor,
+        list_nodes=lambda: nodes,
+        list_running_pods=lambda: storm_running,
+    )
+
+    def _storm_pod(name, prio):
+        return Pod(
+            name=name,
+            namespace=ns0,
+            labels={"scv/priority": str(prio)},
+            containers=[Container(
+                requests={"cpu": 100.0, "memory": float(2**28)}
+            )],
+        )
+
+    n_overlap = 8
+    for j in range(32):  # filler: replica 0 binds these first...
+        storm.submit(_storm_pod(f"filler-{j}", 10))
+    for j in range(n_overlap):  # ...while PREFETCHING the overlap window
+        storm.submit_overlap(_storm_pod(f"overlap-{j}", 5))
+    for _ in range(64):  # round-robin cycles (the scenario runner's drain)
+        progressed = False
+        active = False
+        for sched in storm.schedulers:
+            if len(sched.queue) == 0 and sched._prefetched is None:
+                continue
+            active = True
+            m = sched.run_cycle()
+            if m.pods_bound > 0 or m.pods_dropped > 0:
+                progressed = True
+        if not active or not progressed:
+            break
+    for sched in storm.schedulers:
+        sched.drain_pipeline()
+    sev = storm.evidence()
+
+    head = {
+        "metric": f"host_loop_{n_nodes}nodes_replicas",
+        # HEADLINE = aggregate-throughput scaling at 2 replicas with
+        # zero double binds (the acceptance gate reads scaling_x_2 and
+        # double_binds off this row)
+        "scaling_x_2": rows[1]["scaling_x"],
+        "scaling_x_4": rows[2]["scaling_x"],
+        "aggregate_pods_per_sec": {
+            str(r["replicas"]): r["aggregate_pods_per_sec"] for r in rows
+        },
+        "double_binds": max(double_binds, sev["double_binds"]),
+        # storm accounting: 32 filler + 8 overlap must bind exactly
+        # once each — every overlap loser resolved, never a lost pod
+        "storm_overlap_pods": n_overlap,
+        "bind_conflicts": sev["bind_conflicts_total"],
+        "conflict_rate": round(
+            sev["bind_conflicts_total"] / n_overlap, 2
+        ),
+        "pods_discarded": sev["pods_discarded"],
+        "pods_lost": 32 + n_overlap - sev["total_binds"],
+        "requeue_latency_count": sev["requeue_latency_count"],
+        "requeue_latency_mean_ms": round(
+            1e3 * sev["requeue_latency_mean_s"], 2
+        ),
+        "requeue_latency_max_ms": round(
+            1e3 * sev["requeue_latency_max_s"], 2
+        ),
+    }
+    return rows + [head]
 
 
 def _sharded_throughput() -> dict:
@@ -1424,12 +1776,18 @@ def main():
         print(json.dumps(_resident_loop_rate()))
         print(json.dumps(_streaming_loop_rate()), flush=True)
         print(json.dumps(_idle_streaming_rate()), flush=True)
+        print(json.dumps(_drift_streaming_rate()), flush=True)
         # the mesh-sharded resident loop at the 100k-node scale (plus
         # its tenth-scale flat-bytes reference) and the 100k x 50k
         # sharded engine headline
         for row in _sharded_loop_rate():
             print(json.dumps(row), flush=True)
         print(json.dumps(_sharded_throughput()), flush=True)
+        # the replicated fleet: 1 vs 2 vs 4 schedulers over the
+        # partitioned queue + first-bind-wins table, plus the
+        # deterministic conflict-storm evidence row
+        for row in _replica_loop_rate():
+            print(json.dumps(row), flush=True)
         print(json.dumps(_replay_loop_rate()))
         tel, attrib = _telemetry_loop_rate(pipe)
         print(json.dumps(tel))
@@ -1505,12 +1863,20 @@ def main():
         # evidence), and the idle-cluster zero-event row
         print(json.dumps(_streaming_loop_rate()), flush=True)
         print(json.dumps(_idle_streaming_rate()), flush=True)
+        print(json.dumps(_drift_streaming_rate()), flush=True)
         # the mesh-sharded resident loop at the 100k-node scale (with
         # the flat-bytes reference) and the sharded engine headline:
         # 100k nodes x 50k pods in one device-resident program
         for row in _sharded_loop_rate():
             print(json.dumps(row), flush=True)
         print(json.dumps(_sharded_throughput()), flush=True)
+        # the replicated scheduler fleet: 1 vs 2 vs 4 full Schedulers
+        # over the partitioned queue + first-bind-wins bind table —
+        # aggregate-throughput scaling with zero double binds, plus the
+        # deterministic conflict-storm row (conflict rate, requeue
+        # latency, loser accounting)
+        for row in _replica_loop_rate():
+            print(json.dumps(row), flush=True)
         # flight recorder on, then replay-from-trace: perf from a
         # captured workload + bitwise binding parity (binding_diffs=0)
         print(json.dumps(_replay_loop_rate()), flush=True)

@@ -31,14 +31,16 @@ Abstraction notes, per model:
   optimistic resident commit that a failure path must roll back.
   `last_fail` / `scored_stale` are ghost variables making the two
   failure-path obligations state-visible.
-- `replica-bind`: the PROPOSED cross-replica conflict protocol
-  (ROADMAP horizontal scale-out): two replicas whose queue partitions
-  transiently overlap on one pod, binds fenced by an epoch CAS
-  (first bind wins), the loser requeueing via restore_window and
-  dropping on re-pop when the informer shows the pod bound. Checked
-  BEFORE the scale-out PR exists; its anchors point at the primitives
-  the proposal composes (restore_window, the binder's 404/409
-  semantics, mark_scheduled).
+- `replica-bind`: the cross-replica conflict protocol, SHIPPED as
+  host/replica.py (the replicated fleet over the partitioned queue):
+  two replicas whose queue partitions transiently overlap on one pod,
+  binds fenced by the BindTable epoch CAS (first bind wins), the loser
+  requeueing via restore_window and dropping on re-pop when the table
+  shows the pod bound. Checked BEFORE the scale-out PR existed; its
+  anchors now bind to the shipped primitives (ReplicaCoordinator.
+  pop_window/bind_lose/drop_bound, BindTable.try_bind, and the
+  binder's 404/409 arm the conflict raise lands in) — anchor drift
+  fails lint, so the model is a proof about the code that runs.
 """
 
 from __future__ import annotations
@@ -59,6 +61,7 @@ _SCHED = "kubernetes_scheduler_tpu/host/scheduler.py"
 _QUEUE = "kubernetes_scheduler_tpu/host/queue.py"
 _SNAP = "kubernetes_scheduler_tpu/host/snapshot.py"
 _RESIL = "kubernetes_scheduler_tpu/host/resilience.py"
+_REPLICA = "kubernetes_scheduler_tpu/host/replica.py"
 _FAULTS = "kubernetes_scheduler_tpu/sim/faults.py"
 
 # ---- model 1: RemoteEngine client session / sidecar session state --------
@@ -671,7 +674,7 @@ def pipeline_slot_model() -> ProtocolModel:
     )
 
 
-# ---- model 4: proposed 2-replica cross-partition bind conflict -----------
+# ---- model 4: 2-replica cross-partition bind conflict (host/replica.py) --
 
 
 def _bind_win(r):
@@ -725,7 +728,12 @@ def replica_bind_model() -> ProtocolModel:
                                  "pod_epoch"}),
                 writes=frozenset({f"r{r}", f"avail_{r}", f"seen_{r}"}),
                 anchors=(
-                    Anchor(_QUEUE, "SchedulingQueue.pop_window"),
+                    # the replica's partition pop: filters table-bound
+                    # pods (drop_bound) and records the epoch each
+                    # surviving pod was seen at — the fence operand
+                    Anchor(_REPLICA, "ReplicaCoordinator.pop_window",
+                           must_contain=("epoch",),
+                           calls=("pop_window",)),
                 ),
             ),
             Transition(
@@ -737,9 +745,13 @@ def replica_bind_model() -> ProtocolModel:
                                  "pod_epoch"}),
                 writes=frozenset({"pod_bound", "pod_epoch", f"r{r}"}),
                 anchors=(
-                    # the fence the proposal reuses: resident epochs'
-                    # optimistic-concurrency compare, and the binder's
-                    # first-write-wins 409 semantics
+                    # THE CAS: unbound + current epoch, or rejected;
+                    # success installs the winner and advances the epoch
+                    Anchor(_REPLICA, "BindTable.try_bind",
+                           must_contain=("seen_epoch != rec[0]",
+                                         "rec[0] += 1")),
+                    # the loser's raise lands in the binder's existing
+                    # first-write-wins 409 arm (drop, never requeue)
                     Anchor(_SCHED, "Scheduler._bind",
                            must_contain=("404, 409",)),
                 ),
@@ -753,6 +765,10 @@ def replica_bind_model() -> ProtocolModel:
                                  "pod_epoch"}),
                 writes=frozenset({f"r{r}", f"avail_{r}"}),
                 anchors=(
+                    Anchor(_REPLICA, "ReplicaCoordinator.bind_lose",
+                           calls=("restore_window",)),
+                    # the requeue preserves per-partition front-restore
+                    # semantics — the same machinery gang deferral uses
                     Anchor(_QUEUE, "SchedulingQueue.restore_window",
                            must_contain=("_front_floor",)),
                 ),
@@ -768,18 +784,19 @@ def replica_bind_model() -> ProtocolModel:
                 reads=frozenset({f"avail_{r}", f"r{r}", "pod_bound"}),
                 writes=frozenset({f"avail_{r}"}),
                 anchors=(
-                    Anchor(_QUEUE, "SchedulingQueue.mark_scheduled"),
+                    Anchor(_REPLICA, "ReplicaCoordinator.drop_bound",
+                           calls=("mark_scheduled",)),
                 ),
             ),
         ])
     return ProtocolModel(
         name="replica-bind",
         description=(
-            "PROPOSED horizontal scale-out conflict protocol: two "
-            "scheduler replicas transiently share one pod (partition "
-            "handoff overlap); binds are fenced by an epoch CAS, first "
-            "bind wins, the loser requeues via restore_window and drops "
-            "on re-pop once the informer shows the pod bound"
+            "horizontal scale-out conflict protocol (host/replica.py): "
+            "two scheduler replicas transiently share one pod (partition "
+            "handoff overlap); binds are fenced by the BindTable epoch "
+            "CAS, first bind wins, the loser requeues via restore_window "
+            "and drops on re-pop once the table shows the pod bound"
         ),
         init={
             "pod_bound": "", "pod_epoch": 0,

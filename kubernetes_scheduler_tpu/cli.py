@@ -237,6 +237,13 @@ def cmd_scheduler(args) -> int:
 
     cfg = _load_config(args)
     if args.source == "kube":
+        if args.replicas > 1:
+            log.error(
+                "--replicas is the sim-source fleet runner; a kube "
+                "deployment scales by running one process per "
+                "membership slot (see README: Replicated schedulers)"
+            )
+            return 2
         return cmd_scheduler_kube(args, cfg)
     nodes, advisor = gen_host_cluster(
         args.nodes, seed=args.seed, gpu=args.gpu, constraints=args.constraints
@@ -244,6 +251,9 @@ def cmd_scheduler(args) -> int:
     pods = gen_host_pods(
         args.pods, seed=args.seed + 1, gpu=args.gpu, constraints=args.constraints
     )
+
+    if args.replicas > 1:
+        return _cmd_scheduler_replicated(args, cfg, nodes, advisor, pods)
 
     engine = None
     if args.engine and args.engine != "local":
@@ -326,6 +336,145 @@ def cmd_scheduler(args) -> int:
                 time.sleep(3600)
         except (KeyboardInterrupt, SystemExit):
             exporter.close()
+    return 0
+
+
+def _cmd_scheduler_replicated(args, cfg, nodes, advisor, pods) -> int:
+    """`yoda-tpu scheduler --replicas N`: the replicated fleet — N full
+    scheduler loops over one partitioned queue and one first-bind-wins
+    bind table (host/replica.py). With --lease, each replica loop first
+    JOINS the elected membership (host/leader.ReplicaMembership: N slot
+    leases at <lease>.slot<i>, slot index == partition index), so a
+    second fleet process started against the same lease path finds all
+    slots held and stands by — the single-lease active/passive story,
+    generalized to N active."""
+    from kubernetes_scheduler_tpu.host.queue import namespace_partition
+    from kubernetes_scheduler_tpu.host.replica import ReplicaFleet
+
+    n = args.replicas
+    engine_factory = None
+    if args.engine and args.engine != "local":
+        from kubernetes_scheduler_tpu.bridge.client import RemoteEngine
+
+        engine_factory = lambda i: RemoteEngine(args.engine)  # noqa: E731
+
+    memberships = []
+    if args.lease:
+        from kubernetes_scheduler_tpu.host.leader import ReplicaMembership
+
+        for i in range(n):
+            # per-loop identity suffix: one shared identity would make
+            # every loop's slot lease look like the same holder
+            m = ReplicaMembership.on_files(
+                args.lease, n,
+                identity=(
+                    f"{args.lease_identity}-r{i}"
+                    if args.lease_identity else None
+                ),
+            )
+            # blocks while every slot is held — the standby posture,
+            # exactly like the single-lease acquire_blocking()
+            slot = m.join()
+            log.info("replica loop %d holds membership slot %s", i, slot)
+            memberships.append(m)
+
+    running: list = []
+    fleet = ReplicaFleet(
+        cfg,
+        n_replicas=n,
+        advisor_factory=lambda i: advisor,
+        list_nodes=lambda: nodes,
+        list_running_pods=lambda: running,
+        engine_factory=engine_factory,
+    )
+
+    # the generated pods all live in "default"; spread them over one
+    # tenant namespace per partition (round-robin, exactly balanced for
+    # any N) so every replica owns real traffic
+    ns_for = {}
+    i = 0
+    while len(ns_for) < n:
+        ns = f"tenant-{i}"
+        ns_for.setdefault(namespace_partition(ns, n), ns)
+        i += 1
+    for j, pod in enumerate(pods):
+        pod.namespace = ns_for[j % n]
+        fleet.submit(pod)
+
+    exporters = []
+    if args.metrics_port:
+        from kubernetes_scheduler_tpu.host.observe import MetricsExporter
+
+        class _ReplicaMetricsView:
+            """Exporter facade for replica i: the scheduler's own
+            surfaces plus the SHARED fleet counters (every replica's
+            /metrics shows the whole fleet's conflict picture)."""
+
+            def __init__(self, idx):
+                self._sched = fleet.schedulers[idx]
+                self._idx = idx
+
+            def __getattr__(self, name):
+                return getattr(self._sched, name)
+
+            @property
+            def prom_collectors(self):
+                return fleet.prom_collectors(self._idx)
+
+        for i in range(n):
+            exporter = MetricsExporter(_ReplicaMetricsView(i))
+            exporter.serve(args.metrics_port + i, host=cfg.metrics_bind_host)
+            exporters.append(exporter)
+
+    t0 = time.perf_counter()
+    try:
+        evidence = fleet.run_until_empty(max_cycles=args.max_cycles)
+    finally:
+        for sched in fleet.schedulers:
+            if sched.recorder is not None:
+                sched.recorder.close()
+            if sched.spans is not None:
+                sched.spans.close()
+        for m in memberships:
+            m.leave()
+    dt = time.perf_counter() - t0
+    cycles = [
+        c for result in evidence.pop("replica_results") for c in result
+    ]
+    bound = sum(c.pods_bound for c in cycles)
+    lat = [c.cycle_seconds for c in cycles]
+    print(
+        json.dumps(
+            {
+                "replicas": n,
+                "cycles": len(cycles),
+                "pods_bound": bound,
+                "pods_unschedulable": sum(
+                    c.pods_unschedulable for c in cycles
+                ),
+                "seconds": round(dt, 3),
+                "pods_per_sec": round(bound / dt, 1) if dt > 0 else None,
+                "fallback_cycles": sum(c.used_fallback for c in cycles),
+                "cycle_p50_ms": round(
+                    1e3 * float(np.percentile(lat, 50)), 2
+                ) if cycles else None,
+                "cycle_p99_ms": round(
+                    1e3 * float(np.percentile(lat, 99)), 2
+                ) if cycles else None,
+                **evidence,
+            }
+        )
+    )
+    if args.serve_forever and exporters:
+        log.info("metrics on :%d..%d; ctrl-c to exit",
+                 args.metrics_port, args.metrics_port + n - 1)
+        try:
+            while True:
+                time.sleep(3600)
+        except (KeyboardInterrupt, SystemExit):
+            pass
+    for exporter in exporters:
+        exporter.close()
     return 0
 
 
@@ -604,6 +753,14 @@ def build_parser() -> argparse.ArgumentParser:
         type=float,
         default=30.0,
         help="seconds per bounded pending-pod watch stream",
+    )
+    ps.add_argument(
+        "--replicas",
+        type=int,
+        default=1,
+        help="run N scheduler replicas over a partitioned queue with "
+        "first-bind-wins fencing (sim source; with --lease each "
+        "replica joins a membership slot at <lease>.slot<i>)",
     )
     ps.add_argument("--lease", help="leader-election lease file path")
     ps.add_argument(

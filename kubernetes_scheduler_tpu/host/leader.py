@@ -21,6 +21,7 @@ and loses leadership when renewal fails or the lease was stolen.
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import json
 import logging
 import os
@@ -235,3 +236,108 @@ class LeaderElector:
         if self._leading.is_set():
             self.lease.clear(self.identity)
             self._leading.clear()
+
+
+# distinct default identities for memberships created within one
+# process (see ReplicaMembership.__init__)
+_MEMBERSHIP_SEQ = itertools.count()
+
+
+class ReplicaMembership:
+    """Elected MEMBERSHIP for the replicated fleet: N slots, each an
+    ordinary fenced lease, where the slot index IS the queue partition
+    index (host/queue.pod_partition with n_partitions == n_slots).
+
+    This generalizes the single active/passive pair to N active
+    replicas: instead of one lease everyone contends for, a joining
+    replica claims the first free slot (scanning 0..N-1, one-shot
+    acquire per slot, then backing off). Holding slot i means "I own
+    partition i" — renewal, renew-deadline fencing, and loss semantics
+    are exactly LeaderElector's, so a crashed replica's partition
+    becomes claimable after its lease expires and the successor resumes
+    that partition's queue. Safety does NOT rest on the lease: even a
+    zombie replica that schedules past its deadline cannot double-bind,
+    because every bind runs the bind-table CAS (host/replica.BindTable)
+    — the lease bounds unowned-partition downtime, the CAS guards
+    correctness. `yoda-tpu scheduler --replicas N` joins one membership
+    per in-process replica.
+    """
+
+    def __init__(
+        self,
+        make_lease,
+        n_slots: int,
+        *,
+        identity: str | None = None,
+        lease_duration: float = 15.0,
+        retry_period: float = 2.0,
+        renew_deadline: float | None = None,
+    ):
+        if n_slots < 1:
+            raise ValueError(f"n_slots must be >= 1, got {n_slots}")
+        self._make_lease = make_lease
+        self.n_slots = n_slots
+        # the default identity carries a per-instance sequence number:
+        # nodename-pid alone would make two memberships in ONE process
+        # (the in-process fleet runner) look like the same holder, and
+        # a slot lease re-acquires for its own identity — both would
+        # "win" slot 0
+        self.identity = identity or (
+            f"{os.uname().nodename}-{os.getpid()}"
+            f"-m{next(_MEMBERSHIP_SEQ)}"
+        )
+        self._kw = dict(
+            lease_duration=lease_duration,
+            retry_period=retry_period,
+            renew_deadline=renew_deadline,
+        )
+        self.retry_period = retry_period
+        self.slot: int | None = None
+        self.elector: LeaderElector | None = None
+
+    @classmethod
+    def on_files(cls, path: str, n_slots: int, **kw) -> "ReplicaMembership":
+        """Membership over FileLease slot files `<path>.slot<i>`."""
+        return cls(
+            lambda i: FileLease(f"{path}.slot{i}"), n_slots, **kw
+        )
+
+    def join(self, timeout: float | None = None) -> int | None:
+        """Claim the first free slot; block up to `timeout` (None =
+        forever). Returns the slot index — the partition this replica
+        now owns — or None on timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            for i in range(self.n_slots):
+                elector = LeaderElector(
+                    self._make_lease(i),
+                    # filesystem-safe separator: FileLease embeds the
+                    # identity in its tmp-file name, so "/" would point
+                    # the write at a nonexistent directory
+                    identity=f"{self.identity}.slot{i}",
+                    **self._kw,
+                )
+                # one-shot: timeout=0 tries the slot once and moves on
+                if elector.acquire_blocking(timeout=0):
+                    self.slot = i
+                    self.elector = elector
+                    log.info(
+                        "joined membership as %s: slot %d of %d",
+                        self.identity, i, self.n_slots,
+                    )
+                    return i
+            if deadline is not None and time.monotonic() > deadline:
+                return None
+            time.sleep(self.retry_period)
+
+    def is_member(self) -> bool:
+        """True while this replica's slot lease is held (same fencing
+        as LeaderElector.is_leader — flips False before the slot is
+        stealable)."""
+        return self.elector is not None and self.elector.is_leader()
+
+    def leave(self) -> None:
+        if self.elector is not None:
+            self.elector.release()
+            self.elector = None
+            self.slot = None

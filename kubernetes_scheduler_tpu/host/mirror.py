@@ -38,11 +38,17 @@ line for line):
 
 Flush-to-full triggers (mirror -> build_snapshot, emitted delta = None):
 any node event (the static block is cached per node SET), selector
-drift (a window or running pod minting a selector the tables were never
-sized/matched against), hostPort slot growth or port-column remapping,
-and any verification mismatch. These are exactly the conditions under
-which snapshot_delta returns None today, so mirror-on and mirror-off
-ship full uploads on the same cycles.
+drift past the allocated power-of-two bucket, hostPort SLOT GROWTH, and
+any verification mismatch. The two heaviest recurring drift classes are
+absorbed IN PLACE instead of flushing
+(mirror_incremental_extensions_total{kind}): a selector minted into an
+existing padding column is filled from the running set
+(_extend_selectors — O(running x new selectors), not O(everything)),
+and a same-width hostPort remap recomputes only the rows of nodes
+hosting port pods (_remap_ports). Mirror-off (snapshot_delta) still
+degrades to full uploads on those cycles; the mirror's extension paths
+are strictly-better host work with the same emitted arrays, and the
+periodic verify cross-check pins that equality.
 """
 
 from __future__ import annotations
@@ -170,6 +176,10 @@ class SnapshotMirror:
         self._util_dirty: set[int] = set()
         self._dom_dirty: set[int] = set()
         self._last_emitted: SnapshotArrays | None = None
+        # set when an in-place extension patched a STATIC leaf the delta
+        # format cannot carry (domain_id columns): the next emit must
+        # ship a full upload even though the mirror never rebuilt
+        self._force_full_upload = False
         self._emits = 0
         # exported beside the scheduler's collectors (SHIPPED_METRICS)
         self.ctr_events = Counter(
@@ -189,8 +199,17 @@ class SnapshotMirror:
             "Periodic mirror-vs-rebuild cross-checks that found a "
             "bitwise mismatch (resynced by a full rebuild)",
         )
+        self.ctr_extensions = Counter(
+            "mirror_incremental_extensions_total",
+            "Layout drifts absorbed in place instead of flushing to a "
+            "full rebuild (selector = new selector columns filled from "
+            "the running set; port-remap = hostPort columns recomputed "
+            "under a remapped same-width port table)",
+            labels=("kind",),
+        )
         self.collectors = (
             self.ctr_events, self.ctr_rebuilds, self.ctr_verify_failures,
+            self.ctr_extensions,
         )
 
     # -- seeding / state -------------------------------------------------
@@ -229,6 +248,118 @@ class SnapshotMirror:
 
     def _selectors_stable(self) -> bool:
         return len(self.builder.selectors) == self._adopt_n_sel
+
+    def _extend_selectors(self) -> bool:
+        """Absorb selector drift IN PLACE: fill the already-allocated
+        padding columns for selector ids minted since adopt, instead of
+        flushing to a full rebuild.
+
+        The domain tables (and domain_id) are sized to the power-of-two
+        selector bucket, so a freshly minted id usually lands in columns
+        that already exist as zero padding — the only state a new
+        selector actually changes. The fill is the builder's own math
+        for exactly those columns: count running pods matching each new
+        key (pref/anti term WEIGHTS cannot target a new id — every
+        running pod's pref/anti keys were interned when the pod entered
+        the running set, so the new columns' weight tables stay zero),
+        then aggregate the new columns over their topology domains.
+        Returns False — caller flushes — when the drift crosses the
+        bucket boundary (array shapes grow; a rebuild must re-size).
+
+        A non-hostname topology also patches domain_id, a STATIC leaf
+        the delta format cannot carry ("domain_id is layout and never
+        rides a delta" — engine.py): the next emit ships a full upload,
+        but the mirror still never rebuilt."""
+        with self._lock:
+            b = self.builder
+            if len(b.selectors) == self._adopt_n_sel:
+                return True
+            cur_s = int(self._leaves["domain_counts"].shape[1])
+            if b._selector_slots() != cur_s:
+                return False  # bucket growth: shapes change, rebuild
+            new_items = list(b.selectors.items())[self._adopt_n_sel:]
+            n_real = len(self.nodes)
+            if self._raw is None:
+                # adopt saw zero selectors: allocate the raw tables the
+                # first minted id needs (bucket width is already 1+)
+                self._raw = tuple(
+                    np.zeros((n_real, cur_s), np.float32) for _ in range(4)
+                )
+            raw = self._raw[0]
+            node_index = self._node_index
+            for pod in self.running:
+                i = node_index.get(pod.node_name)
+                if i is None:
+                    continue
+                for key, sid in new_items:
+                    if b._key_matches(pod, key):
+                        raw[i, sid] += 1
+            new_by_topo: dict[str, list[int]] = {}
+            for key, sid in new_items:
+                new_by_topo.setdefault(key[2], []).append(sid)
+            outs = tuple(
+                self._writable(name) for name in _DOMAIN_LEAVES
+            )
+            dom_id = None
+            for topo, sids in new_by_topo.items():
+                grp = self._topo_groups.get(topo)
+                if grp is None:
+                    labels = [
+                        nd.name
+                        if topo == "kubernetes.io/hostname"
+                        else nd.labels.get(topo, "")
+                        for nd in self.nodes
+                    ]
+                    members: dict[str, list[int]] = {}
+                    for i, lab in enumerate(labels):
+                        members.setdefault(lab, []).append(i)
+                    grp = self._topo_groups[topo] = {
+                        "labels": labels, "members": members, "sids": [],
+                    }
+                grp["sids"].extend(sids)
+                for rows in grp["members"].values():
+                    ix = np.ix_(rows, sids)
+                    touched = False
+                    for table, out in zip(self._raw, outs):
+                        vals = table[ix].sum(axis=0, dtype=np.float64)
+                        out[ix] = vals
+                        if vals.any():
+                            touched = True
+                    if touched:
+                        self._dom_dirty.update(rows)
+                if topo != "kubernetes.io/hostname":
+                    # hostname columns equal the padding default (every
+                    # node its own domain, first index == own index) —
+                    # only a label topology moves domain_id
+                    if dom_id is None:
+                        dom_id = np.array(self._static.domain_id)
+                    for rows in grp["members"].values():
+                        dom_id[np.ix_(rows, sids)] = rows[0]
+            if dom_id is not None:
+                self._static = self._static._replace(domain_id=dom_id)
+                self._force_full_upload = True
+            self._adopt_n_sel = len(b.selectors)
+            self.ctr_extensions.inc(kind="selector")
+            return True
+
+    def _remap_ports(self, new_index: dict) -> None:
+        """Absorb a hostPort REMAP within the existing slot budget: only
+        the port-column block of `requested` means something different
+        under the new port->column table, so recompute the rows of nodes
+        hosting port pods (row-exact, the builder's phase order) instead
+        of flushing. The static block survives untouched — every port
+        column's capacity is the same 1.0/node and the column NAMES are
+        slot-generic (hostport/<i>), so neither alloc nor the resource-
+        name tuple moves. Slot GROWTH still flushes (every width in the
+        snapshot changes)."""
+        with self._lock:
+            self._adopt_ports = dict(new_index)
+            for name, pods_on in self._by_node.items():
+                if any(p.host_ports for p in pods_on):
+                    i = self._node_index.get(name)
+                    if i is not None:
+                        self._recompute_requested_row(i, name)
+            self.ctr_extensions.inc(kind="port-remap")
 
     def _notify(self) -> None:
         if self._on_dirty is not None:
@@ -278,7 +409,7 @@ class SnapshotMirror:
                         p for p in lst if p is not old
                     ]
                 if not self._flush:
-                    if self._selectors_stable():
+                    if self._extend_selectors():
                         self._recompute_node_rows(old.node_name)
                     else:
                         self._mark_flush("selector-drift")
@@ -298,7 +429,7 @@ class SnapshotMirror:
                             p for p in lst if p is not existing
                         ]
                     if not self._flush:
-                        if self._selectors_stable():
+                        if self._extend_selectors():
                             self._recompute_node_rows(existing.node_name)
                         else:
                             self._mark_flush("selector-drift")
@@ -306,13 +437,32 @@ class SnapshotMirror:
                     self._running_keys.pop(key, None)
                     self._notify()
                     return
+                # absorb selector drift BEFORE the pod joins the running
+                # set: the extension's new-column fill scans running, and
+                # _apply_pod_add below counts this pod once against the
+                # (now grown) adopted prefix. The incoming pod's own
+                # pref/anti keys are minted first — the exact term kinds
+                # the builder's running-set intake interns — so a pod
+                # introducing a fresh soft-affinity selector extends
+                # instead of flushing
+                if not self._flush:
+                    fl = pod.__dict__.get("_flags_cache")
+                    if fl is None:
+                        fl = pod_flags(pod)
+                    if not fl & FLAG_PLAIN:
+                        for term in pod.pod_affinity:
+                            if (term.preferred or term.anti) and (
+                                selector_key(term)
+                                not in self.builder.selectors
+                            ):
+                                self.builder._selector_id(term)
+                    if not self._extend_selectors():
+                        self._mark_flush("layout-drift")
                 self._running_keys[key] = pod
                 self.running.append(pod)
                 self._by_node.setdefault(pod.node_name, []).append(pod)
                 if not self._flush:
-                    if not self._selectors_stable() or not (
-                        self._pod_compatible(pod)
-                    ):
+                    if not self._pod_compatible(pod):
                         self._mark_flush("layout-drift")
                     else:
                         self._apply_pod_add(pod)
@@ -548,8 +698,13 @@ class SnapshotMirror:
                 return snap, None, True
             snap = self._static._replace(**self._leaves)
             delta = None
-            if prev is not None and prev is self._last_emitted:
+            if (
+                prev is not None
+                and prev is self._last_emitted
+                and not self._force_full_upload
+            ):
                 delta = self._make_delta(snap, max_byte_frac)
+            self._force_full_upload = False
             self._req_dirty.clear()
             self._util_dirty.clear()
             self._dom_dirty.clear()
@@ -566,10 +721,13 @@ class SnapshotMirror:
         b = self.builder
         if not self._selectors_stable():
             # an out-of-band build_pod_batch (preemption pass, direct
-            # callers) minted selector ids since adopt
-            self._mark_flush("selector-drift")
-            return
+            # callers) minted selector ids since adopt — absorb the new
+            # columns in place when they fit the allocated bucket
+            if not self._extend_selectors():
+                self._mark_flush("selector-drift")
+                return
         has_ports = False
+        minted = False
         if not pending_all_plain:
             for pod in window:
                 fl = pod.__dict__.get("_flags_cache")
@@ -579,29 +737,36 @@ class SnapshotMirror:
                     continue
                 if pod.host_ports:
                     has_ports = True
+                # mint window selectors NOW, in build_pod_batch's own
+                # scan order (per pod: affinity terms, then spread
+                # constraints — ids are append-only so the suffix is
+                # exactly what _extend_selectors fills)
                 for term in pod.pod_affinity:
                     if selector_key(term) not in b.selectors:
-                        self._mark_flush("selector-drift")
-                        return
+                        b._selector_id(term)
+                        minted = True
                 for sc in pod.topology_spread:
                     if selector_key(sc) not in b.selectors:
-                        self._mark_flush("selector-drift")
-                        return
+                        b._selector_id(sc)
+                        minted = True
+        if minted and not self._extend_selectors():
+            self._mark_flush("selector-drift")
+            return
         if has_ports or self._adopt_ports:
             # refresh the port->column mapping the way build_snapshot
-            # would; growth or remapping is layout churn (running pods'
-            # port contributions would sit in stale columns)
+            # would; running pods' port contributions would otherwise
+            # sit in stale columns
             b._assign_port_slots(
                 self.running,
                 [] if pending_all_plain else window,
                 ephemeral=True,
                 pending_all_plain=pending_all_plain,
             )
-            if (
-                b._port_slots != self._adopt_slots
-                or b._port_index != self._adopt_ports
-            ):
+            if b._port_slots != self._adopt_slots:
+                # slot growth: `requested`/alloc widths change — rebuild
                 self._mark_flush("port-churn")
+            elif b._port_index != self._adopt_ports:
+                self._remap_ports(b._port_index)
 
     def _rebuild(self, window: list, pending_all_plain: bool) -> SnapshotArrays:
         self.ctr_rebuilds.inc(reason=self._flush_reason or "seed")
@@ -649,6 +814,7 @@ class SnapshotMirror:
             self._dom_dirty.clear()
             self._flush = False
             self._flush_reason = ""
+            self._force_full_upload = False
             self._last_emitted = snap
 
     def _build_topo_groups(self) -> None:

@@ -282,6 +282,9 @@ SHIPPED_METRICS = (
     "events_applied_total",
     "mirror_full_rebuilds_total",
     "mirror_verify_failures_total",
+    # layout drifts absorbed in place (selector column fill / hostPort
+    # remap) instead of flushing to a full rebuild
+    "mirror_incremental_extensions_total",
     # mesh-sharded resident engine: routed delta payload per owning
     # shard (host labels shard index; the sharded sidecar's twin does
     # too)
@@ -304,6 +307,11 @@ SHIPPED_METRICS = (
     "rpcs_served_total",
     "resident_applies_total",
     "resident_sessions_count",
+    # replicated fleet (host/replica.py): CAS wins per replica and
+    # cross-replica conflicts resolved first-bind-wins (each one is a
+    # loser requeued through restore_window, never a lost pod)
+    "replica_binds_total",
+    "bind_conflicts_total",
 )
 
 

@@ -16,6 +16,7 @@ import heapq
 import itertools
 import threading
 import time
+import zlib
 from dataclasses import dataclass, field
 
 from kubernetes_scheduler_tpu.host.types import Pod
@@ -348,6 +349,90 @@ class NativeBackedQueue:
     def __len__(self) -> int:
         with self._lock:
             return len(self._q)
+
+
+def pod_partition_key(pod: Pod) -> str:
+    """The partition key: the pod's namespace (tenant boundary). The
+    gang identity key is `f"{namespace}/{name}"` (pod_gang above), so
+    namespace-keyed partitioning guarantees BY CONSTRUCTION that a gang
+    never straddles two partitions — gang atomicity (_defer_gang's
+    restore_window dance) stays a single-replica affair."""
+    return pod.namespace
+
+
+def namespace_partition(namespace: str, n_partitions: int) -> int:
+    """crc32(namespace) % n — the partition a namespace's pods belong
+    to. Exposed for traffic generators / tests that need to TARGET a
+    partition (pick a namespace that lands where they want)."""
+    if n_partitions <= 1:
+        return 0
+    return zlib.crc32(namespace.encode("utf-8")) % n_partitions
+
+
+def pod_partition(pod: Pod, n_partitions: int) -> int:
+    """Deterministic partition index in [0, n_partitions): crc32 of the
+    namespace, NOT Python's `hash()` — crc32 is stable across processes
+    and restarts (hash() is salted per interpreter), so a pod resubmitted
+    after a replica crash lands on the same partition and its backoff /
+    gang state reconverges instead of forking. The crc is memoized on
+    the pod object (immutable spec) like pod_priority; the modulus is
+    not, so the same pod re-partitions correctly if the fleet is resized."""
+    if n_partitions <= 1:
+        return 0
+    crc = pod.__dict__.get("_part_crc")
+    if crc is None:
+        crc = zlib.crc32(pod_partition_key(pod).encode("utf-8"))
+        pod.__dict__["_part_crc"] = crc
+    return crc % n_partitions
+
+
+class PartitionedQueue:
+    """N independent sub-queues, one per scheduler replica, with pushes
+    routed by pod_partition. Each sub-queue is a full SchedulingQueue /
+    NativeBackedQueue, so per-partition pop_window / restore_window /
+    backoff semantics are EXACTLY the single-queue semantics — gang
+    atomicity and the pipelined prefetch slot survive unchanged inside
+    a partition, and there is no cross-partition ordering to preserve
+    because priorities only ever competed within a tenant's submit
+    stream in the first place.
+
+    This class is a router, not a scheduler-facing queue: replicas talk
+    to their own partition through a ReplicaCoordinator (host/replica.py)
+    and never see the router at pop time."""
+
+    def __init__(
+        self,
+        n_partitions: int,
+        *,
+        initial_backoff: float = 1.0,
+        max_backoff: float = 10.0,
+        prefer_native: bool = True,
+        clock=time.monotonic,
+    ):
+        if n_partitions < 1:
+            raise ValueError(f"n_partitions must be >= 1, got {n_partitions}")
+        self.n_partitions = n_partitions
+        self.partitions = [
+            make_queue(
+                initial_backoff=initial_backoff,
+                max_backoff=max_backoff,
+                prefer_native=prefer_native,
+                clock=clock,
+            )
+            for _ in range(n_partitions)
+        ]
+
+    def partition_of(self, pod: Pod) -> int:
+        return pod_partition(pod, self.n_partitions)
+
+    def push(self, pod: Pod) -> None:
+        self.partitions[self.partition_of(pod)].push(pod)
+
+    def partition(self, i: int):
+        return self.partitions[i]
+
+    def __len__(self) -> int:
+        return sum(len(q) for q in self.partitions)
 
 
 def make_queue(

@@ -230,6 +230,7 @@ class Scheduler:
         controller_replicas: Callable[[str, str, str], int | None] | None = None,
         engine=None,
         queue_clock: Callable[[], float] | None = None,
+        queue=None,
     ):
         self.config = config
         self.advisor = advisor
@@ -341,8 +342,12 @@ class Scheduler:
             self._native_ok = False
         # queue_clock: injectable retry-backoff clock (default wall
         # monotonic) — the scenario harness passes a virtual clock so
-        # backoffs resolve in simulated ticks, deterministically
-        self.queue = make_queue(
+        # backoffs resolve in simulated ticks, deterministically.
+        # queue: injectable pre-built queue (any SchedulingQueue-surface
+        # object) — the replicated fleet (host/replica.py) hands each
+        # replica its ReplicaCoordinator, a partition of the shared
+        # queue fenced by the bind table, through this seam
+        self.queue = queue if queue is not None else make_queue(
             initial_backoff=config.initial_backoff_seconds,
             max_backoff=config.max_backoff_seconds,
             prefer_native=self._native_ok,

@@ -5,10 +5,12 @@
 """
 
 from kubernetes_scheduler_tpu.sim.scenarios.base import (
+    FleetScenarioWorld,
     Scenario,
     ScenarioWorld,
     SimClock,
     run_scenario,
+    run_scenario_replicated,
     scenario_config,
 )
 from kubernetes_scheduler_tpu.sim.scenarios.library import SCENARIOS
@@ -27,14 +29,22 @@ def run(
 ) -> dict:
     """Instantiate and run a registered scenario by name. faults=False
     runs a chaos program's traffic WITHOUT its fault plan (the clean
-    A/B twin)."""
+    A/B twin). Scenarios declaring `replicas` > 1 run through the
+    replicated-fleet runner (N schedulers over a partitioned queue,
+    per-replica journals under <trace_path>/r<i>)."""
     cls = SCENARIOS.get(name)
     if cls is None:
         raise ValueError(
             f"unknown scenario {name!r}; expected one of {sorted(SCENARIOS)}"
         )
-    return run_scenario(
-        cls(n_nodes=n_nodes, intensity=intensity),
+    scenario = cls(n_nodes=n_nodes, intensity=intensity)
+    runner = (
+        run_scenario_replicated
+        if getattr(scenario, "replicas", 1) > 1
+        else run_scenario
+    )
+    return runner(
+        scenario,
         seed=seed,
         trace_path=trace_path,
         span_path=span_path,

@@ -421,6 +421,84 @@ class CompoundStormScenario(ChaosScenario):
                 mirror.inject_corruption(leaf="net_up", row=3)
 
 
+class ReplicaConflictStormScenario(Scenario):
+    """2-replica fleet over the partitioned queue: partition-skew plus
+    conflict storms (host/replica.py, the shipped replica-bind protocol).
+
+    Two tenant namespaces are picked to land on partitions 0 and 1
+    (queue.namespace_partition), with skewed traffic — 75% of arrivals
+    on replica 0's partition, 25% on replica 1's — so the fleet drains
+    an UNBALANCED workload. Every third tick is a conflict storm: a
+    filler window of high-priority pods occupies replica 0's current
+    cycle while mid-priority OVERLAP pods are submitted to BOTH
+    replicas (FleetScenarioWorld.submit_overlap — the partition-handoff
+    race). With pipeline_depth=1, replica 0 prefetches the overlap
+    window while binding filler, replica 1 binds its overlap copies in
+    the same round-robin round, and replica 0's prefetched binds then
+    LOSE the bind-table CAS — bind_lose requeues, the 409 lands in the
+    binder's drop arm, and the requeued copies retire via drop_bound on
+    the next pop. Deterministic, so the per-replica journals replay-pin;
+    the evidence gate is bind_conflicts > 0 with double_binds == 0 and
+    every pod bound exactly once."""
+
+    name = "replica-conflict-storm"
+    description = (
+        "2-replica partitioned fleet: skewed tenants + overlap-pod "
+        "conflict storms resolved first-bind-wins"
+    )
+    ticks = 10
+    smoke = True
+    replicas = 2
+    # small windows so a storm's filler fills exactly one cycle, ONE
+    # window per cycle (deep-queue batching would swallow filler AND
+    # overlap in one backlog pop), and the pipelined prefetch slot to
+    # hold the overlap window across the round-robin round
+    config_overrides = {
+        "batch_window": 32,
+        "pipeline_depth": 1,
+        "max_windows_per_cycle": 1,
+    }
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        from kubernetes_scheduler_tpu.host.queue import namespace_partition
+
+        # first tenant names landing on each partition, deterministically
+        self.ns_by_partition = {}
+        i = 0
+        while len(self.ns_by_partition) < 2:
+            ns = f"tenant-{i}"
+            part = namespace_partition(ns, self.replicas)
+            self.ns_by_partition.setdefault(part, ns)
+            i += 1
+
+    def _pod(self, rng, name, ns, prio):
+        pod = _mk_pod(rng, name, labels={"scv/priority": str(prio)}, cpu=100)
+        pod.namespace = ns
+        return pod
+
+    def tick(self, t: int, world: ScenarioWorld, rng) -> None:
+        ns0 = self.ns_by_partition[0]
+        ns1 = self.ns_by_partition[1]
+        # partition skew: 75% of steady traffic on replica 0's tenant
+        for i in range(12):
+            world.submit(self._pod(rng, f"skew0-{t}-{i}", ns0, 0))
+        for i in range(4):
+            world.submit(self._pod(rng, f"skew1-{t}-{i}", ns1, 0))
+        if t % 3 == 1:
+            # conflict storm: filler occupies r0's current window so the
+            # overlap pods land in its PREFETCHED window...
+            for i in range(32):
+                world.submit(self._pod(rng, f"filler-{t}-{i}", ns0, 10))
+            # ...while the same overlap pods also enter r1's queue (the
+            # handoff race) and bind there first — r0's prefetched copy
+            # then loses the CAS
+            for i in range(8):
+                world.submit_overlap(
+                    self._pod(rng, f"overlap-{t}-{i}", ns0, 5)
+                )
+
+
 SCENARIOS = {
     s.name: s
     for s in (
@@ -436,5 +514,6 @@ SCENARIOS = {
         DiskFullJournalScenario,
         MirrorCorruptionScenario,
         CompoundStormScenario,
+        ReplicaConflictStormScenario,
     )
 }

@@ -184,9 +184,15 @@ class SchedulerConfig:
     # selector/port layout drift) and the verification path:
     # mirror_verify_interval > 0 cross-checks the mirror against a full
     # rebuild every N emits, BITWISE, resyncing loudly on mismatch
-    # (mirror_verify_failures_total). Off by default; mirror-on and
-    # mirror-off bindings are bit-identical (PARITY.md round 16).
-    snapshot_mirror: bool = False
+    # (mirror_verify_failures_total). ON by default since the in-place
+    # extension paths absorbed the recurring flush classes (selector
+    # drift within the power-of-two bucket, same-width hostPort remaps
+    # — mirror_incremental_extensions_total{kind}): mirror-on and
+    # mirror-off bindings are bit-identical (PARITY.md rounds 16/19 and
+    # tests/test_mirror.py's default-config pin), so the flip changes
+    # host-side cost, never decisions. Turn off to fall back to the
+    # per-cycle rebuild loop.
+    snapshot_mirror: bool = True
     mirror_verify_interval: int = 256
     # cycle triggering: "tick" (default) keeps the fixed-poll idle waits
     # of the host loops; "event" arms a CycleTrigger the loops sleep on

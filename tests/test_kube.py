@@ -1177,8 +1177,10 @@ def test_volume_restrictions_rwop_exclusive(fake):
         m2 = sched.run_cycle()
         assert m2.pods_bound == 0 and m2.pods_unschedulable == 1
 
-        # holder released: the waiter binds
+        # holder released: the waiter binds (the mirror owns running
+        # state once seeded, so the release is an informer event too)
         running.clear()
+        sched.mirror.apply_pod_event("DELETED", winner)
         sched.queue._clock = lambda: 2e9
         m3 = sched.run_cycle()
         assert m3.pods_bound == 1

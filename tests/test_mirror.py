@@ -182,11 +182,48 @@ def test_mirror_binding_parity_node_flap(overrides):
 
 def test_mirror_binding_parity_selector_drift():
     # constraint traffic: anti-affinity terms mint selectors as pods
-    # arrive — every mint must flush, and decisions must not move
+    # arrive — mints inside the allocated power-of-two column bucket
+    # extend the mirror in place, crossings flush, and decisions must
+    # not move either way (verify_interval=1 cross-checks every emit)
     a, ba = _run_workload(mirror=False, constraints=True)
     b, bb = _run_workload(mirror=True, constraints=True)
     assert ba and ba == bb
     assert not b.mirror.ctr_verify_failures._series
+
+
+def test_mirror_default_config_parity_with_mirror_off():
+    """The shipped SchedulerConfig defaults run the mirror ON: against
+    an otherwise-identical mirror-off config, bindings are bitwise
+    identical on constraint traffic — the default flip moved host-side
+    cost, never decisions — and every emit cross-checks clean."""
+    from kubernetes_scheduler_tpu.sim.scenarios import SimClock
+
+    assert SchedulerConfig().snapshot_mirror is True  # the shipped default
+
+    def run(overrides):
+        nodes, base = gen_host_cluster(24, seed=0, constraints=True)
+        advisor = _ChurnAdvisor(base, [nd.name for nd in nodes])
+        running: list = []
+        clock = SimClock()
+        sched = Scheduler(
+            SchedulerConfig(mirror_verify_interval=1, **overrides),
+            advisor=advisor,
+            binder=RecordingBinder(),
+            list_nodes=lambda: nodes,
+            list_running_pods=lambda: running,
+            queue_clock=clock,
+        )
+        sched._test_clock = clock
+        for pod in gen_host_pods(96, seed=1, constraints=True):
+            sched.submit(pod)
+        return sched, _drain(sched, nodes, running)
+
+    on_s, on = run({})
+    assert on_s.mirror is not None
+    assert not on_s.mirror.ctr_verify_failures._series
+    off_s, off = run({"snapshot_mirror": False})
+    assert off_s.mirror is None
+    assert on and on == off
 
 
 def test_mirror_idle_emit_zero_row_delta():
@@ -253,20 +290,31 @@ def test_mirror_flush_reasons():
     assert rebuilt and delta is None
     assert mir.ctr_rebuilds.total() == base_rebuilds + 1
     assert mir.ctr_rebuilds.value(reason="node-churn") == base_node_churn + 1
-    # selector-minting window -> flush
+    # a window minting ONE selector fits the allocated power-of-two
+    # column bucket: absorbed in place (extension), NOT a rebuild
     from kubernetes_scheduler_tpu.host.types import Pod, PodAffinityTerm
 
-    pod = Pod(
-        name="drift", namespace="d",
-        pod_affinity=[
-            PodAffinityTerm(
-                match_labels={"nonesuch": "x"},
-                topology_key="kubernetes.io/hostname",
-                anti=True,
-            )
-        ],
+    def drift_pod(i):
+        return Pod(
+            name=f"drift-{i}", namespace="d",
+            pod_affinity=[
+                PodAffinityTerm(
+                    match_labels={"nonesuch": str(i)},
+                    topology_key="kubernetes.io/hostname",
+                    anti=True,
+                )
+            ],
+        )
+
+    _, delta, rebuilt = mir.emit([drift_pod(0)], pending_all_plain=False, prev=None)
+    assert not rebuilt
+    assert mir.ctr_rebuilds.total() == base_rebuilds + 1
+    assert mir.ctr_extensions.value(kind="selector") == 1
+    assert mir.verify([drift_pod(0)])
+    # drift PAST the bucket (1 -> 4 selector slots): shapes grow, flush
+    _, delta, rebuilt = mir.emit(
+        [drift_pod(1), drift_pod(2)], pending_all_plain=False, prev=None
     )
-    _, delta, rebuilt = mir.emit([pod], pending_all_plain=False, prev=None)
     assert rebuilt
     assert mir.ctr_rebuilds.total() == base_rebuilds + 2
     assert mir.ctr_rebuilds.value(reason="selector-drift") >= 1
@@ -275,6 +323,96 @@ def test_mirror_flush_reasons():
     rendered = "\n".join(mir.ctr_rebuilds.render())
     assert 'mirror_full_rebuilds_total{reason="seed"}' in rendered
     assert 'mirror_full_rebuilds_total{reason="selector-drift"}' in rendered
+
+
+def test_mirror_port_remap_in_place():
+    """A same-width hostPort remap (a port retires, another appears) is
+    absorbed by recomputing only the port-hosting rows — no rebuild —
+    and the surviving port's contribution moves to its new column."""
+    from kubernetes_scheduler_tpu.host.types import Pod
+
+    nodes, advisor = gen_host_cluster(16, seed=0)
+    p8080 = Pod(name="web", namespace="d", node_name=nodes[0].name,
+                host_ports=[8080])
+    p9090 = Pod(name="db", namespace="d", node_name=nodes[1].name,
+                host_ports=[9090])
+    running: list = [p8080, p9090]
+    sched = _mk_sched(nodes, CoalescingAdvisor(advisor), running, mirror=True)
+    for pod in gen_host_pods(8, seed=1):
+        sched.submit(pod)
+    _drain(sched, nodes, running)
+    mir = sched.mirror
+    assert mir._adopt_ports == {8080: 0, 9090: 1}
+    prev, _, _ = mir.emit([], pending_all_plain=True, prev=None)
+    rebuilds = mir.ctr_rebuilds.total()
+    # 8080 retires; a pending pod brings 9999 — live ports {9090, 9999}
+    # re-sort into the SAME two slots, so 9090's column moves 1 -> 0
+    mir.apply_pod_event("DELETED", p8080)
+    wpod = Pod(name="new", namespace="d", host_ports=[9999])
+    snap, delta, rebuilt = mir.emit([wpod], pending_all_plain=False, prev=prev)
+    # verify_interval=1 cross-checked this very emit bitwise: a wrong
+    # remap would have flushed and reported rebuilt=True
+    assert not rebuilt
+    assert mir.ctr_rebuilds.total() == rebuilds
+    assert mir.ctr_extensions.value(kind="port-remap") == 1
+    assert mir._adopt_ports == {9090: 0, 9999: 1}
+    assert delta is not None  # no static leaf moved: the delta survives
+    i = mir._node_index[p9090.node_name]
+    req = np.asarray(snap.requested)
+    assert req[i, mir._port0 + 0] == 1.0  # 9090 now occupies slot 0
+    assert req[i, mir._port0 + 1] == 0.0
+    # slot GROWTH (a third live port) still flushes: widths change
+    wider = Pod(name="wide", namespace="d", host_ports=[7070, 7071])
+    _, _, rebuilt = mir.emit([wpod, wider], pending_all_plain=False, prev=None)
+    assert rebuilt
+    assert mir.ctr_rebuilds.value(reason="port-churn") >= 1
+
+
+def test_mirror_selector_extension_zone_topology():
+    """An in-place selector extension with REAL matches over a label
+    topology: the new column's domain counts are filled from the running
+    set and domain_id is patched — a static leaf the delta format cannot
+    carry, so that one emit degrades to a full upload (delta=None) while
+    the mirror itself never rebuilds."""
+    from kubernetes_scheduler_tpu.host.snapshot import selector_key
+    from kubernetes_scheduler_tpu.host.types import Pod, PodAffinityTerm
+
+    nodes, advisor = gen_host_cluster(16, seed=0, constraints=True)
+    running: list = []
+    sched = _mk_sched(nodes, CoalescingAdvisor(advisor), running, mirror=True)
+    # plain pods (no constraints): the mirror adopts with ZERO selectors,
+    # but every generated pod carries an "app: svc-<i>" label to match
+    for pod in gen_host_pods(8, seed=1):
+        sched.submit(pod)
+    _drain(sched, nodes, running)
+    mir = sched.mirror
+    assert mir._adopt_n_sel == 0
+    prev, _, _ = mir.emit([], pending_all_plain=True, prev=None)
+    rebuilds = mir.ctr_rebuilds.total()
+    term = PodAffinityTerm(
+        match_labels={"app": "svc-1"},
+        topology_key="topology.kubernetes.io/zone",
+        anti=True,
+    )
+    wpod = Pod(name="z", namespace="d", pod_affinity=[term])
+    snap, delta, rebuilt = mir.emit([wpod], pending_all_plain=False, prev=prev)
+    assert not rebuilt  # bitwise-verified in-emit (verify_interval=1)
+    assert mir.ctr_rebuilds.total() == rebuilds
+    assert mir.ctr_extensions.value(kind="selector") == 1
+    sid = mir.builder.selectors[selector_key(term)]
+    counts = np.asarray(snap.domain_counts)
+    # the running svc-1 pod really counted into the new column
+    assert counts[:, sid].sum() > 0
+    # zone domains: grouped rows share their first index, so domain_id
+    # departs from the hostname default (each node its own index)
+    dom = np.asarray(snap.domain_id)
+    assert (dom[:, sid] != np.arange(len(nodes))).any()
+    assert delta is None  # domain_id moved: full upload this once
+    snap2, delta2, rebuilt2 = mir.emit(
+        [wpod], pending_all_plain=False, prev=snap
+    )
+    assert not rebuilt2
+    assert delta2 is not None  # the degradation was one emit, not sticky
 
 
 def test_mirror_bound_pod_event_dedups_by_identity():

@@ -336,7 +336,9 @@ def test_cycle_spans_written_by_both_drivers(tmp_path, depth):
     by_name = {}
     for ev in events:
         by_name.setdefault(ev["name"], []).append(ev)
-    for want in ("queue_pop", "state_fetch", "snapshot_build",
+    # snapshot_mirror is default-on: the state stage is event_apply +
+    # mirror_emit (snapshot_build only appears on the flush/rebuild path)
+    for want in ("queue_pop", "state_fetch", "event_apply", "mirror_emit",
                  "engine_step", "bind", "cycle", "recorder_write"):
         assert want in by_name, (want, sorted(by_name))
     if depth == 1:

@@ -144,8 +144,10 @@ def test_pipeline_informer_event_midflight_forces_rebuild():
     m1 = sched.run_cycle()  # dispatches the plain window, prefetches "pinned"
     assert m1.pods_bound == 4
     assert sched._spec_batch is not None  # speculative batch in hand
-    nodes.append(make_node("n-new"))      # informer event mid-flight
+    n_new = make_node("n-new")            # informer event mid-flight
+    nodes.append(n_new)
     advisor.utils["n-new"] = NodeUtil(cpu_pct=10.0)
+    sched.mirror.apply_node_event("ADDED", n_new)
     m2 = sched.run_cycle()
     assert m2.pipeline_flushes == 1
     assert m2.pods_bound == 1

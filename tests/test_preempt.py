@@ -485,7 +485,9 @@ def test_host_terminating_victim_not_reevicted_and_node_reserved():
     assert m3.pods_preempted == 0 and len(ev.evictions) == 1
 
     # victim finally dies: pending eviction record clears; preemptors bind
+    # (the mirror owns running state once seeded — play the informer event)
     running.remove(low)
+    s.mirror.apply_pod_event("DELETED", low)
     s.queue._clock = lambda: 2e9  # past the retry backoff from cycle 2/3
     m4 = s.run_cycle()
     assert m4.pods_bound >= 1
@@ -549,6 +551,7 @@ def test_host_nominated_capacity_not_stolen_by_lower_priority_arrival():
     # victim terminates while urgent sits in backoff; a fresh low-prio
     # pod arrives and is popped immediately (no backoff)
     running.remove(low)
+    s.mirror.apply_pod_event("DELETED", low)
     s.submit(make_pod("sneaky", cpu=800, labels={"scv/priority": "1"}))
     m2 = s.run_cycle()
     assert m2.pods_bound == 0  # reservation holds n0: sneaky can't fit

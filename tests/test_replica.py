@@ -1,0 +1,429 @@
+"""Replicated scheduler fleet: partition hash, bind-table CAS, fencing.
+
+The `replica-bind` protocol model (analysis/model/protocols.py) proved
+no-double-bind and bound-pod-never-re-popped over every interleaving of
+the ABSTRACT transitions; these tests pin the shipped primitives those
+transitions anchor to:
+
+- pod_partition: crc32(namespace), stable across interpreter restarts
+  (hash() is salted per process and would fork a pod's partition on
+  resubmit-after-crash), gangs never straddling by construction.
+- PartitionedQueue: per-partition pop/restore semantics EXACTLY the
+  single-queue semantics, on both queue backends (the PR-6 ordering
+  pins, per partition).
+- BindTable.try_bind: first bind wins, stale-epoch fencing (the
+  `unfenced-replica-bind` mutant's load-bearing line).
+- ReplicaCoordinator / FencedBinder: the pop-filter (drop_bound), the
+  conflict flow (bind_lose -> requeue -> 409 -> drop_bound), requeue
+  latency accounting.
+- ReplicaFleet: partition-routed drains, N-replica union-of-bindings
+  parity with 1 replica on conflict-free workloads (PARITY round 19).
+- ReplicaMembership: slot claiming, standby, slot release.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from kubernetes_scheduler_tpu.host.queue import (
+    PartitionedQueue,
+    make_queue,
+    namespace_partition,
+    pod_gang,
+    pod_partition,
+    pod_partition_key,
+)
+from kubernetes_scheduler_tpu.host.replica import (
+    BindConflictError,
+    BindTable,
+    FencedBinder,
+    ReplicaCoordinator,
+    ReplicaFleet,
+)
+from kubernetes_scheduler_tpu.host.types import Container, Pod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def mk_pod(name, ns="default", priority=None, gang=None, gang_size=0,
+           cpu=100.0):
+    labels = {}
+    if priority is not None:
+        labels["scv/priority"] = str(priority)
+    if gang is not None:
+        labels["scv/gang"] = gang
+        labels["scv/gang-size"] = str(gang_size)
+    return Pod(
+        name=name,
+        namespace=ns,
+        labels=labels,
+        containers=[Container(requests={"cpu": cpu, "memory": 2**28})],
+    )
+
+
+# ---- partition hash -------------------------------------------------------
+
+
+def test_partition_assignment_survives_interpreter_restarts():
+    """The determinism claim that rules out Python's salted hash():
+    two interpreters with DIFFERENT hash seeds must agree with this
+    process on every namespace's partition."""
+    namespaces = [f"tenant-{i}" for i in range(16)] + ["default", "kube-system"]
+    here = {ns: namespace_partition(ns, 4) for ns in namespaces}
+
+    src = (
+        "import json, sys\n"
+        "from kubernetes_scheduler_tpu.host.queue import namespace_partition\n"
+        "print(json.dumps({ns: namespace_partition(ns, 4)"
+        " for ns in sys.argv[1:]}))\n"
+    )
+    for seed in ("0", "12345"):
+        out = subprocess.run(
+            [sys.executable, "-c", src, *namespaces],
+            capture_output=True, text=True, timeout=60, cwd=REPO,
+            env={**os.environ, "PYTHONHASHSEED": seed},
+        )
+        assert out.returncode == 0, out.stderr[-500:]
+        import json
+
+        assert json.loads(out.stdout) == here, f"hash seed {seed} diverged"
+
+
+def test_pod_partition_matches_namespace_partition_and_is_memoized():
+    for i in range(8):
+        pod = mk_pod("p", ns=f"tenant-{i}")
+        for n in (1, 2, 3, 4, 8):
+            assert pod_partition(pod, n) == namespace_partition(pod.namespace, n)
+    # the crc is memoized on the pod, the modulus is not: the same pod
+    # re-partitions correctly when the fleet is resized
+    pod = mk_pod("p", ns="tenant-3")
+    parts = {n: pod_partition(pod, n) for n in (2, 4, 8)}
+    assert parts == {n: namespace_partition("tenant-3", n) for n in (2, 4, 8)}
+    assert "_part_crc" in pod.__dict__
+
+
+def test_single_partition_short_circuits_to_zero():
+    assert namespace_partition("anything", 1) == 0
+    assert namespace_partition("anything", 0) == 0
+    assert pod_partition(mk_pod("p", ns="x"), 1) == 0
+
+
+def test_gangs_never_straddle_partitions():
+    """The gang identity key is namespace-prefixed (pod_gang), and the
+    partition key IS the namespace — so every member of a gang lands on
+    one partition for every fleet size, by construction."""
+    for g in range(6):
+        ns = f"team-{g}"
+        members = [
+            mk_pod(f"g{g}-m{i}", ns=ns, gang=f"job-{g}", gang_size=4)
+            for i in range(4)
+        ]
+        key, size = pod_gang(members[0])
+        assert key.startswith(f"{ns}/") and size == 4
+        assert pod_partition_key(members[0]) == ns
+        for n in (2, 3, 4, 8):
+            assert len({pod_partition(p, n) for p in members}) == 1
+
+
+# ---- partitioned queue ----------------------------------------------------
+
+
+@pytest.mark.parametrize("native", [True, False])
+def test_partitioned_queue_routes_by_namespace(native):
+    q = PartitionedQueue(2, prefer_native=native, clock=lambda: 0.0)
+    ns = {p: None for p in range(2)}
+    i = 0
+    while any(v is None for v in ns.values()):
+        name = f"tenant-{i}"
+        part = namespace_partition(name, 2)
+        if ns[part] is None:
+            ns[part] = name
+        i += 1
+    pods = [mk_pod(f"p{j}", ns=ns[j % 2]) for j in range(8)]
+    for pod in pods:
+        q.push(pod)
+    assert len(q) == 8
+    for part in range(2):
+        got = q.partition(part).pop_window(8)
+        assert {p.name for p in got} == {
+            p.name for p in pods if q.partition_of(p) == part
+        }
+    assert len(q) == 0
+
+
+@pytest.mark.parametrize("native", [True, False])
+def test_restore_window_order_per_partition_matches_single_queue(native):
+    """The PR-6 restore-ordering pins, per partition: a partition's
+    pop -> restore -> push -> pop sequence produces EXACTLY the order a
+    standalone queue of the same backend produces for the same pods —
+    the router adds no ordering semantics of its own."""
+    ns0 = next(
+        f"tenant-{i}" for i in range(64)
+        if namespace_partition(f"tenant-{i}", 2) == 0
+    )
+    ns1 = next(
+        f"tenant-{i}" for i in range(64)
+        if namespace_partition(f"tenant-{i}", 2) == 1
+    )
+
+    def traffic(ns):
+        return [
+            mk_pod("a", ns=ns, priority=5),
+            mk_pod("b", ns=ns, priority=5),
+            mk_pod("c", ns=ns, priority=9),
+        ]
+
+    def drive(queue, ns):
+        for pod in traffic(ns):
+            queue.push(pod)
+        window = queue.pop_window(2)
+        queue.restore_window(window)
+        queue.push(mk_pod("d", ns=ns, priority=9))
+        return [p.name for p in queue.pop_window(4)]
+
+    part = PartitionedQueue(2, prefer_native=native, clock=lambda: 0.0)
+    got = {
+        0: drive(part.partition(0), ns0),
+        1: drive(part.partition(1), ns1),
+    }
+    for ns, sequence in ((ns0, got[0]), (ns1, got[1])):
+        solo = make_queue(prefer_native=native, clock=lambda: 0.0)
+        assert drive(solo, ns) == sequence
+
+
+# ---- bind table -----------------------------------------------------------
+
+
+def test_bind_table_first_bind_wins():
+    t = BindTable()
+    assert t.holder("ns/p") == "" and t.epoch("ns/p") == 0
+    assert t.try_bind("ns/p", 0, "r0") is True
+    assert t.holder("ns/p") == "r0"
+    assert t.epoch("ns/p") == 1  # success advances the epoch
+    # the racer loses regardless of the epoch it presents
+    assert t.try_bind("ns/p", 0, "r1") is False
+    assert t.try_bind("ns/p", 1, "r1") is False
+    assert t.holder("ns/p") == "r0"
+    assert t.bound == 1 and t.double_binds == 0
+    assert t.holders() == {"ns/p": "r0"}
+
+
+def test_bind_table_stale_epoch_fence():
+    """The fence the `unfenced-replica-bind` mutant removes: an unbound
+    key still rejects a bind whose seen-epoch is not current (a pop that
+    never recorded the epoch presents -1 — the coordinator's default for
+    an un-popped pod)."""
+    t = BindTable()
+    assert t.try_bind("ns/p", -1, "r0") is False  # never saw a pop
+    assert t.try_bind("ns/p", 1, "r0") is False   # future epoch: stale state
+    assert t.holder("ns/p") == ""
+    assert t.try_bind("ns/p", 0, "r0") is True    # the honest pop wins
+
+
+# ---- coordinator + fenced binder ------------------------------------------
+
+
+class _StubBinder:
+    def __init__(self):
+        self.bindings = []
+
+    def bind(self, pod, node_name):
+        self.bindings.append((pod.name, node_name))
+
+
+def _coordinator_pair():
+    """Two coordinators over their own partitions, one shared table —
+    the 2-replica topology without schedulers."""
+    table = BindTable()
+    queues = PartitionedQueue(2, prefer_native=False, clock=lambda: 0.0)
+    c0 = ReplicaCoordinator("r0", queues.partition(0), table)
+    c1 = ReplicaCoordinator("r1", queues.partition(1), table)
+    return table, c0, c1
+
+
+def test_pop_window_filters_bound_pods_and_records_epochs():
+    table, c0, c1 = _coordinator_pair()
+    mine = mk_pod("mine", ns="a")
+    stale = mk_pod("stale", ns="a")
+    c0.push(mine)
+    c0.push(stale)
+    # the other replica already bound its copy of "stale"
+    assert table.try_bind("a/stale", 0, "r1")
+    got = c0.pop_window(8)
+    assert [p.name for p in got] == ["mine"]
+    assert c0.pods_discarded == 1
+    assert len(c0) == 0  # the filtered pod was retired, not requeued
+    assert c0._seen == {"a/mine": 0}
+
+
+def test_fenced_binder_conflict_resolves_without_losing_the_pod():
+    table, c0, c1 = _coordinator_pair()
+    b0 = FencedBinder(_StubBinder(), c0)
+    b1 = FencedBinder(_StubBinder(), c1)
+    # both replicas hold a popped copy of the same pod (partition
+    # handoff overlap): epochs recorded on both sides
+    for c in (c0, c1):
+        c.push(mk_pod("racer", ns="x"))
+    w0 = c0.pop_window(4)
+    w1 = c1.pop_window(4)
+    assert [p.name for p in w0] == [p.name for p in w1] == ["racer"]
+    b0.bind(w0[0], "node-1")  # first bind wins
+    assert b0.bindings == [("racer", "node-1")]
+    with pytest.raises(BindConflictError) as err:
+        b1.bind(w1[0], "node-2")
+    assert err.value.status == 409
+    assert b1.bindings == []  # the real bind never ran
+    assert c1.conflicts == 1
+    assert len(c1) == 1  # bind_lose requeued the loser's copy...
+    redo = c1.pop_window(4)
+    assert redo == []  # ...and the re-pop retires it via drop_bound
+    assert c1.pods_discarded == 1
+    assert len(c1) == 0
+    assert len(c1.requeue_latencies) == 1
+    assert table.double_binds == 0 and table.bound == 1
+
+
+def test_bind_win_on_unpopped_pod_is_fenced():
+    """A bind attempt for a pod this replica never popped (no recorded
+    epoch) must lose — the -1 default can never match a real epoch."""
+    _, c0, _ = _coordinator_pair()
+    assert c0.bind_win(mk_pod("ghost", ns="x")) is False
+
+
+# ---- fleet ----------------------------------------------------------------
+
+
+def _tenant_for(residue, n):
+    return next(
+        ns for i in range(256)
+        if namespace_partition(ns := f"tenant-{i}", n) == residue
+    )
+
+
+def _fleet_workload(pods_per=12):
+    # one tenant per partition residue, so a 2-replica fleet is
+    # guaranteed traffic on BOTH partitions
+    ns_names = [_tenant_for(r, 2) for r in range(2)]
+    return [
+        mk_pod(f"w{t}-{j}", ns=ns_names[t])
+        for t in range(2)
+        for j in range(pods_per)
+    ]
+
+
+def _make_fleet(n_replicas, nodes, advisor, running):
+    from kubernetes_scheduler_tpu.utils.config import SchedulerConfig
+
+    return ReplicaFleet(
+        SchedulerConfig(batch_window=64, normalizer="none"),
+        n_replicas=n_replicas,
+        advisor_factory=lambda i: advisor,
+        list_nodes=lambda: nodes,
+        list_running_pods=lambda: running,
+    )
+
+
+def test_fleet_partitioned_drain_and_union_parity():
+    """Disjoint partitioned traffic: zero conflicts, zero double binds,
+    every pod bound by the replica owning its namespace — and the
+    2-replica UNION of bound pods equals the 1-replica bound set on the
+    same workload (the PARITY round-19 claim; node choices may differ,
+    membership of the bound set may not)."""
+    from kubernetes_scheduler_tpu.sim.host_gen import gen_host_cluster
+
+    nodes, advisor = gen_host_cluster(16, seed=0)
+
+    def drain(n_replicas):
+        running: list = []
+        fleet = _make_fleet(n_replicas, nodes, advisor, running)
+        for pod in _fleet_workload():
+            fleet.submit(pod)
+        evidence = fleet.run_until_empty(max_cycles=100)
+        return fleet, evidence
+
+    fleet2, ev2 = drain(2)
+    assert ev2["bind_conflicts_total"] == 0
+    assert ev2["double_binds"] == 0
+    assert ev2["pods_discarded"] == 0
+    assert ev2["total_binds"] == 24
+    assert all(v > 0 for v in ev2["binds_per_replica"].values())
+    # partition honesty: each replica bound only namespaces it owns
+    for i, sched in enumerate(fleet2.schedulers):
+        for binding in sched.binder.bindings:
+            assert fleet2.partition_of(binding.pod) == i
+
+    fleet1, ev1 = drain(1)
+    assert ev1["total_binds"] == 24
+    union2 = {b.pod.name for s in fleet2.schedulers for b in s.binder.bindings}
+    union1 = {b.pod.name for b in fleet1.schedulers[0].binder.bindings}
+    assert union2 == union1
+
+
+def test_fleet_overlap_submissions_resolve_exactly_once():
+    """submit_overlap hands the SAME pod to every replica (membership
+    churn re-homing a namespace): exactly one replica binds it, every
+    other copy is retired, nothing is lost, nothing double-binds."""
+    from kubernetes_scheduler_tpu.sim.host_gen import gen_host_cluster
+
+    nodes, advisor = gen_host_cluster(16, seed=0)
+    running: list = []
+    fleet = _make_fleet(2, nodes, advisor, running)
+    for pod in _fleet_workload(pods_per=4):
+        fleet.submit(pod)
+    for j in range(5):
+        fleet.submit_overlap(mk_pod(f"overlap-{j}", ns="contested"))
+    ev = fleet.run_sequential(max_cycles=100)
+    assert ev["double_binds"] == 0
+    assert ev["total_binds"] == 8 + 5  # every pod bound exactly once
+    # the 5 losing copies resolved (conflict or filtered pop, depending
+    # on interleaving — sequential drains resolve via the pop filter)
+    assert ev["pods_discarded"] + ev["bind_conflicts_total"] == 5
+    assert sum(len(s.queue) for s in fleet.schedulers) == 0
+
+
+def test_replica_scenario_is_deterministic():
+    """Two runs of the 2-replica conflict storm at the same (seed,
+    scale) produce identical evidence — conflicts included. Wall-time
+    fields are the only legitimate diffs (requeue latency runs on the
+    shared SimClock, so even it must match)."""
+    from kubernetes_scheduler_tpu.sim.scenarios import run
+
+    def storm():
+        out = run("replica-conflict-storm", n_nodes=24, seed=3)
+        for key in ("seconds", "pods_per_sec"):
+            out.pop(key, None)
+        return out
+
+    first, second = storm(), storm()
+    assert first["bind_conflicts"] > 0
+    assert first["double_binds"] == 0
+    assert first == second
+
+
+# ---- membership -----------------------------------------------------------
+
+
+def test_replica_membership_slots(tmp_path):
+    from kubernetes_scheduler_tpu.host.leader import ReplicaMembership
+
+    path = str(tmp_path / "fleet-lease")
+    kw = dict(retry_period=0.05)
+    m0 = ReplicaMembership.on_files(path, 2, **kw)
+    m1 = ReplicaMembership.on_files(path, 2, **kw)
+    assert m0.join(timeout=5) == 0
+    # a second in-process membership must NOT look like the same holder
+    # (identities carry a per-instance sequence number)
+    assert m1.join(timeout=5) == 1
+    assert m0.is_member() and m1.is_member()
+    standby = ReplicaMembership.on_files(path, 2, **kw)
+    assert standby.join(timeout=0.3) is None  # all slots held: stand by
+    m0.leave()
+    assert not m0.is_member()
+    # the freed slot (and ONLY that slot) is claimable again — the
+    # successor resumes partition 0
+    successor = ReplicaMembership.on_files(path, 2, **kw)
+    assert successor.join(timeout=5) == 0
+    m1.leave()
+    successor.leave()

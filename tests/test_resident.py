@@ -42,9 +42,10 @@ def run_workload(
     resident, *, constraints=False, n_nodes=48, n_pods=130, engine=None,
     mutate=None, depth=1, **cfg_kw,
 ):
-    """Drain a backlog cycle by cycle; `mutate(cycle_no, nodes, advisor)`
-    injects deterministic churn at the same points in every run so
-    resident and plain runs stay comparable."""
+    """Drain a backlog cycle by cycle; `mutate(cycle_no, nodes, advisor,
+    sched)` injects deterministic churn at the same points in every run so
+    resident and plain runs stay comparable (node churn plays through the
+    mirror too — informer events own cluster state once it seeds)."""
     nodes, advisor = gen_host_cluster(n_nodes, seed=0, constraints=constraints)
     running: list = []
     sched = make_sched(
@@ -65,7 +66,7 @@ def run_workload(
         seen = len(sched.binder.bindings)
         cycle += 1
         if mutate is not None:
-            mutate(cycle, nodes, advisor)
+            mutate(cycle, nodes, advisor, sched)
     binds = [(b.pod.namespace, b.pod.name, b.node_name)
              for b in sched.binder.bindings]
     return binds, metrics, sched
@@ -108,7 +109,7 @@ def test_resident_parity_metric_churn():
     by value, bindings stay bit-identical, and the delta path keeps
     engaging (metric churn alone must not force full uploads)."""
 
-    def churn(cycle, nodes, advisor):
+    def churn(cycle, nodes, advisor, sched):
         rng = np.random.default_rng(1000 + cycle)
         for nd in nodes[:: 3]:
             advisor.utils[nd.name] = NodeUtil(
@@ -130,13 +131,18 @@ def test_resident_parity_node_add_remove():
     upload — never a stale delta — and bindings match full-upload mode
     with the same events."""
 
-    def events(cycle, nodes, advisor):
+    def events(cycle, nodes, advisor, sched):
         if cycle == 1:
-            nodes.append(make_node("n-late"))
+            late = make_node("n-late")
+            nodes.append(late)
             advisor.utils["n-late"] = NodeUtil(cpu_pct=5.0)
+            if sched.mirror is not None:
+                sched.mirror.apply_node_event("ADDED", late)
         if cycle == 2:
             gone = nodes.pop(0)
             advisor.utils.pop(gone.name, None)
+            if sched.mirror is not None:
+                sched.mirror.apply_node_event("DELETED", gone)
 
     b0, _, _ = run_workload(False, mutate=events)
     b1, _, s1 = run_workload(True, mutate=events)
@@ -237,10 +243,13 @@ def test_resident_backlog_flushes_on_node_churn():
     backlog path to a full upload — never a stale delta — and bindings
     still match the no-resident run with the same events."""
 
-    def events(cycle, nodes, advisor):
+    def events(cycle, nodes, advisor, sched):
         if cycle == 1:
-            nodes.append(make_node("n-late"))
+            late = make_node("n-late")
+            nodes.append(late)
             advisor.utils["n-late"] = NodeUtil(cpu_pct=5.0)
+            if sched.mirror is not None:
+                sched.mirror.apply_node_event("ADDED", late)
 
     b0, _, _ = run_workload(
         False, n_pods=160, max_windows_per_cycle=4, mutate=events

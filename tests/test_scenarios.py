@@ -22,6 +22,8 @@ def test_registry_names_match_and_describe():
         # chaos programs (sim/faults.py): deterministic fault injection
         "advisor-outage", "sidecar-crash-restart", "rpc-flap",
         "disk-full-journal", "mirror-corruption", "compound-storm",
+        # replica fleet (host/replica.py): partitioned-queue conflict storm
+        "replica-conflict-storm",
     }
     for name, cls in SCENARIOS.items():
         assert cls.name == name

@@ -218,6 +218,33 @@ replica-smoke:
 	  $(REPLICA_SMOKE_DIR)/storm/r0
 	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_scheduler_tpu trace replay \
 	  $(REPLICA_SMOKE_DIR)/storm/r1
+	# the SAME storm through the fleet-shared engine (--shared-engine:
+	# one pooled resident engine, cross-replica dispatch coalescing).
+	# Gates: contention semantics intact (conflicts happened and every
+	# loser resolved, zero double binds), the pool actually coalesced
+	# (coalesced_dispatches > 0), and the fleet paid FEWER device
+	# dispatches than scheduler cycles — under a 2-replica storm that is
+	# the dispatches-per-tick < N claim. Both journals replay-pinned
+	# through a PRIVATE engine: shared-engine decisions are bitwise the
+	# decisions a private engine makes.
+	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_scheduler_tpu scenario run \
+	  replica-conflict-storm --nodes 24 --shared-engine \
+	  --trace $(REPLICA_SMOKE_DIR)/storm-shared \
+	  > $(REPLICA_SMOKE_DIR)/summary-shared.out
+	tail -n 1 $(REPLICA_SMOKE_DIR)/summary-shared.out | $(PY) -c "import json,sys; \
+	  s = json.loads(sys.stdin.read()); se = s['shared_engine']; \
+	  assert s['double_binds'] == 0, s; \
+	  assert s['bind_conflicts'] > 0, s; \
+	  assert s['pods_bound'] == s['pods_submitted'], s; \
+	  assert se['coalesced_dispatches'] > 0, se; \
+	  assert se['device_dispatches'] < s['cycles'], (se, s['cycles']); \
+	  print('replica-smoke (shared): conflicts resolved =', s['bind_conflicts'], \
+	        'coalesced =', se['coalesced_dispatches'], \
+	        'dispatches', se['device_dispatches'], '< cycles', s['cycles'])"
+	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_scheduler_tpu trace replay \
+	  $(REPLICA_SMOKE_DIR)/storm-shared/r0
+	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_scheduler_tpu trace replay \
+	  $(REPLICA_SMOKE_DIR)/storm-shared/r1
 
 # end-to-end telemetry round trip on CPU: a sidecar with its own
 # /metrics + span files, a short sim-driven host run with spans + the

@@ -1484,6 +1484,167 @@ def _replica_loop_rate() -> list[dict]:
             "double_binds": ev["double_binds"],
         })
 
+    # -- shared-engine fleet (ONE resident sidecar, coalesced dispatch) --
+    # Same backlog/accounting model as the private rows — N single-host
+    # processes drain their partitions in true parallel, so the quotient
+    # is max per-replica busy seconds — with one refinement: the fused
+    # coalesced execute is ONE device invocation serving every
+    # participant, so its wall time is apportioned evenly across the
+    # requests it carried (each replica's private-engine alternative
+    # would have paid a whole dispatch alone; sharing it IS the win this
+    # row measures). Host-side dispatch/complete work stays charged to
+    # the replica that did it.
+    from kubernetes_scheduler_tpu.engine import snapshot_nbytes
+
+    shared_rows: list = []
+    shared_base = None
+    for n_replicas in (1, 4):
+        running_s: list = []
+        fleet = ReplicaFleet(
+            SchedulerConfig(
+                batch_window=1024,
+                normalizer="none",
+                max_windows_per_cycle=max_windows,
+                adaptive_dispatch=False,
+                min_device_work=1,
+                pipeline_depth=1,
+                shared_engine=True,
+            ),
+            n_replicas=n_replicas,
+            advisor_factory=lambda i: advisor,
+            list_nodes=lambda: nodes,
+            list_running_pods=lambda: running_s,
+        )
+        pool = fleet.engine_pool
+        cursors_s = [0] * n_replicas
+
+        def absorb_s():
+            for k, sched in enumerate(fleet.schedulers):
+                bs = sched.binder.bindings
+                running_s.extend(b.pod for b in bs[cursors_s[k]:])
+                cursors_s[k] = len(bs)
+
+        def backlog_s(seed_):
+            for j, pod in enumerate(gen_host_pods(n_pods, seed=seed_)):
+                pod.name = f"{pod.name}-s{seed_}"
+                pod.namespace = tenants[j % 4]
+                fleet.submit(pod)
+
+        round_walls: list = []
+        round_bound: list = []
+        rounds = [0]
+
+        def drain_s(measure: bool):
+            for _ in range(256):
+                live = [
+                    (k, s) for k, s in enumerate(fleet.schedulers)
+                    if len(s.queue) or s._prefetched is not None
+                ]
+                if not live:
+                    break
+                rounds[0] += measure
+                bound_before = sum(
+                    len(s.binder.bindings) for s in fleet.schedulers
+                )
+                exec0 = pool.execute_seconds
+                charge = {}
+                handles = []
+                for k, s in live:
+                    t0 = time.perf_counter()
+                    handles.append((k, s.run_cycle_split()))
+                    charge[k] = time.perf_counter() - t0
+                t_complete = {}
+                for k, h in handles:
+                    t0 = time.perf_counter()
+                    h.complete()
+                    t_complete[k] = time.perf_counter() - t0
+                dev = pool.execute_seconds - exec0
+                if measure:
+                    # the fused execute landed inside ONE leader's
+                    # complete(): strip it there, then charge every
+                    # participant an even share of the shared dispatch
+                    lead = max(t_complete, key=t_complete.get)
+                    t_complete[lead] = max(t_complete[lead] - dev, 0.0)
+                    share = dev / max(len(handles), 1)
+                    for k, _ in handles:
+                        charge[k] += t_complete[k] + share
+                    round_walls.append(max(charge.values()))
+                    round_bound.append(
+                        sum(len(s.binder.bindings) for s in fleet.schedulers)
+                        - bound_before
+                    )
+                absorb_s()
+
+        backlog_s(1)
+        drain_s(False)  # warmup: compiles; populates `running_s`
+        # second warmup backlog: the first round's replica snapshots are
+        # identical (zero-delta elements); once the mirrors diverge the
+        # fleet program's element structure carries real deltas — a
+        # DIFFERENT jit signature whose compile must not land measured
+        backlog_s(99)
+        drain_s(False)
+        bound0 = fleet.evidence()["total_binds"]
+        st0 = pool.stats()
+        for s in range(2, 2 + samples):
+            backlog_s(s)
+            drain_s(True)
+        ev = fleet.evidence()
+        st = pool.stats()
+        bound = ev["total_binds"] - bound0
+        # rate from the MEDIAN round (same reasoning as the host-loop
+        # p50 companions): delta row buckets occasionally cross a
+        # power-of-two during measured rounds, and that round's one-time
+        # XLA recompile is a cache event, not the steady-state cost the
+        # scaling gate compares
+        wall_p50 = float(np.percentile(round_walls, 50))
+        bound_p50 = float(np.percentile(round_bound, 50))
+        rate = bound_p50 / max(wall_p50, 1e-9)
+        if shared_base is None:
+            shared_base = rate
+        dispatches = st["device_dispatches"] - st0["device_dispatches"]
+        shared_bytes = sum(st["upload_bytes"].values()) - sum(
+            st0["upload_bytes"].values()
+        )
+        # what the SAME measured traffic costs with private engines: one
+        # full snapshot upload per replica-dispatch (the non-resident
+        # fleet rows above device_put the whole snapshot every cycle)
+        s0 = fleet.schedulers[0]
+        snap_bytes = snapshot_nbytes(
+            s0.builder.build_snapshot(
+                nodes, s0.advisor.fetch(), running_s, ephemeral=True
+            )
+        )
+        # one dispatch per live replica-round under private engines
+        private_bytes = rounds[0] * n_replicas * snap_bytes
+        row = {
+            "metric": f"host_loop_{n_nodes}nodes_replicas{n_replicas}_shared",
+            "replicas": n_replicas,
+            "pods_bound": bound,
+            "aggregate_pods_per_sec": round(rate, 1),
+            "scaling_x": round(rate / max(shared_base, 1e-9), 2),
+            "round_wall_p50_ms": round(1e3 * wall_p50, 2),
+            "rounds": rounds[0],
+            "device_dispatches": dispatches,
+            "dispatches_per_round": round(dispatches / max(rounds[0], 1), 2),
+            "coalesced_dispatches": st["coalesced_dispatches"]
+            - st0["coalesced_dispatches"],
+            "uploads": {
+                k: st["uploads"][k] - st0["uploads"][k]
+                for k in ("full", "delta", "dedup")
+            },
+            # per-fleet bytes actually shipped vs what N private engines
+            # ship for the same traffic — the <= ~1/N dedupe gate
+            "snapshot_upload_bytes": shared_bytes,
+            "private_engine_upload_bytes": private_bytes,
+            "upload_bytes_vs_private": round(
+                shared_bytes / max(private_bytes, 1), 4
+            ),
+            "double_binds": ev["double_binds"],
+        }
+        if n_replicas == 4:
+            row["scaling_x_4"] = row["scaling_x"]
+        shared_rows.append(row)
+
     # -- conflict storm (deterministic; evidence for the headline row) --
     ns0 = next(
         f"tenant-{i}" for i in range(64)
@@ -1536,6 +1697,51 @@ def _replica_loop_rate() -> list[dict]:
         sched.drain_pipeline()
     sev = storm.evidence()
 
+    # -- shared-engine storm: the same deterministic conflict program
+    # through ONE pooled engine — under contention the fleet must still
+    # resolve every loser (no pod lost, no double bind) while the pool
+    # coalesces the per-tick dispatches below one-per-replica
+    storm2_running: list = []
+    storm2 = ReplicaFleet(
+        SchedulerConfig(
+            batch_window=32,
+            normalizer="none",
+            max_windows_per_cycle=1,
+            pipeline_depth=1,
+            adaptive_dispatch=False,
+            min_device_work=1,
+            shared_engine=True,
+        ),
+        n_replicas=2,
+        advisor_factory=lambda i: advisor,
+        list_nodes=lambda: nodes,
+        list_running_pods=lambda: storm2_running,
+    )
+    for j in range(32):
+        storm2.submit(_storm_pod(f"filler-{j}", 10))
+    for j in range(n_overlap):
+        storm2.submit_overlap(_storm_pod(f"overlap-{j}", 5))
+    storm_ticks = 0
+    for _ in range(64):
+        live = [
+            s for s in storm2.schedulers
+            if len(s.queue) or s._prefetched is not None
+        ]
+        if not live:
+            break
+        storm_ticks += 1
+        handles = [s.run_cycle_split() for s in live]
+        progressed = False
+        for h in handles:
+            m = h.complete()
+            progressed |= m.pods_bound > 0 or m.pods_dropped > 0
+        if not progressed:
+            break
+    for sched in storm2.schedulers:
+        sched.drain_pipeline()
+    sev2 = storm2.evidence()
+    st2 = storm2.engine_pool.stats()
+
     head = {
         "metric": f"host_loop_{n_nodes}nodes_replicas",
         # HEADLINE = aggregate-throughput scaling at 2 replicas with
@@ -1563,8 +1769,20 @@ def _replica_loop_rate() -> list[dict]:
         "requeue_latency_max_ms": round(
             1e3 * sev["requeue_latency_max_s"], 2
         ),
+        # shared-engine storm: contention semantics intact (no pod lost,
+        # no double bind, every loser resolved) while the pool coalesces
+        # below one dispatch per replica per tick — the <N gate
+        "shared_storm_double_binds": sev2["double_binds"],
+        "shared_storm_pods_lost": 32 + n_overlap - sev2["total_binds"],
+        "shared_storm_bind_conflicts": sev2["bind_conflicts_total"],
+        "shared_storm_ticks": storm_ticks,
+        "shared_storm_device_dispatches": st2["device_dispatches"],
+        "shared_storm_dispatches_per_tick": round(
+            st2["device_dispatches"] / max(storm_ticks, 1), 2
+        ),
+        "shared_storm_coalesced_dispatches": st2["coalesced_dispatches"],
     }
-    return rows + [head]
+    return rows + shared_rows + [head]
 
 
 def _sharded_throughput() -> dict:

@@ -197,6 +197,19 @@ def mutant_unfenced_replica_bind() -> ProtocolModel:
     )
 
 
+def mutant_shared_delta_unfenced() -> ProtocolModel:
+    """The shared pool ships replica B's coalesced dispatch as a
+    row-diff delta WITHOUT checking the resident epoch fence: after a
+    flush/crash dropped the sidecar's base, a blind delta applies
+    against state the engine no longer holds (caught by
+    `shared-delta-fenced` via the ghost variable)."""
+    m = protocols.replica_bind_model()
+    return _swap(
+        m, "dispatch_b",
+        effect=lambda s: protocols._dispatch_effect(s, "b", fenced=False),
+    )
+
+
 # ---- degradation-ladder mutants ------------------------------------------
 
 
@@ -255,6 +268,7 @@ MUTANTS = {
     "fail-keeps-resident-commit": mutant_fail_keeps_resident_commit,
     "dispatch-scores-stale-batch": mutant_dispatch_scores_stale_batch,
     "unfenced-replica-bind": mutant_unfenced_replica_bind,
+    "shared-delta-unfenced": mutant_shared_delta_unfenced,
     "ladder-skips-rung": mutant_ladder_skips_rung,
     "promote-without-probe": mutant_promote_without_probe,
 }

@@ -41,6 +41,16 @@ Abstraction notes, per model:
   pop_window/bind_lose/drop_bound, BindTable.try_bind, and the
   binder's 404/409 arm the conflict raise lands in) — anchor drift
   fails lint, so the model is a proof about the code that runs.
+  Extended for the fleet-shared engine (host/engine_pool.py): each
+  replica's window now DISPATCHES through the shared pool before it
+  can bind, and the pool's resident epoch is modeled RELATIVELY (the
+  same abstraction `client-session` uses for `srv_sess`): `pool_base`
+  says whether the sidecar retains the pool's current delta base
+  ("held") or a flush/crash dropped it ("none"). `stale_delta` is a
+  ghost variable: it can only become True if a coalesced dispatch
+  ever ships a row-diff delta against a base the sidecar no longer
+  holds — the bug class the pool's epoch fence (classify-full on a
+  dropped base) exists to prevent.
 """
 
 from __future__ import annotations
@@ -62,6 +72,7 @@ _QUEUE = "kubernetes_scheduler_tpu/host/queue.py"
 _SNAP = "kubernetes_scheduler_tpu/host/snapshot.py"
 _RESIL = "kubernetes_scheduler_tpu/host/resilience.py"
 _REPLICA = "kubernetes_scheduler_tpu/host/replica.py"
+_POOL = "kubernetes_scheduler_tpu/host/engine_pool.py"
 _FAULTS = "kubernetes_scheduler_tpu/sim/faults.py"
 
 # ---- model 1: RemoteEngine client session / sidecar session state --------
@@ -617,7 +628,10 @@ def pipeline_slot_model() -> ProtocolModel:
             writes=frozenset({"inflight", "spec", "resident_ok",
                               "last_fail", "fail_budget"}),
             anchors=(
-                Anchor(_SCHED, "Scheduler._run_cycle_pipelined",
+                # the failure arm moved into the split-phase completion
+                # when run_cycle_split/complete grew the dispatch seam
+                # (fleet-shared engine PR); the obligations are the same
+                Anchor(_SCHED, "Scheduler._complete_cycle_split",
                        must_contain=("_invalidate_resident",
                                      "_discard_speculative")),
             ),
@@ -680,14 +694,15 @@ def pipeline_slot_model() -> ProtocolModel:
 def _bind_win(r):
     def guard(s):
         return (
-            s[f"r{r}"] == "holds" and s["pod_bound"] == ""
+            s[f"r{r}"] == "holds" and s[f"disp_{r}"]
+            and s["pod_bound"] == ""
             and s[f"seen_{r}"] == s["pod_epoch"]
         )
 
     def effect(s):
         return {
             "pod_bound": r, "pod_epoch": s["pod_epoch"] + 1,
-            f"r{r}": "idle",
+            f"r{r}": "idle", f"disp_{r}": False,
         }
 
     return guard, effect
@@ -695,16 +710,32 @@ def _bind_win(r):
 
 def _bind_lose(r):
     def guard(s):
-        return s[f"r{r}"] == "holds" and not (
+        return s[f"r{r}"] == "holds" and s[f"disp_{r}"] and not (
             s["pod_bound"] == "" and s[f"seen_{r}"] == s["pod_epoch"]
         )
 
     def effect(s):
         # first bind wins; the loser requeues its copy via
         # restore_window and retries from the queue
-        return {f"r{r}": "idle", f"avail_{r}": True}
+        return {f"r{r}": "idle", f"avail_{r}": True, f"disp_{r}": False}
 
     return guard, effect
+
+
+def _dispatch_effect(s, r, *, fenced: bool = True):
+    """One coalesced dispatch through the shared pool for replica
+    `r`'s held window. With the shipped fence, a row-diff delta ships
+    ONLY while the sidecar retains the pool's current base
+    (`_classify` returns "full" on a dropped base); either way the
+    dispatch re-establishes the base at the advanced epoch. The
+    mutant harness flips `fenced` to ship the delta blindly."""
+    ships_delta = s["pool_base"] == "held" if fenced else True
+    return {
+        f"disp_{r}": True,
+        "pool_base": "held",
+        "stale_delta": s["stale_delta"]
+        or (ships_delta and s["pool_base"] != "held"),
+    }
 
 
 def replica_bind_model() -> ProtocolModel:
@@ -737,13 +768,41 @@ def replica_bind_model() -> ProtocolModel:
                 ),
             ),
             Transition(
+                name=f"dispatch_{r}",
+                process=f"replica_{r}",
+                guard=lambda s, r=r: (
+                    s[f"r{r}"] == "holds" and not s[f"disp_{r}"]
+                ),
+                effect=lambda s, r=r: _dispatch_effect(s, r),
+                reads=frozenset({f"r{r}", f"disp_{r}", "pool_base",
+                                 "stale_delta"}),
+                writes=frozenset({f"disp_{r}", "pool_base",
+                                  "stale_delta"}),
+                anchors=(
+                    # the executor drains every queued replica window
+                    # into one fused dispatch; the base delta is
+                    # classified against the pool's retained copy —
+                    # a dropped base (flush raced) classifies "full"
+                    Anchor(_POOL, "SharedEnginePool._settle",
+                           must_contain=("self._executing = True",)),
+                    Anchor(_POOL, "SharedEnginePool._execute_group",
+                           must_contain=(
+                               "self._classify(self._prev, base)",
+                           ),
+                           calls=("snapshot_delta",)),
+                    Anchor(_POOL, "SharedEnginePool._classify",
+                           must_contain=("prev is None",)),
+                ),
+            ),
+            Transition(
                 name=f"bind_win_{r}",
                 process=f"replica_{r}",
                 guard=wg,
                 effect=we,
-                reads=frozenset({f"r{r}", "pod_bound", f"seen_{r}",
-                                 "pod_epoch"}),
-                writes=frozenset({"pod_bound", "pod_epoch", f"r{r}"}),
+                reads=frozenset({f"r{r}", f"disp_{r}", "pod_bound",
+                                 f"seen_{r}", "pod_epoch"}),
+                writes=frozenset({"pod_bound", "pod_epoch", f"r{r}",
+                                  f"disp_{r}"}),
                 anchors=(
                     # THE CAS: unbound + current epoch, or rejected;
                     # success installs the winner and advances the epoch
@@ -761,9 +820,9 @@ def replica_bind_model() -> ProtocolModel:
                 process=f"replica_{r}",
                 guard=lg,
                 effect=le,
-                reads=frozenset({f"r{r}", "pod_bound", f"seen_{r}",
-                                 "pod_epoch"}),
-                writes=frozenset({f"r{r}", f"avail_{r}"}),
+                reads=frozenset({f"r{r}", f"disp_{r}", "pod_bound",
+                                 f"seen_{r}", "pod_epoch"}),
+                writes=frozenset({f"r{r}", f"avail_{r}", f"disp_{r}"}),
                 anchors=(
                     Anchor(_REPLICA, "ReplicaCoordinator.bind_lose",
                            calls=("restore_window",)),
@@ -789,21 +848,46 @@ def replica_bind_model() -> ProtocolModel:
                 ),
             ),
         ])
+    t.append(Transition(
+        name="engine_flush",
+        process="env",
+        guard=lambda s: s["flush_budget"] > 0 and s["pool_base"] == "held",
+        effect=lambda s: {
+            "pool_base": "none", "flush_budget": s["flush_budget"] - 1,
+        },
+        reads=frozenset({"pool_base", "flush_budget"}),
+        writes=frozenset({"pool_base", "flush_budget"}),
+        anchors=(
+            # sidecar crash mid-batch (_fail fans the error out and
+            # drops the base) and external invalidation both leave the
+            # pool baseless — the next dispatch MUST re-sync full
+            Anchor(_POOL, "SharedEnginePool._fail",
+                   must_contain=("self._prev = None",)),
+            Anchor(_POOL, "SharedEnginePool.invalidate",
+                   must_contain=("self._prev = None",)),
+        ),
+    ))
     return ProtocolModel(
         name="replica-bind",
         description=(
-            "horizontal scale-out conflict protocol (host/replica.py): "
-            "two scheduler replicas transiently share one pod (partition "
-            "handoff overlap); binds are fenced by the BindTable epoch "
-            "CAS, first bind wins, the loser requeues via restore_window "
-            "and drops on re-pop once the table shows the pod bound"
+            "horizontal scale-out conflict protocol (host/replica.py + "
+            "host/engine_pool.py): two scheduler replicas transiently "
+            "share one pod (partition handoff overlap) and dispatch "
+            "through ONE fleet-shared engine; binds are fenced by the "
+            "BindTable epoch CAS, first bind wins, the loser requeues "
+            "via restore_window and drops on re-pop once the table "
+            "shows the pod bound; the shared resident base is fenced by "
+            "the pool epoch — a flushed base re-syncs full, never a "
+            "blind delta"
         ),
         init={
             "pod_bound": "", "pod_epoch": 0,
             "ra": "idle", "rb": "idle",
             "avail_a": True, "avail_b": True,
             "seen_a": 0, "seen_b": 0,
-            "double_bound": False,
+            "disp_a": False, "disp_b": False,
+            "pool_base": "none", "flush_budget": 2,
+            "double_bound": False, "stale_delta": False,
         },
         transitions=tuple(t),
         invariants=(
@@ -825,6 +909,15 @@ def replica_bind_model() -> ProtocolModel:
                 "a replica holding the pod after someone bound it must "
                 "hold a STALE epoch — its bind attempt is then fenced "
                 "off by the CAS",
+            ),
+            Invariant(
+                "shared-delta-fenced",
+                lambda s: not s["stale_delta"],
+                "a coalesced dispatch ships a row-diff delta only while "
+                "the sidecar retains the pool's current resident base — "
+                "a replica that raced a flush re-syncs with a fenced "
+                "full upload, never a blind delta against state the "
+                "engine no longer holds (SharedEnginePool._classify)",
             ),
         ),
         convergences=(

@@ -122,13 +122,14 @@ THREAD_ROOTS: tuple[ThreadRoot, ...] = (
         name="pipelined-completion",
         thread=MAIN,
         path=f"{_PKG}/host/scheduler.py",
-        func="Scheduler._run_cycle_pipelined",
+        func="Scheduler._complete_cycle_split",
         must_contain=("self._observe_dispatch",),
         calls=("_observe_dispatch",),
         description=(
-            "in-flight completion stage — resolved ON the host loop "
-            "thread (the async handle is awaited there), not a thread "
-            "of its own"
+            "in-flight completion stage — the force half of the "
+            "run_cycle_split seam, resolved ON the thread that calls "
+            "complete() (the host loop, or a fleet drain completing "
+            "replicas in order), not a thread of its own"
         ),
     ),
     ThreadRoot(

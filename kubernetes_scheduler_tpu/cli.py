@@ -253,6 +253,14 @@ def cmd_scheduler(args) -> int:
     )
 
     if args.replicas > 1:
+        if getattr(args, "shared_engine", False) and not cfg.shared_engine:
+            import dataclasses
+
+            cfg = dataclasses.replace(
+                cfg, shared_engine=True,
+                # the coalescing seam is the async-dispatch path
+                pipeline_depth=max(1, cfg.pipeline_depth),
+            )
         return _cmd_scheduler_replicated(args, cfg, nodes, advisor, pods)
 
     engine = None
@@ -587,6 +595,13 @@ def cmd_scenario(args) -> int:
         overrides["gang_scheduling"] = False
     if args.mirror:
         overrides["snapshot_mirror"] = True
+    if args.shared_engine:
+        # fleet-shared device engine (host/engine_pool): replicated
+        # scenarios multiplex every replica onto ONE engine and drain
+        # through the split-phase seam so each round-robin round
+        # coalesces into one device invocation
+        overrides["shared_engine"] = True
+        overrides["pipeline_depth"] = 1
     # a chaos program's own config knobs (sim/faults.py: mirror/
     # resident/stale-TTL/breaker settings its fault plan targets) are
     # the baseline; explicit flags win on conflict
@@ -762,6 +777,14 @@ def build_parser() -> argparse.ArgumentParser:
         "first-bind-wins fencing (sim source; with --lease each "
         "replica joins a membership slot at <lease>.slot<i>)",
     )
+    ps.add_argument(
+        "--shared-engine", dest="shared_engine", action="store_true",
+        help="with --replicas N: multiplex the fleet onto ONE "
+        "Local/Remote engine (host/engine_pool) — one resident "
+        "snapshot, one upload per churn event, concurrent windows "
+        "coalesced into one device invocation; with --engine <addr> "
+        "the fleet shares ONE bridge client/session",
+    )
     ps.add_argument("--lease", help="leader-election lease file path")
     ps.add_argument(
         "--lease-kube",
@@ -910,6 +933,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="streaming state ingestion (snapshot_mirror): the world "
         "drives informer-style events through the event-sourced "
         "snapshot mirror instead of per-cycle rebuilds",
+    )
+    zr.add_argument(
+        "--shared-engine", dest="shared_engine", action="store_true",
+        help="fleet-shared device engine (replicated scenarios): ONE "
+        "resident engine behind host/engine_pool, replicas' windows "
+        "coalesced into one device invocation per round (implies "
+        "--pipeline; no-op for replicas=1 scenarios)",
     )
     zr.add_argument(
         "--no-faults", action="store_true",

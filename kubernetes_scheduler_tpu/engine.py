@@ -24,6 +24,7 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from kubernetes_scheduler_tpu.ops import (
     balanced_cpu_diskio,
@@ -482,6 +483,68 @@ class SnapshotDelta(NamedTuple):
     # pref_attract, pref_avoid
     dom_vals: jnp.ndarray
     node_mask: jnp.ndarray  # [n] bool (cheap; shipped whole every delta)
+
+
+def _delta_row_chunks(rows, vals, sentinel: int, chunk: int):
+    """Split a changed-row vector (+ its value block) into fixed-`chunk`
+    slices, the short tail sentinel-padded. The fleet applier scatters
+    per chunk so its jit cache keys on ONE shape per leaf family — a
+    growing cluster walks the power-of-two delta buckets upward and an
+    unchunked eager apply would recompile every scatter at every
+    crossing (seconds per coalesced dispatch on a cold bucket), while
+    chunked slices hit the cache forever after first use. Sentinel rows
+    are out of range and dropped by the scatter's mode="drop"."""
+    rows = np.asarray(rows)
+    vals = np.asarray(vals)
+    k = len(rows)
+    out = []
+    for i in range(0, max(k, 1), chunk):
+        r, v = rows[i : i + chunk], vals[i : i + chunk]
+        if len(r) < chunk:
+            rp = np.full(chunk, sentinel, np.int32)
+            rp[: len(r)] = r
+            vp = np.zeros((chunk,) + v.shape[1:], vals.dtype)
+            vp[: len(v)] = v
+            r, v = rp, vp
+        out.append((r, v))
+    return out
+
+
+def _apply_delta_rows_chunked(
+    snapshot: SnapshotArrays, delta: SnapshotDelta, *, chunk: int = 128
+) -> SnapshotArrays:
+    """Bitwise twin of `_apply_delta_rows` for the EAGER fleet path
+    (schedule_batch_fleet): same row sets by value, but scattered in
+    fixed-shape chunks so per-element deltas of any bucket size reuse
+    one compiled scatter per leaf. Row indices within a delta are
+    unique by construction and sentinel pads drop, so chunk boundaries
+    cannot change the result."""
+    n = int(snapshot.node_mask.shape[0])
+    requested = snapshot.requested
+    for r, v in _delta_row_chunks(delta.req_rows, delta.req_vals, n, chunk):
+        requested = requested.at[r].set(v, mode="drop")
+    util = [
+        snapshot.disk_io, snapshot.cpu_pct, snapshot.mem_pct,
+        snapshot.net_up, snapshot.net_down,
+    ]
+    for r, v in _delta_row_chunks(delta.util_rows, delta.util_vals, n, chunk):
+        for col in range(5):
+            util[col] = util[col].at[r].set(v[:, col], mode="drop")
+    dom = [
+        snapshot.domain_counts, snapshot.avoid_counts,
+        snapshot.pref_attract, snapshot.pref_avoid,
+    ]
+    for r, v in _delta_row_chunks(delta.dom_rows, delta.dom_vals, n, chunk):
+        for col in range(4):
+            dom[col] = dom[col].at[r].set(v[:, :, col], mode="drop")
+    return snapshot._replace(
+        requested=requested,
+        disk_io=util[0], cpu_pct=util[1], mem_pct=util[2],
+        net_up=util[3], net_down=util[4],
+        domain_counts=dom[0], avoid_counts=dom[1],
+        pref_attract=dom[2], pref_avoid=dom[3],
+        node_mask=jnp.asarray(delta.node_mask),
+    )
 
 
 def _apply_delta_rows(
@@ -986,6 +1049,32 @@ class LocalEngine:
             )
         )
 
+    def schedule_batch_fleet(
+        self, snapshot, requests, *, delta=None, epoch=None, **kw
+    ) -> tuple:
+        """Coalesced fleet dispatch (host/engine_pool.SharedEnginePool):
+        one invocation schedules every (delta | None, pods) request in
+        `requests` against the shared base `snapshot`, each element's
+        delta applied functionally inside the program (see the free
+        schedule_batch_fleet). With `epoch` given the base rides the
+        resident front half — an applicable `delta` folds into the
+        retained state (donated scatter, no [n, r] upload) and a
+        mismatch flushes to a full upload, exactly the
+        schedule_resident semantics; epoch=None schedules against the
+        uploaded `snapshot` without retaining it. The retained layout
+        is never injected: per-element deltas would invalidate it, and
+        in-kernel prep is parity-pinned (PARITY round 15)."""
+        if epoch is None:
+            snap = self._consts.swap(snapshot)
+        else:
+            st, kw = self._resident_dispatch(snapshot, delta, epoch, kw)
+            snap = st.snapshot
+        kw.pop("layout", None)
+        reqs = tuple((d, self._consts.swap(p)) for d, p in requests)
+        return self._maybe_profile(
+            lambda: schedule_batch_fleet(snap, reqs, **kw)
+        )
+
     def preempt(self, snapshot, pods, victims, *, k_cap: int):
         return preempt_batch(snapshot, pods, victims, k_cap=k_cap)
 
@@ -1471,6 +1560,69 @@ def schedule_batch(
         assigner=assigner, affinity_aware=affinity_aware, soft=soft,
         auction_rounds=auction_rounds, auction_price_frac=auction_price_frac,
     )
+
+
+def schedule_batch_fleet(
+    snapshot: SnapshotArrays,
+    requests: tuple,
+    *,
+    policy: str = "balanced_cpu_diskio",
+    assigner: str = "greedy",
+    normalizer: str = "min_max",
+    fused: bool = False,
+    affinity_aware: bool = True,
+    soft: bool = False,
+    auction_rounds: int = 1024,
+    auction_price_frac: float = 1.0,
+    score_plugins: tuple | None = None,
+) -> tuple:
+    """N independent scheduling cycles in ONE device invocation — the
+    coalesced super-batch behind host/engine_pool.SharedEnginePool.
+
+    `requests` is a tuple of (delta | None, pods) pairs, one per origin
+    replica: each window is scored against `snapshot` with its own
+    optional SnapshotDelta applied FUNCTIONALLY first (row sets by
+    value, never donated — the shared base is untouched), so every
+    replica sees exactly the cluster state its private engine would
+    have scored, bit for bit, while the fleet ships the common base
+    once and only the per-replica divergence rows ride per element.
+
+    Deliberately NOT wrapped in an outer jit: a fleet-wide program
+    would key its signature on every element's delta bucket, and a
+    growing cluster walking the power-of-two buckets upward recompiles
+    the whole program at every crossing — seconds per coalesced
+    dispatch, paid exactly when the fleet is busiest. Instead each
+    element's delta folds in through fixed-shape chunked scatters
+    (`_apply_delta_rows_chunked` — one compiled scatter per leaf
+    family, forever) and the element schedules through the SAME cached
+    jitted `schedule_batch` a private engine would run; the group still
+    costs one pool dispatch/one RPC, and only the shared base crosses
+    the host boundary once. The elements are mutually independent — no
+    capacity or affinity coupling crosses them — which is what keeps
+    first-bind-wins union parity unchanged (the BindTable, not the
+    device, resolves races).
+    `layout` is deliberately not threaded through: a per-element delta
+    invalidates retained kernel-layout buffers, and the fused kernel's
+    in-kernel prep is binding-parity-pinned against the injected-layout
+    path (PARITY.md round 15)."""
+    out = []
+    for delta, pods in requests:
+        snap = (
+            snapshot
+            if delta is None
+            else _apply_delta_rows_chunked(snapshot, delta)
+        )
+        out.append(
+            schedule_batch(
+                snap, pods,
+                policy=policy, assigner=assigner, normalizer=normalizer,
+                fused=fused, affinity_aware=affinity_aware, soft=soft,
+                auction_rounds=auction_rounds,
+                auction_price_frac=auction_price_frac,
+                score_plugins=score_plugins,
+            )
+        )
+    return tuple(out)
 
 
 def normalize_scores(

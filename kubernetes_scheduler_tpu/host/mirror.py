@@ -456,6 +456,20 @@ class SnapshotMirror:
                                 not in self.builder.selectors
                             ):
                                 self.builder._selector_id(term)
+                        # topology-spread selectors: BOTH
+                        # whenUnsatisfiable variants (DoNotSchedule
+                        # hard, ScheduleAnyway soft) intern through the
+                        # same canonical selector_key, so a bound pod
+                        # arriving with either variant of a fresh
+                        # spread selector extends the column in place
+                        # (the fill scans running before this pod joins;
+                        # _apply_pod_domains then counts it once) — an
+                        # out-of-band bind with spread constraints used
+                        # to leave the selector unminted until a window
+                        # used it
+                        for sc in pod.topology_spread:
+                            if selector_key(sc) not in self.builder.selectors:
+                                self.builder._selector_id(sc)
                     if not self._extend_selectors():
                         self._mark_flush("layout-drift")
                 self._running_keys[key] = pod

@@ -312,6 +312,14 @@ SHIPPED_METRICS = (
     # loser requeued through restore_window, never a lost pod)
     "replica_binds_total",
     "bind_conflicts_total",
+    # fleet-shared device engine (host/engine_pool.SharedEnginePool):
+    # device dispatches that carried >= 2 replicas' windows in one
+    # coalesced super-batch, windows per dispatch, and snapshot uploads
+    # by kind (`upload`: full = base resync, delta = changed rows once
+    # per fleet, dedup = zero-row epoch advance)
+    "coalesced_dispatches_total",
+    "coalesce_batch_window_count",
+    "shared_engine_uploads_total",
 )
 
 

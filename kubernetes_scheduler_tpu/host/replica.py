@@ -378,6 +378,24 @@ class ReplicaFleet:
             prefer_native=prefer_native,
             **({"clock": queue_clock} if queue_clock is not None else {}),
         )
+        # fleet-shared device engine (config.shared_engine): ONE
+        # Local/Remote engine behind a SharedEnginePool, each replica
+        # wired through a per-replica view — one resident snapshot and
+        # one upload per churn event for the whole fleet, concurrent
+        # windows coalesced into one device invocation. engine_factory
+        # is consulted ONCE (replica 0) for the pool's inner engine;
+        # decisions stay bit-identical to private engines (PARITY.md
+        # round 20), so the BindTable protocol above is untouched.
+        self.engine_pool = None
+        if getattr(config, "shared_engine", False):
+            from kubernetes_scheduler_tpu.host.engine_pool import (
+                SharedEnginePool,
+            )
+
+            self.engine_pool = SharedEnginePool(
+                engine_factory(0) if engine_factory else None,
+                coalesce_window_ms=config.coalesce_window_ms,
+            )
         self.coordinators: list[ReplicaCoordinator] = []
         self.schedulers = []
         for i in range(n_replicas):
@@ -410,7 +428,11 @@ class ReplicaFleet:
                 evictor=evictor_factory(i) if evictor_factory else None,
                 list_nodes=list_nodes,
                 list_running_pods=list_running_pods,
-                engine=engine_factory(i) if engine_factory else None,
+                engine=(
+                    self.engine_pool.view(name)
+                    if self.engine_pool is not None
+                    else engine_factory(i) if engine_factory else None
+                ),
                 queue_clock=queue_clock,
                 queue=coord,
             )
@@ -473,6 +495,18 @@ class ReplicaFleet:
                 raise e
         return self.evidence(results)
 
+    def run_round_split(self) -> list:
+        """One deterministic fleet round through the split-phase cycle
+        seam (Scheduler.run_cycle_split): dispatch EVERY replica's
+        window first, then complete them in order. With a shared engine
+        all N windows sit in the pool's queue when the first force
+        arrives, so the round coalesces into one device invocation —
+        round-robin harnesses get the coalescing a threaded fleet gets
+        from timing, deterministically. Works (as a plain pipelined
+        cycle per replica) with private engines too."""
+        handles = [s.run_cycle_split() for s in self.schedulers]
+        return [h.complete() for h in handles]
+
     def run_sequential(self, *, max_cycles: int = 1000) -> dict:
         """Drain replicas one at a time, timing each drain — the
         deterministic scaling probe. N single-host processes would run
@@ -513,6 +547,8 @@ class ReplicaFleet:
             "requeue_latency_mean_s": (sum(lat) / len(lat)) if lat else 0.0,
             "requeue_latency_max_s": max(lat) if lat else 0.0,
         }
+        if self.engine_pool is not None:
+            ev["shared_engine"] = self.engine_pool.stats()
         if results is not None:
             ev["replica_results"] = results
         return ev

@@ -216,6 +216,35 @@ class _InFlight:
     trace_ctx: dict | None = None
 
 
+class _PendingCycle:
+    """Handle from Scheduler.run_cycle_split(): the dispatch half has
+    run; .complete() forces the in-flight engine call (with the full
+    fallback chain) and finishes the cycle. Cycles that never reached
+    the device (scalar, backlog, empty queue, failed dispatch) arrive
+    already completed and .complete() just returns their metrics.
+    Complete every handle exactly once, before the next run_cycle/
+    run_cycle_split on the same scheduler."""
+
+    __slots__ = ("_sched", "_m", "_flight")
+
+    def __init__(self, sched, m, flight):
+        self._sched = sched
+        self._m = m
+        self._flight = flight  # None => cycle already finished
+
+    @property
+    def dispatched(self) -> bool:
+        """True while an engine call is in flight for this cycle."""
+        return self._flight is not None
+
+    def complete(self):
+        if self._flight is None:
+            return self._m
+        start, infl, t0 = self._flight
+        self._flight = None
+        return self._sched._complete_cycle_split(self._m, start, infl, t0)
+
+
 class Scheduler:
     def __init__(
         self,
@@ -356,6 +385,10 @@ class Scheduler:
         self.builder = SnapshotBuilder(
             extended_resources=list(config.extended_resources),
             gang_scheduling=config.gang_scheduling,
+            # warm-restart pre-size (`trace stats` peak_selector_slots):
+            # start the selector bucket at the prior run's peak so the
+            # early power-of-two crossings never flush the mirror
+            initial_selectors=config.mirror_initial_selectors,
         )
         # event-driven cycle triggering (config.cycle_trigger="event"):
         # queue pushes and mirror events notify the trigger the host
@@ -1435,11 +1468,32 @@ class Scheduler:
         mid-flight drains the pipeline and falls back to scalar for this
         window exactly once; the preemption pass runs in the completion
         stage against real — never speculative — capacity."""
+        return self.run_cycle_split().complete()
+
+    def run_cycle_split(self) -> "_PendingCycle":
+        """The dispatch half of a pipelined cycle as a first-class seam:
+        begin the cycle, launch the engine call asynchronously, overlap
+        the prefetch, and return a handle whose .complete() forces the
+        result and finishes the cycle. run_cycle_split().complete() is
+        exactly _run_cycle_pipelined().
+
+        This is the fleet-shared-engine dispatch seam
+        (host/engine_pool.SharedEnginePool): a round-robin fleet drain
+        calls run_cycle_split() on EVERY replica before completing any,
+        so all N windows sit in the pool's queue when the first force
+        arrives and the round coalesces into one device invocation —
+        deterministically, without relying on thread timing. Non-device
+        paths (scalar, deep backlog, empty queue, dispatch failure)
+        finish inside this call and return an already-completed handle.
+
+        Between dispatch and complete() the scheduler must not start
+        another cycle: builder/mirror state snapshotted at dispatch is
+        what the in-flight call scores."""
         m = CycleMetrics()
         t0 = time.perf_counter()
         start = self._begin_cycle(m, t0, window=self._take_prefetched())
         if start is None:
-            return m
+            return _PendingCycle(self, m, None)
         if not (
             self.config.feature_gates.tpu_batch_score
             and start.nodes
@@ -1451,7 +1505,7 @@ class Scheduler:
             self._discard_speculative(m)
             self._run_paths(start, m)
             self._finish_cycle(start, m, t0)
-            return m
+            return _PendingCycle(self, m, None)
         try:
             infl = self._dispatch_window(
                 start.window, start.nodes, start.running, start.utils, m,
@@ -1473,7 +1527,7 @@ class Scheduler:
             )
             self._observe_dispatch(start, m)
             self._finish_cycle(start, m, t0)
-            return m
+            return _PendingCycle(self, m, None)
         # overlap: next-cycle host work while the engine runs — this is
         # the serialized host time the strictly alternating loop paid
         # on the critical path (BENCH_r05: ~65 ms of a 168 ms cycle)
@@ -1481,6 +1535,11 @@ class Scheduler:
         self._prefetch_next()
         m.host_overlap_seconds = time.perf_counter() - t_prep
         self._span("host_overlap", t_prep, t_prep + m.host_overlap_seconds)
+        return _PendingCycle(self, m, (start, infl, t0))
+
+    def _complete_cycle_split(self, m, start, infl, t0) -> CycleMetrics:
+        """The force half of run_cycle_split (shared with the inline
+        pipelined loop through _PendingCycle.complete)."""
         try:
             self._complete_window(
                 infl, start.window, start.nodes, m,

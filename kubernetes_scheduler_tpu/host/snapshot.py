@@ -509,6 +509,13 @@ class SnapshotBuilder:
     # build_pod_batch's per-pod image-id lists
     images: Interner = field(default_factory=Interner)
     selectors: dict[tuple, int] = field(default_factory=dict)
+    # pre-sized selector bucket (config.mirror_initial_selectors): a warm
+    # restart that knows the prior run's peak (`trace stats`
+    # peak_selector_slots) starts the power-of-two bucket there, so the
+    # early crossings (1 -> 2 -> 4 -> ...) — each a mirror flush-to-full
+    # and a fresh XLA compile — never happen. Purely a floor: the live
+    # selector count still grows the bucket past it as before
+    initial_selectors: int = 0
     # hostPort conflict state (upstream NodePorts): each distinct hostPort
     # in flight becomes a capacity-1 pseudo-resource column, so the
     # engine's existing capacity machinery (greedy decrement, auction
@@ -1085,7 +1092,10 @@ class SnapshotBuilder:
         return labels_match(pod.labels, parsed[0], parsed[1])
 
     def _selector_slots(self) -> int:
-        return bucket_size(max(len(self.selectors), 1), floor=1, multiple=1)
+        return bucket_size(
+            max(len(self.selectors), self.initial_selectors, 1),
+            floor=1, multiple=1,
+        )
 
     def _domain_counts(
         self,

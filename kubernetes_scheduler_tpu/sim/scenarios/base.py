@@ -493,17 +493,37 @@ def run_scenario_replicated(
             for _ in range(max_cycles_per_tick):
                 progressed = False
                 active = False
-                for sched in fleet.schedulers:
-                    if len(sched.queue) == 0 and sched._prefetched is None:
-                        continue
-                    active = True
-                    m = sched.run_cycle()
-                    cycles += 1
-                    world.absorb_bindings()
-                    # a conflict cycle binds 0 but DROPS its fenced
-                    # copies — that is progress (the queue shrank)
-                    if m.pods_bound > 0 or m.pods_dropped > 0:
-                        progressed = True
+                if fleet.engine_pool is not None:
+                    # shared engine: split-phase round — dispatch EVERY
+                    # live replica before the first force, so the whole
+                    # round's windows coalesce into one device
+                    # invocation (the deterministic round-robin
+                    # equivalent of the timing a threaded fleet gets)
+                    live = [
+                        s for s in fleet.schedulers
+                        if len(s.queue) > 0 or s._prefetched is not None
+                    ]
+                    if live:
+                        active = True
+                        handles = [s.run_cycle_split() for s in live]
+                        for h in handles:
+                            m = h.complete()
+                            cycles += 1
+                            world.absorb_bindings()
+                            if m.pods_bound > 0 or m.pods_dropped > 0:
+                                progressed = True
+                else:
+                    for sched in fleet.schedulers:
+                        if len(sched.queue) == 0 and sched._prefetched is None:
+                            continue
+                        active = True
+                        m = sched.run_cycle()
+                        cycles += 1
+                        world.absorb_bindings()
+                        # a conflict cycle binds 0 but DROPS its fenced
+                        # copies — that is progress (the queue shrank)
+                        if m.pods_bound > 0 or m.pods_dropped > 0:
+                            progressed = True
                 if not active or not progressed:
                     break
         for sched in fleet.schedulers:
@@ -551,6 +571,10 @@ def run_scenario_replicated(
             s.ladder.fully_recovered() for s in fleet.schedulers
         ),
     }
+    if "shared_engine" in evidence:
+        # fleet-shared engine evidence: dispatch coalescing + upload
+        # dedupe (the replica-smoke --shared-engine leg asserts on these)
+        out["shared_engine"] = evidence["shared_engine"]
     if trace_path is not None:
         out["journal"] = trace_path
         out["journals"] = [

@@ -66,6 +66,7 @@ def stats(path: str) -> dict:
     assigned = 0
     delta_records = 0
     full_records = 0
+    peak_sel = 0
     first_seq = last_seq = None
     for rec in read_journal(path):
         records += 1
@@ -76,8 +77,14 @@ def stats(path: str) -> dict:
             assigned += int((np.asarray(a) >= 0).sum())
         if "delta" in rec:
             delta_records += 1
+            dv = rec["delta"].get("dom_vals")
+            if dv is not None and np.asarray(dv).ndim == 3:
+                peak_sel = max(peak_sel, int(np.asarray(dv).shape[1]))
         elif "snapshot" in rec:
             full_records += 1
+            dc = rec["snapshot"].get("domain_counts")
+            if dc is not None and np.asarray(dc).ndim == 2:
+                peak_sel = max(peak_sel, int(np.asarray(dc).shape[1]))
         if first_seq is None:
             first_seq = rec.get("seq")
         last_seq = rec.get("seq")
@@ -92,6 +99,12 @@ def stats(path: str) -> dict:
         "pods_assigned": assigned,
         "snapshot_records": full_records,
         "delta_records": delta_records,
+        # the selector-table width the run peaked at (the snapshot's
+        # domain tables are sized to the power-of-two selector bucket):
+        # feed this to config.mirror_initial_selectors on a warm restart
+        # so the restarted mirror skips the early bucket-crossing
+        # rebuilds the original run already paid for
+        "peak_selector_slots": peak_sel,
     }
 
 

@@ -173,6 +173,34 @@ class SchedulerConfig:
     # (PARITY.md round 15); only in-process engines are built from this
     # knob — a remote sidecar's mesh is its own --mesh-devices flag.
     sharded_engine: bool = False
+    # fleet-shared device engine (host/engine_pool.SharedEnginePool):
+    # every replica of a ReplicaFleet multiplexes its engine traffic
+    # onto ONE Local/Remote engine holding ONE device-resident snapshot
+    # — a churn event uploads once per fleet instead of once per
+    # replica (pool-held base + per-dispatch deltas, epoch-fenced so a
+    # replica that raced a flush transparently re-syncs with a full
+    # upload), and dispatches queued while the device is busy stack
+    # into one coalesced invocation (schedule_batch_fleet) with results
+    # de-multiplexed per replica. Decisions are bit-identical to
+    # private-engine replicas: every stacked window is scored against
+    # ITS OWN snapshot content (base + that replica's functional
+    # delta), so first-bind-wins semantics and union parity are
+    # unchanged (PARITY.md round 20). Only consulted by ReplicaFleet;
+    # a single scheduler ignores it.
+    shared_engine: bool = False
+    # how long a THREADED shared-engine dispatch with no companions yet
+    # waits for other replicas' windows to arrive before dispatching
+    # alone (milliseconds). Only consulted when several fleet threads
+    # are inside the pool concurrently — single-threaded/round-robin
+    # drains never wait, so sequential harnesses pay zero latency.
+    coalesce_window_ms: float = 2.0
+    # pre-size the snapshot/mirror selector bucket (warm restart):
+    # selector tables grow by power-of-two crossings, and every
+    # crossing is a mirror flush-to-full rebuild. `yoda-tpu trace stats`
+    # reports the journal's peak selector count; plumbing it back here
+    # lets a restart allocate the steady-state bucket up front and skip
+    # the early crossing rebuilds entirely. 0 = grow from scratch.
+    mirror_initial_selectors: int = 0
     # streaming state ingestion (host/mirror.SnapshotMirror): informer
     # pod/node/utilization events apply directly to a persistent
     # host-side numpy mirror of the snapshot arrays, and each cycle
@@ -194,14 +222,16 @@ class SchedulerConfig:
     # per-cycle rebuild loop.
     snapshot_mirror: bool = True
     mirror_verify_interval: int = 256
-    # cycle triggering: "tick" (default) keeps the fixed-poll idle waits
-    # of the host loops; "event" arms a CycleTrigger the loops sleep on
-    # — queue pushes and mirror events wake a cycle immediately, the
-    # poll interval degrades to a watchdog timeout (no lost wakeups:
-    # the trigger latches notifies that land between the work check and
-    # the wait). Scheduling decisions are unaffected — only WHEN cycles
-    # run changes.
-    cycle_trigger: str = "tick"
+    # cycle triggering: "event" (default since the flip pinned by
+    # tests/test_trigger.py's default-config parity test) arms a
+    # CycleTrigger the loops sleep on — queue pushes and mirror events
+    # wake a cycle immediately, the poll interval degrades to a
+    # watchdog timeout (no lost wakeups: the trigger latches notifies
+    # that land between the work check and the wait). "tick" restores
+    # the fixed-poll idle waits of the host loops. Scheduling decisions
+    # are unaffected — only WHEN cycles run changes (tick↔event
+    # bindings are bitwise identical under the default config).
+    cycle_trigger: str = "event"
     # gang co-scheduling (ops/gang.py, arXiv:2511.08373): pods labeled
     # scv/gang + scv/gang-size bind all-or-nothing — the engine rescinds
     # every placement of a gang that did not fully fit, and the host

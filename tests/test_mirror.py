@@ -549,6 +549,79 @@ def test_bad_cycle_trigger_rejected():
         _mk_sched(nodes, advisor, [], mirror=False, cycle_trigger="nope")
 
 
+def test_cycle_trigger_event_default_parity_with_tick():
+    """cycle_trigger now defaults to "event": the default config binds
+    bitwise identically to the tick driver (the trigger only decides
+    WHEN the loop wakes, never what a cycle decides)."""
+    assert SchedulerConfig().cycle_trigger == "event"
+    a, ba = _run_workload(mirror=True)  # default config: event
+    b, bb = _run_workload(mirror=True, cycle_trigger="tick")
+    assert ba and ba == bb
+    assert a.trigger is not None and b.trigger is None
+
+
+# ---- selector pre-size + spread intake (warm-restart satellites) ----------
+
+
+def test_mirror_spread_selector_bound_intake_extends_in_place():
+    """A BOUND pod arriving via the informer with a fresh topology-
+    spread selector — EITHER whenUnsatisfiable variant (DoNotSchedule
+    hard, ScheduleAnyway soft) — extends the selector table in place
+    instead of flushing the mirror, and the filled columns verify
+    bitwise against a fresh rebuild."""
+    from kubernetes_scheduler_tpu.host.snapshot import selector_key
+    from kubernetes_scheduler_tpu.host.types import Pod, SpreadConstraint
+
+    nodes, advisor = gen_host_cluster(16, seed=0, constraints=True)
+    running: list = []
+    sched = _mk_sched(nodes, CoalescingAdvisor(advisor), running, mirror=True)
+    # a constraints workload, so the selector bucket has PADDING room:
+    # in-place extension is only possible inside the current
+    # power-of-two width (a crossing is a legitimate flush)
+    for pod in gen_host_pods(90, seed=1, constraints=True):
+        sched.submit(pod)
+    _drain(sched, nodes, running)
+    mir = sched.mirror
+    assert len(mir.builder.selectors) + 2 <= mir.builder._selector_slots()
+    mir.emit([], pending_all_plain=True, prev=None)
+    rebuilds = mir.ctr_rebuilds.total()
+    ext0 = mir.ctr_extensions.value(kind="selector")
+    for i, soft in enumerate((False, True)):  # hard, then soft
+        sc = SpreadConstraint(
+            match_labels={"spread-test": f"v{i}"},
+            topology_key="topology.kubernetes.io/zone",
+            soft=soft,
+        )
+        bound = Pod(
+            name=f"spread-{i}", namespace="d",
+            topology_spread=[sc], node_name=nodes[0].name,
+        )
+        mir.apply_pod_event("ADDED", bound)
+        assert selector_key(sc) in mir.builder.selectors
+    assert mir.ctr_extensions.value(kind="selector") == ext0 + 2
+    assert mir.ctr_rebuilds.total() == rebuilds
+    assert mir.verify()
+
+
+def test_mirror_presize_skips_early_bucket_crossings():
+    """mirror_initial_selectors (fed from `trace stats`
+    peak_selector_slots on a warm restart) floors the power-of-two
+    selector bucket: the presized run never pays the early crossing
+    flushes, and bindings stay bitwise identical to the unsized run."""
+    kw = dict(constraints=True, resident_state=True, pipeline_depth=1)
+    a, ba = _run_workload(mirror=True, **kw)
+    peak = a.builder._selector_slots()
+    assert peak >= 2  # the workload really crossed selector buckets
+    # the unsized run pays flush-to-full rebuilds at the crossings
+    assert a.mirror.ctr_rebuilds.value(reason="layout-drift") >= 1
+    b, bb = _run_workload(mirror=True, mirror_initial_selectors=peak, **kw)
+    assert ba and ba == bb
+    assert b.builder._selector_slots() == peak
+    # with the bucket pre-sized the width never moves mid-run: the
+    # crossing flushes (and their XLA recompiles) disappear
+    assert b.mirror.ctr_rebuilds.value(reason="layout-drift") == 0
+
+
 # ---- scenario harness integration -----------------------------------------
 
 

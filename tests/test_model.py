@@ -184,6 +184,7 @@ _EXPECTED_CATCH = {
     "fail-keeps-resident-commit": "failure-invalidates-resident",
     "dispatch-scores-stale-batch": "stale-spec-batch-never-scored",
     "unfenced-replica-bind": "no-double-bind",
+    "shared-delta-unfenced": "shared-delta-fenced",
     "ladder-skips-rung": "never-skips-a-rung",
     "promote-without-probe": "recovery-re-probes",
 }

@@ -1,0 +1,367 @@
+"""Fleet-shared device engine (host/engine_pool.SharedEnginePool).
+
+The tentpole claims, pinned end to end through ReplicaFleet:
+
+- PARITY (round 20): a fleet multiplexed onto ONE shared engine binds
+  the same pods to the same nodes as the same fleet on private
+  engines — coalescing and upload dedupe change WHERE the work runs,
+  never what a cycle decides.
+- Coalescing: a deterministic round-robin drain through the split-phase
+  seam (run_round_split) fuses the whole round's windows into one
+  device invocation; device dispatches per drain stay strictly below
+  one-per-replica-window.
+- Upload dedupe: churn uploads once per FLEET — the base ships full
+  once, identical co-dispatched snapshots ride as zero-row dedup
+  elements.
+- Failure fan-out: a sidecar crash mid-coalesced-batch delivers the
+  error to EVERY participant (each replica falls back and re-binds its
+  own window — nothing lost, nothing double-bound) and drops the pool
+  base, so the next dispatch re-syncs with a fenced FULL upload (the
+  `shared-delta-fenced` invariant's load-bearing line).
+- Capability state lives in the ONE inner engine: a sidecar capability
+  downgrade is probed/relearned once per fleet drain, not once per
+  replica.
+"""
+
+from kubernetes_scheduler_tpu.host.engine_pool import SharedEnginePool
+from kubernetes_scheduler_tpu.host.queue import namespace_partition
+from kubernetes_scheduler_tpu.host.replica import ReplicaFleet
+from kubernetes_scheduler_tpu.host.types import Container, Pod
+from kubernetes_scheduler_tpu.sim.host_gen import gen_host_cluster
+from kubernetes_scheduler_tpu.utils.config import SchedulerConfig
+
+
+def mk_pod(name, ns, cpu=100.0):
+    return Pod(
+        name=name,
+        namespace=ns,
+        containers=[Container(requests={"cpu": cpu, "memory": 2**28})],
+    )
+
+
+def _tenant_for(residue, n):
+    return next(
+        ns for i in range(256)
+        if namespace_partition(ns := f"tenant-{i}", n) == residue
+    )
+
+
+def _workload(n_replicas, pods_per, tag="w"):
+    # one tenant per partition residue: every replica is guaranteed
+    # traffic, so every fleet round has N windows to coalesce
+    ns_names = [_tenant_for(r, n_replicas) for r in range(n_replicas)]
+    return [
+        mk_pod(f"{tag}{t}-{j}", ns_names[t])
+        for t in range(n_replicas)
+        for j in range(pods_per)
+    ]
+
+
+def _mk_fleet(n_replicas, nodes, advisor, running, *, shared,
+              engine_factory=None, **overrides):
+    cfg = dict(
+        batch_window=8, normalizer="none", adaptive_dispatch=False,
+        min_device_work=0, pipeline_depth=1,
+        # single-window cycles: the multi-window backlog scan carries
+        # state across its own windows and dispatches alone, so only
+        # single-window rounds exercise cross-replica coalescing
+        max_windows_per_cycle=1,
+    )
+    cfg.update(overrides)
+    return ReplicaFleet(
+        SchedulerConfig(shared_engine=shared, **cfg),
+        n_replicas=n_replicas,
+        advisor_factory=lambda i: advisor,
+        list_nodes=lambda: nodes,
+        list_running_pods=lambda: running,
+        engine_factory=engine_factory,
+    )
+
+
+def _drain_rounds(fleet, *, max_rounds=64):
+    """Deterministic split-phase fleet drain: every replica dispatches
+    before any completes, so a shared pool coalesces each round."""
+    rounds = 0
+    while any(
+        len(s.queue) > 0 or s._prefetched is not None
+        for s in fleet.schedulers
+    ):
+        assert rounds < max_rounds, "fleet failed to drain"
+        fleet.run_round_split()
+        rounds += 1
+    for s in fleet.schedulers:
+        s.drain_pipeline()
+    return rounds
+
+
+# ---- parity: shared == private, bit for bit -------------------------------
+
+
+def test_shared_engine_union_binding_parity_with_private():
+    """PARITY round 20: the same 2-replica workload drained on a shared
+    engine and on private engines produces the SAME pod->node map (not
+    just the same bound set). The threaded drain is the real topology —
+    coalescing happens on whatever timing the threads produce, and must
+    be invisible in the decisions."""
+    nodes, advisor = gen_host_cluster(16, seed=0)
+
+    def drain(shared):
+        running: list = []
+        fleet = _mk_fleet(2, nodes, advisor, running, shared=shared)
+        for pod in _workload(2, 12):
+            fleet.submit(pod)
+        ev = fleet.run_until_empty(max_cycles=100)
+        bound = {
+            (b.pod.namespace, b.pod.name): b.node_name
+            for s in fleet.schedulers
+            for b in s.binder.bindings
+        }
+        return ev, bound
+
+    ev_s, bound_s = drain(True)
+    ev_p, bound_p = drain(False)
+    assert ev_s["double_binds"] == 0 == ev_p["double_binds"]
+    assert ev_s["total_binds"] == ev_p["total_binds"] == 24
+    assert bound_s == bound_p
+    st = ev_s["shared_engine"]
+    assert st["device_dispatches"] >= 1
+    # upload dedupe across the fleet: ONE full base sync, every other
+    # dispatch of the unchanged cluster rides as a zero-row dedup (this
+    # workload never mutates nodes/running between cycles)
+    assert st["uploads"]["full"] == 1
+    assert st["uploads"]["dedup"] >= 1
+    assert st["upload_bytes"]["full"] > 0
+    assert st["upload_bytes"]["dedup"] == 0
+    assert "shared_engine" not in ev_p
+
+
+# ---- coalescing: one device invocation per fleet round --------------------
+
+
+def test_round_split_coalesces_fleet_windows():
+    """4 replicas x 2 windows each through the deterministic round
+    drain: each round's 4 windows fuse into ONE device invocation, so
+    the drain's device dispatches stay strictly below the 8 a private
+    fleet would pay."""
+    nodes, advisor = gen_host_cluster(24, seed=0)
+    running: list = []
+    fleet = _mk_fleet(4, nodes, advisor, running, shared=True)
+    for pod in _workload(4, 16):  # batch_window=8 -> 2 windows/replica
+        fleet.submit(pod)
+    _drain_rounds(fleet)
+    ev = fleet.evidence()
+    assert ev["double_binds"] == 0
+    assert ev["pods_discarded"] == 0
+    assert ev["total_binds"] == 64
+    st = ev["shared_engine"]
+    assert st["coalesced_dispatches"] >= 1
+    assert st["device_dispatches"] < 4 * 2  # fused below one-per-window
+    # the fused epochs advanced monotonically with the dispatches
+    assert st["epoch"] == st["device_dispatches"]
+    assert st["uploads"]["full"] == 1  # one base sync for the whole fleet
+    # exporter wiring: the pool's collectors ride every replica's
+    # /metrics surface (view.collectors -> scheduler.prom_collectors),
+    # so the ONE shared pool is visible from all N exporters
+    for replica in (0, 3):
+        body = "\n".join(
+            line
+            for collector in fleet.prom_collectors(replica)
+            for line in collector.render()
+        )
+        assert "yoda_tpu_coalesced_dispatches_total" in body
+        assert "yoda_tpu_coalesce_batch_window_count_bucket" in body
+        assert 'yoda_tpu_shared_engine_uploads_total{upload="full"}' in body
+
+
+# ---- failure fan-out: crash mid-coalesced-batch ---------------------------
+
+
+class _CrashOnSecondFleetCall:
+    """LocalEngine wrapper: the SECOND fused fleet dispatch dies after
+    the round coalesced (sidecar crash mid-batch); every other call
+    serves normally. Crashing on the second call — after a successful
+    round established the pool's resident base — makes the post-crash
+    FULL re-sync observable in the upload accounting."""
+
+    def __init__(self):
+        from kubernetes_scheduler_tpu.engine import LocalEngine
+
+        self._inner = LocalEngine()
+        self.fleet_calls = 0
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def schedule_batch_fleet(self, *args, **kw):
+        self.fleet_calls += 1
+        if self.fleet_calls == 2:
+            raise RuntimeError("sidecar crashed mid-coalesced-batch")
+        return self._inner.schedule_batch_fleet(*args, **kw)
+
+
+def test_sidecar_crash_mid_coalesced_batch_loses_nothing():
+    """A crash inside a coalesced super-batch fans the failure out to
+    EVERY participant: each replica's completion falls back to its own
+    scalar re-schedule of its own window, so no pod is lost and nothing
+    double-binds; the pool drops its base and the next dispatch re-syncs
+    with a fenced full upload."""
+    nodes, advisor = gen_host_cluster(16, seed=0)
+    running: list = []
+    inner = _CrashOnSecondFleetCall()
+    fleet = _mk_fleet(
+        2, nodes, advisor, running, shared=True,
+        engine_factory=lambda i: inner,
+    )
+    for pod in _workload(2, 24):  # 3 windows per replica -> >= 3 rounds
+        fleet.submit(pod)
+    _drain_rounds(fleet)
+    ev = fleet.evidence()
+    assert inner.fleet_calls >= 3  # crashed once, then served fused again
+    assert ev["total_binds"] == 48  # every pod bound exactly once
+    assert ev["double_binds"] == 0
+    assert ev["pods_discarded"] == 0
+    # BOTH participants of the crashed super-batch fell back (the pool
+    # fans the inner failure to every request it coalesced)
+    assert sum(s.totals["fallback_cycles"] for s in fleet.schedulers) >= 2
+    st = ev["shared_engine"]
+    # round 1 synced full; the crash dropped the base (no accounting for
+    # the dead dispatch); the first post-crash dispatch re-synced FULL
+    # instead of shipping a delta against state the engine lost
+    assert st["uploads"]["full"] >= 2
+
+
+# ---- capability state: probed once per fleet ------------------------------
+
+
+class _ProbedInner:
+    """LocalEngine wrapper counting capability probes; flipping
+    `resident` simulates a sidecar capability downgrade."""
+
+    def __init__(self):
+        from kubernetes_scheduler_tpu.engine import LocalEngine
+
+        self._inner = LocalEngine()
+        self.probes = 0
+        self.resident = True
+        self.batch_calls = 0
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def supports_resident(self):
+        self.probes += 1
+        return self.resident
+
+    def schedule_batch(self, *args, **kw):
+        self.batch_calls += 1
+        return self._inner.schedule_batch(*args, **kw)
+
+
+def test_capability_downgrade_relearned_once_per_fleet():
+    """Capability state lives in the ONE inner engine: a 4-replica round
+    costs one capability probe, and after a downgrade the pool relearns
+    it once for the whole fleet — never once per replica."""
+    nodes, advisor = gen_host_cluster(16, seed=0)
+    running: list = []
+    inner = _ProbedInner()
+    fleet = _mk_fleet(
+        4, nodes, advisor, running, shared=True,
+        engine_factory=lambda i: inner,
+    )
+    for pod in _workload(4, 8):  # exactly one window per replica
+        fleet.submit(pod)
+    fleet.run_round_split()
+    assert inner.probes == 1  # 4 windows, ONE probe
+    pool = fleet.engine_pool
+    st = pool.stats()
+    assert st["device_dispatches"] == 1
+    assert st["coalesced_dispatches"] == 1
+    # the fused round: one full base, three identical co-snapshots dedup
+    assert st["uploads"] == {"full": 1, "delta": 0, "dedup": 3}
+
+    inner.resident = False  # the sidecar downgraded mid-run
+    pool.invalidate()
+    for pod in _workload(4, 8, tag="x"):
+        fleet.submit(pod)
+    fleet.run_round_split()
+    ev = fleet.evidence()
+    assert ev["total_binds"] == 64
+    assert ev["double_binds"] == 0
+    # the downgrade was relearned by ONE probe for the whole fleet; the
+    # degraded round forwarded each window plainly through the inner
+    assert inner.probes == 2
+    assert inner.batch_calls == 4
+
+
+# ---- the fleet applier's fixed-shape scatter ------------------------------
+
+
+def test_chunked_delta_apply_bitwise_matches_unchunked():
+    """The fleet path scatters per-element deltas in fixed-shape chunks
+    (one compiled scatter per leaf family instead of one per
+    power-of-two bucket — a growing cluster otherwise recompiles every
+    coalesced dispatch). Chunking must be invisible in the data: every
+    leaf bitwise-equal to the unchunked apply, at chunk sizes that
+    divide, straddle, and exceed the row count."""
+    import numpy as np
+
+    from kubernetes_scheduler_tpu.engine import (
+        _apply_delta_rows,
+        _apply_delta_rows_chunked,
+    )
+    from kubernetes_scheduler_tpu.host.snapshot import (
+        SnapshotBuilder,
+        snapshot_delta,
+    )
+    from kubernetes_scheduler_tpu.sim.host_gen import (
+        gen_host_cluster,
+        gen_host_pods,
+    )
+
+    nodes, advisor = gen_host_cluster(64, seed=0)
+    util = advisor.fetch()
+    pods = gen_host_pods(48, seed=3)
+    names = [n.name for n in nodes]
+    for j, p in enumerate(pods):
+        p.node_name = names[(j * 7) % len(names)]
+    base = SnapshotBuilder().build_snapshot(nodes, util, [], ephemeral=True)
+    new = SnapshotBuilder().build_snapshot(
+        nodes, util, pods, ephemeral=True
+    )
+    delta = snapshot_delta(base, new)
+    assert delta is not None and len(delta.req_rows) > 0
+    # device leaves, as the engine's _consts.swap hands the appliers
+    import jax
+    import jax.numpy as jnp
+
+    base = jax.tree_util.tree_map(jnp.asarray, base)
+    want = _apply_delta_rows(base, delta)
+    for chunk in (1, 8, 13, 128):
+        got = _apply_delta_rows_chunked(base, delta, chunk=chunk)
+        for field in want._fields:
+            assert np.array_equal(
+                np.asarray(getattr(want, field)),
+                np.asarray(getattr(got, field)),
+            ), (chunk, field)
+
+
+# ---- the view surface -----------------------------------------------------
+
+
+def test_view_never_claims_resident_and_invalidate_drops_base():
+    """Replica views deliberately advertise supports_resident()=False —
+    residency is the POOL's job (per-replica resident sessions on one
+    sidecar would fight over the base); invalidate through any view
+    drops the fleet base so the next dispatch re-syncs full."""
+    pool = SharedEnginePool(_ProbedInner(), coalesce_window_ms=0.0)
+    v0, v1 = pool.view("r0"), pool.view("r1")
+    assert v0.supports_resident() is False
+    assert v0.supports_windows_resident() is False
+    assert v0.healthy()
+    pool._prev = {"sentinel": object()}
+    v1.invalidate_resident()
+    assert pool._prev is None
+    v0.close()
+    assert not pool._closed  # refcounted: v1 still open
+    v1.close()
+    assert pool._closed

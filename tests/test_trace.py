@@ -286,6 +286,22 @@ def test_disk_budget_drops_oldest(tmp_path):
 # ---- replay parity --------------------------------------------------------
 
 
+def test_trace_stats_peak_selector_slots(tmp_path):
+    """`trace stats` reports the widest selector table the run shipped
+    (snapshot domain_counts / delta dom_vals widths) — the number a warm
+    restart feeds to config.mirror_initial_selectors so the restarted
+    builder starts past the early bucket-crossing flushes."""
+    path = tmp_path / "journal"
+    _, sched = record_workload(path, constraints=True, n_pods=90)
+    st = tinspect.stats(str(path))
+    assert st["peak_selector_slots"] == sched.builder._selector_slots()
+    assert st["peak_selector_slots"] >= 2
+    # a selector-free workload peaks at the width-1 padding table
+    path2 = tmp_path / "journal-plain"
+    record_workload(path2, n_pods=20)
+    assert tinspect.stats(str(path2))["peak_selector_slots"] <= 1
+
+
 def test_replay_parity_modes(tmp_path):
     """One recorded constraint workload replays with zero binding diffs
     through serial, pipelined, and resident local engines — and the

@@ -5,7 +5,7 @@ PY ?= python
 IMAGE_REPO ?= registry.example.com/yoda-tpu
 TAG ?= latest
 
-.PHONY: local test test-fast bench trace-smoke obs-smoke scenario-smoke chaos-smoke replica-smoke perf-gate perf-baseline lint lint-fast lint-sarif collective-baseline model-check native native-asan native-tsan proto clean build push
+.PHONY: local test test-fast bench trace-smoke obs-smoke scenario-smoke chaos-smoke replica-smoke soak-smoke perf-gate perf-baseline lint lint-fast lint-sarif collective-baseline model-check native native-asan native-tsan proto clean build push
 
 # "make local" in the reference = fmt + vet + compile. Here: byte-compile
 # the package, build the native library, lint, run the fast tests.
@@ -245,6 +245,106 @@ replica-smoke:
 	  $(REPLICA_SMOKE_DIR)/storm-shared/r0
 	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_scheduler_tpu trace replay \
 	  $(REPLICA_SMOKE_DIR)/storm-shared/r1
+
+# shadow-mode serving + soak trend gate, end to end on CPU:
+# 1. a baseline soak run (same seed, no shadow) pins the journal the
+#    primary writes when NOTHING is tailing it;
+# 2. the live run starts in the background and a `yoda-tpu shadow`
+#    process attaches to its journal DIRECTORY as soon as the first
+#    file appears — tailing through every rotation
+#    (trace_file_bytes=64KiB forces several) while the primary is
+#    still writing, re-scoring each cycle through an IDENTICAL
+#    candidate config;
+# 3. the shadow's own /metrics exporter is scraped while it tails
+#    (decision-diff series must be present);
+# 4. the shadow summary must show every record scored with ZERO
+#    divergence, >= 1 rotation followed live, breaker closed — and
+#    `trace diff` pins baseline vs live journal bitwise equal: a
+#    tailing shadow perturbs NOTHING;
+# 5. the BASELINE run's span stream passes `spans report --trend` (no
+#    leak; the live run's spans would carry the colocated shadow's own
+#    CPU contention ramping up, which is drift in the harness, not the
+#    scheduler), a perturb_trend-seeded copy (engine_step durations
+#    ramped 1x->4x over the soak) must FAIL it with exit 1 exactly,
+#    and `trace trend` over the journal must stay clean.
+# tests/test_bench_smoke.py wraps the same flow as a slow-marked test.
+SOAK_SMOKE_DIR ?= /tmp/yoda-soak-smoke
+SOAK_SMOKE_METRICS_PORT ?= 9163
+soak-smoke:
+	rm -rf $(SOAK_SMOKE_DIR)
+	mkdir -p $(SOAK_SMOKE_DIR)
+	printf '{"batch_window": 256, "normalizer": "none", "min_device_work": 1, "adaptive_dispatch": false, "trace_file_bytes": 65536, "cycle_slo_ms": 15000.0}' \
+	  > $(SOAK_SMOKE_DIR)/candidate.json
+	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_scheduler_tpu scenario run soak \
+	  --nodes 16 --seed 0 --trace $(SOAK_SMOKE_DIR)/journal-off \
+	  --spans $(SOAK_SMOKE_DIR)/spans \
+	  > $(SOAK_SMOKE_DIR)/summary-off.out
+	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_scheduler_tpu scenario run soak \
+	  --nodes 16 --seed 0 --trace $(SOAK_SMOKE_DIR)/journal \
+	  > $(SOAK_SMOKE_DIR)/summary.out 2>&1 & echo $$! > $(SOAK_SMOKE_DIR)/scenario.pid
+	for i in `seq 1 240`; do \
+	  ls $(SOAK_SMOKE_DIR)/journal/journal-*.ytrj >/dev/null 2>&1 && break; \
+	  kill -0 `cat $(SOAK_SMOKE_DIR)/scenario.pid` 2>/dev/null \
+	    || { cat $(SOAK_SMOKE_DIR)/summary.out; exit 1; }; \
+	  sleep 0.5; done
+	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_scheduler_tpu shadow \
+	  $(SOAK_SMOKE_DIR)/journal \
+	  --candidate-config $(SOAK_SMOKE_DIR)/candidate.json \
+	  --follow --idle-timeout-s 15 \
+	  --metrics-port $(SOAK_SMOKE_METRICS_PORT) --metrics-host 127.0.0.1 \
+	  --spans $(SOAK_SMOKE_DIR)/shadow-spans \
+	  > $(SOAK_SMOKE_DIR)/shadow.out 2>&1 & echo $$! > $(SOAK_SMOKE_DIR)/shadow.pid
+	for i in `seq 1 120`; do \
+	  $(PY) -c "import urllib.request; \
+	    body = urllib.request.urlopen('http://127.0.0.1:$(SOAK_SMOKE_METRICS_PORT)/metrics', timeout=5).read().decode(); \
+	    assert 'shadow_records_applied_total' in body, body[:400]; \
+	    assert 'shadow_cycles_total' in body, body[:400]" 2>/dev/null \
+	    && { echo 'soak-smoke: shadow exporter scraped live'; break; }; \
+	  test $$i -lt 120 || { echo 'shadow exporter never served'; \
+	    kill `cat $(SOAK_SMOKE_DIR)/shadow.pid` 2>/dev/null; exit 1; }; \
+	  sleep 0.5; done
+	for i in `seq 1 240`; do \
+	  kill -0 `cat $(SOAK_SMOKE_DIR)/scenario.pid` 2>/dev/null || break; sleep 0.5; done
+	for i in `seq 1 360`; do \
+	  kill -0 `cat $(SOAK_SMOKE_DIR)/shadow.pid` 2>/dev/null || break; sleep 0.5; done
+	kill -0 `cat $(SOAK_SMOKE_DIR)/shadow.pid` 2>/dev/null \
+	  && { kill `cat $(SOAK_SMOKE_DIR)/shadow.pid`; exit 1; } || true
+	tail -n 1 $(SOAK_SMOKE_DIR)/shadow.out | $(PY) -c "import json,sys; \
+	  s = json.loads(sys.stdin.read()); \
+	  assert s['records_applied'] > 0, s; \
+	  assert s['cycles'].get('scored') == s['records_applied'], s; \
+	  assert s['bindings_changed'] == 0 and s['divergence_ratio'] == 0.0, s; \
+	  assert s['gangs_diverged'] == 0, s; \
+	  assert s['breaker_state'] == 'closed', s; \
+	  assert s['tail']['rotations_followed'] >= 1, s['tail']; \
+	  print('soak-smoke: shadow scored', s['records_applied'], \
+	        'cycles live, divergence 0, rotations', \
+	        s['tail']['rotations_followed'])"
+	tail -n 1 $(SOAK_SMOKE_DIR)/summary.out | $(PY) -c "import json,sys; \
+	  s = json.loads(sys.stdin.read()); \
+	  assert s['fallback_cycles'] == 0, s"
+	tail -n 1 $(SOAK_SMOKE_DIR)/summary-off.out | $(PY) -c "import json,sys; \
+	  s = json.loads(sys.stdin.read()); \
+	  assert s['slo_breaches'] == 0, s; \
+	  assert s['fallback_cycles'] == 0, s"
+	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_scheduler_tpu trace diff \
+	  $(SOAK_SMOKE_DIR)/journal-off $(SOAK_SMOKE_DIR)/journal
+	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_scheduler_tpu trace replay \
+	  $(SOAK_SMOKE_DIR)/journal
+	# coarse floor at smoke scale (the perf-gate convention): each time
+	# window holds ~15 cycles, so a micro-stage p99 is max-like noise —
+	# 0.2 ms p50 / 2 ms p99 floors are far above that jitter and far
+	# below the 3x-median additive drift the seeded leak plants
+	$(PY) -m kubernetes_scheduler_tpu spans report --trend \
+	  $(SOAK_SMOKE_DIR)/spans --min-ms 0.2
+	$(PY) -c "from kubernetes_scheduler_tpu.trace.trend import perturb_trend; \
+	  perturb_trend('$(SOAK_SMOKE_DIR)/spans', \
+	  '$(SOAK_SMOKE_DIR)/spans-leaky', stage='engine_step', factor=4.0)"
+	$(PY) -m kubernetes_scheduler_tpu spans report --trend \
+	  $(SOAK_SMOKE_DIR)/spans-leaky --min-ms 0.2; \
+	  test $$? -eq 1  # exactly the regression exit — 2 (error) must fail
+	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_scheduler_tpu trace trend \
+	  $(SOAK_SMOKE_DIR)/journal
 
 # end-to-end telemetry round trip on CPU: a sidecar with its own
 # /metrics + span files, a short sim-driven host run with spans + the

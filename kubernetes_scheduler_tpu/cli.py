@@ -536,6 +536,25 @@ def cmd_trace(args) -> int:
         for line in tinspect.dump(args.journal, limit=args.limit):
             print(json.dumps(line))
         return 0
+    if args.trace_cmd == "trend":
+        from kubernetes_scheduler_tpu.trace.recorder import TraceError
+        from kubernetes_scheduler_tpu.trace.trend import (
+            TrendError,
+            journal_trend,
+        )
+
+        try:
+            report = journal_trend(
+                args.journal,
+                windows=args.windows,
+                threshold_pct=args.threshold_pct,
+                min_ms=args.min_ms,
+            )
+        except (TraceError, TrendError) as e:
+            print(json.dumps({"error": str(e)}))
+            return 2
+        print(json.dumps(report))
+        return 0 if report["clean"] else 1
     if args.trace_cmd == "diff":
         report = tinspect.diff(args.journal, args.other)
         print(json.dumps(report))
@@ -638,6 +657,52 @@ def cmd_scenario(args) -> int:
     return 0
 
 
+def cmd_shadow(args) -> int:
+    """Shadow-mode serving (host/shadow.py): tail a live flight-recorder
+    journal and re-score every cycle through a CANDIDATE config, zero
+    writes to the bind path. Prints the decision/latency-diff summary as
+    one JSON line; with --metrics-port the shadow's own exporter serves
+    the divergence series for Prometheus (the continuous rollout gate);
+    --max-divergence-ratio turns the summary into an exit code."""
+    from kubernetes_scheduler_tpu.host.shadow import ShadowScheduler
+    from kubernetes_scheduler_tpu.trace.recorder import last_journal_seq
+
+    cfg = (
+        SchedulerConfig.from_json(args.candidate_config)
+        if args.candidate_config
+        else SchedulerConfig()
+    )
+    resume = args.resume_seq
+    if args.resume_end:
+        resume = last_journal_seq(args.journal)
+    shadow = ShadowScheduler(
+        args.journal,
+        cfg,
+        mode=args.mode,
+        resume_seq=resume,
+        span_path=args.span_path,
+    )
+    if args.metrics_port is not None:
+        port = shadow.serve(args.metrics_port, host=args.metrics_host)
+        print(json.dumps({"shadow_metrics_port": port}), flush=True)
+    try:
+        summary = shadow.run(
+            follow=args.follow,
+            poll_interval_s=args.poll_interval_s,
+            idle_timeout_s=args.idle_timeout_s,
+            limit=args.limit,
+        )
+    finally:
+        shadow.close()
+    print(json.dumps(summary))
+    if (
+        args.max_divergence_ratio is not None
+        and summary["divergence_ratio"] > args.max_divergence_ratio
+    ):
+        return 1
+    return 0
+
+
 def cmd_spans(args) -> int:
     """Span-timeline tooling: `merge` joins host + sidecar span
     directories on the shared trace ids into ONE Perfetto-loadable
@@ -655,6 +720,25 @@ def cmd_spans(args) -> int:
             build_report,
         )
 
+        if args.trend:
+            from kubernetes_scheduler_tpu.trace.trend import (
+                TrendError,
+                build_trend,
+            )
+
+            try:
+                report = build_trend(
+                    args.source,
+                    windows=args.trend_windows,
+                    warmup=args.trend_warmup,
+                    threshold_pct=args.threshold_pct,
+                    min_ms=args.min_ms,
+                )
+            except (AnalyzeError, TrendError) as e:
+                print(json.dumps({"error": str(e)}))
+                return 2
+            print(json.dumps(report))
+            return 0 if report["clean"] else 1
         try:
             report = build_report(args.source)
         except AnalyzeError as e:
@@ -681,6 +765,31 @@ def cmd_spans(args) -> int:
                     {"error": f"--stage-threshold {spec!r}: want stage=pct"}
                 ))
                 return 2
+        if args.trend:
+            from kubernetes_scheduler_tpu.trace.trend import (
+                TrendError,
+                trend_over_reports,
+            )
+
+            sources = [args.baseline, args.candidate, *(args.more or ())]
+            try:
+                report = trend_over_reports(
+                    [load_report(s) for s in sources],
+                    threshold_pct=args.threshold_pct,
+                    min_ms=args.min_ms,
+                )
+            except (AnalyzeError, TrendError) as e:
+                print(json.dumps({"error": str(e)}))
+                return 2
+            report["sources"] = sources
+            print(json.dumps(report))
+            return 0 if report["clean"] else 1
+        if args.more:
+            print(json.dumps(
+                {"error": "extra span sources need --trend (pairwise "
+                 "diff takes exactly baseline + candidate)"}
+            ))
+            return 2
         try:
             report = diff_reports(
                 load_report(args.baseline),
@@ -840,7 +949,8 @@ def build_parser() -> argparse.ArgumentParser:
     pb.set_defaults(fn=cmd_bench)
 
     pt = sub.add_parser(
-        "trace", help="flight-recorder journals: dump/stats/diff/replay"
+        "trace",
+        help="flight-recorder journals: dump/stats/diff/replay/trend",
     )
     tsub = pt.add_subparsers(dest="trace_cmd", required=True)
     td = tsub.add_parser("dump", help="per-record summaries as JSON lines")
@@ -884,6 +994,27 @@ def build_parser() -> argparse.ArgumentParser:
         help="re-emit every replayed cycle as Chrome-trace spans under "
         "this directory (post-hoc attribution for a telemetry-off "
         "journal; analyze with `spans report`/`spans diff`)",
+    )
+    tn = tsub.add_parser(
+        "trend",
+        help="soak-length leak & drift gate over one journal: windowed "
+        "regression slopes for p99 creep, queue-depth runaway, "
+        "resident-state growth and delta hit-rate decay (exit 1 on a "
+        "regression, 2 on error)",
+    )
+    tn.add_argument("journal")
+    tn.add_argument(
+        "--windows", type=int, default=6,
+        help="number of equal record slices the journal is cut into",
+    )
+    tn.add_argument(
+        "--threshold-pct", type=float, default=25.0,
+        help="relative first-to-last growth a series must show to fail",
+    )
+    tn.add_argument(
+        "--min-ms", type=float, default=0.05,
+        help="absolute cycle-latency growth floor (sub-tick jitter "
+        "must not fail soaks)",
     )
     pt.set_defaults(fn=cmd_trace)
 
@@ -954,6 +1085,68 @@ def build_parser() -> argparse.ArgumentParser:
     )
     zr.set_defaults(fn=cmd_scenario)
 
+    pw = sub.add_parser(
+        "shadow",
+        help="shadow-mode serving: tail a live flight-recorder journal "
+        "and re-score every cycle through a CANDIDATE config — "
+        "decision/latency diffs on a dedicated /metrics exporter, "
+        "zero writes to the bind path (the rollout gate)",
+    )
+    pw.add_argument("journal", help="journal directory to tail")
+    pw.add_argument(
+        "--candidate-config", default=None,
+        help="candidate SchedulerConfig JSON (default: built-in "
+        "defaults) — policy/assigner/normalizer/plugins/auction knobs "
+        "override the recorded engine options per cycle",
+    )
+    pw.add_argument(
+        "--mode", choices=("serial", "pipelined"), default="serial",
+        help="candidate dispatch mode (pipelined = async handle path)",
+    )
+    pw.add_argument(
+        "--follow", action="store_true",
+        help="keep tailing across rotations until idle-timeout or "
+        "interrupt (without it: one catch-up pass over what exists)",
+    )
+    pw.add_argument(
+        "--poll-interval-s", type=float, default=0.25,
+        help="(--follow) sleep between empty polls",
+    )
+    pw.add_argument(
+        "--idle-timeout-s", type=float, default=None,
+        help="(--follow) stop after this long with no new records",
+    )
+    pw.add_argument(
+        "--limit", type=int, default=None,
+        help="stop after scoring this many records",
+    )
+    pw.add_argument(
+        "--resume-seq", type=int, default=None,
+        help="skip records with seq <= this (resume a prior shadow)",
+    )
+    pw.add_argument(
+        "--resume-end", action="store_true",
+        help="resume past everything already in the journal (score "
+        "only records written after startup)",
+    )
+    pw.add_argument(
+        "--metrics-port", type=int, default=None,
+        help="serve the shadow's own /metrics exporter on this port "
+        "(0 = ephemeral; the bound port is printed as a JSON line)",
+    )
+    pw.add_argument("--metrics-host", default="127.0.0.1")
+    pw.add_argument(
+        "--spans", dest="span_path", default=None,
+        help="emit shadow span timelines (reconstruct/candidate_step/"
+        "decision_diff) under this directory",
+    )
+    pw.add_argument(
+        "--max-divergence-ratio", type=float, default=None,
+        help="exit 1 when the final bindings-changed / pods-compared "
+        "ratio exceeds this (the CI-able rollout gate)",
+    )
+    pw.set_defaults(fn=cmd_shadow)
+
     pn = sub.add_parser(
         "spans",
         help="span timelines: merge host + sidecar files, per-stage "
@@ -978,6 +1171,30 @@ def build_parser() -> argparse.ArgumentParser:
     nr.add_argument(
         "source", help="span directory / merged trace JSON / span file"
     )
+    nr.add_argument(
+        "--trend", action="store_true",
+        help="slice ONE soak-length span source into time windows and "
+        "gate on monotone p50/p99 drift instead of printing the "
+        "budget table (exit 1 on a regression, 2 on error)",
+    )
+    nr.add_argument(
+        "--trend-windows", type=int, default=8,
+        help="number of equal time slices for --trend",
+    )
+    nr.add_argument(
+        "--trend-warmup", type=int, default=1,
+        help="(--trend) leading non-empty windows to drop as warmup "
+        "(JIT compile / cold caches) when enough points remain",
+    )
+    nr.add_argument(
+        "--threshold-pct", type=float, default=25.0,
+        help="(--trend) relative growth a series must show to fail",
+    )
+    nr.add_argument(
+        "--min-ms", type=float, default=0.05,
+        help="(--trend) absolute growth floor below which a series "
+        "never regresses",
+    )
     nd = nsub.add_parser(
         "diff",
         help="compare two span sources (or saved reports) per stage; "
@@ -986,6 +1203,16 @@ def build_parser() -> argparse.ArgumentParser:
     )
     nd.add_argument("baseline", help="span dir / merged trace / report JSON")
     nd.add_argument("candidate", help="span dir / merged trace / report JSON")
+    nd.add_argument(
+        "more", nargs="*",
+        help="(--trend) additional span sources, oldest -> newest",
+    )
+    nd.add_argument(
+        "--trend", action="store_true",
+        help="treat baseline/candidate/MORE as a time-ordered series "
+        "of soak snapshots and fail on a monotone p50/p99 regression "
+        "slope across them (exit 1 on a regression, 2 on error)",
+    )
     nd.add_argument(
         "--threshold-pct", type=float, default=25.0,
         help="default per-stage relative p50 regression threshold",

@@ -320,6 +320,27 @@ SHIPPED_METRICS = (
     "coalesced_dispatches_total",
     "coalesce_batch_window_count",
     "shared_engine_uploads_total",
+    # shadow-mode serving (host/shadow.py): the candidate exporter's
+    # decision/latency-diff series — journal records tailed and scored
+    # (cycles labeled by `result`: scored / skipped / unanchored /
+    # breaker_open / error), binding divergence vs the recorded primary,
+    # gang admission flips, candidate wall-time vs recorded engine time,
+    # tail-follow health (rotations followed, torn-tail recoveries),
+    # and how far behind the live writer the shadow is running
+    "shadow_records_applied_total",
+    "shadow_cycles_total",
+    "shadow_bindings_changed_total",
+    "shadow_pods_compared_total",
+    "shadow_gangs_diverged_total",
+    "shadow_candidate_errors_total",
+    "shadow_breaker_skips_total",
+    "shadow_rotations_followed_total",
+    "shadow_tail_recoveries_total",
+    "shadow_divergence_ratio",
+    "shadow_latency_ratio",
+    "shadow_score_delta_mean",
+    "shadow_lag_seconds",
+    "shadow_candidate_step_duration_seconds",
 )
 
 
@@ -534,6 +555,11 @@ SHIPPED_SPANS = (
     "serialize",
     # post-hoc replay stages (trace/replay.py --spans)
     "reconstruct",
+    # shadow-mode serving (host/shadow.py --spans): the candidate
+    # engine's re-score of a tailed cycle and the decision-diff verdict
+    # (bindings changed / gangs flipped vs the recorded primary)
+    "candidate_step",
+    "decision_diff",
 )
 
 

@@ -366,6 +366,10 @@ def run_scenario(
             and sched.engine_breaker.state() == "closed"
             and sched.advisor_breaker.state() == "closed"
         ),
+        # SLO watchdog verdict (config.cycle_slo_ms): a soak run asserts
+        # the armed watchdog stayed QUIET — "watchdog clean" is an
+        # outcome, not the absence of instrumentation
+        "slo_breaches": int(getattr(sched, "slo_breaches", 0)),
     }
     if sched.recorder is not None:
         out["trace_records_dropped"] = sched.recorder.records_dropped

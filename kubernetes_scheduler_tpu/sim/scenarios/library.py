@@ -499,12 +499,75 @@ class ReplicaConflictStormScenario(Scenario):
                 )
 
 
+class SoakScenario(Scenario):
+    """A multi-hour soak compressed onto the virtual clock: TWO diurnal
+    day cycles composed with periodic arrival bursts and node flaps —
+    the traffic shape a long-lived deployment actually survives, run in
+    minutes. This is what the trend gate (trace/trend.py) and the
+    shadow scorer chew on: long enough for leak/drift slopes to mean
+    something, rotated enough (config_overrides pins a small journal
+    file size) that a live tailer crosses real file boundaries, and
+    SLO-armed so the watchdog staying quiet is an assertable outcome
+    (`make soak-smoke` checks slo_breaches == 0).
+    """
+
+    name = "soak"
+    description = "compressed soak: diurnal x2 + bursts + node flaps"
+    ticks = 48
+    smoke = True
+    config_overrides = {
+        # force journal rotation during even a smoke-scale soak so the
+        # shadow tailer's boundary-following is exercised end-to-end
+        "trace_file_bytes": 1 << 16,
+        # the watchdog is ARMED (not off) and expected to stay clean on
+        # the virtual clock; a breach in a soak run is a finding. The
+        # bound must clear the first-cycle JIT compile even on a loaded
+        # smoke machine (a colocated shadow doubles wall time) while
+        # still catching a genuinely wedged cycle
+        "cycle_slo_ms": 15000.0,
+    }
+
+    def tick(self, t: int, world: ScenarioWorld, rng) -> None:
+        base = max(2, int(self.n_nodes * self.intensity / 2))
+        # two compressed day cycles across the run
+        phase = 2.0 * math.pi * t / max(1, self.ticks // 2)
+        n = max(1, int(base * (0.6 - 0.4 * math.cos(phase))))
+        # the final eighth is a COOL-DOWN: diurnal tail only, no bursts
+        # or flaps, so the trend gate's queue-depth series measures
+        # drain health (a backlog surviving the cool-down is a real
+        # runaway) instead of aliasing the injection schedule
+        cooldown = t >= self.ticks - max(2, self.ticks // 8)
+        if t % 12 == 6 and not cooldown:
+            n *= 6  # rollout-style burst on top of the curve
+        for i in range(n):
+            world.submit(_mk_pod(rng, f"soak-t{t}-{i}"))
+        if cooldown:
+            for name in list(world.downed):
+                world.restore_node(name)
+            return
+        if t >= 4 and t % 8 == 4 and world.nodes:
+            k = max(1, len(world.nodes) // 16)
+            names = [
+                world.nodes[int(j)].name
+                for j in rng.choice(
+                    len(world.nodes), size=min(k, len(world.nodes)),
+                    replace=False,
+                )
+            ]
+            for name in names:
+                world.fail_node(name)
+        if t % 8 == 6:
+            for name in list(world.downed):
+                world.restore_node(name)
+
+
 SCENARIOS = {
     s.name: s
     for s in (
         DiurnalScenario,
         BurstScenario,
         NodeFlapScenario,
+        SoakScenario,
         ZoneFailureScenario,
         AntiAffinityPackScenario,
         GangMixScenario,

@@ -305,6 +305,191 @@ def read_journal(path: str, *, strict_version: bool = True):
         yield from read_journal_file(fp, strict_version=strict_version)
 
 
+class JournalTailer:
+    """Incremental reader over a journal that is STILL BEING WRITTEN.
+
+    read_journal assumes a closed file set: it treats the first short or
+    CRC-failing frame as end-of-file, which is exactly right post-mortem
+    and exactly wrong mid-flight — a tail that is short because the
+    writer's buffered append has not landed yet must be re-polled, not
+    abandoned. The tailer keeps, per file, the byte offset after the
+    last GOOD frame and distinguishes the three tail states a live
+    journal can be in:
+
+    - short/garbled tail on the NEWEST file: bytes may still be
+      arriving (or the writer truncated a torn frame and will overwrite
+      them) — hold position and retry next poll. A tail that was short
+      and then decoded counts a truncated-tail-then-grew recovery.
+    - short/garbled tail on a file with a SUCCESSOR: the writer only
+      appends to the newest file, so that tail is final torn garbage
+      (the ENOSPC poison path) — skip it and follow the rotation.
+    - rotation: the next numbered file opens with a full snapshot
+      record (CycleRecorder re-anchors the delta chain on every
+      rotation), so following a boundary never strands the consumer's
+      reconstruction.
+
+    `resume_seq` filters records at or below an already-applied seq —
+    the restart contract for a shadow consumer: re-open the tailer at
+    its last applied seq and the delta chain re-anchors at the next
+    full-snapshot record. The tailer never writes; it shares nothing
+    with the writer but the directory."""
+
+    def __init__(
+        self,
+        path: str,
+        *,
+        resume_seq: int | None = None,
+        strict_version: bool = True,
+    ):
+        self.path = path
+        self.strict_version = strict_version
+        self.last_seq = None if resume_seq is None else int(resume_seq)
+        self._file: str | None = None
+        self._offset = 0            # byte offset after the last good frame
+        self._short_tail = False    # last poll stopped mid-frame at _offset
+        self._skip_file = False     # version-skipped file (non-strict mode)
+        self.rotations_followed = 0
+        self.truncations_recovered = 0
+        self.dead_tails_skipped = 0
+        self.records_yielded = 0
+        self.records_filtered = 0   # skipped by the resume_seq watermark
+
+    def stats(self) -> dict:
+        return {
+            "file": self._file,
+            "offset": self._offset,
+            "last_seq": self.last_seq,
+            "records_yielded": self.records_yielded,
+            "records_filtered": self.records_filtered,
+            "rotations_followed": self.rotations_followed,
+            "truncations_recovered": self.truncations_recovered,
+            "dead_tails_skipped": self.dead_tails_skipped,
+        }
+
+    def poll(self, *, max_records: int | None = None) -> list[dict]:
+        """Decode every record that became readable since the last poll
+        (bounded by `max_records`); empty when the writer has not
+        progressed. Never blocks, never raises on a recoverable tail —
+        only on bad magic or (strict) schema-version mismatch."""
+        out: list[dict] = []
+        while True:
+            files = journal_files(self.path)
+            if not files:
+                return out
+            if self._file is None:
+                self._enter(files[0], first=True)
+            elif self._file not in files:
+                # the file we were reading was dropped by the disk
+                # budget — resume at the oldest survivor newer than it
+                base = os.path.basename(self._file)
+                newer = [
+                    f for f in files if os.path.basename(f) > base
+                ]
+                if not newer:
+                    return out
+                self._enter(newer[0])
+            self._drain(out, max_records)
+            if max_records is not None and len(out) >= max_records:
+                return out
+            # current file exhausted: follow the rotation only when a
+            # successor exists — the writer appends solely to the
+            # newest file, so an older file's tail is final
+            files = journal_files(self.path)
+            try:
+                i = files.index(self._file)
+            except ValueError:
+                continue  # dropped between listings; re-resolve
+            if i + 1 >= len(files):
+                return out
+            if self._short_tail:
+                log.warning(
+                    "trace: %s rotated away with a torn tail; skipping "
+                    "to %s", self._file, files[i + 1],
+                )
+                self.dead_tails_skipped += 1
+            self._enter(files[i + 1])
+
+    def _enter(self, fp: str, *, first: bool = False) -> None:
+        self._file = fp
+        self._offset = 0
+        self._short_tail = False
+        self._skip_file = False
+        if not first:
+            self.rotations_followed += 1
+
+    def _drain(self, out: list, max_records: int | None) -> None:
+        """Decode frames from the current file starting at _offset."""
+        if self._skip_file:
+            return
+        try:
+            f = open(self._file, "rb")
+        except OSError:
+            return
+        with f:
+            if self._offset == 0:
+                head = f.read(_HEADER.size)
+                if len(head) < _HEADER.size:
+                    return  # header still being written; retry next poll
+                magic, version = _HEADER.unpack(head)
+                if magic != MAGIC:
+                    raise TraceError(
+                        f"{self._file}: not a journal file (bad magic)"
+                    )
+                if version != SCHEMA_VERSION:
+                    if self.strict_version:
+                        raise TraceVersionError(
+                            f"{self._file}: journal schema version "
+                            f"{version}, this reader speaks "
+                            f"{SCHEMA_VERSION}"
+                        )
+                    log.warning(
+                        "trace: %s version %d skipped", self._file, version
+                    )
+                    self._skip_file = True
+                    return
+                self._offset = _HEADER.size
+            else:
+                f.seek(self._offset)
+            while max_records is None or len(out) < max_records:
+                frame = f.read(_FRAME.size)
+                if len(frame) < _FRAME.size:
+                    self._short_tail = self._short_tail or bool(frame)
+                    return
+                ln, crc = _FRAME.unpack(frame)
+                payload = f.read(ln)
+                if len(payload) < ln:
+                    self._short_tail = True
+                    return
+                if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+                    # a torn frame the writer may truncate and overwrite
+                    # — hold position; rotation supersedes if it never
+                    # heals
+                    self._short_tail = True
+                    return
+                try:
+                    rec = decode_record(payload)
+                except TraceError:
+                    self._short_tail = True
+                    return
+                if self._short_tail:
+                    # the bytes we previously stopped on completed
+                    self.truncations_recovered += 1
+                    self._short_tail = False
+                self._offset += _FRAME.size + ln
+                seq = rec.get("seq")
+                if (
+                    seq is not None
+                    and self.last_seq is not None
+                    and int(seq) <= self.last_seq
+                ):
+                    self.records_filtered += 1
+                    continue
+                if seq is not None:
+                    self.last_seq = int(seq)
+                self.records_yielded += 1
+                out.append(rec)
+
+
 def last_journal_seq(path: str) -> int | None:
     """The highest `seq` in the journal, or None when empty — scanned
     newest file backwards so a restarting recorder's startup cost is

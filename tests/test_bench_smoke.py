@@ -80,6 +80,7 @@ def test_bench_smoke_e2e():
         "host_loop_32nodes_replicas4_shared",
         "host_loop_32nodes_replicas",
         "host_loop_32nodes_replay",
+        "host_loop_32nodes_shadow",
         "host_loop_32nodes_telemetry",
         "host_loop_32nodes_attribution",
         "scenario_burst_32nodes",
@@ -243,6 +244,16 @@ def test_bench_smoke_e2e():
     # evidence; not asserted at smoke sizes where cycles are ~ms)
     assert "trace_overhead_pct" in rep, rep
     assert rep["trace_bytes"] > 0, rep
+    # the shadow-serving metric: an identical candidate config re-scored
+    # the recorded journal with ZERO decision divergence, and the
+    # keep-up evidence (re-score rate, candidate/recorded latency
+    # ratio) is in-data every round
+    sh = metrics["host_loop_32nodes_shadow"]
+    assert sh["records_rescored"] > 0, sh
+    assert sh["bindings_changed"] == 0, sh
+    assert sh["divergence_ratio"] == 0.0, sh
+    assert sh["shadow_pods_per_sec"] > 0, sh
+    assert sh["breaker_state"] == "closed", sh
     # full-telemetry metric: spans were actually written during the
     # drain, the concurrent scraper got real responses, and the
     # vs-pipelined ratio (the <5% gate's evidence at real sizes) is
@@ -908,3 +919,145 @@ def test_model_check_e2e(tmp_path):
     from kubernetes_scheduler_tpu.analysis.sarif import validate_sarif
 
     validate_sarif(json.loads(sarif_proc.stdout))
+
+
+def test_soak_smoke_e2e(tmp_path):
+    """The `make soak-smoke` flow as a test: a baseline soak run pins
+    the undisturbed journal, then a `yoda-tpu shadow` process attaches
+    to a SECOND, still-being-written soak journal — following live
+    rotations, serving its own /metrics — and must score every cycle
+    with zero divergence under an identical candidate config while the
+    primary's journal stays bitwise equal to the baseline (a tailing
+    shadow perturbs nothing). The soak's span stream then drives the
+    trend gate: clean exits 0, a perturb_trend-seeded leak exits 1."""
+    import time
+    import urllib.request
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    cand = tmp_path / "candidate.json"
+    cand.write_text(
+        '{"batch_window": 256, "normalizer": "none", "min_device_work": 1, '
+        '"adaptive_dispatch": false, "trace_file_bytes": 65536, '
+        '"cycle_slo_ms": 15000.0}'
+    )
+
+    def run(*argv, check=True):
+        proc = subprocess.run(
+            [sys.executable, "-m", "kubernetes_scheduler_tpu", *argv],
+            capture_output=True, text=True, timeout=420, cwd=REPO, env=env,
+        )
+        if check:
+            assert proc.returncode == 0, proc.stderr[-2000:] + proc.stdout[-500:]
+        return proc
+
+    journal_off = str(tmp_path / "journal-off")
+    journal = str(tmp_path / "journal")
+    spans = str(tmp_path / "spans")
+    base = run(
+        "scenario", "run", "soak", "--nodes", "16", "--seed", "0",
+        "--trace", journal_off, "--spans", spans,
+    )
+    base_summary = json.loads(base.stdout.splitlines()[-1])
+    assert base_summary["slo_breaches"] == 0, base_summary
+    assert base_summary["fallback_cycles"] == 0, base_summary
+
+    scenario = subprocess.Popen(
+        [
+            sys.executable, "-m", "kubernetes_scheduler_tpu", "scenario",
+            "run", "soak", "--nodes", "16", "--seed", "0",
+            "--trace", journal,
+        ],
+        cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    shadow = None
+    try:
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if any(os.scandir(journal)) if os.path.isdir(journal) else False:
+                break
+            assert scenario.poll() is None, scenario.stdout.read()[-2000:]
+            time.sleep(0.25)
+        else:
+            raise AssertionError("live soak journal never appeared")
+
+        shadow = subprocess.Popen(
+            [
+                sys.executable, "-m", "kubernetes_scheduler_tpu", "shadow",
+                journal, "--candidate-config", str(cand),
+                "--follow", "--idle-timeout-s", "15",
+                "--metrics-port", "0", "--metrics-host", "127.0.0.1",
+                "--spans", str(tmp_path / "shadow-spans"),
+            ],
+            cwd=REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        # the exporter's bound port is the first stdout line
+        port = json.loads(shadow.stdout.readline())["shadow_metrics_port"]
+        # scrape the shadow's own exporter while it tails the live run
+        body = ""
+        deadline = time.time() + 120
+        while time.time() < deadline and shadow.poll() is None:
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=2
+                ) as r:
+                    body = r.read().decode()
+                if "yoda_tpu_shadow_records_applied_total" in body:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.3)
+        assert "yoda_tpu_shadow_records_applied_total" in body, body[:400]
+        assert "yoda_tpu_shadow_cycles_total" in body, body[:400]
+
+        sc_out, _ = scenario.communicate(timeout=240)
+        assert scenario.returncode == 0, sc_out[-2000:]
+        live_summary = json.loads(sc_out.splitlines()[-1])
+        assert live_summary["fallback_cycles"] == 0, live_summary
+
+        sh_out, sh_err = shadow.communicate(timeout=240)
+        assert shadow.returncode == 0, sh_err[-2000:]
+        summary = json.loads(sh_out.splitlines()[-1])
+    finally:
+        for proc in (scenario, shadow):
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+
+    # every tailed record scored, zero divergence under the identical
+    # config, and the tail followed at least one live rotation
+    assert summary["records_applied"] > 0, summary
+    assert summary["cycles"].get("scored") == summary["records_applied"], summary
+    assert summary["bindings_changed"] == 0, summary
+    assert summary["divergence_ratio"] == 0.0, summary
+    assert summary["gangs_diverged"] == 0, summary
+    assert summary["breaker_state"] == "closed", summary
+    assert summary["tail"]["rotations_followed"] >= 1, summary["tail"]
+
+    # the primary never felt the shadow: bitwise-equal journals
+    diff = run("trace", "diff", journal_off, journal)
+    report = json.loads(diff.stdout.splitlines()[-1])
+    assert report["differences"] == 0, report
+    assert report["records_compared"] == summary["records_applied"], report
+
+    # trend gate: the undisturbed soak is clean (exit 0)...
+    clean = run("spans", "report", "--trend", spans, "--min-ms", "0.2")
+    clean_report = json.loads(clean.stdout.splitlines()[-1])
+    assert clean_report["clean"] is True, clean_report["regressions"]
+    # ...and a seeded leak (engine_step ramped 1x->4x) exits 1 exactly
+    from kubernetes_scheduler_tpu.trace.trend import perturb_trend
+
+    leaky = str(tmp_path / "spans-leaky")
+    perturb_trend(spans, leaky, stage="engine_step", factor=4.0)
+    dirty = run(
+        "spans", "report", "--trend", leaky, "--min-ms", "0.2", check=False
+    )
+    assert dirty.returncode == 1, dirty.stdout[-800:]
+    assert "engine_step.p50_ms" in json.loads(
+        dirty.stdout.splitlines()[-1]
+    )["regressions"]
+
+    # journal-level leak signals stay quiet on the clean soak
+    trend = run("trace", "trend", journal)
+    trend_report = json.loads(trend.stdout.splitlines()[-1])
+    assert trend_report["clean"] is True, trend_report["regressions"]

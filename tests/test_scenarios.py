@@ -19,6 +19,8 @@ def test_registry_names_match_and_describe():
     assert set(SCENARIOS) == {
         "diurnal", "burst", "node-flap", "zone-failure",
         "anti-affinity-pack", "gang-mix",
+        # soak composition (trend-gate + shadow-tailer substrate)
+        "soak",
         # chaos programs (sim/faults.py): deterministic fault injection
         "advisor-outage", "sidecar-crash-restart", "rpc-flap",
         "disk-full-journal", "mirror-corruption", "compound-storm",

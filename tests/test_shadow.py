@@ -1,0 +1,302 @@
+"""Shadow-mode serving (host/shadow.py): the read-only rescoring loop.
+
+The contract under test is PARITY.md round 21: a ShadowScheduler fed
+the soak journal through a candidate configured IDENTICALLY to the
+primary must diff to zero — bitwise reconstruction plus a deterministic
+engine leaves no room for drift — while a genuinely different candidate
+produces a non-zero, run-stable decision diff. The isolation half of
+the contract is pinned from both sides: a wedged candidate trips the
+breaker and tailing continues (the shadow outlives its candidate), and
+a live primary's journal is bitwise unchanged by a shadow tailing it
+(the primary never feels the shadow)."""
+
+import threading
+import time
+
+import pytest
+
+from kubernetes_scheduler_tpu.host.scheduler import SchedulerConfig
+from kubernetes_scheduler_tpu.host.shadow import (
+    MODES,
+    ShadowScheduler,
+    candidate_kw,
+)
+from kubernetes_scheduler_tpu.sim import scenarios
+from kubernetes_scheduler_tpu.sim.scenarios import SCENARIOS, scenario_config
+from kubernetes_scheduler_tpu.trace import inspect as tinspect
+from kubernetes_scheduler_tpu.trace.recorder import last_journal_seq
+
+
+def _soak_config(**overrides) -> SchedulerConfig:
+    """The exact config run_scenario uses for the soak program — the
+    'identical candidate' of the parity contract (overrides carve out
+    the divergent-candidate variants)."""
+    kw = dict(SCENARIOS["soak"].config_overrides)
+    kw.update(overrides)
+    return scenario_config(kw)
+
+
+@pytest.fixture(scope="module")
+def soak_journal(tmp_path_factory):
+    """One recorded soak journal shared by the module: 48 device-path
+    cycles across several rotated files (the soak's smoke-size
+    trace_file_bytes forces rotation, so catch-up crosses boundaries)."""
+    path = str(tmp_path_factory.mktemp("shadow") / "journal")
+    summary = scenarios.run("soak", n_nodes=16, seed=0, trace_path=path)
+    assert summary["pods_bound"] > 0
+    assert summary["fallback_cycles"] == 0
+    return path, summary
+
+
+def test_shadow_identical_config_zero_divergence(soak_journal, tmp_path):
+    journal, primary = soak_journal
+    shadow = ShadowScheduler(
+        journal, _soak_config(), span_path=str(tmp_path / "spans")
+    )
+    try:
+        summary = shadow.run()
+    finally:
+        shadow.close()
+    assert summary["records_applied"] == primary["cycles"]
+    assert summary["cycles"] == {"scored": summary["records_applied"]}
+    assert summary["pods_compared"] > 0
+    assert summary["bindings_changed"] == 0
+    assert summary["divergence_ratio"] == 0.0
+    assert summary["gangs_diverged"] == 0
+    assert summary["score_delta_mean"] == 0.0
+    assert summary["candidate_errors"] == 0
+    assert summary["breaker_state"] == "closed"
+    assert summary["unanchored_skips"] == 0
+    # the candidate actually ran (latency diff is real data)
+    assert summary["candidate_engine_seconds"] > 0
+    assert summary["recorded_engine_seconds"] > 0
+    assert summary["latency_ratio"] > 0
+    # catch-up crossed the soak's rotation boundaries
+    assert summary["tail"]["rotations_followed"] >= 1
+    assert summary["tail"]["records_yielded"] == summary["records_applied"]
+    # the shadow's own span stream carries the shipped stage names
+    from kubernetes_scheduler_tpu.trace.spans import (
+        read_span_file,
+        span_files,
+    )
+
+    names = {
+        ev["name"]
+        for fp in span_files(str(tmp_path / "spans"))
+        for ev in read_span_file(fp)
+        if ev.get("ph") == "X"
+    }
+    assert {"cycle", "reconstruct", "candidate_step", "decision_diff"} <= names
+
+
+def test_shadow_modes_agree_on_decisions(soak_journal):
+    journal, _ = soak_journal
+    results = {}
+    for mode in MODES:
+        shadow = ShadowScheduler(journal, _soak_config(), mode=mode)
+        summary = shadow.run()
+        results[mode] = {
+            k: summary[k]
+            for k in (
+                "records_applied", "cycles", "pods_compared",
+                "bindings_changed", "gangs_diverged",
+            )
+        }
+    assert results["serial"] == results["pipelined"]
+    assert results["serial"]["bindings_changed"] == 0
+
+
+def test_shadow_divergent_candidate_is_deterministic(soak_journal):
+    journal, _ = soak_journal
+
+    def once():
+        shadow = ShadowScheduler(
+            journal, _soak_config(policy="least_allocated")
+        )
+        s = shadow.run()
+        return {
+            k: s[k]
+            for k in (
+                "records_applied", "pods_compared", "bindings_changed",
+                "divergence_ratio", "gangs_diverged", "score_delta_mean",
+            )
+        }
+
+    s1, s2 = once(), once()
+    # a different policy genuinely moves pods...
+    assert s1["bindings_changed"] > 0
+    assert s1["divergence_ratio"] > 0
+    # ...and the candidate scores its own placements higher than the
+    # primary's on the rows it moved (its units, its opinion)
+    assert s1["score_delta_mean"] > 0
+    # ...by exactly the same amount every run: the diff is evidence,
+    # not noise
+    assert s1 == s2
+
+
+def test_shadow_breaker_guards_wedged_candidate(soak_journal):
+    class WedgedEngine:
+        def schedule_windows(self, *a, **kw):
+            raise RuntimeError("candidate wedged")
+
+        def schedule_batch(self, *a, **kw):
+            raise RuntimeError("candidate wedged")
+
+    journal, primary = soak_journal
+    cfg = _soak_config()
+    shadow = ShadowScheduler(journal, cfg, engine=WedgedEngine())
+    summary = shadow.run()  # must not raise: tailing outlives the candidate
+    assert summary["records_applied"] == primary["cycles"]
+    # failures counted until the breaker opened, then cycles skipped
+    assert summary["candidate_errors"] >= cfg.breaker_failure_threshold
+    assert summary["breaker_skips"] > 0
+    assert summary["breaker_state"] == "open"
+    assert summary["cycles"].get("scored", 0) == 0
+    assert (
+        summary["cycles"]["error"] + summary["cycles"]["breaker_open"]
+        == summary["records_applied"]
+    )
+    # records still folded while the breaker was open: the delta chain
+    # stayed anchored, so nothing went unanchored
+    assert summary["unanchored_skips"] == 0
+    assert summary["bindings_changed"] == 0
+
+
+def test_shadow_resume_seq_skips_replayed_records(soak_journal):
+    journal, _ = soak_journal
+    last = last_journal_seq(journal)
+    assert last is not None
+    shadow = ShadowScheduler(journal, _soak_config(), resume_seq=last)
+    summary = shadow.run()
+    # everything at or below the watermark is filtered, nothing scored
+    assert summary["records_applied"] == 0
+    assert summary["tail"]["records_filtered"] > 0
+    assert summary["cycles"] == {}
+
+
+def test_shadow_rejects_unknown_mode(tmp_path):
+    with pytest.raises(ValueError, match="unknown shadow mode"):
+        ShadowScheduler(str(tmp_path / "j"), _soak_config(), mode="turbo")
+
+
+def test_candidate_kw_swaps_scoring_surface_only():
+    base = SchedulerConfig()
+    recorded = {
+        "policy": "card",
+        "assigner": base.assigner,
+        "normalizer": "min_max",
+        "fused": True,
+        "auction_rounds": 7,
+        "auction_price_frac": 0.5,
+    }
+    cfg = SchedulerConfig(policy="balanced_cpu_diskio", normalizer="none")
+    kw = candidate_kw(recorded, cfg)
+    assert kw["policy"] == "balanced_cpu_diskio"
+    assert kw["normalizer"] == "none"
+    assert kw["auction_rounds"] == cfg.auction_rounds
+    assert kw["auction_price_frac"] == cfg.auction_price_frac
+    # fused survives only inside the candidate's fusable domain
+    assert kw["fused"] is True
+    kw2 = candidate_kw(recorded, SchedulerConfig(policy="least_allocated"))
+    assert kw2["fused"] is False
+    # the recorded kw is input, not scratch space
+    assert recorded["policy"] == "card" and recorded["fused"] is True
+
+
+def test_shadow_exporter_renders_shipped_metrics(soak_journal):
+    journal, _ = soak_journal
+    shadow = ShadowScheduler(journal, _soak_config())
+    shadow.run()
+    body = shadow._render()
+    for name in (
+        "shadow_records_applied_total",
+        "shadow_cycles_total",
+        "shadow_bindings_changed_total",
+        "shadow_pods_compared_total",
+        "shadow_divergence_ratio",
+        "shadow_latency_ratio",
+        "shadow_candidate_step_duration_seconds",
+        "shadow_rotations_followed_total",
+    ):
+        assert name in body, name
+
+
+def test_shadow_divergent_diff_matches_through_live_sidecar(soak_journal):
+    """The candidate engine can be a live bridge sidecar: the shadow's
+    decision diff through the wire is identical to the in-process one
+    (the diff is a property of the candidate config, not the engine
+    residency)."""
+    pytest.importorskip("grpc")
+    from kubernetes_scheduler_tpu.bridge.client import RemoteEngine
+    from kubernetes_scheduler_tpu.bridge.server import make_server
+
+    journal, _ = soak_journal
+    keys = (
+        "records_applied", "pods_compared", "bindings_changed",
+        "divergence_ratio", "gangs_diverged", "score_delta_mean",
+    )
+    local = ShadowScheduler(
+        journal, _soak_config(policy="least_allocated")
+    ).run()
+    server, port, _ = make_server("127.0.0.1:0")
+    server.start()
+    client = RemoteEngine(f"127.0.0.1:{port}", deadline_seconds=120.0)
+    try:
+        remote = ShadowScheduler(
+            journal, _soak_config(policy="least_allocated"), engine=client
+        ).run()
+    finally:
+        client.close()
+        server.stop(grace=None)
+    assert local["bindings_changed"] > 0
+    assert {k: remote[k] for k in keys} == {k: local[k] for k in keys}
+
+
+def test_shadow_on_vs_off_bitwise_e2e(tmp_path):
+    """PARITY.md round 21, the in-process half: a primary tailed LIVE
+    by a shadow writes a journal bitwise identical to an undisturbed
+    run — the shadow never perturbs a single decision — while the
+    shadow scores every cycle with zero divergence as they land."""
+    journal_off = str(tmp_path / "journal-off")
+    baseline = scenarios.run(
+        "soak", n_nodes=16, seed=0, trace_path=journal_off
+    )
+
+    journal = str(tmp_path / "journal")
+    live: dict = {}
+
+    def primary():
+        live["summary"] = scenarios.run(
+            "soak", n_nodes=16, seed=0, trace_path=journal
+        )
+
+    t = threading.Thread(target=primary, daemon=True)
+    t.start()
+    from kubernetes_scheduler_tpu.trace.recorder import journal_files
+
+    deadline = time.monotonic() + 120
+    while not journal_files(journal):
+        assert time.monotonic() < deadline, "live journal never appeared"
+        assert t.is_alive() or "summary" in live
+        time.sleep(0.05)
+    shadow = ShadowScheduler(journal, _soak_config())
+    summary = shadow.run(
+        follow=True, poll_interval_s=0.05, idle_timeout_s=20
+    )
+    t.join(timeout=120)
+    assert not t.is_alive()
+    assert live["summary"]["cycles"] == baseline["cycles"]
+
+    # the primary never felt the shadow: bitwise-equal journals
+    report = tinspect.diff(journal_off, journal)
+    assert report["differences"] == 0, report
+    assert report["extra_records_a"] == 0, report
+    assert report["extra_records_b"] == 0, report
+    assert report["records_compared"] == baseline["cycles"], report
+
+    # and the shadow kept up live: every cycle scored, zero divergence
+    assert summary["records_applied"] == live["summary"]["cycles"]
+    assert summary["cycles"] == {"scored": summary["records_applied"]}
+    assert summary["bindings_changed"] == 0
+    assert summary["divergence_ratio"] == 0.0
+    assert summary["tail"]["rotations_followed"] >= 1

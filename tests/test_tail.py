@@ -1,0 +1,201 @@
+"""JournalTailer regression pins: live journals are readable mid-write.
+
+The closed-set reader (read_journal) stops a file at the first short or
+CRC-failing frame — correct post-mortem, fatal for a live consumer.
+These tests pin the three live-tail behaviors the shadow scheduler
+depends on: rotation boundaries are followed (each new file opens with
+a full snapshot, so the delta chain re-anchors), a truncated tail that
+later grows is recovered rather than treated as EOF, and a resume_seq
+watermark filters already-applied records across a reopen.
+
+Engine/jax-free, like the rest of the journal read tooling.
+"""
+
+import os
+import struct
+import zlib
+
+import pytest
+
+from kubernetes_scheduler_tpu.trace.recorder import (
+    JournalTailer,
+    JournalWriter,
+    TraceError,
+    encode_record,
+    journal_files,
+    read_journal,
+)
+
+
+def _payload(seq: int, path: str = "scalar") -> bytes:
+    return encode_record(
+        {"seq": seq, "path": path, "metrics": {"pods_in": seq}}
+    )
+
+
+def _frame(payload: bytes) -> bytes:
+    return (
+        struct.pack("<II", len(payload), zlib.crc32(payload) & 0xFFFFFFFF)
+        + payload
+    )
+
+
+def _append(w: JournalWriter, payload: bytes) -> None:
+    """Append honoring the writer's file-size budget, the way
+    CycleRecorder drives it (JournalWriter never rotates on its own)."""
+    w.append(payload, rotate=w.needs_rotation(len(payload)))
+
+
+def test_tailer_matches_closed_reader(tmp_path):
+    """Over a closed journal the tailer is bitwise the batch reader."""
+    path = str(tmp_path / "journal")
+    w = JournalWriter(path)
+    for i in range(7):
+        _append(w, _payload(i))
+    w.close()
+    tailer = JournalTailer(path)
+    got = tailer.poll()
+    want = list(read_journal(path))
+    assert [r["seq"] for r in got] == [r["seq"] for r in want] == list(
+        range(7)
+    )
+    assert tailer.poll() == []  # no growth, no records
+    assert tailer.rotations_followed == 0
+
+
+def test_tailer_follows_rotation_live(tmp_path):
+    """Records appended AND rotated after the first poll are picked up;
+    every boundary crossing is counted."""
+    path = str(tmp_path / "journal")
+    w = JournalWriter(path, file_bytes=1)  # every append rotates
+    _append(w, _payload(0))
+    tailer = JournalTailer(path)
+    assert [r["seq"] for r in tailer.poll()] == [0]
+    for i in range(1, 5):
+        _append(w, _payload(i))
+    assert [r["seq"] for r in tailer.poll()] == [1, 2, 3, 4]
+    w.close()
+    assert len(journal_files(path)) == 5
+    assert tailer.rotations_followed == 4
+    assert tailer.poll() == []
+
+
+def test_tailer_resumes_by_seq(tmp_path):
+    """resume_seq filters already-applied records — the reopen contract
+    for a consumer that remembers its last applied seq."""
+    path = str(tmp_path / "journal")
+    w = JournalWriter(path, file_bytes=1)
+    for i in range(8):
+        _append(w, _payload(i))
+    w.close()
+    tailer = JournalTailer(path, resume_seq=4)
+    assert [r["seq"] for r in tailer.poll()] == [5, 6, 7]
+    assert tailer.records_filtered == 5
+    assert tailer.last_seq == 7
+
+
+def test_tailer_truncated_tail_then_grew(tmp_path):
+    """A frame cut mid-payload is NOT end-of-file for the tailer: once
+    the writer's remaining bytes land, the record decodes and the
+    recovery is surfaced."""
+    path = str(tmp_path / "journal")
+    w = JournalWriter(path)
+    _append(w, _payload(0))
+    w.close()
+    fp = journal_files(path)[0]
+    full = _frame(_payload(1))
+    cut = len(full) // 2
+    with open(fp, "ab") as f:
+        f.write(full[:cut])
+    tailer = JournalTailer(path)
+    assert [r["seq"] for r in tailer.poll()] == [0]
+    assert tailer.truncations_recovered == 0
+    with open(fp, "ab") as f:
+        f.write(full[cut:])
+    assert [r["seq"] for r in tailer.poll()] == [1]
+    assert tailer.truncations_recovered == 1
+    # the closed-set reader would have stopped at the cut forever; pin
+    # that the recovered record is also what a fresh batch read sees
+    assert [r["seq"] for r in read_journal(path)] == [0, 1]
+
+
+def test_tailer_torn_tail_superseded_by_rotation(tmp_path):
+    """A torn tail in a file that has a successor is final garbage (the
+    writer only appends to the newest file): skip it, follow the
+    rotation, keep every good record."""
+    path = str(tmp_path / "journal")
+    w = JournalWriter(path, file_bytes=1)
+    _append(w, _payload(0))
+    files = journal_files(path)
+    with open(files[0], "ab") as f:
+        f.write(_frame(_payload(99))[:-3])  # torn, never completed
+    _append(w, _payload(1))
+    w.close()
+    tailer = JournalTailer(path)
+    assert [r["seq"] for r in tailer.poll()] == [0, 1]
+    assert tailer.dead_tails_skipped == 1
+    assert tailer.rotations_followed == 1
+
+
+def test_tailer_crc_mismatch_holds_then_rotation_supersedes(tmp_path):
+    """Garbage with a valid length prefix on the newest file holds
+    position (the writer may truncate and rewrite); once a successor
+    file appears the tail is abandoned."""
+    path = str(tmp_path / "journal")
+    w = JournalWriter(path, file_bytes=1)
+    _append(w, _payload(0))
+    fp = journal_files(path)[0]
+    bad = bytearray(_frame(_payload(7)))
+    bad[-1] ^= 0xFF  # break the CRC
+    with open(fp, "ab") as f:
+        f.write(bytes(bad))
+    tailer = JournalTailer(path)
+    assert [r["seq"] for r in tailer.poll()] == [0]
+    assert tailer.poll() == []  # held, not crashed, not advanced
+    _append(w, _payload(1))
+    w.close()
+    assert [r["seq"] for r in tailer.poll()] == [1]
+    assert tailer.dead_tails_skipped == 1
+
+
+def test_tailer_header_not_yet_complete(tmp_path):
+    """A file shorter than its header (the writer's open() landed, the
+    header write has not) yields nothing and does not error."""
+    path = str(tmp_path / "journal")
+    os.makedirs(path)
+    fp = os.path.join(path, "journal-00000000.ytrj")
+    with open(fp, "wb") as f:
+        f.write(b"YT")
+    tailer = JournalTailer(path)
+    assert tailer.poll() == []
+    w = JournalWriter(path)  # opens journal-00000001
+    _append(w, _payload(0))
+    w.close()
+    # the stub never grew a valid header; tailer waits on it until the
+    # successor supersedes it
+    assert [r["seq"] for r in tailer.poll()] == [0]
+
+
+def test_tailer_bad_magic_raises(tmp_path):
+    path = str(tmp_path / "journal")
+    os.makedirs(path)
+    with open(os.path.join(path, "journal-00000000.ytrj"), "wb") as f:
+        f.write(b"NOPE" + struct.pack("<H", 1) + b"x" * 16)
+    with pytest.raises(TraceError):
+        JournalTailer(path).poll()
+
+
+def test_tailer_survives_disk_budget_drop(tmp_path):
+    """When the file being tailed is dropped by the disk budget, the
+    tailer resumes at the oldest survivor."""
+    path = str(tmp_path / "journal")
+    w = JournalWriter(path, file_bytes=1)
+    _append(w, _payload(0))
+    tailer = JournalTailer(path)
+    assert [r["seq"] for r in tailer.poll()] == [0]
+    first = journal_files(path)[0]
+    for i in range(1, 4):
+        _append(w, _payload(i))
+    w.close()
+    os.remove(first)  # simulate enforce_disk_budget dropping the head
+    assert [r["seq"] for r in tailer.poll()] == [1, 2, 3]

@@ -1,0 +1,343 @@
+"""Trend gate (trace/trend.py): the soak-length leak detector.
+
+Everything here is engine/jax-free and synthetic: gate_series rows over
+hand-built series, build_trend over hand-written span files,
+journal_trend over hand-framed journals — so the gate's decision
+boundary (slope direction x absolute floor x relative threshold x
+monotonicity) is pinned point by point, and the CLI exit-code contract
+(0 clean / 1 regression / 2 unusable input) is pinned in-process."""
+
+import json
+import os
+
+import pytest
+
+from kubernetes_scheduler_tpu import cli
+from kubernetes_scheduler_tpu.trace.recorder import (
+    JournalWriter,
+    encode_record,
+)
+from kubernetes_scheduler_tpu.trace.trend import (
+    TrendError,
+    build_trend,
+    gate_series,
+    journal_trend,
+    perturb_trend,
+    trend_over_reports,
+)
+
+
+# ---- gate_series: the decision boundary --------------------------------
+
+
+def test_gate_flags_monotone_growth():
+    row = gate_series("s", [1.0, 1.4, 1.9, 2.3, 3.0])
+    assert row["regression"] is True
+    assert row["monotone_frac"] == 1.0
+    assert row["rise_pct"] == 200.0
+
+
+def test_gate_ignores_flat_and_falling_series():
+    assert gate_series("s", [2.0, 2.0, 2.0, 2.0])["regression"] is False
+    assert gate_series("s", [3.0, 2.0, 1.5, 1.0])["regression"] is False
+
+
+def test_gate_rejects_jagged_rise():
+    # big end-to-end rise, but noise-shaped: half the deltas fight the
+    # slope
+    row = gate_series("s", [1.0, 3.0, 1.2, 3.2, 2.9])
+    assert row["monotone_frac"] < 0.6
+    assert row["regression"] is False
+
+
+def test_gate_absolute_floor_gates_sub_tick_jitter():
+    # 300% relative rise, 0.03 absolute — under the 0.05 default floor
+    small = [0.01, 0.02, 0.03, 0.04]
+    assert gate_series("s", small)["regression"] is False
+    assert gate_series("s", small, min_abs=0.005)["regression"] is True
+
+
+def test_gate_relative_threshold_gates_big_bases():
+    # +10 ms on a 100 ms base: clears any floor, not the 25% threshold
+    row = gate_series("s", [100.0, 103.0, 107.0, 110.0])
+    assert row["regression"] is False
+    assert gate_series(
+        "s", [100.0, 103.0, 107.0, 110.0], threshold_pct=5.0
+    )["regression"] is True
+
+
+def test_gate_down_direction_flags_decay():
+    # delta hit-rate style: monotone decay trips the "down" gate
+    row = gate_series(
+        "hit", [0.9, 0.8, 0.6, 0.45], direction="down", min_abs=0.05
+    )
+    assert row["regression"] is True
+    assert gate_series(
+        "hit", [0.9, 0.91, 0.9, 0.89], direction="down", min_abs=0.05
+    )["regression"] is False
+
+
+def test_gate_too_few_points_never_regresses():
+    row = gate_series("s", [1.0, 9.0])
+    assert row["regression"] is False
+    assert "too few points" in row["reason"]
+
+
+# ---- trend_over_reports: N snapshots in time order ---------------------
+
+
+def _report(engine_ms: float, *, p99_ms: float | None = None) -> dict:
+    p99 = engine_ms if p99_ms is None else p99_ms
+    return {
+        "cycles": 10,
+        "cycle_ms": {"count": 10, "p50_ms": engine_ms + 1, "p99_ms": p99 + 1},
+        "stages": {
+            "engine_step": {"count": 10, "p50_ms": engine_ms, "p99_ms": p99}
+        },
+    }
+
+
+def test_trend_over_reports_flags_ramp_and_passes_flat():
+    flat = trend_over_reports([_report(2.0) for _ in range(5)])
+    assert flat["clean"] is True
+    ramp = trend_over_reports([_report(2.0 + 0.8 * i) for i in range(5)])
+    assert "engine_step.p50_ms" in ramp["regressions"]
+    assert "cycle.p50_ms" in ramp["regressions"]
+    assert ramp["clean"] is False
+
+
+def test_trend_p99_floor_is_ten_x():
+    # identical 0.1 -> 0.3 ramp on both metrics: 0.2 rise clears the
+    # 0.05 p50 floor but not the 0.5 p99 floor (p99 is max-like at
+    # window sample counts — tail jitter must not fail a soak)
+    reports = [_report(0.1 + 0.05 * i) for i in range(5)]
+    out = trend_over_reports(reports)
+    assert "engine_step.p50_ms" in out["regressions"]
+    assert "engine_step.p99_ms" not in out["regressions"]
+
+
+def test_trend_skips_stages_missing_from_some_snapshots():
+    reports = [_report(2.0 + 0.8 * i) for i in range(5)]
+    reports[2]["stages"]["ghost"] = {"count": 4, "p50_ms": 1, "p99_ms": 2}
+    out = trend_over_reports(reports)
+    assert not any(r["series"].startswith("ghost") for r in out["rows"])
+
+
+def test_trend_needs_three_snapshots():
+    with pytest.raises(TrendError, match=">= 3 report snapshots"):
+        trend_over_reports([_report(1.0), _report(2.0)])
+
+
+# ---- build_trend / perturb_trend: one span source, windowed ------------
+
+
+def _write_spans(path: str, durs_us: list[float]) -> None:
+    """One span file in the recorder's crash-tolerant trailing-comma
+    format: per cycle an engine_step span plus its owning cycle span,
+    1ms apart in start time."""
+    os.makedirs(path, exist_ok=True)
+    events = []
+    for i, dur in enumerate(durs_us):
+        ts = 1000.0 * i
+        args = {"trace_id": i}
+        events.append(
+            {"ph": "X", "name": "engine_step", "ts": ts, "dur": dur,
+             "args": args}
+        )
+        events.append(
+            {"ph": "X", "name": "cycle", "ts": ts, "dur": dur + 100.0,
+             "args": args}
+        )
+    with open(
+        os.path.join(path, "spans-00000000.trace.json"), "w",
+        encoding="utf-8",
+    ) as f:
+        f.write("[\n")
+        for ev in events:
+            f.write(json.dumps(ev, separators=(",", ":")) + ",\n")
+
+
+def test_build_trend_clean_on_steady_state(tmp_path):
+    src = str(tmp_path / "spans")
+    # deterministic sub-floor jitter around 1ms
+    _write_spans(src, [1000.0 + (i * 37 % 13) for i in range(96)])
+    out = build_trend(src)
+    assert out["clean"] is True
+    assert out["warmup_windows_dropped"] == 1
+
+
+def test_build_trend_catches_seeded_leak(tmp_path):
+    src, dst = str(tmp_path / "spans"), str(tmp_path / "leaky")
+    _write_spans(src, [1000.0 + (i * 37 % 13) for i in range(96)])
+    touched = perturb_trend(src, dst, stage="engine_step", factor=3.0)
+    assert touched == 96
+    out = build_trend(dst)
+    assert "engine_step.p50_ms" in out["regressions"]
+    # the owning cycle stretched by the same added time: the leak is
+    # visible end-to-end, not only in the stage that leaks
+    assert "cycle.p50_ms" in out["regressions"]
+
+
+def test_build_trend_warmup_unmasks_drift_behind_compile(tmp_path):
+    # a slow compile-dominated first window opens the run; behind it,
+    # genuine +67% drift. without the warmup drop the first window's
+    # fall swamps the rise and the leak sails through; with it the
+    # drift is caught.
+    src = str(tmp_path / "spans")
+    _write_spans(
+        src,
+        [60000.0] * 12 + [1000.0 + 8.0 * i for i in range(84)],
+    )
+    masked = build_trend(src, warmup=0)
+    assert masked["warmup_windows_dropped"] == 0
+    assert "engine_step.p50_ms" not in masked["regressions"]
+    caught = build_trend(src, warmup=1)
+    assert caught["warmup_windows_dropped"] == 1
+    assert "engine_step.p50_ms" in caught["regressions"]
+
+
+def test_build_trend_single_instant_errors(tmp_path):
+    src = str(tmp_path / "spans")
+    os.makedirs(src)
+    with open(
+        os.path.join(src, "spans-00000000.trace.json"), "w",
+        encoding="utf-8",
+    ) as f:
+        f.write("[\n")
+        for _ in range(8):
+            f.write(
+                json.dumps(
+                    {"ph": "X", "name": "cycle", "ts": 5.0, "dur": 1.0}
+                ) + ",\n"
+            )
+    with pytest.raises(TrendError, match="single instant"):
+        build_trend(src)
+
+
+# ---- journal_trend: leak signals from per-cycle metrics ----------------
+
+
+def _write_journal(
+    path: str,
+    n: int = 60,
+    *,
+    cycle_s=lambda i: 0.002,
+    pods_in=lambda i: 8,
+    delta=lambda i: (9, 1),
+) -> None:
+    w = JournalWriter(path)
+    for i in range(n):
+        du, fu = delta(i)
+        payload = encode_record(
+            {
+                "seq": i,
+                "path": "device",
+                "metrics": {
+                    "cycle_seconds": cycle_s(i),
+                    "pods_in": pods_in(i),
+                    "delta_uploads": du,
+                    "full_uploads": fu,
+                },
+            }
+        )
+        w.append(payload, rotate=w.needs_rotation(len(payload)))
+    w.close()
+
+
+def test_journal_trend_clean_on_steady_journal(tmp_path):
+    path = str(tmp_path / "journal")
+    _write_journal(path)
+    out = journal_trend(path)
+    assert out["clean"] is True
+    assert out["records"] == 60
+    assert {r["series"] for r in out["rows"]} == {
+        "cycle_p99_ms", "queue_depth_mean", "state_bytes_mean",
+        "delta_hit_ratio",
+    }
+
+
+def test_journal_trend_flags_latency_creep(tmp_path):
+    path = str(tmp_path / "journal")
+    _write_journal(path, cycle_s=lambda i: 0.002 + 0.0001 * i)
+    out = journal_trend(path)
+    assert "cycle_p99_ms" in out["regressions"]
+
+
+def test_journal_trend_flags_queue_runaway(tmp_path):
+    path = str(tmp_path / "journal")
+    _write_journal(path, pods_in=lambda i: 8 + i)
+    out = journal_trend(path)
+    assert "queue_depth_mean" in out["regressions"]
+
+
+def test_journal_trend_flags_delta_hit_decay(tmp_path):
+    path = str(tmp_path / "journal")
+    # early cycles nearly all deltas, late cycles nearly all fulls
+    _write_journal(path, delta=lambda i: (max(10 - i // 6, 0), 1 + i // 6))
+    out = journal_trend(path)
+    assert "delta_hit_ratio" in out["regressions"]
+
+
+def test_journal_trend_too_short_errors(tmp_path):
+    path = str(tmp_path / "journal")
+    _write_journal(path, n=5)
+    with pytest.raises(TrendError, match="too short"):
+        journal_trend(path)
+
+
+# ---- exit-code contract (0 clean / 1 regression / 2 error) -------------
+
+
+def test_trace_trend_exit_codes(tmp_path, capsys):
+    clean = str(tmp_path / "clean")
+    _write_journal(clean)
+    assert cli.main(["trace", "trend", clean]) == 0
+    leaky = str(tmp_path / "leaky")
+    _write_journal(leaky, cycle_s=lambda i: 0.002 + 0.0001 * i)
+    assert cli.main(["trace", "trend", leaky]) == 1
+    short = str(tmp_path / "short")
+    _write_journal(short, n=4)
+    assert cli.main(["trace", "trend", short]) == 2
+    assert "too short" in capsys.readouterr().out
+
+
+def test_spans_report_trend_exit_codes(tmp_path, capsys):
+    clean = str(tmp_path / "spans")
+    _write_spans(clean, [1000.0 + (i * 37 % 13) for i in range(96)])
+    assert cli.main(["spans", "report", "--trend", clean]) == 0
+    leaky = str(tmp_path / "leaky")
+    perturb_trend(clean, leaky, factor=3.0)
+    assert cli.main(["spans", "report", "--trend", leaky]) == 1
+    assert (
+        cli.main(["spans", "report", "--trend", str(tmp_path / "absent")])
+        == 2
+    )
+    out = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    assert "engine_step.p50_ms" in out[1]["regressions"]
+    assert "error" in out[2]
+
+
+def test_spans_diff_trend_exit_codes(tmp_path, capsys):
+    # three snapshots of one soak, saved as `spans report` JSONs, fed
+    # to `spans diff --trend` oldest-first
+    from kubernetes_scheduler_tpu.trace.analyze import build_report
+
+    dirs = []
+    for i, scale in enumerate((1.0, 1.5, 2.2)):
+        d = str(tmp_path / f"win{i}")
+        _write_spans(d, [1000.0 * scale] * 24)
+        rp = tmp_path / f"report{i}.json"
+        rp.write_text(json.dumps(build_report(d)))
+        dirs.append(str(rp))
+    assert cli.main(["spans", "diff", "--trend", *dirs]) == 1
+    flat = []
+    for i in range(3):
+        d = str(tmp_path / f"flat{i}")
+        _write_spans(d, [1000.0] * 24)
+        rp = tmp_path / f"flat-report{i}.json"
+        rp.write_text(json.dumps(build_report(d)))
+        flat.append(str(rp))
+    assert cli.main(["spans", "diff", "--trend", *flat]) == 0
+    # pairwise mode refuses extra sources: N-way compare IS --trend
+    assert cli.main(["spans", "diff", *dirs]) == 2
+    assert "need --trend" in capsys.readouterr().out
